@@ -1,0 +1,31 @@
+"""heat_tpu — a TPU-native distributed n-dimensional array framework.
+
+Ground-up re-design of the Heat (Helmholtz Analytics Toolkit) capability set
+(reference: /root/reference, heat/__init__.py:5-19) for the JAX/XLA stack:
+arrays are sharded `jax.Array`s over a `jax.sharding.Mesh`, collectives ride
+ICI/DCN via XLA instead of MPI, local math runs on the MXU instead of torch.
+
+Importing enables 64-bit dtypes (`jax_enable_x64`) so the numpy-compatible
+dtype surface (int64/float64 defaults) matches the reference; TPU compute
+paths default to float32/bfloat16 regardless.
+"""
+
+import jax as _jax
+
+_jax.config.update("jax_enable_x64", True)
+
+from .core import *
+from . import core
+from .core import linalg, random, version
+from .core.version import version as __version__
+
+# ML subpackages (assembled as they are built; reference heat/__init__.py
+# mounts cluster/classification/graph/naive_bayes/regression/spatial/nn/
+# optim/utils the same way)
+from . import cluster
+from . import classification
+from . import graph
+from . import naive_bayes
+from . import regression
+from . import spatial
+from . import utils

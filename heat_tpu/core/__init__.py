@@ -1,0 +1,29 @@
+"""heat_tpu core namespace assembly (reference: heat/core/__init__.py)."""
+
+from .communication import *
+from .devices import *
+from .dndarray import *
+from .types import *
+from .constants import *
+from .factories import *
+from .memory import *
+from .stride_tricks import *
+from .sanitation import *
+from ._operations import *
+from .arithmetics import *
+from .relational import *
+from .rounding import *
+from .exponential import *
+from .trigonometrics import *
+from .complex_math import *
+from .logical import *
+from .indexing import *
+from .printing import *
+from .statistics import *
+from .manipulations import *
+from .io import *
+from .base import *
+from . import random
+from . import linalg
+from . import version
+from .version import version as __version__

@@ -1,0 +1,255 @@
+"""Generic operation machinery.
+
+Re-design of reference heat/core/_operations.py:25-481, whose four wrappers
+(`__binary_op`, `__local_op`, `__reduce_op`, `__cum_op`) each hand-roll MPI
+traffic for the split axis (Bcast of broadcast dims, Allreduce of partial
+reductions, Exscan for cumulative ops). Under XLA the wrappers reduce to
+dispatching a jnp computation with correct *metadata* (result split, dtype)
+and correct handling of the tail-pad region:
+
+* fast path — no operand is padded: apply jnp directly to the physical
+  buffers; XLA propagates shardings and inserts any collectives.
+* padded reductions/scans crossing the split axis first neutralize the pad
+  via ``DNDarray._masked(neutral)``; reductions along other axes simply carry
+  the pad through (pad in → pad out).
+* binary ops with one padded operand pad the other operand's aligned
+  dimension so physical shapes broadcast.
+"""
+
+from __future__ import annotations
+
+import builtins
+from typing import Any, Callable, Optional, Sequence, Tuple, Type, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import sanitation
+from . import types
+from .communication import MeshCommunication
+from .dndarray import DNDarray
+from .stride_tricks import broadcast_shape, sanitize_axis
+
+__all__ = ["binary_op", "local_op", "reduce_op", "cum_op"]
+
+Scalar = (builtins.int, builtins.float, builtins.bool, builtins.complex)
+
+
+def _as_operand(x, comm_hint=None, device_hint=None):
+    """Normalize an operand: DNDarrays and python scalars pass through (weak
+    typing preserves numpy promotion), everything else becomes a replicated
+    DNDarray."""
+    from . import factories
+
+    if isinstance(x, DNDarray) or isinstance(x, Scalar) or isinstance(x, np.generic):
+        return x
+    return factories.array(x, device=device_hint, comm=comm_hint)
+
+
+def binary_op(
+    operation: Callable,
+    t1,
+    t2,
+    out: Optional[DNDarray] = None,
+    fn_kwargs: Optional[dict] = None,
+) -> DNDarray:
+    """Generic binary operation with broadcasting and split reconciliation
+    (reference _operations.py:25-181)."""
+    fn_kwargs = fn_kwargs or {}
+    arrays = [a for a in (t1, t2) if isinstance(a, DNDarray)]
+    comm = arrays[0].comm if arrays else None
+    device = arrays[0].device if arrays else None
+    t1 = _as_operand(t1, comm, device)
+    t2 = _as_operand(t2, comm, device)
+    arrays = [a for a in (t1, t2) if isinstance(a, DNDarray)]
+    if not arrays:
+        raise TypeError(
+            f"expected at least one DNDarray operand, got {type(t1)}, {type(t2)}"
+        )
+    comm = arrays[0].comm
+    device = arrays[0].device
+
+    shape1 = t1.shape if isinstance(t1, DNDarray) else ()
+    shape2 = t2.shape if isinstance(t2, DNDarray) else ()
+    out_shape = broadcast_shape(shape1, shape2)
+    ndim_out = len(out_shape)
+
+    # map each operand's split into the output frame (right-aligned broadcast)
+    def out_split_of(a):
+        if not isinstance(a, DNDarray) or a.split is None:
+            return None
+        return a.split + (ndim_out - a.ndim)
+
+    s1, s2 = out_split_of(t1), out_split_of(t2)
+    if s1 is not None and s2 is not None and s1 != s2:
+        raise ValueError(
+            f"operands are distributed along different axes (splits {t1.split}/{t2.split}); "
+            f"resplit one operand first"
+        )
+    out_split = s1 if s1 is not None else s2
+
+    padded = any(isinstance(a, DNDarray) and a.pad_count for a in (t1, t2))
+
+    def phys(a):
+        if not isinstance(a, DNDarray):
+            return a
+        buf = a.larray
+        if out_split is not None and padded:
+            # align this operand's dim with the output split dim and pad it to
+            # the physical size if it spans the full logical extent
+            own_dim = out_split - (ndim_out - a.ndim)
+            if own_dim >= 0 and a.split is None and buf.shape[own_dim] == out_shape[out_split]:
+                P = comm.padded_size(out_shape[out_split])
+                pad = [(0, 0)] * a.ndim
+                pad[own_dim] = (0, P - buf.shape[own_dim])
+                buf = jnp.pad(buf, pad)
+        return buf
+
+    result = operation(phys(t1), phys(t2), **fn_kwargs)
+
+    out_gshape = out_shape
+    res = DNDarray(
+        result,
+        out_gshape,
+        types.canonical_heat_type(result.dtype),
+        out_split,
+        device,
+        comm,
+        True,
+    )
+    # physical sanity: result must obey the tail-pad invariant
+    expected = comm.padded_shape(out_gshape, out_split)
+    if tuple(result.shape) != expected:
+        res = DNDarray.from_logical(result[tuple(slice(0, n) for n in out_gshape)]
+                                    if tuple(result.shape) != out_gshape else result,
+                                    out_split, device, comm)
+    if out is not None:
+        sanitation.sanitize_out(out, out_gshape, out_split, device)
+        out.larray = res.larray.astype(out.dtype.jnp_type())
+        return out
+    return res
+
+
+def local_op(
+    operation: Callable,
+    x: DNDarray,
+    out: Optional[DNDarray] = None,
+    **kwargs,
+) -> DNDarray:
+    """Elementwise operation, embarrassingly parallel across shards
+    (reference _operations.py:281-352)."""
+    sanitation.sanitize_in(x)
+    result = operation(x.larray, **kwargs)
+    res = DNDarray(
+        result,
+        x.shape,
+        types.canonical_heat_type(result.dtype),
+        x.split,
+        x.device,
+        x.comm,
+        True,
+    )
+    if out is not None:
+        sanitation.sanitize_out(out, x.shape, x.split, x.device)
+        out.larray = result.astype(out.dtype.jnp_type())
+        return out
+    return res
+
+
+def reduce_op(
+    operation: Callable,
+    x: DNDarray,
+    axis: Union[int, Tuple[int, ...], None],
+    neutral: Any,
+    out: Optional[DNDarray] = None,
+    keepdims: bool = False,
+    dtype: Optional[Type[types.datatype]] = None,
+    **kwargs,
+) -> DNDarray:
+    """Generic reduction (reference _operations.py:355-478: local partial
+    reduce + Allreduce over the split axis, neutral elements for empty
+    shards). Here: neutralize the pad when the reduction crosses the split
+    axis, then one jnp reduction — XLA inserts the cross-shard combine."""
+    sanitation.sanitize_in(x)
+    axes = sanitize_axis(x.shape, axis)
+    if axes is None:
+        red_axes = tuple(range(x.ndim))
+    elif isinstance(axes, builtins.int):
+        red_axes = (axes,)
+    else:
+        red_axes = tuple(axes)
+
+    split = x.split
+    crosses_split = split is not None and split in red_axes
+
+    buf = x._masked(neutral) if (crosses_split and x.pad_count) else x.larray
+    result = operation(buf, axis=red_axes if axis is not None else None, keepdims=keepdims, **kwargs)
+
+    # output metadata
+    if split is None or crosses_split:
+        out_split = None
+    else:
+        if keepdims:
+            out_split = split
+        else:
+            out_split = split - sum(1 for a in red_axes if a < split)
+    if keepdims:
+        out_gshape = tuple(1 if d in red_axes else s for d, s in enumerate(x.shape))
+    else:
+        out_gshape = tuple(s for d, s in enumerate(x.shape) if d not in red_axes)
+
+    if dtype is not None:
+        dtype = types.canonical_heat_type(dtype)
+        result = result.astype(dtype.jnp_type())
+
+    res = DNDarray(
+        result,
+        out_gshape,
+        types.canonical_heat_type(result.dtype),
+        out_split,
+        x.device,
+        x.comm,
+        True,
+    )
+    if out is not None:
+        sanitation.sanitize_out(out, out_gshape, out_split, x.device)
+        out.larray = res.larray.astype(out.dtype.jnp_type())
+        return out
+    return res
+
+
+def cum_op(
+    operation: Callable,
+    x: DNDarray,
+    axis: int,
+    neutral: Any,
+    out: Optional[DNDarray] = None,
+    dtype: Optional[Type[types.datatype]] = None,
+) -> DNDarray:
+    """Generic cumulative operation (reference _operations.py:184-278: local
+    cum + Exscan + combine). Tail-pad sits at the global end of the split
+    dim, so a masked single jnp scan is exact on the logical region; XLA
+    lowers the cross-shard carry."""
+    sanitation.sanitize_in(x)
+    axis = sanitize_axis(x.shape, axis)
+    if not isinstance(axis, builtins.int):
+        raise TypeError(f"axis must be an integer, got {axis!r}")
+    buf = x._masked(neutral) if (x.split == axis and x.pad_count) else x.larray
+    result = operation(buf, axis=axis)
+    if dtype is not None:
+        result = result.astype(types.canonical_heat_type(dtype).jnp_type())
+    res = DNDarray(
+        result,
+        x.shape,
+        types.canonical_heat_type(result.dtype),
+        x.split,
+        x.device,
+        x.comm,
+        True,
+    )
+    if out is not None:
+        sanitation.sanitize_out(out, x.shape, x.split, x.device)
+        out.larray = res.larray.astype(out.dtype.jnp_type())
+        return out
+    return res

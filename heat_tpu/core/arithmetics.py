@@ -1,0 +1,247 @@
+"""Arithmetic operations (reference: heat/core/arithmetics.py, 31 exports).
+
+Every function is an instance of the four generic wrappers in
+`_operations`; the reference's per-op MPI choreography (Exscan for cumsum,
+Allreduce for sum/prod, edge-slice sends for diff) is replaced by single jnp
+calls whose collectives XLA derives from the sharding.
+"""
+
+from __future__ import annotations
+
+import builtins
+from typing import Optional, Union
+
+import jax.numpy as jnp
+
+from . import types
+from ._operations import binary_op, cum_op, local_op, reduce_op
+from .dndarray import DNDarray
+
+__all__ = [
+    "add",
+    "bitwise_and",
+    "bitwise_not",
+    "bitwise_or",
+    "bitwise_xor",
+    "cumprod",
+    "cumproduct",
+    "cumsum",
+    "diff",
+    "div",
+    "divide",
+    "floordiv",
+    "floor_divide",
+    "fmod",
+    "invert",
+    "left_shift",
+    "mod",
+    "mul",
+    "multiply",
+    "neg",
+    "negative",
+    "pos",
+    "positive",
+    "pow",
+    "power",
+    "prod",
+    "remainder",
+    "right_shift",
+    "sub",
+    "subtract",
+    "sum",
+]
+
+
+def add(t1, t2, out=None) -> DNDarray:
+    """Elementwise addition (reference arithmetics.py `add`)."""
+    return binary_op(jnp.add, t1, t2, out)
+
+
+def _check_int_or_bool(*ts):
+    for t in ts:
+        if isinstance(t, DNDarray) and not issubclass(t.dtype, (types.integer, types.bool)):
+            raise TypeError(f"operation not supported for input type {t.dtype}")
+        if isinstance(t, builtins.float):
+            raise TypeError("operation not supported for float scalars")
+
+
+def bitwise_and(t1, t2, out=None) -> DNDarray:
+    _check_int_or_bool(t1, t2)
+    return binary_op(jnp.bitwise_and, t1, t2, out)
+
+
+def bitwise_or(t1, t2, out=None) -> DNDarray:
+    _check_int_or_bool(t1, t2)
+    return binary_op(jnp.bitwise_or, t1, t2, out)
+
+
+def bitwise_xor(t1, t2, out=None) -> DNDarray:
+    _check_int_or_bool(t1, t2)
+    return binary_op(jnp.bitwise_xor, t1, t2, out)
+
+
+def bitwise_not(t, out=None) -> DNDarray:
+    _check_int_or_bool(t)
+    return local_op(jnp.bitwise_not, t, out)
+
+
+invert = bitwise_not
+
+
+def cumprod(a: DNDarray, axis: int, dtype=None, out=None) -> DNDarray:
+    """Cumulative product along axis (reference arithmetics.py `cumprod`;
+    Exscan-based there, one masked jnp.cumprod here)."""
+    return cum_op(jnp.cumprod, a, axis, neutral=1, out=out, dtype=dtype)
+
+
+cumproduct = cumprod
+
+
+def cumsum(a: DNDarray, axis: int, dtype=None, out=None) -> DNDarray:
+    """Cumulative sum along axis (reference arithmetics.py `cumsum`)."""
+    return cum_op(jnp.cumsum, a, axis, neutral=0, out=out, dtype=dtype)
+
+
+def diff(a: DNDarray, n: int = 1, axis: int = -1) -> DNDarray:
+    """n-th discrete difference along axis (reference arithmetics.py `diff`,
+    which sends boundary slices between ranks; the shifted-slice subtraction
+    here compiles to a halo exchange)."""
+    if n == 0:
+        return a
+    if n < 0:
+        raise ValueError(f"order must be non-negative but was {n}")
+    from .stride_tricks import sanitize_axis
+
+    axis = sanitize_axis(a.shape, axis)
+    log = a._logical()
+    res = log
+    for _ in range(n):
+        res = jnp.diff(res, axis=axis)
+    split = a.split
+    return DNDarray.from_logical(res, split, a.device, a.comm)
+
+
+def div(t1, t2, out=None) -> DNDarray:
+    """Elementwise true division (reference arithmetics.py `div`)."""
+    return binary_op(jnp.true_divide, t1, t2, out)
+
+
+divide = div
+
+
+def floordiv(t1, t2, out=None) -> DNDarray:
+    return binary_op(jnp.floor_divide, t1, t2, out)
+
+
+floor_divide = floordiv
+
+
+def fmod(t1, t2, out=None) -> DNDarray:
+    """Elementwise C-style remainder (sign of dividend; reference
+    arithmetics.py `fmod`)."""
+    return binary_op(jnp.fmod, t1, t2, out)
+
+
+def left_shift(t1, t2, out=None) -> DNDarray:
+    _check_int_or_bool(t1)
+    return binary_op(jnp.left_shift, t1, t2, out)
+
+
+def mod(t1, t2, out=None) -> DNDarray:
+    """Elementwise python-style modulo (sign of divisor; reference
+    arithmetics.py `mod` = remainder)."""
+    return binary_op(jnp.mod, t1, t2, out)
+
+
+remainder = mod
+
+
+def mul(t1, t2, out=None) -> DNDarray:
+    return binary_op(jnp.multiply, t1, t2, out)
+
+
+multiply = mul
+
+
+def neg(t, out=None) -> DNDarray:
+    return local_op(jnp.negative, t, out)
+
+
+negative = neg
+
+
+def pos(t, out=None) -> DNDarray:
+    return local_op(jnp.positive, t, out)
+
+
+positive = pos
+
+
+def pow(t1, t2, out=None) -> DNDarray:
+    return binary_op(jnp.power, t1, t2, out)
+
+
+power = pow
+
+
+def prod(a: DNDarray, axis=None, out=None, keepdims: bool = False) -> DNDarray:
+    """Product of elements over axis (reference arithmetics.py `prod` via
+    __reduce_op + MPI.PROD)."""
+    return reduce_op(jnp.prod, a, axis, neutral=1, out=out, keepdims=keepdims)
+
+
+def right_shift(t1, t2, out=None) -> DNDarray:
+    _check_int_or_bool(t1)
+    return binary_op(jnp.right_shift, t1, t2, out)
+
+
+def sub(t1, t2, out=None) -> DNDarray:
+    return binary_op(jnp.subtract, t1, t2, out)
+
+
+subtract = sub
+
+
+def sum(a: DNDarray, axis=None, out=None, keepdims: bool = False) -> DNDarray:
+    """Sum of elements over axis (reference arithmetics.py `sum` via
+    __reduce_op + MPI.SUM; one jnp.sum here, psum inserted by XLA)."""
+    return reduce_op(jnp.sum, a, axis, neutral=0, out=out, keepdims=keepdims)
+
+
+# ---- DNDarray operator attachment (the reference assigns these in
+# dndarray.py itself; we attach from the op modules to avoid import cycles)
+
+DNDarray.__add__ = lambda self, other: add(self, other)
+DNDarray.__radd__ = lambda self, other: add(other, self)
+DNDarray.__iadd__ = lambda self, other: add(self, other)
+DNDarray.__sub__ = lambda self, other: sub(self, other)
+DNDarray.__rsub__ = lambda self, other: sub(other, self)
+DNDarray.__isub__ = lambda self, other: sub(self, other)
+DNDarray.__mul__ = lambda self, other: mul(self, other)
+DNDarray.__rmul__ = lambda self, other: mul(other, self)
+DNDarray.__imul__ = lambda self, other: mul(self, other)
+DNDarray.__truediv__ = lambda self, other: div(self, other)
+DNDarray.__rtruediv__ = lambda self, other: div(other, self)
+DNDarray.__itruediv__ = lambda self, other: div(self, other)
+DNDarray.__floordiv__ = lambda self, other: floordiv(self, other)
+DNDarray.__rfloordiv__ = lambda self, other: floordiv(other, self)
+DNDarray.__mod__ = lambda self, other: mod(self, other)
+DNDarray.__rmod__ = lambda self, other: mod(other, self)
+DNDarray.__pow__ = lambda self, other: pow(self, other)
+DNDarray.__rpow__ = lambda self, other: pow(other, self)
+DNDarray.__neg__ = lambda self: neg(self)
+DNDarray.__pos__ = lambda self: pos(self)
+DNDarray.__invert__ = lambda self: bitwise_not(self)
+DNDarray.__and__ = lambda self, other: bitwise_and(self, other)
+DNDarray.__rand__ = lambda self, other: bitwise_and(other, self)
+DNDarray.__or__ = lambda self, other: bitwise_or(self, other)
+DNDarray.__ror__ = lambda self, other: bitwise_or(other, self)
+DNDarray.__xor__ = lambda self, other: bitwise_xor(self, other)
+DNDarray.__rxor__ = lambda self, other: bitwise_xor(other, self)
+DNDarray.__lshift__ = lambda self, other: left_shift(self, other)
+DNDarray.__rshift__ = lambda self, other: right_shift(self, other)
+
+DNDarray.sum = lambda self, axis=None, out=None, keepdims=False: sum(self, axis, out, keepdims)
+DNDarray.prod = lambda self, axis=None, out=None, keepdims=False: prod(self, axis, out, keepdims)
+DNDarray.cumsum = lambda self, axis, dtype=None, out=None: cumsum(self, axis, dtype, out)
+DNDarray.cumprod = lambda self, axis, dtype=None, out=None: cumprod(self, axis, dtype, out)

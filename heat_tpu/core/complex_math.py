@@ -1,0 +1,45 @@
+"""Complex number ops (reference: heat/core/complex_math.py)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import types
+from ._operations import local_op
+from .dndarray import DNDarray
+
+__all__ = ["angle", "conj", "conjugate", "imag", "real"]
+
+
+def angle(x: DNDarray, deg: bool = False, out=None) -> DNDarray:
+    """Argument of a complex array, in radians (degrees if deg)
+    (reference complex_math.py `angle`)."""
+    res = local_op(lambda a: jnp.angle(a, deg=deg), x, out)
+    return res
+
+
+def conjugate(x: DNDarray, out=None) -> DNDarray:
+    """Elementwise complex conjugate (reference complex_math.py `conj`)."""
+    return local_op(jnp.conjugate, x, out)
+
+
+conj = conjugate
+
+
+def imag(x: DNDarray) -> DNDarray:
+    """Imaginary part (zeros for real input; reference complex_math.py)."""
+    if issubclass(x.dtype, types.complexfloating):
+        return local_op(jnp.imag, x)
+    from . import factories
+
+    return factories.zeros_like(x)
+
+
+def real(x: DNDarray) -> DNDarray:
+    """Real part (reference complex_math.py `real`)."""
+    if issubclass(x.dtype, types.complexfloating):
+        return local_op(jnp.real, x)
+    return x
+
+
+DNDarray.conj = lambda self, out=None: conjugate(self, out)

@@ -1,0 +1,27 @@
+"""Mathematical constants exported into the top-level namespace.
+
+Parity with reference heat/core/constants.py (exports e, Euler, inf and aliases,
+nan and aliases, pi).
+"""
+
+import math
+
+__all__ = ["e", "Euler", "inf", "Inf", "Infty", "Infinity", "nan", "NaN", "pi"]
+
+INF = float("inf")
+NAN = float("nan")
+PI = math.pi
+E = math.e
+
+e = E
+Euler = E
+inf = INF
+Inf = INF
+Infty = INF
+Infinity = INF
+nan = NAN
+NaN = NAN
+pi = PI
+
+# sanitation.sanitize_infinity uses per-dtype "largest value" semantics; keep the
+# generic float infinity here and let callers specialize.

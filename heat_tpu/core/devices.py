@@ -1,0 +1,156 @@
+"""Device abstraction over JAX platforms.
+
+TPU-native re-design of the reference device layer (reference:
+heat/core/devices.py:17-167, `Device`, `cpu`, `gpu`, `get_device`,
+`sanitize_device`, `use_device`). The reference binds each MPI rank to one
+torch device (GPU picked round-robin by rank, devices.py:100). Here a
+``Device`` names a JAX *platform* whose device set backs the arrays; the
+actual placement of shards onto the platform's chips is owned by the
+:class:`~heat_tpu.core.communication.Communication` mesh, not by the device —
+on TPU the "one rank = one chip" pairing of the reference is replaced by
+"one mesh = all chips".
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Union
+
+import jax
+
+__all__ = ["Device", "cpu", "get_device", "sanitize_device", "use_device"]
+
+
+class Device:
+    """A logical compute platform backing DNDarray storage.
+
+    Parameters
+    ----------
+    device_type : str
+        Platform name understood by ``jax.devices()`` — ``"cpu"``, ``"tpu"``,
+        ``"gpu"`` — or the meta-name ``"accelerator"`` (first non-CPU platform;
+        this is what the sandboxed ``axon`` TPU tunnel reports, for instance).
+    device_id : int, optional
+        Index of a specific device of that platform; ``None`` means the whole
+        platform (all chips — the normal, mesh-backed mode).
+    """
+
+    def __init__(self, device_type: str, device_id: Optional[int] = None):
+        self.__device_type = device_type
+        self.__device_id = device_id
+
+    @property
+    def device_type(self) -> str:
+        return self.__device_type
+
+    @property
+    def device_id(self) -> Optional[int]:
+        return self.__device_id
+
+    def jax_devices(self) -> List["jax.Device"]:
+        """All JAX devices belonging to this platform (one-element list if a
+        specific ``device_id`` was requested)."""
+        devs = _platform_devices(self.__device_type)
+        if self.__device_id is not None:
+            return [devs[self.__device_id]]
+        return devs
+
+    @property
+    def jax_device(self) -> "jax.Device":
+        """The first (or the requested) JAX device of this platform."""
+        return self.jax_devices()[0]
+
+    def __eq__(self, other) -> bool:
+        if isinstance(other, Device):
+            return (
+                self.device_type == other.device_type and self.device_id == other.device_id
+            )
+        return NotImplemented
+
+    def __hash__(self):
+        return hash((self.device_type, self.device_id))
+
+    def __repr__(self) -> str:
+        return f"device({self.__str__()!r})"
+
+    def __str__(self) -> str:
+        if self.__device_id is None:
+            return self.__device_type
+        return f"{self.__device_type}:{self.__device_id}"
+
+
+def _platform_names() -> List[str]:
+    """Names of available JAX platforms, CPU last."""
+    names = []
+    for d in jax.devices():
+        if d.platform not in names:
+            names.append(d.platform)
+    if "cpu" not in names:
+        try:
+            jax.devices("cpu")
+            names.append("cpu")
+        except RuntimeError:  # pragma: no cover - cpu should always exist
+            pass
+    return names
+
+
+def _platform_devices(device_type: str) -> List["jax.Device"]:
+    """Resolve a device-type string to the JAX device list of that platform."""
+    if device_type in ("accelerator", "tpu", "gpu"):
+        # prefer a real accelerator platform; tolerate vendor names like "axon"
+        candidates = [n for n in _platform_names() if n != "cpu"]
+        if device_type in candidates:
+            return jax.devices(device_type)
+        if candidates:
+            return jax.devices(candidates[0])
+        if device_type == "accelerator":
+            return jax.devices("cpu")
+        raise RuntimeError(f"no {device_type} platform available")
+    return jax.devices(device_type)
+
+
+# platform singletons ---------------------------------------------------------
+
+cpu = Device("cpu")
+"""The CPU platform (always available)."""
+
+# The default device prefers an accelerator when one exists; resolved lazily so
+# that test harnesses can force ``jax_platforms=cpu`` before first array use.
+__default_device: Optional[Device] = None
+
+
+def _accelerator_available() -> bool:
+    return any(n != "cpu" for n in _platform_names())
+
+
+def get_device() -> Device:
+    """The currently globally-set default device (reference devices.py:125)."""
+    global __default_device
+    if __default_device is None:
+        __default_device = Device("accelerator") if _accelerator_available() else cpu
+    return __default_device
+
+
+def sanitize_device(device: Optional[Union[str, Device]]) -> Device:
+    """Map a device specifier (None/str/Device) onto a Device object
+    (reference devices.py:128-154)."""
+    if device is None:
+        return get_device()
+    if isinstance(device, Device):
+        return device
+    if isinstance(device, str):
+        spec = device.strip().lower()
+        if ":" in spec:
+            dtype, _, did = spec.partition(":")
+            dev = Device(dtype, int(did))
+        else:
+            dev = Device(spec)
+        # validate platform exists now rather than at first use
+        dev.jax_devices()
+        return dev
+    raise ValueError(f"Unknown device, must be str or Device, got {device!r}")
+
+
+def use_device(device: Optional[Union[str, Device]] = None) -> None:
+    """Set the globally-used default device (reference devices.py:157)."""
+    global __default_device
+    __default_device = sanitize_device(device)

@@ -1,0 +1,78 @@
+"""Exponential and logarithmic ops (reference: heat/core/exponential.py)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ._operations import binary_op, local_op
+from .dndarray import DNDarray
+
+__all__ = [
+    "exp",
+    "expm1",
+    "exp2",
+    "log",
+    "log2",
+    "log10",
+    "log1p",
+    "logaddexp",
+    "logaddexp2",
+    "sqrt",
+    "square",
+]
+
+
+def exp(x, out=None) -> DNDarray:
+    return local_op(jnp.exp, x, out)
+
+
+def expm1(x, out=None) -> DNDarray:
+    return local_op(jnp.expm1, x, out)
+
+
+def exp2(x, out=None) -> DNDarray:
+    return local_op(jnp.exp2, x, out)
+
+
+def log(x, out=None) -> DNDarray:
+    return local_op(jnp.log, x, out)
+
+
+def log2(x, out=None) -> DNDarray:
+    return local_op(jnp.log2, x, out)
+
+
+def log10(x, out=None) -> DNDarray:
+    return local_op(jnp.log10, x, out)
+
+
+def log1p(x, out=None) -> DNDarray:
+    return local_op(jnp.log1p, x, out)
+
+
+def logaddexp(t1, t2, out=None) -> DNDarray:
+    """log(exp(t1)+exp(t2)) (reference exponential.py `logaddexp`)."""
+    return binary_op(jnp.logaddexp, t1, t2, out)
+
+
+def logaddexp2(t1, t2, out=None) -> DNDarray:
+    return binary_op(jnp.logaddexp2, t1, t2, out)
+
+
+def sqrt(x, out=None) -> DNDarray:
+    return local_op(jnp.sqrt, x, out)
+
+
+def square(x, out=None) -> DNDarray:
+    return local_op(jnp.square, x, out)
+
+
+DNDarray.exp = lambda self, out=None: exp(self, out)
+DNDarray.exp2 = lambda self, out=None: exp2(self, out)
+DNDarray.expm1 = lambda self, out=None: expm1(self, out)
+DNDarray.log = lambda self, out=None: log(self, out)
+DNDarray.log2 = lambda self, out=None: log2(self, out)
+DNDarray.log10 = lambda self, out=None: log10(self, out)
+DNDarray.log1p = lambda self, out=None: log1p(self, out)
+DNDarray.sqrt = lambda self, out=None: sqrt(self, out)
+DNDarray.square = lambda self, out=None: square(self, out)

@@ -1,0 +1,301 @@
+"""Array construction routines.
+
+Re-design of reference heat/core/factories.py:40-1323. The reference builds
+the full array on every rank and slices out the local chunk
+(factories.py:381-384), or stitches pre-distributed local shards together via
+a neighbor handshake (``is_split``, factories.py:386-429). Here construction
+is one `device_put` with a `NamedSharding` (single-controller), and the
+``is_split`` path maps onto assembling a global array from per-position
+blocks.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, List, Optional, Sequence, Tuple, Type, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import types
+from .communication import MeshCommunication, sanitize_comm
+from .devices import Device, sanitize_device
+from .dndarray import DNDarray
+from .stride_tricks import sanitize_axis, sanitize_shape
+
+__all__ = [
+    "arange",
+    "array",
+    "asarray",
+    "empty",
+    "empty_like",
+    "eye",
+    "full",
+    "full_like",
+    "linspace",
+    "logspace",
+    "meshgrid",
+    "ones",
+    "ones_like",
+    "zeros",
+    "zeros_like",
+]
+
+
+def _wrap(
+    data: jax.Array,
+    split: Optional[int],
+    device: Device,
+    comm: MeshCommunication,
+    dtype: Optional[Type[types.datatype]] = None,
+) -> DNDarray:
+    return DNDarray.from_logical(data, split, device, comm, dtype)
+
+
+def arange(*args, dtype=None, split=None, device=None, comm=None) -> DNDarray:
+    """Evenly spaced values in [start, stop) with step (reference
+    factories.py:40)."""
+    num_of_param = len(args)
+    if num_of_param == 1:
+        start, stop, step = 0, args[0], 1
+    elif num_of_param == 2:
+        start, stop, step = args[0], args[1], 1
+    elif num_of_param == 3:
+        start, stop, step = args
+    else:
+        raise TypeError(f"function takes minimum one and at most 3 positional arguments ({num_of_param} given)")
+
+    if dtype is None:
+        # numpy semantics: all-int args give the platform int, else float32
+        if all(isinstance(a, int) for a in (start, stop, step)):
+            dtype = types.int64
+        else:
+            dtype = types.float32
+    dtype = types.canonical_heat_type(dtype)
+    device = sanitize_device(device)
+    comm = sanitize_comm(comm)
+    data = jnp.arange(start, stop, step, dtype=dtype.jnp_type())
+    return _wrap(data, sanitize_axis(data.shape, split), device, comm, dtype)
+
+
+def array(
+    obj: Any,
+    dtype: Optional[Type[types.datatype]] = None,
+    copy: Optional[bool] = True,
+    ndmin: int = 0,
+    order: str = "C",
+    split: Optional[int] = None,
+    is_split: Optional[int] = None,
+    device: Optional[Union[str, Device]] = None,
+    comm: Optional[MeshCommunication] = None,
+) -> DNDarray:
+    """The main constructor (reference factories.py:150).
+
+    ``split`` distributes the given *global* data along an axis; ``is_split``
+    declares ``obj`` to be this process's *local* shard of a distributed
+    array (the reference infers the global shape via a neighbor handshake,
+    factories.py:386-429; under a single controller every position holds the
+    same block list, so the global shape is locally computable).
+    """
+    if split is not None and is_split is not None:
+        raise ValueError(f"split and is_split are mutually exclusive parameters")
+    device = sanitize_device(device)
+    comm = sanitize_comm(comm)
+
+    if isinstance(obj, DNDarray):
+        if dtype is None and split is None and is_split is None:
+            if copy:
+                return DNDarray(
+                    obj.larray, obj.shape, obj.dtype, obj.split, device, comm, True
+                )
+            return obj
+        data = obj._logical()
+        if dtype is not None:
+            data = data.astype(types.canonical_heat_type(dtype).jnp_type())
+        tgt_split = split if split is not None else (obj.split if is_split is None else is_split)
+        return _wrap(data, tgt_split, device, comm)
+
+    if isinstance(obj, (jnp.ndarray,)):
+        data = obj
+    else:
+        data = np.asarray(obj, order=order)
+
+    if dtype is not None:
+        dtype = types.canonical_heat_type(dtype)
+        data = jnp.asarray(data, dtype=dtype.jnp_type())
+    else:
+        if isinstance(data, np.ndarray) and data.dtype == np.float64 and not isinstance(obj, np.ndarray):
+            # python floats default to float32 (reference types promotion)
+            data = jnp.asarray(data, dtype=jnp.float32)
+        else:
+            data = jnp.asarray(data)
+        dtype = types.canonical_heat_type(data.dtype)
+
+    while data.ndim < ndmin:
+        data = data[None]
+
+    if is_split is not None:
+        # obj is one position's shard; global = concatenation of `size` shards
+        is_split = sanitize_axis(data.shape, is_split)
+        blocks = [data] * comm.size
+        data = jnp.concatenate(blocks, axis=is_split) if comm.size > 1 else data
+        return _wrap(data, is_split, device, comm, dtype)
+
+    split = sanitize_axis(data.shape, split)
+    return _wrap(data, split, device, comm, dtype)
+
+
+def asarray(obj, dtype=None, copy=None, order="C", is_split=None, device=None, comm=None) -> DNDarray:
+    """Convert to DNDarray without copying when possible (reference
+    factories.py: `asarray`)."""
+    if isinstance(obj, DNDarray) and dtype is None and is_split is None and device is None:
+        return obj
+    return array(obj, dtype=dtype, copy=copy, is_split=is_split, device=device, comm=comm)
+
+
+def __factory(shape, dtype, split, fill, device, comm, order="C") -> DNDarray:
+    shape = sanitize_shape(shape)
+    dtype = types.canonical_heat_type(dtype)
+    split = sanitize_axis(shape, split)
+    device = sanitize_device(device)
+    comm = sanitize_comm(comm)
+    data = fill(shape, dtype=dtype.jnp_type())
+    return _wrap(data, split, device, comm, dtype)
+
+
+def empty(shape, dtype=types.float32, split=None, device=None, comm=None, order="C") -> DNDarray:
+    """Uninitialized (zero-filled on XLA) array (reference factories.py:513)."""
+    return __factory(shape, dtype, split, jnp.zeros, device, comm, order)
+
+
+def full(shape, fill_value, dtype=types.float32, split=None, device=None, comm=None, order="C") -> DNDarray:
+    """Constant-filled array (reference factories.py:722)."""
+
+    def filler(s, dtype):
+        return jnp.full(s, fill_value, dtype=dtype)
+
+    return __factory(shape, dtype, split, filler, device, comm, order)
+
+
+def ones(shape, dtype=types.float32, split=None, device=None, comm=None, order="C") -> DNDarray:
+    return __factory(shape, dtype, split, jnp.ones, device, comm, order)
+
+
+def zeros(shape, dtype=types.float32, split=None, device=None, comm=None, order="C") -> DNDarray:
+    return __factory(shape, dtype, split, jnp.zeros, device, comm, order)
+
+
+def __factory_like(a, dtype, split, factory, device, comm, order="C", **kwargs) -> DNDarray:
+    shape = a.shape if isinstance(a, DNDarray) else np.asarray(a).shape
+    if dtype is None:
+        dtype = a.dtype if isinstance(a, DNDarray) else types.canonical_heat_type(np.asarray(a).dtype)
+    if split is None:
+        split = a.split if isinstance(a, DNDarray) else None
+    if device is None and isinstance(a, DNDarray):
+        device = a.device
+    if comm is None and isinstance(a, DNDarray):
+        comm = a.comm
+    return factory(shape, dtype=dtype, split=split, device=device, comm=comm, order=order, **kwargs)
+
+
+def empty_like(a, dtype=None, split=None, device=None, comm=None, order="C") -> DNDarray:
+    return __factory_like(a, dtype, split, empty, device, comm, order)
+
+
+def full_like(a, fill_value, dtype=types.float32, split=None, device=None, comm=None, order="C") -> DNDarray:
+    return __factory_like(a, dtype, split, full, device, comm, order, fill_value=fill_value)
+
+
+def ones_like(a, dtype=None, split=None, device=None, comm=None, order="C") -> DNDarray:
+    return __factory_like(a, dtype, split, ones, device, comm, order)
+
+
+def zeros_like(a, dtype=None, split=None, device=None, comm=None, order="C") -> DNDarray:
+    return __factory_like(a, dtype, split, zeros, device, comm, order)
+
+
+def eye(shape, dtype=types.float32, split=None, device=None, comm=None, order="C") -> DNDarray:
+    """2-D identity-like array (reference factories.py:589)."""
+    if isinstance(shape, (int, np.integer)):
+        gshape = (int(shape), int(shape))
+    else:
+        shape = tuple(shape)
+        gshape = (int(shape[0]), int(shape[1] if len(shape) > 1 else shape[0]))
+    dtype = types.canonical_heat_type(dtype)
+    device = sanitize_device(device)
+    comm = sanitize_comm(comm)
+    data = jnp.eye(gshape[0], gshape[1], dtype=dtype.jnp_type())
+    return _wrap(data, sanitize_axis(gshape, split), device, comm, dtype)
+
+
+def linspace(
+    start,
+    stop,
+    num: int = 50,
+    endpoint: bool = True,
+    retstep: bool = False,
+    dtype=None,
+    split=None,
+    device=None,
+    comm=None,
+):
+    """num evenly spaced samples over [start, stop] (reference
+    factories.py:899)."""
+    num = int(num)
+    if num <= 0:
+        raise ValueError(f"number of samples 'num' must be non-negative integer, but was {num}")
+    start = float(start)
+    stop = float(stop)
+    step = (stop - start) / max(1, (num - 1 if endpoint else num))
+    device = sanitize_device(device)
+    comm = sanitize_comm(comm)
+    data = jnp.linspace(start, stop, num, endpoint=endpoint, dtype=None)
+    if dtype is not None:
+        data = data.astype(types.canonical_heat_type(dtype).jnp_type())
+    elif data.dtype == jnp.float64:
+        data = data.astype(jnp.float32)
+    ht = _wrap(data, sanitize_axis(data.shape, split), device, comm)
+    if retstep:
+        return ht, step
+    return ht
+
+
+def logspace(
+    start, stop, num=50, endpoint=True, base=10.0, dtype=None, split=None, device=None, comm=None
+) -> DNDarray:
+    """num samples on a log scale (reference factories.py:985)."""
+    y = linspace(start, stop, num=num, endpoint=endpoint, split=split, device=device, comm=comm)
+    from . import arithmetics
+
+    result = arithmetics.pow(float(base), y)
+    if dtype is None:
+        return result
+    return result.astype(types.canonical_heat_type(dtype))
+
+
+def meshgrid(*arrays, indexing: str = "xy") -> List[DNDarray]:
+    """Coordinate matrices from 1-D coordinate vectors (reference
+    factories.py:1048). Distributed: if any input is split, the first two
+    output grids are split consistently along their major dims."""
+    if indexing not in ("xy", "ij"):
+        raise ValueError(f"indexing must be 'xy' or 'ij', got {indexing}")
+    if len(arrays) == 0:
+        return []
+    hts = [a if isinstance(a, DNDarray) else array(a) for a in arrays]
+    split_in = [a.split for a in hts]
+    if sum(s is not None for s in split_in) > 1:
+        raise ValueError("split axis can be defined for at most one input")
+    comm = hts[0].comm
+    device = hts[0].device
+    logs = [a._logical() for a in hts]
+    outs = jnp.meshgrid(*logs, indexing=indexing)
+    # output split: if input i was split, every output is split along the dim
+    # that carries input i's coordinate
+    out_split = None
+    which = next((i for i, s in enumerate(split_in) if s is not None), None)
+    if which is not None:
+        if indexing == "xy" and which in (0, 1) and len(hts) > 1:
+            out_split = 1 - which if which < 2 else which
+        else:
+            out_split = which
+    return [DNDarray.from_logical(o, out_split, device, comm) for o in outs]

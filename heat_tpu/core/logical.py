@@ -1,0 +1,119 @@
+"""Logical tests and reductions (reference: heat/core/logical.py)."""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import jax.numpy as jnp
+
+from . import types
+from ._operations import binary_op, local_op, reduce_op
+from .dndarray import DNDarray
+
+__all__ = [
+    "all",
+    "allclose",
+    "any",
+    "isclose",
+    "isfinite",
+    "isinf",
+    "isnan",
+    "isneginf",
+    "isposinf",
+    "logical_and",
+    "logical_not",
+    "logical_or",
+    "logical_xor",
+    "signbit",
+]
+
+
+def all(x: DNDarray, axis=None, out=None, keepdims: bool = False) -> DNDarray:
+    """True where all elements (along axis) are truthy (reference
+    logical.py `all`: local all + Allreduce(LAND))."""
+    return reduce_op(
+        lambda a, axis, keepdims: jnp.all(a, axis=axis, keepdims=keepdims),
+        x,
+        axis,
+        neutral=True,
+        out=out,
+        keepdims=keepdims,
+    )
+
+
+def allclose(x: DNDarray, y: DNDarray, rtol: float = 1e-05, atol: float = 1e-08, equal_nan: bool = False) -> bool:
+    """Scalar closeness test (reference logical.py:144: local allclose +
+    Allreduce(LAND))."""
+    res = isclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+    return bool(all(res).item())
+
+
+def any(x: DNDarray, axis=None, out=None, keepdims: bool = False) -> DNDarray:
+    """True where any element (along axis) is truthy (reference logical.py
+    `any`)."""
+    return reduce_op(
+        lambda a, axis, keepdims: jnp.any(a, axis=axis, keepdims=keepdims),
+        x,
+        axis,
+        neutral=False,
+        out=out,
+        keepdims=keepdims,
+    )
+
+
+def isclose(x, y, rtol: float = 1e-05, atol: float = 1e-08, equal_nan: bool = False) -> DNDarray:
+    """Elementwise closeness (reference logical.py:240)."""
+    return binary_op(
+        lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan), x, y
+    )
+
+
+def isfinite(x) -> DNDarray:
+    return local_op(jnp.isfinite, x)
+
+
+def isinf(x) -> DNDarray:
+    return local_op(jnp.isinf, x)
+
+
+def isnan(x) -> DNDarray:
+    return local_op(jnp.isnan, x)
+
+
+def isneginf(x, out=None) -> DNDarray:
+    return local_op(jnp.isneginf, x, out)
+
+
+def isposinf(x, out=None) -> DNDarray:
+    return local_op(jnp.isposinf, x, out)
+
+
+def logical_and(t1, t2) -> DNDarray:
+    return binary_op(jnp.logical_and, t1, t2)
+
+
+def logical_not(t, out=None) -> DNDarray:
+    return local_op(jnp.logical_not, t, out)
+
+
+def logical_or(t1, t2) -> DNDarray:
+    return binary_op(jnp.logical_or, t1, t2)
+
+
+def logical_xor(t1, t2) -> DNDarray:
+    return binary_op(jnp.logical_xor, t1, t2)
+
+
+def signbit(x, out=None) -> DNDarray:
+    """True where the sign bit is set (reference logical.py `signbit`)."""
+    return local_op(jnp.signbit, x, out)
+
+
+DNDarray.all = lambda self, axis=None, out=None, keepdims=False: all(self, axis, out, keepdims)
+DNDarray.any = lambda self, axis=None, out=None, keepdims=False: any(self, axis, out, keepdims)
+DNDarray.allclose = lambda self, other, rtol=1e-05, atol=1e-08, equal_nan=False: allclose(
+    self, other, rtol, atol, equal_nan
+)
+DNDarray.isclose = lambda self, other, rtol=1e-05, atol=1e-08, equal_nan=False: isclose(
+    self, other, rtol, atol, equal_nan
+)

@@ -1,0 +1,475 @@
+"""Shape & layout manipulations (reference: heat/core/manipulations.py, 4040
+LoC, the comm-heaviest module: reshape via Alltoallv :1962, parallel
+sample-sort :2258-2409, ring roll :2061, rank-mirror flip :876).
+
+Design here: every function computes on the **logical global view** and
+relays out through `DNDarray.from_logical`, which restores the tail-pad
+layout — the explicit Alltoall/Gatherv choreography of the reference becomes
+XLA relayout. Ops whose semantics cross the split axis on *padded* arrays
+(sort/topk) neutralize the pad first; `unique`/`nonzero` run eagerly (dynamic
+shapes are jit-hostile — the documented host path, SURVEY §7 hard parts).
+"""
+
+from __future__ import annotations
+
+import builtins
+from typing import List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import types
+from .dndarray import DNDarray
+from .stride_tricks import sanitize_axis, sanitize_shape
+
+__all__ = [
+    "balance",
+    "column_stack",
+    "concatenate",
+    "diag",
+    "diagonal",
+    "dsplit",
+    "expand_dims",
+    "flatten",
+    "flip",
+    "fliplr",
+    "flipud",
+    "hsplit",
+    "hstack",
+    "moveaxis",
+    "pad",
+    "ravel",
+    "redistribute",
+    "repeat",
+    "reshape",
+    "resplit",
+    "roll",
+    "rot90",
+    "row_stack",
+    "shape",
+    "sort",
+    "split",
+    "squeeze",
+    "stack",
+    "swapaxes",
+    "tile",
+    "topk",
+    "unique",
+    "vsplit",
+    "vstack",
+]
+
+
+def _rewrap(log, split, proto: DNDarray, dtype=None) -> DNDarray:
+    return DNDarray.from_logical(log, split, proto.device, proto.comm, dtype)
+
+
+def balance(array: DNDarray, copy: bool = False) -> DNDarray:
+    """Balanced copy (reference manipulations.py `balance`); the tail-pad
+    layout is always balanced, so this is (a copy of) the input."""
+    from .memory import copy as _copy
+
+    return _copy(array) if copy else array
+
+
+def concatenate(arrays: Sequence[DNDarray], axis: int = 0) -> DNDarray:
+    """Join arrays along an existing axis (reference manipulations.py:188,
+    with the split-combination case table :377-443)."""
+    from . import factories
+
+    arrays = [a if isinstance(a, DNDarray) else factories.array(a) for a in arrays]
+    if len(arrays) < 1:
+        raise ValueError("need at least one array to concatenate")
+    axis = sanitize_axis(arrays[0].shape, axis)
+    out_split = next((a.split for a in arrays if a.split is not None), None)
+    out_dtype = arrays[0].dtype
+    for a in arrays[1:]:
+        out_dtype = types.promote_types(out_dtype, a.dtype)
+    logs = [a._logical().astype(out_dtype.jnp_type()) for a in arrays]
+    res = jnp.concatenate(logs, axis=axis)
+    return _rewrap(res, out_split, arrays[0], out_dtype)
+
+
+def column_stack(arrays: Sequence[DNDarray]) -> DNDarray:
+    """Stack 1-D/2-D arrays as columns of a 2-D array (reference
+    manipulations.py `column_stack`)."""
+    prepared = []
+    for a in arrays:
+        if a.ndim == 1:
+            prepared.append(_rewrap(a._logical()[:, None], a.split, a))
+        else:
+            prepared.append(a)
+    return concatenate(prepared, axis=1)
+
+
+def diag(a: DNDarray, offset: int = 0) -> DNDarray:
+    """Extract a diagonal or construct a diagonal matrix (reference
+    manipulations.py `diag`)."""
+    if a.ndim == 1:
+        res = jnp.diag(a._logical(), k=offset)
+        return _rewrap(res, a.split, a)
+    return diagonal(a, offset=offset)
+
+
+def diagonal(a: DNDarray, offset: int = 0, dim1: int = 0, dim2: int = 1) -> DNDarray:
+    """Diagonal view (reference manipulations.py `diagonal`)."""
+    dim1 = sanitize_axis(a.shape, dim1)
+    dim2 = sanitize_axis(a.shape, dim2)
+    if dim1 == dim2:
+        raise ValueError("dim1 and dim2 need to be different")
+    res = jnp.diagonal(a._logical(), offset=offset, axis1=dim1, axis2=dim2)
+    out_split = None
+    if a.split is not None and a.split not in (dim1, dim2):
+        s = a.split
+        s -= builtins.sum(1 for d in (dim1, dim2) if d < s)
+        out_split = s
+    elif a.split is not None:
+        out_split = res.ndim - 1
+    return _rewrap(res, out_split, a)
+
+
+def dsplit(x: DNDarray, indices_or_sections) -> List[DNDarray]:
+    """Split along axis 2 (reference manipulations.py `dsplit`)."""
+    return split(x, indices_or_sections, axis=2)
+
+
+def expand_dims(a: DNDarray, axis: int) -> DNDarray:
+    """Insert a size-1 dimension (reference manipulations.py:?)."""
+    axis = sanitize_axis(tuple(a.shape) + (1,), axis)
+    res = jnp.expand_dims(a._logical(), axis)
+    out_split = a.split
+    if out_split is not None and axis <= out_split:
+        out_split += 1
+    return _rewrap(res, out_split, a)
+
+
+def flatten(a: DNDarray) -> DNDarray:
+    """1-D copy of the array (reference manipulations.py `flatten`)."""
+    res = a._logical().ravel()
+    return _rewrap(res, 0 if a.split is not None else None, a)
+
+
+def flip(a: DNDarray, axis=None) -> DNDarray:
+    """Reverse element order along axis (reference manipulations.py:876 swaps
+    mirrored ranks p2p; relayout here)."""
+    res = jnp.flip(a._logical(), axis=axis)
+    return _rewrap(res, a.split, a)
+
+
+def fliplr(a: DNDarray) -> DNDarray:
+    if a.ndim < 2:
+        raise IndexError("expected at least a 2-D array")
+    return flip(a, 1)
+
+
+def flipud(a: DNDarray) -> DNDarray:
+    return flip(a, 0)
+
+
+def hsplit(x: DNDarray, indices_or_sections) -> List[DNDarray]:
+    """Split along axis 1 (axis 0 for 1-D; reference `hsplit`)."""
+    if x.ndim < 2:
+        return split(x, indices_or_sections, axis=0)
+    return split(x, indices_or_sections, axis=1)
+
+
+def hstack(arrays: Sequence[DNDarray]) -> DNDarray:
+    """Horizontal stack (reference `hstack`)."""
+    arrays = list(arrays)
+    if builtins.all(a.ndim == 1 for a in arrays):
+        return concatenate(arrays, axis=0)
+    return concatenate(arrays, axis=1)
+
+
+def moveaxis(x: DNDarray, source, destination) -> DNDarray:
+    """Move axes to new positions (reference `moveaxis`)."""
+    if isinstance(source, builtins.int):
+        source = (source,)
+    if isinstance(destination, builtins.int):
+        destination = (destination,)
+    source = [sanitize_axis(x.shape, s) for s in source]
+    destination = [sanitize_axis(x.shape, d) for d in destination]
+    if len(source) != len(destination):
+        raise ValueError("source and destination arguments must have the same number of elements")
+    order = [n for n in range(x.ndim) if n not in source]
+    for dest, src in sorted(zip(destination, source)):
+        order.insert(dest, src)
+    from .linalg import transpose
+
+    return transpose(x, order)
+
+
+def pad(array: DNDarray, pad_width, mode: str = "constant", constant_values=0) -> DNDarray:
+    """Pad the logical array (reference manipulations.py:1126)."""
+    log = array._logical()
+    if mode == "constant":
+        res = jnp.pad(log, pad_width, mode=mode, constant_values=constant_values)
+    else:
+        res = jnp.pad(log, pad_width, mode=mode)
+    return _rewrap(res, array.split, array)
+
+
+def ravel(a: DNDarray) -> DNDarray:
+    """Flatten (reference `ravel`)."""
+    return flatten(a)
+
+
+def redistribute(arr: DNDarray, lshape_map=None, target_map=None) -> DNDarray:
+    """Non-inplace redistribute (reference manipulations.py `redistribute`);
+    see DNDarray.redistribute_ for the layout discussion."""
+    from .memory import copy as _copy
+
+    out = _copy(arr)
+    out.redistribute_(lshape_map, target_map)
+    return out
+
+
+def repeat(a: DNDarray, repeats, axis: Optional[int] = None) -> DNDarray:
+    """Repeat elements (reference `repeat`)."""
+    from . import factories
+
+    if not isinstance(a, DNDarray):
+        a = factories.array(a)
+    if isinstance(repeats, DNDarray):
+        repeats = repeats._logical()
+    res = jnp.repeat(a._logical(), repeats, axis=axis)
+    if axis is None:
+        out_split = 0 if a.split is not None else None
+    else:
+        out_split = a.split
+    return _rewrap(res, out_split, a)
+
+
+def reshape(a: DNDarray, *shape, new_split: Optional[int] = None) -> DNDarray:
+    """Reshape to a new global shape (reference manipulations.py:1815, which
+    redistributes via Alltoallv :1962; here reshape-the-logical + relayout)."""
+    if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+        shape = tuple(shape[0])
+    shape = list(shape)
+    # resolve -1 placeholder
+    neg = [i for i, s in enumerate(shape) if s == -1]
+    if len(neg) > 1:
+        raise ValueError("can only specify one unknown dimension")
+    if neg:
+        known = 1
+        for i, s in enumerate(shape):
+            if i != neg[0]:
+                known *= s
+        shape[neg[0]] = a.size // known
+    shape = sanitize_shape(tuple(shape))
+    if int(np.prod(shape)) != a.size:
+        raise ValueError(f"cannot reshape array of size {a.size} into shape {tuple(shape)}")
+    res = jnp.reshape(a._logical(), shape)
+    if new_split is None:
+        new_split = a.split if (a.split is not None and a.split < len(shape)) else (
+            0 if a.split is not None else None
+        )
+    new_split = sanitize_axis(shape, new_split)
+    return _rewrap(res, new_split, a)
+
+
+def resplit(arr: DNDarray, axis: Optional[int] = None) -> DNDarray:
+    """Out-of-place redistribution to a new split axis (reference
+    manipulations.py:3351)."""
+    axis = sanitize_axis(arr.shape, axis)
+    return DNDarray.from_logical(arr._logical(), axis, arr.device, arr.comm, arr.dtype)
+
+
+def roll(x: DNDarray, shift, axis=None) -> DNDarray:
+    """Circular shift (reference manipulations.py:1980, Isend/Irecv ring
+    :2061-2069; XLA collective-permute here)."""
+    res = jnp.roll(x._logical(), shift, axis=axis)
+    return _rewrap(res, x.split, x)
+
+
+def rot90(m: DNDarray, k: int = 1, axes=(0, 1)) -> DNDarray:
+    """Rotate by 90° in the axes plane (reference `rot90`)."""
+    res = jnp.rot90(m._logical(), k=k, axes=tuple(axes))
+    out_split = m.split
+    if out_split in tuple(sanitize_axis(m.shape, a) for a in axes) and k % 2 != 0:
+        a0, a1 = (sanitize_axis(m.shape, a) for a in axes)
+        out_split = a1 if out_split == a0 else a0
+    return _rewrap(res, out_split, m)
+
+
+def row_stack(arrays: Sequence[DNDarray]) -> DNDarray:
+    """Stack arrays as rows (reference `row_stack`)."""
+    prepared = []
+    for a in arrays:
+        if a.ndim == 1:
+            prepared.append(_rewrap(a._logical()[None, :], None, a))
+        else:
+            prepared.append(a)
+    return concatenate(prepared, axis=0)
+
+
+vstack = row_stack
+
+
+def shape(a: DNDarray) -> Tuple[int, ...]:
+    """Global shape (reference `shape`)."""
+    return a.shape
+
+
+def sort(a: DNDarray, axis: int = -1, descending: bool = False, out=None):
+    """Global sort along axis, returning (values, indices) like the reference
+    (manipulations.py:2258: parallel sample-sort — local sort, Bcast pivots,
+    partition Allreduce, Alltoallv; here one masked jnp sort, XLA's
+    distributed sort handles the split axis)."""
+    axis = sanitize_axis(a.shape, axis)
+    fill = _sort_fill(a, descending)
+    buf = a._masked(fill) if (a.split == axis and a.pad_count) else a.larray
+    idx = jnp.argsort(buf, axis=axis, stable=True, descending=descending)
+    vals = jnp.take_along_axis(buf, idx, axis=axis)
+    values = DNDarray(vals, a.shape, a.dtype, a.split, a.device, a.comm, True)
+    indices = DNDarray(idx.astype(jnp.int64), a.shape, types.int64, a.split, a.device, a.comm, True)
+    if out is not None:
+        out.larray = values.larray
+        return values, indices
+    return values, indices
+
+
+def split(x: DNDarray, indices_or_sections, axis: int = 0) -> List[DNDarray]:
+    """Split into sub-arrays along axis (reference manipulations.py `split`)."""
+    axis = sanitize_axis(x.shape, axis)
+    if isinstance(indices_or_sections, builtins.int):
+        if x.shape[axis] % indices_or_sections != 0:
+            raise ValueError("array split does not result in an equal division")
+        pieces = jnp.split(x._logical(), indices_or_sections, axis=axis)
+    else:
+        if isinstance(indices_or_sections, DNDarray):
+            indices_or_sections = indices_or_sections.tolist()
+        pieces = jnp.split(x._logical(), list(indices_or_sections), axis=axis)
+    out_split = x.split
+    return [_rewrap(p, out_split, x) for p in pieces]
+
+
+def squeeze(x: DNDarray, axis=None) -> DNDarray:
+    """Remove size-1 dimensions (reference `squeeze`)."""
+    if axis is not None:
+        ax = sanitize_axis(x.shape, axis)
+        axes = (ax,) if isinstance(ax, builtins.int) else ax
+        for a in axes:
+            if x.shape[a] != 1:
+                raise ValueError(f"cannot select an axis to squeeze out which has size not equal to one, got axis {a}")
+    else:
+        axes = tuple(d for d, s in enumerate(x.shape) if s == 1)
+    res = jnp.squeeze(x._logical(), axis=axes if axes else None)
+    out_split = x.split
+    if out_split is not None:
+        if out_split in axes:
+            out_split = None
+        else:
+            out_split -= builtins.sum(1 for a in axes if a < out_split)
+    return _rewrap(res, out_split, x)
+
+
+def stack(arrays: Sequence[DNDarray], axis: int = 0, out=None) -> DNDarray:
+    """Join along a new axis (reference `stack`)."""
+    from . import factories
+
+    arrays = [a if isinstance(a, DNDarray) else factories.array(a) for a in arrays]
+    logs = [a._logical() for a in arrays]
+    res = jnp.stack(logs, axis=axis)
+    proto = arrays[0]
+    out_split = proto.split
+    if out_split is not None:
+        ax = axis % res.ndim
+        if ax <= out_split:
+            out_split += 1
+    result = _rewrap(res, out_split, proto)
+    if out is not None:
+        out.larray = result.larray
+        return out
+    return result
+
+
+def swapaxes(x: DNDarray, axis1: int, axis2: int) -> DNDarray:
+    """Interchange two axes (reference `swapaxes`)."""
+    from .linalg import transpose
+
+    axis1 = sanitize_axis(x.shape, axis1)
+    axis2 = sanitize_axis(x.shape, axis2)
+    order = list(range(x.ndim))
+    order[axis1], order[axis2] = order[axis2], order[axis1]
+    return transpose(x, order)
+
+
+def tile(x: DNDarray, reps) -> DNDarray:
+    """Tile the array (reference `tile`)."""
+    if isinstance(reps, DNDarray):
+        reps = reps.tolist()
+    res = jnp.tile(x._logical(), reps)
+    out_split = x.split
+    if out_split is not None and res.ndim != x.ndim:
+        out_split += res.ndim - x.ndim
+    return _rewrap(res, out_split, x)
+
+
+def topk(a: DNDarray, k: int, dim: int = -1, largest: bool = True, sorted: bool = True, out=None):
+    """k largest/smallest elements along dim, returning (values, indices)
+    (reference manipulations.py:3856). Masked `lax.top_k` — tail pads can
+    never be selected."""
+    dim = sanitize_axis(a.shape, dim)
+    fill = _sort_fill(a, descending=largest)
+    buf = a._masked(fill) if (a.split == dim and a.pad_count) else a.larray
+    moved = jnp.moveaxis(buf, dim, -1)
+    if largest:
+        vals, idx = jax.lax.top_k(moved, k)
+    else:
+        # negation wraps for unsigned/bool dtypes — take the k smallest via a
+        # full argsort instead of reusing top_k on -x
+        order = jnp.argsort(moved, axis=-1, stable=True)
+        idx = order[..., :k]
+        vals = jnp.take_along_axis(moved, idx, axis=-1)
+    vals = jnp.moveaxis(vals, -1, dim)
+    idx = jnp.moveaxis(idx, -1, dim)
+    out_shape = tuple(k if d == dim else s for d, s in enumerate(a.shape))
+    values = DNDarray.from_logical(vals, None if a.split == dim else a.split, a.device, a.comm, a.dtype)
+    indices = DNDarray.from_logical(idx.astype(jnp.int64), None if a.split == dim else a.split, a.device, a.comm, types.int64)
+    if out is not None:
+        out[0].larray = values.larray
+        out[1].larray = indices.larray
+        return values, indices
+    return values, indices
+
+
+def _sort_fill(a: DNDarray, descending: bool):
+    if issubclass(a.dtype, types.integer):
+        info = types.iinfo(a.dtype)
+        return info.min if descending else info.max
+    if issubclass(a.dtype, types.bool):
+        return False if descending else True
+    return -float("inf") if descending else float("inf")
+
+
+def unique(a: DNDarray, sorted: bool = False, return_inverse: bool = False, axis: Optional[int] = None):
+    """Unique elements (reference manipulations.py:3077). Dynamic output
+    shape → eager host-path (documented; SURVEY §7 hard parts)."""
+    log = a._logical()
+    if axis is not None:
+        axis = sanitize_axis(a.shape, axis)
+    if return_inverse:
+        res, inverse = jnp.unique(log, return_inverse=True, axis=axis)
+        res_ht = _rewrap(res, 0 if a.split is not None else None, a)
+        inv_ht = _rewrap(inverse, None, a)
+        return res_ht, inv_ht
+    res = jnp.unique(log, axis=axis)
+    return _rewrap(res, 0 if a.split is not None else None, a)
+
+
+def vsplit(x: DNDarray, indices_or_sections) -> List[DNDarray]:
+    """Split along axis 0 (reference `vsplit`)."""
+    return split(x, indices_or_sections, axis=0)
+
+
+DNDarray.expand_dims = lambda self, axis: expand_dims(self, axis)
+DNDarray.flatten = lambda self: flatten(self)
+DNDarray.ravel = lambda self: ravel(self)
+DNDarray.reshape = lambda self, *shape, new_split=None: reshape(self, *shape, new_split=new_split)
+DNDarray.resplit = lambda self, axis=None: resplit(self, axis)
+DNDarray.squeeze = lambda self, axis=None: squeeze(self, axis)
+DNDarray.unique = lambda self, sorted=False, return_inverse=False, axis=None: unique(
+    self, sorted, return_inverse, axis
+)

@@ -1,0 +1,31 @@
+"""Memory layout helpers (reference: heat/core/memory.py:13-87).
+
+XLA owns physical layout on TPU (tiled, not strided), so the C/F-order
+enforcement of the reference is metadata-only here; `copy` remains a real
+deep copy.
+"""
+
+from __future__ import annotations
+
+from .dndarray import DNDarray
+
+__all__ = ["copy", "sanitize_memory_layout"]
+
+
+def copy(x: DNDarray) -> DNDarray:
+    """Deep copy (reference memory.py:13)."""
+    if not isinstance(x, DNDarray):
+        raise TypeError(f"input needs to be a DNDarray, but was {type(x)}")
+    import jax.numpy as jnp
+
+    return DNDarray(
+        jnp.copy(x.larray), x.shape, x.dtype, x.split, x.device, x.comm, True
+    )
+
+
+def sanitize_memory_layout(x, order: str = "C"):
+    """Accepted for API parity (reference memory.py:42 re-strides torch
+    tensors); XLA arrays have no user-visible stride order."""
+    if order not in ("C", "F"):
+        raise ValueError(f"invalid memory layout {order!r}, expected 'C' or 'F'")
+    return x

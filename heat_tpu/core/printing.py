@@ -1,0 +1,63 @@
+"""Formatted array printing (reference: heat/core/printing.py:20-167).
+
+The reference gathers edge items of each shard to rank 0 and defers to torch
+print options; here the logical array is globally addressable, so printing
+defers to numpy's formatter (with the same threshold/edgeitems controls).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["get_printoptions", "set_printoptions"]
+
+# numpy-managed state; expose the reference's API names
+_LOCAL_PRINT = False
+
+
+def get_printoptions() -> dict:
+    """Current print options (reference printing.py:20)."""
+    return dict(np.get_printoptions())
+
+
+def set_printoptions(
+    precision=None,
+    threshold=None,
+    edgeitems=None,
+    linewidth=None,
+    profile=None,
+    sci_mode=None,
+):
+    """Configure print options (reference printing.py:27; torch-style
+    ``profile`` presets are honored)."""
+    if profile == "default":
+        np.set_printoptions(precision=4, threshold=1000, edgeitems=3, linewidth=80)
+    elif profile == "short":
+        np.set_printoptions(precision=2, threshold=1000, edgeitems=2, linewidth=80)
+    elif profile == "full":
+        np.set_printoptions(precision=4, threshold=np.inf, edgeitems=3, linewidth=80)
+    kwargs = {}
+    if precision is not None:
+        kwargs["precision"] = precision
+    if threshold is not None:
+        kwargs["threshold"] = threshold
+    if edgeitems is not None:
+        kwargs["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kwargs["linewidth"] = linewidth
+    if kwargs:
+        np.set_printoptions(**kwargs)
+
+
+def __str__(dndarray) -> str:
+    """Render a DNDarray (reference printing.py:61 `__str__`/`_tensor_str`)."""
+    try:
+        values = np.array2string(
+            dndarray.numpy(), separator=", ", prefix="DNDarray("
+        )
+    except Exception as e:  # pragma: no cover - debugging aid
+        values = f"<unprintable: {e}>"
+    return (
+        f"DNDarray({values}, dtype=ht.{dndarray.dtype.__name__}, "
+        f"device={dndarray.device}, split={dndarray.split})"
+    )

@@ -1,0 +1,193 @@
+"""Pseudo-random number generation.
+
+The reference implements its own counter-based Threefry-12 generator
+(reference: heat/core/random.py:39-1065) so that every rank can generate only
+its slice of one global bit-stream, bit-identical at any process count. JAX's
+PRNG is the same construction natively (counter-based threefry, Salmon et al.
+2011), so this module is a thin stateful façade over `jax.random`: a global
+``(seed, counter)`` pair (reference random.py:39-42) derives one fresh key per
+call, and results are device-count-invariant by construction.
+"""
+
+from __future__ import annotations
+
+import builtins
+import time
+from typing import Optional, Tuple, Type, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import types
+from .communication import sanitize_comm
+from .devices import sanitize_device
+from .dndarray import DNDarray
+from .stride_tricks import sanitize_shape
+
+__all__ = [
+    "get_state",
+    "normal",
+    "permutation",
+    "rand",
+    "ranf",
+    "randint",
+    "random_integer",
+    "randn",
+    "random",
+    "random_sample",
+    "randperm",
+    "sample",
+    "seed",
+    "set_state",
+    "standard_normal",
+]
+
+# global generator state (reference random.py:39-42)
+__seed: int = 0
+__counter: int = 0
+
+
+def __init_seed() -> None:
+    global __seed, __counter
+    if __seed is None:
+        __seed = int(time.time() * 1000) & 0x7FFFFFFF
+        __counter = 0
+
+
+def _next_key() -> jax.Array:
+    """One fresh threefry key per draw: fold the call counter into the seed
+    key (the reference advances a 128-bit counter, random.py:55)."""
+    global __counter
+    key = jax.random.fold_in(jax.random.PRNGKey(__seed), __counter)
+    __counter += 1
+    return key
+
+
+def _wrap(data, split, device, comm, dtype=None) -> DNDarray:
+    device = sanitize_device(device)
+    comm = sanitize_comm(comm)
+    return DNDarray.from_logical(data, split, device, comm, dtype)
+
+
+def get_state() -> Tuple[str, int, int, int, float]:
+    """Internal state tuple ('Threefry', seed, counter, 0, 0.0) (reference
+    random.py:203)."""
+    return ("Threefry", __seed, __counter, 0, 0.0)
+
+
+def set_state(state: Tuple) -> None:
+    """Restore generator state (reference random.py:778)."""
+    global __seed, __counter
+    if not isinstance(state, tuple) or len(state) not in (3, 5):
+        raise ValueError("state needs to be a 3- or 5-tuple")
+    if state[0] != "Threefry":
+        raise ValueError("algorithm must be 'Threefry'")
+    __seed = builtins.int(state[1])
+    __counter = builtins.int(state[2])
+
+
+def seed(seed: Optional[int] = None) -> None:
+    """(Re-)seed the global generator (reference random.py:760)."""
+    global __seed, __counter
+    if seed is None:
+        seed = int(time.time() * 1000) & 0x7FFFFFFF
+    __seed = builtins.int(seed)
+    __counter = 0
+
+
+def normal(mean=0.0, std=1.0, shape=None, dtype=types.float32, split=None, device=None, comm=None) -> DNDarray:
+    """Normal distribution with given mean/std (reference random.py:268)."""
+    if shape is None:
+        shape = ()
+    shape = sanitize_shape(shape) if shape != () else ()
+    dtype = types.canonical_heat_type(dtype)
+    if not issubclass(dtype, types.floating):
+        raise ValueError("dtype must be a float type")
+    data = jax.random.normal(_next_key(), shape, dtype=dtype.jnp_type())
+    data = data * jnp.asarray(std, data.dtype) + jnp.asarray(mean, data.dtype)
+    return _wrap(data, split, device, comm, dtype)
+
+
+def permutation(x: Union[int, DNDarray]) -> DNDarray:
+    """Random permutation of range(x) or a global shuffle of x's first axis
+    (reference random.py:326)."""
+    if isinstance(x, builtins.int):
+        return randperm(x)
+    if not isinstance(x, DNDarray):
+        raise TypeError(f"x must be int or DNDarray, got {type(x)}")
+    perm = jax.random.permutation(_next_key(), x.shape[0])
+    data = jnp.take(x._logical(), perm, axis=0)
+    return DNDarray.from_logical(data, x.split, x.device, x.comm, x.dtype)
+
+
+def rand(*d, dtype=types.float32, split=None, device=None, comm=None) -> DNDarray:
+    """Uniform [0, 1) samples (reference random.py:396)."""
+    if not d:
+        shape = ()
+    else:
+        shape = sanitize_shape(d)
+    dtype = types.canonical_heat_type(dtype)
+    if not issubclass(dtype, types.floating):
+        raise ValueError("dtype must be a float type")
+    data = jax.random.uniform(_next_key(), shape, dtype=dtype.jnp_type())
+    return _wrap(data, split, device, comm, dtype)
+
+
+def randint(low, high=None, size=None, dtype=types.int32, split=None, device=None, comm=None) -> DNDarray:
+    """Random integers in [low, high) (reference random.py:473)."""
+    if high is None:
+        low, high = 0, low
+    if low >= high:
+        raise ValueError(f"low >= high ({low} >= {high})")
+    if size is None:
+        size = ()
+    elif isinstance(size, builtins.int):
+        size = (size,)
+    else:
+        size = sanitize_shape(size)
+    dtype = types.canonical_heat_type(dtype)
+    if not issubclass(dtype, types.integer):
+        raise ValueError("dtype must be an integer type")
+    data = jax.random.randint(_next_key(), size, low, high, dtype=dtype.jnp_type())
+    return _wrap(data, split, device, comm, dtype)
+
+
+random_integer = randint
+
+
+def randn(*d, dtype=types.float32, split=None, device=None, comm=None) -> DNDarray:
+    """Standard normal samples (reference random.py:580, Box-Muller via
+    Kundu inverse there; jax.random.normal here)."""
+    return normal(0.0, 1.0, d if d else (), dtype=dtype, split=split, device=device, comm=comm)
+
+
+def random_sample(shape=None, dtype=types.float32, split=None, device=None, comm=None) -> DNDarray:
+    """Uniform [0, 1) with a shape argument (reference random.py aliases)."""
+    if shape is None:
+        shape = ()
+    shape = sanitize_shape(shape) if shape != () else ()
+    return rand(*shape, dtype=dtype, split=split, device=device, comm=comm)
+
+
+random = random_sample
+ranf = random_sample
+sample = random_sample
+
+
+def randperm(n: int, dtype=types.int64, split=None, device=None, comm=None) -> DNDarray:
+    """Random permutation of [0, n) (reference random.py:637)."""
+    if not isinstance(n, builtins.int):
+        raise TypeError(f"n must be int, got {type(n)}")
+    data = jax.random.permutation(_next_key(), n).astype(
+        types.canonical_heat_type(dtype).jnp_type()
+    )
+    return _wrap(data, split, device, comm)
+
+
+def standard_normal(shape=None, dtype=types.float32, split=None, device=None, comm=None) -> DNDarray:
+    """Standard normal with a shape argument (reference random.py)."""
+    if shape is None:
+        shape = ()
+    shape = sanitize_shape(shape) if shape != () else ()
+    return normal(0.0, 1.0, shape, dtype=dtype, split=split, device=device, comm=comm)

@@ -1,0 +1,88 @@
+"""Rounding and absolute-value ops (reference: heat/core/rounding.py)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from . import types
+from ._operations import binary_op, local_op
+from .dndarray import DNDarray
+
+__all__ = ["abs", "absolute", "ceil", "clip", "fabs", "floor", "modf", "round", "trunc"]
+
+
+def abs(x, out=None, dtype=None) -> DNDarray:
+    """Elementwise absolute value (reference rounding.py `abs`)."""
+    if dtype is not None and not issubclass(types.canonical_heat_type(dtype), types.datatype):
+        raise TypeError("dtype must be a heat data type")
+    res = local_op(jnp.abs, x, out)
+    if dtype is not None:
+        res = res.astype(types.canonical_heat_type(dtype), copy=False)
+    return res
+
+
+absolute = abs
+
+
+def ceil(x, out=None) -> DNDarray:
+    return local_op(jnp.ceil, x, out)
+
+
+def clip(x: DNDarray, min, max, out=None) -> DNDarray:
+    """Clip values to [min, max] (reference rounding.py `clip`)."""
+    if min is None and max is None:
+        raise ValueError("either min or max must be set")
+    return local_op(lambda a: jnp.clip(a, min, max), x, out)
+
+
+def fabs(x, out=None) -> DNDarray:
+    """Float absolute value (reference rounding.py `fabs`)."""
+    res = local_op(jnp.abs, x, out=None)
+    if issubclass(res.dtype, types.integer):
+        res = res.astype(types.float32, copy=False)
+    if out is not None:
+        out.larray = res.larray.astype(out.dtype.jnp_type())
+        return out
+    return res
+
+
+def floor(x, out=None) -> DNDarray:
+    return local_op(jnp.floor, x, out)
+
+
+def modf(x: DNDarray, out=None):
+    """Fractional and integral parts (reference rounding.py `modf`)."""
+    frac = local_op(lambda a: jnp.modf(a)[0], x)
+    intg = local_op(lambda a: jnp.modf(a)[1], x)
+    if out is not None:
+        if not isinstance(out, tuple) or len(out) != 2:
+            raise TypeError("expected out to be None or a tuple of two DNDarrays")
+        out[0].larray = frac.larray
+        out[1].larray = intg.larray
+        return out
+    return (frac, intg)
+
+
+def round(x: DNDarray, decimals: int = 0, out=None, dtype=None) -> DNDarray:
+    """Round to `decimals` digits (reference rounding.py `round`)."""
+    res = local_op(lambda a: jnp.round(a, decimals), x, out)
+    if dtype is not None:
+        res = res.astype(types.canonical_heat_type(dtype), copy=False)
+    return res
+
+
+def trunc(x, out=None) -> DNDarray:
+    return local_op(jnp.trunc, x, out)
+
+
+DNDarray.__abs__ = lambda self: abs(self)
+DNDarray.abs = lambda self, out=None, dtype=None: abs(self, out, dtype)
+DNDarray.ceil = lambda self, out=None: ceil(self, out)
+DNDarray.clip = lambda self, a_min=None, a_max=None, out=None: clip(self, a_min, a_max, out)
+DNDarray.fabs = lambda self, out=None: fabs(self, out)
+DNDarray.floor = lambda self, out=None: floor(self, out)
+DNDarray.modf = lambda self, out=None: modf(self, out)
+DNDarray.round = lambda self, decimals=0, out=None, dtype=None: round(self, decimals, out, dtype)
+DNDarray.trunc = lambda self, out=None: trunc(self, out)

@@ -1,0 +1,116 @@
+"""Input/output validation helpers (reference: heat/core/sanitation.py:30-207)."""
+
+from __future__ import annotations
+
+from typing import Any, Sequence, Union
+
+import numpy as np
+import jax.numpy as jnp
+
+from . import types
+from .communication import MeshCommunication
+
+__all__ = [
+    "sanitize_in",
+    "sanitize_infinity",
+    "sanitize_in_tensor",
+    "sanitize_lshape",
+    "sanitize_out",
+    "sanitize_sequence",
+    "scalar_to_1d",
+]
+
+
+def sanitize_in(x: Any) -> None:
+    """Raise TypeError unless ``x`` is a DNDarray (reference sanitation.py:30)."""
+    from .dndarray import DNDarray
+
+    if not isinstance(x, DNDarray):
+        raise TypeError(f"input needs to be a DNDarray, but was {type(x)}")
+
+
+def sanitize_infinity(x) -> Union[int, float]:
+    """Largest representable value for x's dtype — used as a +inf stand-in for
+    integer types (reference sanitation.py)."""
+    dtype = x.dtype if hasattr(x, "dtype") else types.heat_type_of(x)
+    dtype = types.canonical_heat_type(dtype)
+    if issubclass(dtype, types.integer):
+        return types.iinfo(dtype).max
+    return float("inf")
+
+
+def sanitize_in_tensor(x: Any) -> None:
+    """Raise TypeError unless ``x`` is a jax array (the reference's local
+    torch.Tensor check, sanitation.py)."""
+    if not isinstance(x, (jnp.ndarray, np.ndarray)):
+        raise TypeError(f"input needs to be a jax array, but was {type(x)}")
+
+
+def sanitize_lshape(array, tensor) -> None:
+    """Verify a local tensor is a legal shard of the global array
+    (reference sanitation.py)."""
+    tshape = tuple(tensor.shape)
+    gshape = array.shape
+    if tshape == gshape:
+        return
+    split = array.split
+    if split is None:
+        raise ValueError(f"local tensor of shape {tshape} is not compatible with global shape {gshape}")
+    wrong_dims = [
+        d for d in range(len(gshape)) if d != split and tshape[d] != gshape[d]
+    ]
+    if wrong_dims or len(tshape) != len(gshape):
+        raise ValueError(
+            f"local tensor of shape {tshape} is not a valid shard of global shape {gshape} split {split}"
+        )
+
+
+def sanitize_out(out, output_shape, output_split, output_device, output_comm=None) -> None:
+    """Validate an ``out`` buffer's metadata against the expected result
+    (reference sanitation.py:103)."""
+    from .dndarray import DNDarray
+
+    if not isinstance(out, DNDarray):
+        raise TypeError(f"expected out buffer to be a DNDarray but was {type(out)}")
+    if tuple(out.shape) != tuple(output_shape):
+        raise ValueError(f"Expecting output buffer of shape {tuple(output_shape)}, got {out.shape}")
+    if out.split != output_split:
+        raise ValueError(f"Expecting output buffer with split {output_split}, got {out.split}")
+    if output_device is not None and out.device != output_device:
+        raise ValueError(f"Device mismatch: out is on {out.device}, expected {output_device}")
+
+
+def sanitize_sequence(seq: Any) -> list:
+    """Normalize a sequence-like (list/tuple/replicated DNDarray) to a python
+    list (reference sanitation.py)."""
+    from .dndarray import DNDarray
+
+    if isinstance(seq, list):
+        return seq
+    if isinstance(seq, tuple):
+        return list(seq)
+    if isinstance(seq, DNDarray):
+        if seq.split is None:
+            return seq.tolist()
+        raise ValueError(f"seq must not be distributed, got split={seq.split}")
+    raise TypeError(f"seq must be a list, tuple or non-distributed DNDarray, got {type(seq)}")
+
+
+def scalar_to_1d(x):
+    """Turn a scalar DNDarray into a 1-element 1-D DNDarray (reference
+    sanitation.py)."""
+    from .dndarray import DNDarray
+
+    if x.ndim == 1:
+        return x
+    if x.ndim != 0:
+        raise ValueError(f"expected a scalar DNDarray, got ndim={x.ndim}")
+    return DNDarray(
+        jnp.reshape(x.larray, (1,)),
+        gshape=(1,),
+        dtype=x.dtype,
+        split=None,
+        device=x.device,
+        comm=x.comm,
+        balanced=True,
+    )

@@ -1,0 +1,132 @@
+"""Trigonometric and hyperbolic ops (reference: heat/core/trigonometrics.py)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ._operations import binary_op, local_op
+from .dndarray import DNDarray
+
+__all__ = [
+    "acos",
+    "acosh",
+    "asin",
+    "asinh",
+    "atan",
+    "atan2",
+    "atanh",
+    "arccos",
+    "arccosh",
+    "arcsin",
+    "arcsinh",
+    "arctan",
+    "arctan2",
+    "arctanh",
+    "cos",
+    "cosh",
+    "deg2rad",
+    "degrees",
+    "rad2deg",
+    "radians",
+    "sin",
+    "sinh",
+    "tan",
+    "tanh",
+]
+
+
+def acos(x, out=None) -> DNDarray:
+    return local_op(jnp.arccos, x, out)
+
+
+arccos = acos
+
+
+def acosh(x, out=None) -> DNDarray:
+    return local_op(jnp.arccosh, x, out)
+
+
+arccosh = acosh
+
+
+def asin(x, out=None) -> DNDarray:
+    return local_op(jnp.arcsin, x, out)
+
+
+arcsin = asin
+
+
+def asinh(x, out=None) -> DNDarray:
+    return local_op(jnp.arcsinh, x, out)
+
+
+arcsinh = asinh
+
+
+def atan(x, out=None) -> DNDarray:
+    return local_op(jnp.arctan, x, out)
+
+
+arctan = atan
+
+
+def atan2(t1, t2) -> DNDarray:
+    """Elementwise quadrant-correct arctan(t1/t2) (reference
+    trigonometrics.py `atan2`)."""
+    return binary_op(jnp.arctan2, t1, t2)
+
+
+arctan2 = atan2
+
+
+def atanh(x, out=None) -> DNDarray:
+    return local_op(jnp.arctanh, x, out)
+
+
+arctanh = atanh
+
+
+def cos(x, out=None) -> DNDarray:
+    return local_op(jnp.cos, x, out)
+
+
+def cosh(x, out=None) -> DNDarray:
+    return local_op(jnp.cosh, x, out)
+
+
+def deg2rad(x, out=None) -> DNDarray:
+    return local_op(jnp.deg2rad, x, out)
+
+
+radians = deg2rad
+
+
+def rad2deg(x, out=None) -> DNDarray:
+    return local_op(jnp.rad2deg, x, out)
+
+
+degrees = rad2deg
+
+
+def sin(x, out=None) -> DNDarray:
+    return local_op(jnp.sin, x, out)
+
+
+def sinh(x, out=None) -> DNDarray:
+    return local_op(jnp.sinh, x, out)
+
+
+def tan(x, out=None) -> DNDarray:
+    return local_op(jnp.tan, x, out)
+
+
+def tanh(x, out=None) -> DNDarray:
+    return local_op(jnp.tanh, x, out)
+
+
+DNDarray.cos = lambda self, out=None: cos(self, out)
+DNDarray.sin = lambda self, out=None: sin(self, out)
+DNDarray.tan = lambda self, out=None: tan(self, out)
+DNDarray.cosh = lambda self, out=None: cosh(self, out)
+DNDarray.sinh = lambda self, out=None: sinh(self, out)
+DNDarray.tanh = lambda self, out=None: tanh(self, out)

@@ -1,0 +1,19 @@
+"""Version information for heat_tpu.
+
+Mirrors the reference version module layout (reference: heat/core/version.py:3-7)
+but versions this framework independently.
+"""
+
+major: int = 0
+"""Major version number."""
+minor: int = 1
+"""Minor version number."""
+micro: int = 0
+"""Micro version number."""
+extension: str = None
+"""Version extension tag (e.g. dev, rc)."""
+
+if not extension:
+    version: str = f"{major}.{minor}.{micro}"
+else:
+    version: str = f"{major}.{minor}.{micro}-{extension}"

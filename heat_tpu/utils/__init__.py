@@ -1,0 +1,1 @@
+"""Populated by the data-utils build stage."""

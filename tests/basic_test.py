@@ -1,0 +1,93 @@
+"""Shared test harness (reference: heat/core/tests/test_suites/basic_test.py).
+
+Keeps the reference's two oracles:
+
+* ``assert_array_equal(ht_array, expected)`` — global shape/dtype/value check
+  plus a per-position shard-shape check against the communicator's chunk rule
+  (the reference checks each rank's local shard against ``comm.chunk``,
+  basic_test.py:130-139).
+* ``assert_func_equal(shape, heat_func, numpy_func)`` — numpy is the
+  universal oracle, swept over **every possible split axis**
+  (basic_test.py:297-303) and several dtypes.
+"""
+
+import unittest
+
+import numpy as np
+
+import heat_tpu as ht
+
+
+class TestCase(unittest.TestCase):
+    @property
+    def comm(self):
+        return ht.get_comm()
+
+    @property
+    def device(self):
+        return ht.get_device()
+
+    def assert_array_equal(self, heat_array, expected_array, rtol=1e-5, atol=1e-8):
+        """Check global equality and shard-layout consistency (reference
+        basic_test.py:68)."""
+        self.assertIsInstance(
+            heat_array, ht.DNDarray, f"The array to test was not a DNDarray, but {type(heat_array)}"
+        )
+        expected_array = np.asarray(expected_array)
+        self.assertEqual(
+            tuple(heat_array.shape),
+            tuple(expected_array.shape),
+            f"global shape mismatch: {heat_array.shape} != {expected_array.shape}",
+        )
+        # layout: physical buffer must obey the tail-pad invariant
+        expected_physical = heat_array.comm.padded_shape(heat_array.shape, heat_array.split)
+        self.assertEqual(
+            tuple(heat_array.larray.shape),
+            tuple(expected_physical),
+            f"physical shape violates tail-pad invariant: {heat_array.larray.shape} "
+            f"!= {expected_physical} (split={heat_array.split})",
+        )
+        # lshape_map sums to the logical extent
+        if heat_array.split is not None:
+            lmap = heat_array.lshape_map
+            self.assertEqual(
+                int(lmap[:, heat_array.split].sum()), heat_array.shape[heat_array.split]
+            )
+        local = heat_array.numpy()
+        if expected_array.dtype.kind in "fc":
+            np.testing.assert_allclose(local, expected_array, rtol=rtol, atol=atol)
+        else:
+            np.testing.assert_array_equal(local, expected_array)
+
+    def assert_func_equal(
+        self,
+        shape,
+        heat_func,
+        numpy_func,
+        heat_args=None,
+        numpy_args=None,
+        distributed_result=True,
+        dtypes=(np.float32, np.float64),
+        low=-10000,
+        high=10000,
+    ):
+        """Test heat vs numpy for every split axis (reference
+        basic_test.py:142)."""
+        heat_args = heat_args or {}
+        numpy_args = numpy_args or {}
+        if not isinstance(shape, (tuple, list)):
+            raise ValueError(f"The shape must be either a list or a tuple but was {type(shape)}")
+        rng = np.random.default_rng(0)
+        for dtype in dtypes:
+            if np.issubdtype(dtype, np.floating):
+                base = rng.uniform(low, high, size=shape).astype(dtype)
+            else:
+                base = rng.integers(low, high, size=shape).astype(dtype)
+            expected = numpy_func(base.copy(), **numpy_args)
+            for split in [None] + list(range(len(shape))):
+                ht_array = ht.array(base.copy(), split=split)
+                result = heat_func(ht_array, **heat_args)
+                if isinstance(result, ht.DNDarray):
+                    self.assert_array_equal(result, expected)
+                else:
+                    np.testing.assert_allclose(np.asarray(result), expected, rtol=1e-5)

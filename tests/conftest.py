@@ -1,0 +1,24 @@
+"""Test harness bootstrap.
+
+The reference validates distribution by re-running its whole suite under
+``mpirun -n {1..8}`` (reference Jenkinsfile:19-27). The TPU-native analog is
+one run against a virtual 8-device CPU mesh
+(``--xla_force_host_platform_device_count=8``), which exercises every
+sharding/collective path without TPU hardware (SURVEY §4). The device count
+can be swept via ``HEAT_TPU_TEST_DEVICES`` (default 8 — deliberately not a
+divisor-friendly power for every shape, so tail-padding paths are hit).
+"""
+
+import os
+
+_n = os.environ.get("HEAT_TPU_TEST_DEVICES", "8")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + f" --xla_force_host_platform_device_count={_n}"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,108 @@
+"""End-to-end smoke tests of the core slice (SURVEY §7.3: array → arithmetic
+→ statistics on a virtual mesh)."""
+
+import numpy as np
+
+import heat_tpu as ht
+
+from .basic_test import TestCase
+
+
+class TestSmoke(TestCase):
+    def test_mesh_is_virtual_8(self):
+        self.assertEqual(self.comm.size, 8)
+
+    def test_array_split_even(self):
+        x = ht.arange(16, split=0)
+        self.assertEqual(x.shape, (16,))
+        self.assertEqual(x.split, 0)
+        self.assertEqual(x.pad_count, 0)
+        self.assert_array_equal(x, np.arange(16))
+
+    def test_array_split_uneven_padding(self):
+        x = ht.arange(10, split=0)
+        self.assertEqual(x.shape, (10,))
+        self.assertEqual(x.larray.shape, (16,))  # ceil(10/8)*8
+        self.assert_array_equal(x, np.arange(10))
+
+    def test_elementwise_chain_uneven(self):
+        x = ht.arange(10, dtype=ht.float32, split=0)
+        y = (x * 2 + 1).sin()
+        self.assert_array_equal(y, np.sin(np.arange(10, dtype=np.float32) * 2 + 1))
+
+    def test_sum_over_split_axis_masks_pad(self):
+        x = ht.ones((10, 3), split=0)
+        s = x.sum(axis=0)
+        self.assertEqual(s.split, None)
+        self.assert_array_equal(s, np.full(3, 10.0))
+
+    def test_sum_other_axis_keeps_split(self):
+        x = ht.ones((10, 3), split=0)
+        s = x.sum(axis=1)
+        self.assertEqual(s.split, 0)
+        self.assert_array_equal(s, np.full(10, 3.0))
+
+    def test_statistical_moments_slice(self):
+        # the SURVEY §7.3 minimum end-to-end slice: mean/var/std on a split array
+        rng = np.random.default_rng(42)
+        data = rng.standard_normal((1000, 4)).astype(np.float32)
+        x = ht.array(data, split=0)
+        self.assert_array_equal(x.mean(axis=0), data.mean(axis=0), atol=1e-5)
+        self.assert_array_equal(x.var(axis=0), data.var(axis=0), atol=1e-4)
+        self.assert_array_equal(x.std(axis=0), data.std(axis=0), atol=1e-4)
+
+    def test_binary_mixed_split_replicated(self):
+        a = ht.arange(10, dtype=ht.float32, split=0)
+        b = ht.arange(10, dtype=ht.float32)  # replicated, same logical extent
+        c = a + b
+        self.assert_array_equal(c, np.arange(10, dtype=np.float32) * 2)
+
+    def test_matmul_2d_split0(self):
+        rng = np.random.default_rng(1)
+        A = rng.standard_normal((20, 12)).astype(np.float32)
+        B = rng.standard_normal((12, 8)).astype(np.float32)
+        a = ht.array(A, split=0)
+        b = ht.array(B)
+        c = a @ b
+        self.assertEqual(c.split, 0)
+        self.assert_array_equal(c, A @ B, atol=1e-4)
+
+    def test_matmul_contraction_split(self):
+        rng = np.random.default_rng(2)
+        A = rng.standard_normal((6, 10)).astype(np.float32)
+        B = rng.standard_normal((10, 5)).astype(np.float32)
+        a = ht.array(A, split=1)  # contraction axis sharded + padded (10 % 8 != 0)
+        b = ht.array(B, split=0)
+        c = a @ b
+        self.assert_array_equal(c, A @ B, atol=1e-4)
+
+    def test_getitem_slice_keeps_split(self):
+        x = ht.arange(20, split=0)
+        y = x[4:15]
+        self.assertEqual(y.split, 0)
+        self.assert_array_equal(y, np.arange(4, 15))
+
+    def test_setitem(self):
+        x = ht.zeros((10,), split=0)
+        x[3] = 5.0
+        expected = np.zeros(10, dtype=np.float32)
+        expected[3] = 5
+        self.assert_array_equal(x, expected)
+
+    def test_resplit_roundtrip(self):
+        data = np.arange(30).reshape(6, 5).astype(np.float32)
+        x = ht.array(data, split=0)
+        y = x.resplit(1)
+        self.assertEqual(y.split, 1)
+        self.assert_array_equal(y, data)
+        z = y.resplit(None)
+        self.assertEqual(z.split, None)
+        self.assert_array_equal(z, data)
+
+    def test_sort_padded(self):
+        rng = np.random.default_rng(3)
+        data = rng.standard_normal(13).astype(np.float32)
+        x = ht.array(data, split=0)
+        v, i = ht.sort(x)
+        self.assert_array_equal(v, np.sort(data))
+        self.assert_array_equal(i, np.argsort(data, stable=True))
