@@ -1,1 +1,3 @@
-"""Populated by the ML build stage."""
+"""Classification algorithms (reference: heat/classification/)."""
+
+from .kneighborsclassifier import *

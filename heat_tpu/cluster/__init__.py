@@ -1,1 +1,6 @@
-"""Populated by the ML build stage."""
+"""Clustering algorithms (reference: heat/cluster/)."""
+
+from .kmeans import *
+from .kmedians import *
+from .kmedoids import *
+from .spectral import *

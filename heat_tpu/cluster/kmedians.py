@@ -1,0 +1,71 @@
+"""K-Medians clustering (reference: heat/cluster/kmedians.py:10-137 — same
+Lloyd skeleton as KMeans with a per-dimension median update)."""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from ..core import types
+from ..core.dndarray import DNDarray
+from ._kcluster import _KCluster, _d2
+
+__all__ = ["KMedians"]
+
+
+@partial(jax.jit, static_argnums=(3,))
+def _median_step(xb: jax.Array, w: jax.Array, centers: jax.Array, k: int):
+    d2 = _d2(xb, centers)
+    labels = jnp.argmin(d2, axis=1)
+    valid = w > 0
+
+    def upd(c):
+        member = (labels == c) & valid
+        masked = jnp.where(member[:, None], xb, jnp.nan)
+        med = jnp.nanmedian(masked, axis=0)
+        return jnp.where(jnp.any(member), med, centers[c])
+
+    new_centers = jax.vmap(upd)(jnp.arange(k))
+    inertia = jnp.sum(jnp.sqrt(jnp.min(d2, axis=1)) * w)
+    shift = jnp.sum((new_centers - centers) ** 2)
+    return new_centers, labels, inertia, shift
+
+
+class KMedians(_KCluster):
+    """K-Medians clusterer (reference kmedians.py:10)."""
+
+    def __init__(
+        self,
+        n_clusters: int = 8,
+        init: Union[str, DNDarray] = "random",
+        max_iter: int = 300,
+        tol: float = 1e-4,
+        random_state: Optional[int] = None,
+    ):
+        super().__init__("manhattan", n_clusters, init, max_iter, tol, random_state)
+
+    def fit(self, x: DNDarray) -> "KMedians":
+        """Median-update Lloyd iterations (reference kmedians.py `fit`)."""
+        if not isinstance(x, DNDarray):
+            raise TypeError(f"input needs to be a DNDarray, but was {type(x)}")
+        if x.ndim != 2:
+            raise ValueError("input needs to be 2D")
+        dt, xb, w, centers = self._fit_buffers(x)
+
+        labels, inertia, n_iter = None, None, 0
+        for it in range(self.max_iter):
+            centers, labels, inertia, shift = _median_step(xb, w, centers, self.n_clusters)
+            n_iter = it + 1
+            if float(shift) <= self.tol:
+                break
+
+        self._cluster_centers = DNDarray.from_logical(centers, None, x.device, x.comm, dt)
+        self._labels = DNDarray(
+            labels.astype(jnp.int64), (x.shape[0],), types.int64, x.split, x.device, x.comm, True
+        )
+        self._inertia = float(inertia)
+        self._n_iter = n_iter
+        return self

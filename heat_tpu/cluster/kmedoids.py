@@ -1,0 +1,79 @@
+"""K-Medoids clustering (reference: heat/cluster/kmedoids.py:10-150 — Lloyd
+skeleton with the updated centroid snapped to the nearest actual data
+point)."""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from ..core import types
+from ..core.dndarray import DNDarray
+from ._kcluster import _KCluster, _d2
+
+__all__ = ["KMedoids"]
+
+
+@partial(jax.jit, static_argnums=(3,))
+def _medoid_step(xb: jax.Array, w: jax.Array, centers: jax.Array, k: int):
+    d2 = _d2(xb, centers)
+    labels = jnp.argmin(d2, axis=1)
+    valid = w > 0
+    onehot = (labels[:, None] == jnp.arange(k)[None, :]).astype(xb.dtype) * w[:, None]
+    counts = jnp.sum(onehot, axis=0)
+    means = jnp.where(
+        counts[:, None] > 0, (onehot.T @ xb) / jnp.maximum(counts, 1.0)[:, None], centers
+    )
+
+    # snap each mean to the closest member point (the medoid snap)
+    def snap(c):
+        member = (labels == c) & valid
+        dist = jnp.sum((xb - means[c][None, :]) ** 2, axis=1)
+        dist = jnp.where(member, dist, jnp.inf)
+        idx = jnp.argmin(dist)
+        return jnp.where(jnp.any(member), xb[idx], centers[c])
+
+    new_centers = jax.vmap(snap)(jnp.arange(k))
+    inertia = jnp.sum(jnp.sqrt(jnp.min(d2, axis=1)) * w)
+    shift = jnp.sum((new_centers - centers) ** 2)
+    return new_centers, labels, inertia, shift
+
+
+class KMedoids(_KCluster):
+    """K-Medoids clusterer (reference kmedoids.py:10)."""
+
+    def __init__(
+        self,
+        n_clusters: int = 8,
+        init: Union[str, DNDarray] = "random",
+        max_iter: int = 300,
+        tol: float = 1e-4,
+        random_state: Optional[int] = None,
+    ):
+        super().__init__("euclidean", n_clusters, init, max_iter, tol, random_state)
+
+    def fit(self, x: DNDarray) -> "KMedoids":
+        """Medoid-update Lloyd iterations (reference kmedoids.py `fit`)."""
+        if not isinstance(x, DNDarray):
+            raise TypeError(f"input needs to be a DNDarray, but was {type(x)}")
+        if x.ndim != 2:
+            raise ValueError("input needs to be 2D")
+        dt, xb, w, centers = self._fit_buffers(x)
+
+        labels, inertia, n_iter = None, None, 0
+        for it in range(self.max_iter):
+            centers, labels, inertia, shift = _medoid_step(xb, w, centers, self.n_clusters)
+            n_iter = it + 1
+            if float(shift) <= self.tol:
+                break
+
+        self._cluster_centers = DNDarray.from_logical(centers, None, x.device, x.comm, dt)
+        self._labels = DNDarray(
+            labels.astype(jnp.int64), (x.shape[0],), types.int64, x.split, x.device, x.comm, True
+        )
+        self._inertia = float(inertia)
+        self._n_iter = n_iter
+        return self

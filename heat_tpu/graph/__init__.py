@@ -1,1 +1,3 @@
-"""Populated by the ML build stage."""
+"""Graph algorithms (reference: heat/graph/)."""
+
+from .laplacian import *

@@ -1,1 +1,3 @@
-"""Populated by the ML build stage."""
+"""Naive Bayes classifiers (reference: heat/naive_bayes/)."""
+
+from .gaussianNB import *

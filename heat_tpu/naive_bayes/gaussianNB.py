@@ -1,0 +1,179 @@
+"""Gaussian naive Bayes classifier.
+
+Re-design of reference heat/naive_bayes/gaussianNB.py:12-529 (fit/partial_fit
+with incremental mean/variance merge :131, joint log likelihood :391,
+logsumexp :407). Class-conditional moments are computed as one-hot GEMMs on
+the padded sharded sample buffer — the incremental MPI merge of the
+reference becomes a single psum inserted by XLA; `partial_fit` keeps the
+reference's streaming moment-merge semantics on host scalars.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import types
+from ..core.base import BaseEstimator, ClassificationMixin
+from ..core.dndarray import DNDarray
+
+__all__ = ["GaussianNB"]
+
+
+class GaussianNB(BaseEstimator, ClassificationMixin):
+    """Gaussian naive Bayes (reference gaussianNB.py:12).
+
+    Parameters
+    ----------
+    priors : DNDarray, optional
+        Class priors; estimated from data when None.
+    var_smoothing : float
+        Fraction of the largest feature variance added to all variances.
+    """
+
+    def __init__(self, priors: Optional[DNDarray] = None, var_smoothing: float = 1e-9):
+        self.priors = priors
+        self.var_smoothing = var_smoothing
+        self.classes_ = None
+        self.theta_ = None
+        self.var_ = None
+        self.class_prior_ = None
+        self.class_count_ = None
+        self.epsilon_ = None
+
+    def fit(self, x: DNDarray, y: DNDarray, sample_weight=None, _classes=None) -> "GaussianNB":
+        """Estimate per-class feature means/variances (reference
+        gaussianNB.py `fit` → __partial_fit :131). ``sample_weight`` scales
+        each sample's contribution to counts, means and variances."""
+        if not isinstance(x, DNDarray) or not isinstance(y, DNDarray):
+            raise TypeError("x and y need to be DNDarrays")
+        if x.ndim != 2:
+            raise ValueError(f"expected x to be a 2-D tensor, is {x.ndim}-D")
+        yl = y._logical().ravel()
+        xl = x._masked(0).astype(jnp.float64)
+        w = (jnp.arange(xl.shape[0]) < x.shape[0]).astype(xl.dtype)
+        if sample_weight is not None:
+            sw = (
+                sample_weight._logical()
+                if isinstance(sample_weight, DNDarray)
+                else jnp.asarray(sample_weight)
+            ).astype(xl.dtype).ravel()
+            if sw.shape[0] != x.shape[0]:
+                raise ValueError("sample_weight length must match number of samples")
+            w = w.at[: sw.shape[0]].multiply(sw)
+
+        classes = np.unique(np.asarray(yl)) if _classes is None else np.asarray(_classes)
+        self.classes_ = DNDarray.from_logical(jnp.asarray(classes), None, x.device, x.comm)
+        k = len(classes)
+
+        # pad y to physical length for the one-hot GEMM
+        ypad = jnp.zeros((xl.shape[0],), dtype=yl.dtype)
+        ypad = ypad.at[: yl.shape[0]].set(yl)
+        onehot = (ypad[:, None] == jnp.asarray(classes)[None, :]).astype(xl.dtype) * w[:, None]
+        counts = jnp.sum(onehot, axis=0)  # (k,)
+        sums = onehot.T @ xl  # (k, d)
+        means = sums / jnp.maximum(counts, 1.0)[:, None]
+        sq = onehot.T @ (xl * xl)
+        var = sq / jnp.maximum(counts, 1.0)[:, None] - means * means
+
+        self.epsilon_ = float(self.var_smoothing * jnp.max(jnp.var(
+            jnp.where(w[:, None] > 0, xl, jnp.nan), axis=0, where=~jnp.isnan(
+                jnp.where(w[:, None] > 0, xl, jnp.nan))
+        )))
+        var = var + self.epsilon_
+
+        self.theta_ = DNDarray.from_logical(means, None, x.device, x.comm)
+        self.var_ = DNDarray.from_logical(var, None, x.device, x.comm)
+        self.class_count_ = DNDarray.from_logical(counts, None, x.device, x.comm)
+        if self.priors is None:
+            prior = counts / jnp.sum(counts)
+        else:
+            prior = self.priors._logical()
+            if prior.shape[0] != k:
+                raise ValueError("Number of priors must match number of classes.")
+            if not np.isclose(float(jnp.sum(prior)), 1.0):
+                raise ValueError("The sum of the priors should be 1.")
+        self.class_prior_ = DNDarray.from_logical(prior, None, x.device, x.comm)
+        return self
+
+    def partial_fit(self, x: DNDarray, y: DNDarray, classes: Optional[DNDarray] = None) -> "GaussianNB":
+        """Incremental fit on a batch (reference gaussianNB.py `partial_fit`;
+        moment merge per Chan et al., reference __update_mean_variance
+        :131)."""
+        if self.theta_ is None:
+            if classes is None:
+                raise ValueError("classes must be passed on the first call to partial_fit")
+            return self.fit(x, y, _classes=np.asarray(classes.numpy() if isinstance(classes, DNDarray) else classes))
+        # merge batch moments with stored moments
+        old_n = self.class_count_._logical()
+        old_mu = self.theta_._logical()
+        old_var = self.var_._logical() - self.epsilon_
+
+        tmp = GaussianNB(var_smoothing=self.var_smoothing)
+        tmp.fit(x, y)
+        new_classes = tmp.classes_.numpy()
+        ref_classes = self.classes_.numpy()
+        if not np.array_equal(np.intersect1d(new_classes, ref_classes), new_classes):
+            raise ValueError("partial_fit batch contains unseen classes")
+        idx = jnp.asarray(np.searchsorted(ref_classes, new_classes))
+        b_n = jnp.zeros_like(old_n).at[idx].set(tmp.class_count_._logical())
+        b_mu = jnp.zeros_like(old_mu).at[idx].set(tmp.theta_._logical())
+        b_var = jnp.zeros_like(old_var).at[idx].set(tmp.var_._logical() - tmp.epsilon_)
+
+        n_tot = old_n + b_n
+        safe = jnp.maximum(n_tot, 1.0)
+        mu_tot = (old_n[:, None] * old_mu + b_n[:, None] * b_mu) / safe[:, None]
+        ssd = (
+            old_n[:, None] * old_var
+            + b_n[:, None] * b_var
+            + (old_n * b_n / safe)[:, None] * (old_mu - b_mu) ** 2
+        )
+        var_tot = ssd / safe[:, None]
+
+        self.epsilon_ = max(self.epsilon_, tmp.epsilon_)
+        self.class_count_ = DNDarray.from_logical(n_tot, None, x.device, x.comm)
+        self.theta_ = DNDarray.from_logical(mu_tot, None, x.device, x.comm)
+        self.var_ = DNDarray.from_logical(var_tot + self.epsilon_, None, x.device, x.comm)
+        if self.priors is None:
+            self.class_prior_ = DNDarray.from_logical(n_tot / jnp.sum(n_tot), None, x.device, x.comm)
+        return self
+
+    def __joint_log_likelihood(self, x: DNDarray) -> jnp.ndarray:
+        """log P(c) + Σ log N(x_i; μ_c, σ_c²) (reference gaussianNB.py:391)."""
+        xl = x.larray.astype(jnp.float64)
+        mu = self.theta_._logical()
+        var = self.var_._logical()
+        prior = self.class_prior_._logical()
+        log_prior = jnp.log(prior)[None, :]
+        n_ij = -0.5 * jnp.sum(jnp.log(2.0 * jnp.pi * var), axis=1)[None, :]
+        diff = xl[:, None, :] - mu[None, :, :]  # (m, k, d)
+        quad = -0.5 * jnp.sum(diff * diff / var[None, :, :], axis=2)
+        return log_prior + n_ij + quad
+
+    def predict(self, x: DNDarray) -> DNDarray:
+        """Most probable class per sample (reference gaussianNB.py:480)."""
+        if self.theta_ is None:
+            raise RuntimeError("fit needs to be called before predict")
+        jll = self.__joint_log_likelihood(x)
+        classes = self.classes_._logical()
+        pred = jnp.take(classes, jnp.argmax(jll, axis=1))
+        return DNDarray(pred, (x.shape[0],), types.canonical_heat_type(pred.dtype), x.split, x.device, x.comm, True)
+
+    def predict_log_proba(self, x: DNDarray) -> DNDarray:
+        """Log class probabilities via logsumexp (reference gaussianNB.py:407)."""
+        jll = self.__joint_log_likelihood(x)
+        log_prob = jll - jax.scipy.special.logsumexp(jll, axis=1, keepdims=True)
+        k = log_prob.shape[1]
+        return DNDarray(
+            log_prob, (x.shape[0], k), types.float64, x.split, x.device, x.comm, True
+        )
+
+    def predict_proba(self, x: DNDarray) -> DNDarray:
+        """Class probabilities (reference gaussianNB.py:537)."""
+        lp = self.predict_log_proba(x)
+        return DNDarray(
+            jnp.exp(lp.larray), lp.shape, lp.dtype, lp.split, lp.device, lp.comm, True
+        )

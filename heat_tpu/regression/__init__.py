@@ -1,1 +1,3 @@
-"""Populated by the ML build stage."""
+"""Regression algorithms (reference: heat/regression/)."""
+
+from .lasso import *

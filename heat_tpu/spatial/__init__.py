@@ -1,1 +1,3 @@
-"""Populated by the ML build stage."""
+"""Distance computations (reference: heat/spatial/)."""
+
+from .distance import *

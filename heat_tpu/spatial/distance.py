@@ -1,0 +1,177 @@
+"""Pairwise distance computations.
+
+Re-design of reference heat/spatial/distance.py:136-494, whose engine
+`_dist` (:209) is the reference's ring-communication showpiece: each rank
+keeps a stationary row block and circulates moving blocks rank→rank+1 with
+Send/Recv (:280-326), exploiting symmetry by shipping computed tiles back.
+On TPU two paths replace it:
+
+* **MXU path (default)**: the quadratic expansion ``‖a−b‖² = ‖a‖² + ‖b‖²
+  − 2 a·bᵀ`` turns the whole distance matrix into one GEMM — this is where
+  the FLOPs belong on TPU and it is the benchmark path.
+* **Ring path** (`ring=True` or metric without a GEMM form): a `shard_map`
+  kernel with the reference's schedule — stationary local rows, K-side
+  blocks circulated with `jax.lax.ppermute` over ICI, `lax.fori_loop` over
+  mesh steps. Same schedule as ring attention (SURVEY §5); peak memory per
+  chip drops from O(n·m) to O(n·m/p).
+"""
+
+from __future__ import annotations
+
+import builtins
+from functools import partial
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core import types
+from ..core.dndarray import DNDarray
+
+__all__ = ["cdist", "manhattan", "rbf"]
+
+
+def _quadratic_euclidean(x: jax.Array, y: jax.Array) -> jax.Array:
+    """‖x_i − y_j‖ via the GEMM form, clamped for numerical safety.
+
+    The GEMM runs at HIGHEST precision: on TPU the default bf16 MXU passes
+    lose ~1e-3 relative, which catastrophic cancellation at small distances
+    (e.g. the cdist(X, X) diagonal) turns into absolute errors of ~0.3."""
+    x2 = jnp.sum(x * x, axis=1, keepdims=True)
+    y2 = jnp.sum(y * y, axis=1, keepdims=True).T
+    d2 = x2 + y2 - 2.0 * jnp.matmul(x, y.T, precision=jax.lax.Precision.HIGHEST)
+    return jnp.sqrt(jnp.maximum(d2, 0.0))
+
+
+def _pairwise_euclidean(x: jax.Array, y: jax.Array) -> jax.Array:
+    diff = x[:, None, :] - y[None, :, :]
+    return jnp.sqrt(jnp.sum(diff * diff, axis=-1))
+
+
+def _pairwise_manhattan(x: jax.Array, y: jax.Array) -> jax.Array:
+    return jnp.sum(jnp.abs(x[:, None, :] - y[None, :, :]), axis=-1)
+
+
+def _ring_dist(x: DNDarray, y: DNDarray, block_fn: Callable) -> jax.Array:
+    """Ring-pipelined block distance matrix (reference distance.py:280-326).
+
+    Both operands row-split. Each mesh position keeps its stationary x-block
+    and circulates the y-block one hop per step; after p steps every position
+    has filled its (local rows × all columns) slab.
+    """
+    comm = x.comm
+    p = comm.size
+    axis = comm.axis_name
+    xm = x.larray
+    ym = y.larray
+    cy = ym.shape[0] // p
+    n_cols = ym.shape[0]
+    perm = [(i, (i + 1) % p) for i in range(p)]
+
+    def kernel(xb, yb):
+        rank = jax.lax.axis_index(axis)
+        out = jnp.zeros((xb.shape[0], n_cols), dtype=xb.dtype)
+        # mark the accumulator as device-varying for the scan carry typing
+        out = jax.lax.pcast(out, (axis,), to="varying")
+
+        def step(t, carry):
+            yblk, out = carry
+            # perm sends i→i+1, so after t hops shard i holds origin (i−t) mod p
+            col = ((rank - t) % p) * cy
+            tile = block_fn(xb, yblk)
+            zero = jnp.zeros((), dtype=col.dtype)
+            out = jax.lax.dynamic_update_slice(out, tile, (zero, col))
+            yblk = jax.lax.ppermute(yblk, axis, perm=perm)
+            return (yblk, out)
+
+        _, out = jax.lax.fori_loop(0, p, step, (yb, out))
+        return out
+
+    spec = comm.spec(0, 2)
+    out_spec = spec
+    return jax.shard_map(
+        kernel, mesh=comm.mesh, in_specs=(spec, spec), out_specs=out_spec
+    )(xm, ym)
+
+
+def _dist(x: DNDarray, y: Optional[DNDarray], block_fn: Callable, ring_ok: bool, ring: bool) -> DNDarray:
+    """Distance engine (reference distance.py:209): result is
+    (n_x, n_y) distributed along the rows of x."""
+    if not isinstance(x, DNDarray):
+        raise TypeError(f"x must be a DNDarray, but was {type(x)}")
+    if x.ndim != 2:
+        raise NotImplementedError(f"x has {x.ndim} dimensions, expecting 2")
+    if y is None:
+        y = x
+    if not isinstance(y, DNDarray):
+        raise TypeError(f"y must be a DNDarray, but was {type(y)}")
+    if y.ndim != 2:
+        raise NotImplementedError(f"y has {y.ndim} dimensions, expecting 2")
+    if x.shape[1] != y.shape[1]:
+        raise ValueError(
+            f"inputs must have the same number of features, got {x.shape[1]} and {y.shape[1]}"
+        )
+    if x.split is not None and x.split != 0:
+        raise NotImplementedError("cdist requires x.split in (None, 0)")
+
+    promoted = types.promote_types(types.promote_types(x.dtype, y.dtype), types.float32)
+    out_split = 0 if x.split == 0 else None
+    m, n = x.shape[0], y.shape[0]
+
+    use_ring = (
+        ring
+        and ring_ok
+        and x.split == 0
+        and y.split == 0
+        and x.comm.size > 1
+    )
+    if use_ring:
+        # ring kernel works on the padded buffers; x pad rows land in output
+        # pad rows, y pad columns are sliced off below
+        xm = x._masked(0).astype(promoted.jnp_type())
+        ym = y._masked(0).astype(promoted.jnp_type())
+        xw = DNDarray(xm, x.shape, promoted, 0, x.device, x.comm, True)
+        yw = DNDarray(ym, y.shape, promoted, 0, y.device, y.comm, True)
+        out = _ring_dist(xw, yw, block_fn)
+        out = out[:, :n]
+        return DNDarray(out, (m, n), promoted, out_split, x.device, x.comm, True)
+
+    xm = x.larray.astype(promoted.jnp_type())
+    ym = y._logical().astype(promoted.jnp_type())
+    out = block_fn(xm, ym)
+    return DNDarray(out, (m, n), promoted, out_split, x.device, x.comm, True)
+
+
+def cdist(X: DNDarray, Y: Optional[DNDarray] = None, quadratic_expansion: bool = False, ring: bool = False) -> DNDarray:
+    """Euclidean distance matrix (reference distance.py:136).
+
+    ``quadratic_expansion`` selects the GEMM form (reference offers the same
+    switch); ``ring=True`` (extension) forces the ppermute ring kernel for
+    O(n·m/p) per-chip memory when both operands are row-split."""
+    fn = _quadratic_euclidean if quadratic_expansion else _pairwise_euclidean
+    return _dist(X, Y, fn, ring_ok=True, ring=ring)
+
+
+def manhattan(X: DNDarray, Y: Optional[DNDarray] = None, expand: bool = False, ring: bool = False) -> DNDarray:
+    """City-block distance matrix (reference distance.py:186)."""
+    return _dist(X, Y, _pairwise_manhattan, ring_ok=True, ring=ring)
+
+
+def rbf(
+    X: DNDarray,
+    Y: Optional[DNDarray] = None,
+    sigma: float = 1.0,
+    quadratic_expansion: bool = False,
+    ring: bool = False,
+) -> DNDarray:
+    """Gaussian kernel matrix exp(−‖x−y‖²/2σ²) (reference distance.py:159)."""
+    gamma = 1.0 / (2.0 * sigma * sigma)
+
+    def block(x, y):
+        if quadratic_expansion:
+            d = _quadratic_euclidean(x, y)
+        else:
+            d = _pairwise_euclidean(x, y)
+        return jnp.exp(-gamma * d * d)
+
+    return _dist(X, Y, block, ring_ok=True, ring=ring)

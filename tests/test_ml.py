@@ -1,0 +1,201 @@
+"""ML stack tests (reference test strategy: heat/cluster/tests,
+heat/spatial/tests/test_distances.py, heat/regression, heat/naive_bayes,
+heat/classification)."""
+
+import numpy as np
+
+import heat_tpu as ht
+
+from .basic_test import TestCase
+
+
+def _blobs(n=160, d=4, k=4, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = rng.uniform(-20, 20, size=(k, d))
+    labels = rng.integers(0, k, size=n)
+    pts = centers[labels] + rng.standard_normal((n, d))
+    return pts.astype(np.float32), labels, centers
+
+
+class TestSpatial(TestCase):
+    def test_cdist_matches_scipy_formula(self):
+        rng = np.random.default_rng(0)
+        X = rng.standard_normal((40, 5)).astype(np.float32)
+        Y = rng.standard_normal((24, 5)).astype(np.float32)
+        expected = np.sqrt(((X[:, None, :] - Y[None, :, :]) ** 2).sum(-1))
+        for split in (None, 0):
+            d = ht.spatial.cdist(ht.array(X, split=split), ht.array(Y))
+            self.assert_array_equal(d, expected, atol=1e-4)
+
+    def test_cdist_quadratic_expansion(self):
+        rng = np.random.default_rng(1)
+        X = rng.standard_normal((30, 3)).astype(np.float32)
+        expected = np.sqrt(((X[:, None, :] - X[None, :, :]) ** 2).sum(-1))
+        d = ht.spatial.cdist(ht.array(X, split=0), quadratic_expansion=True)
+        self.assert_array_equal(d, expected, atol=1e-3)
+
+    def test_cdist_ring_kernel(self):
+        rng = np.random.default_rng(2)
+        X = rng.standard_normal((32, 4)).astype(np.float32)  # divisible by 8
+        Y = rng.standard_normal((16, 4)).astype(np.float32)
+        expected = np.sqrt(((X[:, None, :] - Y[None, :, :]) ** 2).sum(-1))
+        d = ht.spatial.cdist(ht.array(X, split=0), ht.array(Y, split=0), ring=True)
+        self.assertEqual(d.split, 0)
+        self.assert_array_equal(d, expected, atol=1e-4)
+
+    def test_cdist_ring_kernel_uneven(self):
+        rng = np.random.default_rng(3)
+        X = rng.standard_normal((26, 4)).astype(np.float32)  # 26 % 8 != 0
+        Y = rng.standard_normal((13, 4)).astype(np.float32)
+        expected = np.sqrt(((X[:, None, :] - Y[None, :, :]) ** 2).sum(-1))
+        d = ht.spatial.cdist(ht.array(X, split=0), ht.array(Y, split=0), ring=True)
+        self.assert_array_equal(d, expected, atol=1e-4)
+
+    def test_manhattan_and_rbf(self):
+        rng = np.random.default_rng(4)
+        X = rng.standard_normal((20, 3)).astype(np.float32)
+        man = ht.spatial.manhattan(ht.array(X, split=0))
+        expected = np.abs(X[:, None, :] - X[None, :, :]).sum(-1)
+        self.assert_array_equal(man, expected, atol=1e-4)
+        sig = 2.0
+        r = ht.spatial.rbf(ht.array(X, split=0), sigma=sig)
+        d2 = ((X[:, None, :] - X[None, :, :]) ** 2).sum(-1)
+        self.assert_array_equal(r, np.exp(-d2 / (2 * sig * sig)), atol=1e-4)
+
+
+class TestCluster(TestCase):
+    def test_kmeans_recovers_blobs(self):
+        pts, labels, centers = _blobs()
+        x = ht.array(pts, split=0)
+        km = ht.cluster.KMeans(n_clusters=4, init="probability_based", random_state=0)
+        km.fit(x)
+        self.assertEqual(km.cluster_centers_.shape, (4, 4))
+        # every fitted center is close to a true center
+        fitted = km.cluster_centers_.numpy()
+        for c in fitted:
+            self.assertLess(np.min(np.linalg.norm(centers - c, axis=1)), 1.5)
+        pred = km.predict(x)
+        self.assertEqual(pred.shape, (160,))
+        # predicted labels agree with argmin distance
+        d = np.linalg.norm(pts[:, None] - fitted[None], axis=2)
+        np.testing.assert_array_equal(pred.numpy(), d.argmin(1))
+
+    def test_kmeans_uneven_rows(self):
+        pts, _, _ = _blobs(n=150)  # 150 % 8 != 0 → tail-pad path
+        km = ht.cluster.KMeans(n_clusters=4, random_state=1)
+        km.fit(ht.array(pts, split=0))
+        self.assertTrue(np.isfinite(km.inertia_))
+        self.assertEqual(km.labels_.shape, (150,))
+
+    def test_kmedians_and_kmedoids(self):
+        pts, _, centers = _blobs(n=128, seed=5)
+        for cls in (ht.cluster.KMedians, ht.cluster.KMedoids):
+            est = cls(n_clusters=4, init="probability_based", random_state=2)
+            est.fit(ht.array(pts, split=0))
+            fitted = est.cluster_centers_.numpy()
+            for c in fitted:
+                self.assertLess(np.min(np.linalg.norm(centers - c, axis=1)), 2.0)
+
+    def test_kmedoids_centers_are_data_points(self):
+        pts, _, _ = _blobs(n=64, seed=6)
+        est = ht.cluster.KMedoids(n_clusters=4, random_state=3)
+        est.fit(ht.array(pts, split=0))
+        fitted = est.cluster_centers_.numpy()
+        for c in fitted:
+            dmin = np.min(np.linalg.norm(pts - c, axis=1))
+            self.assertLess(dmin, 1e-5)
+
+    def test_spectral_two_rings(self):
+        # two well-separated blobs; spectral with rbf should separate them
+        rng = np.random.default_rng(7)
+        a = rng.standard_normal((30, 2)) * 0.3
+        b = rng.standard_normal((30, 2)) * 0.3 + np.array([10.0, 0.0])
+        pts = np.vstack([a, b]).astype(np.float32)
+        sp = ht.cluster.Spectral(n_clusters=2, gamma=0.5, n_lanczos=40)
+        sp.fit(ht.array(pts, split=0))
+        lab = sp.labels_.numpy()
+        self.assertEqual(len(set(lab[:30])), 1)
+        self.assertEqual(len(set(lab[30:])), 1)
+        self.assertNotEqual(lab[0], lab[30])
+
+
+class TestRegression(TestCase):
+    def test_lasso_recovers_sparse_signal(self):
+        rng = np.random.default_rng(8)
+        n, d = 200, 10
+        X = rng.standard_normal((n, d)).astype(np.float32)
+        beta = np.zeros(d, dtype=np.float32)
+        beta[[1, 4]] = [3.0, -2.0]
+        y = X @ beta + 0.5
+        est = ht.regression.Lasso(lam=0.01, max_iter=200)
+        est.fit(ht.array(X, split=0), ht.array(y, split=0))
+        coef = est.coef_.numpy()
+        self.assertLess(abs(coef[1] - 3.0), 0.1)
+        self.assertLess(abs(coef[4] + 2.0), 0.1)
+        self.assertLess(np.max(np.abs(np.delete(coef, [1, 4]))), 0.1)
+        self.assertLess(abs(est.intercept_.item() - 0.5), 0.1)
+        pred = est.predict(ht.array(X, split=0))
+        self.assertLess(est.rmse(ht.array(y, split=0), pred), 0.2)
+
+
+class TestNaiveBayes(TestCase):
+    def test_gaussian_nb(self):
+        pts, labels, _ = _blobs(n=200, d=3, k=3, seed=9)
+        x = ht.array(pts, split=0)
+        y = ht.array(labels.astype(np.int64), split=0)
+        nb = ht.naive_bayes.GaussianNB()
+        nb.fit(x, y)
+        pred = nb.predict(x).numpy()
+        acc = (pred == labels).mean()
+        self.assertGreater(acc, 0.95)
+        proba = nb.predict_proba(x).numpy()
+        np.testing.assert_allclose(proba.sum(axis=1), 1.0, rtol=1e-6)
+
+    def test_gaussian_nb_partial_fit(self):
+        pts, labels, _ = _blobs(n=200, d=3, k=3, seed=10)
+        full = ht.naive_bayes.GaussianNB().fit(
+            ht.array(pts, split=0), ht.array(labels.astype(np.int64))
+        )
+        part = ht.naive_bayes.GaussianNB()
+        part.fit(ht.array(pts[:100], split=0), ht.array(labels[:100].astype(np.int64)))
+        part.partial_fit(ht.array(pts[100:], split=0), ht.array(labels[100:].astype(np.int64)))
+        np.testing.assert_allclose(
+            part.theta_.numpy(), full.theta_.numpy(), rtol=1e-4, atol=1e-5
+        )
+        np.testing.assert_allclose(
+            part.var_.numpy(), full.var_.numpy(), rtol=1e-3, atol=1e-5
+        )
+
+
+class TestKNN(TestCase):
+    def test_knn_classifies_blobs(self):
+        pts, labels, _ = _blobs(n=120, d=3, k=3, seed=11)
+        x = ht.array(pts, split=0)
+        y = ht.array(labels.astype(np.int64))
+        knn = ht.classification.KNeighborsClassifier(n_neighbors=5)
+        knn.fit(x, y)
+        pred = knn.predict(x).numpy()
+        # numpy oracle: exact 5-NN majority vote
+        d = np.linalg.norm(pts[:, None] - pts[None], axis=2)
+        idx = np.argsort(d, axis=1)[:, :5]
+        expected = np.array(
+            [np.bincount(r, minlength=3).argmax() for r in labels[idx]]
+        )
+        agreement = (pred == expected).mean()
+        # ties between equidistant neighbors may break differently
+        self.assertGreater(agreement, 0.97)
+        self.assertGreater((pred == labels).mean(), 0.9)
+
+
+class TestLaplacian(TestCase):
+    def test_laplacian_norm_sym(self):
+        rng = np.random.default_rng(12)
+        pts = rng.standard_normal((24, 3)).astype(np.float32)
+        lap = ht.graph.Laplacian(lambda z: ht.spatial.rbf(z, sigma=1.0), definition="norm_sym")
+        L = lap.construct(ht.array(pts, split=0)).numpy()
+        # symmetric, unit diagonal, rows of A scaled
+        np.testing.assert_allclose(L, L.T, atol=1e-5)
+        np.testing.assert_allclose(np.diag(L), 1.0, atol=1e-6)
+        # PSD up to numerical tolerance
+        ev = np.linalg.eigvalsh(L.astype(np.float64))
+        self.assertGreater(ev.min(), -1e-5)
