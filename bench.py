@@ -1,0 +1,161 @@
+"""Benchmark driver — prints ONE JSON line.
+
+Mirrors the reference benchmark harness (reference: benchmarks/{kmeans,
+distance_matrix}/ + linalg matmul; timed with bare perf_counter, e.g.
+benchmarks/kmeans/heat-gpu.py:25-27). The reference publishes no numbers
+(BASELINE.md), so `vs_baseline` is measured in-run against the reference
+harness's own single-process comparison baseline (`benchmarks/*/torch-*.py`):
+the same three workloads implemented in torch on CPU, compared on achieved
+GFLOP/s (size-normalized so the CPU pass stays cheap).
+
+Workloads (BASELINE.json configs):
+  * matmul   — ht.matmul on split DNDarrays (linalg/basics.py parity)
+  * cdist    — ht.spatial.cdist euclidean, split=0 (distance_matrix bench)
+  * kmeans   — ht.cluster.KMeans Lloyd iterations on synthetic blobs
+
+Headline metric: geometric-mean achieved GFLOP/s across the three, on the
+default JAX platform (the one real TPU chip under the driver).
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def _best_time(fn, repeats=3):
+    """Best-of-N wall-clock of fn() (which must block until ready)."""
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def bench_heat_tpu():
+    """Timing note: device dispatch is asynchronous (and, under the axon
+    tunnel, `block_until_ready` does not block), so every timed run chains
+    enough device work to dominate the host round-trip and synchronizes by
+    fetching ONE scalar of the final result — fetching any element forces the
+    whole dependency chain to finish (in-order single-stream execution)."""
+    import jax.numpy as jnp
+
+    import heat_tpu as ht
+
+    def sync(arr):
+        return float(arr[(0,) * arr.ndim])
+
+    results = {}
+
+    # --- matmul: chained (4096x4096) GEMMs, f32, split=0 ---------------------
+    n, reps = 4096, 100
+    a = ht.random.rand(n, n, dtype=ht.float32, split=0) / float(n)  # ρ(a)<1: no overflow
+    y0 = ht.random.rand(n, n, dtype=ht.float32, split=0)
+
+    def mm_chain():
+        y = y0
+        for _ in range(reps):
+            y = ht.matmul(a, y)
+        return sync(y.larray)
+
+    mm_chain()  # compile
+    t = _best_time(mm_chain, repeats=2)
+    results["matmul"] = (reps * 2.0 * n * n * n) / t / 1e9
+
+    # --- cdist: euclidean distance matrix, 16384x128 (GEMM form) ------------
+    m, k, reps = 16384, 128, 10
+    x = ht.random.rand(m, k, dtype=ht.float32, split=0)
+
+    def cd_chain():
+        outs = [ht.spatial.cdist(x, x, quadratic_expansion=True) for _ in range(reps)]
+        return sync(outs[-1].larray)
+
+    cd_chain()
+    t = _best_time(cd_chain, repeats=2)
+    results["cdist"] = (reps * 2.0 * m * m * k) / t / 1e9
+
+    # --- kmeans: 2M x 64 blobs, k=64, fixed 50 Lloyd iterations --------------
+    ns, d, kc, iters = 2_000_000, 64, 64, 50
+    xs = ht.random.randn(ns, d, dtype=ht.float32, split=0)
+    km = ht.cluster.KMeans(n_clusters=kc, init="random", max_iter=iters, tol=0.0, random_state=1)
+    km.fit(xs)  # compile + first run
+
+    def run():
+        km2 = ht.cluster.KMeans(
+            n_clusters=kc, init="random", max_iter=iters, tol=0.0, random_state=1
+        )
+        km2.fit(xs)
+        return sync(km2.cluster_centers_.larray)
+
+    t = _best_time(run, repeats=2)
+    # per iteration: assignment GEMM (2*n*k*d) + update GEMM (2*n*k*d)
+    results["kmeans"] = (iters * 4.0 * ns * kc * d) / t / 1e9
+
+    return results
+
+
+def bench_torch_cpu():
+    """The reference harness's torch-cpu baseline (benchmarks/*/torch-cpu.py),
+    size-reduced; GFLOP/s is the size-normalized comparison."""
+    import torch
+
+    torch.manual_seed(0)
+    results = {}
+
+    n = 2048
+    a = torch.randn(n, n)
+    b = torch.randn(n, n)
+    torch.mm(a, b)
+    t = _best_time(lambda: torch.mm(a, b), repeats=2)
+    results["matmul"] = (2.0 * n * n * n) / t / 1e9
+
+    m, k = 8192, 128
+    x = torch.randn(m, k)
+    torch.cdist(x, x)
+    t = _best_time(lambda: torch.cdist(x, x), repeats=2)
+    results["cdist"] = (2.0 * m * m * k) / t / 1e9
+
+    ns, d, kc, iters = 100_000, 64, 16, 5
+    xs = torch.randn(ns, d)
+    centers = xs[:kc].clone()
+
+    def lloyd():
+        c = centers.clone()
+        for _ in range(iters):
+            d2 = torch.cdist(xs, c) ** 2
+            lab = d2.argmin(dim=1)
+            oh = torch.nn.functional.one_hot(lab, kc).to(xs.dtype)
+            cnt = oh.sum(0).clamp(min=1.0)
+            c = (oh.T @ xs) / cnt[:, None]
+
+    lloyd()
+    t = _best_time(lloyd, repeats=2)
+    results["kmeans"] = (iters * 4.0 * ns * kc * d) / t / 1e9
+
+    return results
+
+
+def main():
+    ours = bench_heat_tpu()
+    base = bench_torch_cpu()
+    geo_ours = float(np.exp(np.mean([np.log(v) for v in ours.values()])))
+    geo_base = float(np.exp(np.mean([np.log(v) for v in base.values()])))
+    detail = {f"{k}_gflops": round(v, 2) for k, v in ours.items()}
+    detail.update({f"{k}_torchcpu_gflops": round(v, 2) for k, v in base.items()})
+    print(json.dumps(detail), file=sys.stderr)
+    print(
+        json.dumps(
+            {
+                "metric": "geomean GFLOP/s (matmul, cdist, kmeans) vs torch-cpu harness baseline",
+                "value": round(geo_ours, 2),
+                "unit": "GFLOP/s",
+                "vs_baseline": round(geo_ours / geo_base, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -11,8 +11,7 @@ same collective count as the reference's Allreduce).
 
 from __future__ import annotations
 
-import builtins
-from typing import Callable, Optional, Union
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -39,6 +38,27 @@ def _d2(xb: "jax.Array", centers: "jax.Array") -> "jax.Array":
     return jnp.maximum(x2 + c2 - 2.0 * prod, 0.0)
 
 
+def _d1(xb: "jax.Array", centers: "jax.Array", budget_bytes: int = 1 << 28) -> "jax.Array":
+    """(m, k) Manhattan distances — the assignment metric of KMedians and
+    KMedoids (reference kmedians.py:49, kmedoids.py:48: both fix
+    ``metric=manhattan``). L1 has no GEMM form, so the (block, k, d)
+    broadcast temporary is bounded by mapping over row blocks."""
+    m, d = xb.shape
+    k = centers.shape[0]
+
+    def block(b):
+        return jnp.sum(jnp.abs(b[:, None, :] - centers[None, :, :]), axis=-1)
+
+    per_row = max(1, k * d * xb.dtype.itemsize)
+    bs = max(1, min(m, budget_bytes // per_row))
+    if bs >= m:
+        return block(xb)
+    nb = -(-m // bs)
+    xp = jnp.pad(xb, ((0, nb * bs - m), (0, 0)))
+    out = jax.lax.map(block, xp.reshape(nb, bs, d))
+    return out.reshape(nb * bs, k)[:m]
+
+
 def _pad_weights(xb: "jax.Array", n_logical: int) -> "jax.Array":
     """Validity weights: 1 for logical rows, 0 for tail pads."""
     return (jnp.arange(xb.shape[0]) < n_logical).astype(xb.dtype)
@@ -54,6 +74,9 @@ class _KCluster(BaseEstimator, ClusteringMixin):
     """
 
     def __init__(self, metric: str, n_clusters: int, init, max_iter: int, tol: float, random_state: Optional[int]):
+        if metric not in ("euclidean", "manhattan"):
+            raise ValueError(f"metric must be 'euclidean' or 'manhattan', got {metric!r}")
+        self._metric_name = metric
         self.n_clusters = n_clusters
         self.init = init
         self.max_iter = max_iter
@@ -107,9 +130,7 @@ class _KCluster(BaseEstimator, ClusteringMixin):
             for i in range(1, k):
                 key, sub = jax.random.split(key)
                 c = jnp.stack(centers)
-                d2 = jnp.min(
-                    jnp.sum((log[:, None, :] - c[None, :, :]) ** 2, axis=-1), axis=1
-                )
+                d2 = jnp.min(_d2(log.astype(jnp.float32), c.astype(jnp.float32)), axis=1)
                 probs = d2 / jnp.maximum(jnp.sum(d2), 1e-30)
                 nxt = jax.random.choice(sub, n, p=probs)
                 centers.append(jnp.take(log, nxt, axis=0))
@@ -121,10 +142,12 @@ class _KCluster(BaseEstimator, ClusteringMixin):
     # -- assignment ----------------------------------------------------------
 
     def _assign_to_cluster(self, x: DNDarray) -> DNDarray:
-        """Hard assignment of each sample (reference _kcluster.py:196)."""
+        """Hard assignment of each sample under the estimator's metric
+        (reference _kcluster.py:196,206: ``self._metric(x, centers).argmin``)."""
         centers = self._cluster_centers._logical()
-        d2 = _d2(x._masked(0).astype(centers.dtype), centers)
-        labels = jnp.argmin(d2, axis=1).astype(jnp.int64)
+        dist_fn = _d1 if self._metric_name == "manhattan" else _d2
+        d = dist_fn(x._masked(0).astype(centers.dtype), centers)
+        labels = jnp.argmin(d, axis=1).astype(jnp.int64)
         return DNDarray(labels, (x.shape[0],), types.int64, x.split, x.device, x.comm, True)
 
     def predict(self, x: DNDarray) -> DNDarray:

@@ -44,6 +44,32 @@ def _lloyd_step(xb: jax.Array, w: jax.Array, centers: jax.Array):
     return new_centers, labels, inertia, shift
 
 
+@partial(jax.jit, static_argnames=("max_iter",))
+def _lloyd_fit(xb: jax.Array, w: jax.Array, centers: jax.Array, max_iter: int, tol):
+    """The whole Lloyd loop as one on-device `lax.while_loop` — the reference
+    drives iterations from Python with a per-iteration convergence fetch
+    (kmeans.py:122-135); on TPU that host sync per iteration would dominate,
+    so the loop, the convergence test, and the final assignment all compile
+    into a single XLA program (SURVEY §3.3)."""
+
+    def cond(carry):
+        _, it, shift = carry
+        return jnp.logical_and(it < max_iter, shift > tol)
+
+    def body(carry):
+        c, it, _ = carry
+        new_c, _, _, shift = _lloyd_step.__wrapped__(xb, w, c)
+        return new_c, it + 1, shift
+
+    centers, n_iter, _ = jax.lax.while_loop(
+        cond, body, (centers, jnp.int32(0), jnp.asarray(jnp.inf, xb.dtype))
+    )
+    d2 = _d2(xb, centers)
+    labels = jnp.argmin(d2, axis=1)
+    inertia = jnp.sum(jnp.min(d2, axis=1) * w)
+    return centers, labels, inertia, n_iter
+
+
 class KMeans(_KCluster):
     """K-Means clusterer (reference kmeans.py:13).
 
@@ -76,14 +102,10 @@ class KMeans(_KCluster):
 
         dt, xb, w, centers = self._fit_buffers(x)
 
-        labels = None
-        inertia = None
-        n_iter = 0
-        for it in range(self.max_iter):
-            centers, labels, inertia, shift = _lloyd_step(xb, w, centers)
-            n_iter = it + 1
-            if float(shift) <= self.tol:
-                break
+        centers, labels, inertia, n_iter = _lloyd_fit(
+            xb, w, centers, self.max_iter, jnp.asarray(self.tol, xb.dtype)
+        )
+        n_iter = int(n_iter)
 
         self._cluster_centers = DNDarray.from_logical(centers, None, x.device, x.comm, dt)
         self._labels = DNDarray(

@@ -1,5 +1,6 @@
-"""K-Medians clustering (reference: heat/cluster/kmedians.py:10-137 — same
-Lloyd skeleton as KMeans with a per-dimension median update)."""
+"""K-Medians clustering (reference: heat/cluster/kmedians.py:10-137 — Lloyd
+skeleton with Manhattan assignment (``metric=manhattan``, reference
+kmedians.py:49) and a per-dimension median update)."""
 
 from __future__ import annotations
 
@@ -11,16 +12,14 @@ import jax.numpy as jnp
 
 from ..core import types
 from ..core.dndarray import DNDarray
-from ._kcluster import _KCluster, _d2
+from ._kcluster import _KCluster, _d1
 
 __all__ = ["KMedians"]
 
 
-@partial(jax.jit, static_argnums=(3,))
-def _median_step(xb: jax.Array, w: jax.Array, centers: jax.Array, k: int):
-    d2 = _d2(xb, centers)
-    labels = jnp.argmin(d2, axis=1)
-    valid = w > 0
+def _median_update(xb: jax.Array, labels: jax.Array, valid: jax.Array, centers: jax.Array):
+    """Per-cluster per-dimension median over members; empty clusters keep
+    their center (reference kmedians.py `_update_centroids`)."""
 
     def upd(c):
         member = (labels == c) & valid
@@ -28,10 +27,33 @@ def _median_step(xb: jax.Array, w: jax.Array, centers: jax.Array, k: int):
         med = jnp.nanmedian(masked, axis=0)
         return jnp.where(jnp.any(member), med, centers[c])
 
-    new_centers = jax.vmap(upd)(jnp.arange(k))
-    inertia = jnp.sum(jnp.sqrt(jnp.min(d2, axis=1)) * w)
-    shift = jnp.sum((new_centers - centers) ** 2)
-    return new_centers, labels, inertia, shift
+    return jax.vmap(upd)(jnp.arange(centers.shape[0]))
+
+
+@partial(jax.jit, static_argnames=("max_iter",))
+def _median_fit(xb: jax.Array, w: jax.Array, centers: jax.Array, max_iter: int, tol):
+    """Whole fit loop on-device (see kmeans._lloyd_fit for the rationale)."""
+    valid = w > 0
+
+    def cond(carry):
+        _, it, shift = carry
+        return jnp.logical_and(it < max_iter, shift > tol)
+
+    def body(carry):
+        c, it, _ = carry
+        d1 = _d1(xb, c)
+        labels = jnp.argmin(d1, axis=1)
+        new_c = _median_update(xb, labels, valid, c)
+        shift = jnp.sum((new_c - c) ** 2)
+        return new_c, it + 1, shift
+
+    centers, n_iter, _ = jax.lax.while_loop(
+        cond, body, (centers, jnp.int32(0), jnp.asarray(jnp.inf, xb.dtype))
+    )
+    d1 = _d1(xb, centers)
+    labels = jnp.argmin(d1, axis=1)
+    inertia = jnp.sum(jnp.min(d1, axis=1) * w)
+    return centers, labels, inertia, n_iter
 
 
 class KMedians(_KCluster):
@@ -55,17 +77,14 @@ class KMedians(_KCluster):
             raise ValueError("input needs to be 2D")
         dt, xb, w, centers = self._fit_buffers(x)
 
-        labels, inertia, n_iter = None, None, 0
-        for it in range(self.max_iter):
-            centers, labels, inertia, shift = _median_step(xb, w, centers, self.n_clusters)
-            n_iter = it + 1
-            if float(shift) <= self.tol:
-                break
+        centers, labels, inertia, n_iter = _median_fit(
+            xb, w, centers, self.max_iter, jnp.asarray(self.tol, xb.dtype)
+        )
 
         self._cluster_centers = DNDarray.from_logical(centers, None, x.device, x.comm, dt)
         self._labels = DNDarray(
             labels.astype(jnp.int64), (x.shape[0],), types.int64, x.split, x.device, x.comm, True
         )
         self._inertia = float(inertia)
-        self._n_iter = n_iter
+        self._n_iter = int(n_iter)
         return self

@@ -1,6 +1,7 @@
 """K-Medoids clustering (reference: heat/cluster/kmedoids.py:10-150 — Lloyd
-skeleton with the updated centroid snapped to the nearest actual data
-point)."""
+skeleton with Manhattan assignment (``metric=manhattan``, reference
+kmedoids.py:48) and the updated centroid snapped to the actual data point
+closest to the member median (reference `_update_centroids` :55-110)."""
 
 from __future__ import annotations
 
@@ -12,34 +13,51 @@ import jax.numpy as jnp
 
 from ..core import types
 from ..core.dndarray import DNDarray
-from ._kcluster import _KCluster, _d2
+from ._kcluster import _KCluster, _d1
+from .kmedians import _median_update
 
 __all__ = ["KMedoids"]
 
 
-@partial(jax.jit, static_argnums=(3,))
-def _medoid_step(xb: jax.Array, w: jax.Array, centers: jax.Array, k: int):
-    d2 = _d2(xb, centers)
-    labels = jnp.argmin(d2, axis=1)
+@partial(jax.jit, static_argnames=("max_iter",))
+def _medoid_fit(xb: jax.Array, w: jax.Array, centers: jax.Array, max_iter: int, tol):
+    """Whole fit loop on-device (see kmeans._lloyd_fit for the rationale).
+
+    Update rule per the reference: per-cluster per-dimension median, then
+    snap to the L1-closest valid data point (searched over the full data set,
+    reference kmedoids.py:99-110); empty clusters keep their center (the
+    reference draws a random sample instead, :86-98 — deterministic
+    keep-old is the jit-stable choice, documented deviation)."""
     valid = w > 0
-    onehot = (labels[:, None] == jnp.arange(k)[None, :]).astype(xb.dtype) * w[:, None]
-    counts = jnp.sum(onehot, axis=0)
-    means = jnp.where(
-        counts[:, None] > 0, (onehot.T @ xb) / jnp.maximum(counts, 1.0)[:, None], centers
+
+    def snap(med, c_old, any_member):
+        d = jnp.sum(jnp.abs(xb - med[None, :]), axis=1)
+        d = jnp.where(valid, d, jnp.inf)
+        return jnp.where(any_member, xb[jnp.argmin(d)], c_old)
+
+    def cond(carry):
+        _, it, shift = carry
+        return jnp.logical_and(it < max_iter, shift > tol)
+
+    def body(carry):
+        c, it, _ = carry
+        d1 = _d1(xb, c)
+        labels = jnp.argmin(d1, axis=1)
+        medians = _median_update(xb, labels, valid, c)
+        member_any = jax.vmap(lambda k: jnp.any((labels == k) & valid))(
+            jnp.arange(c.shape[0])
+        )
+        new_c = jax.vmap(snap)(medians, c, member_any)
+        shift = jnp.sum((new_c - c) ** 2)
+        return new_c, it + 1, shift
+
+    centers, n_iter, _ = jax.lax.while_loop(
+        cond, body, (centers, jnp.int32(0), jnp.asarray(jnp.inf, xb.dtype))
     )
-
-    # snap each mean to the closest member point (the medoid snap)
-    def snap(c):
-        member = (labels == c) & valid
-        dist = jnp.sum((xb - means[c][None, :]) ** 2, axis=1)
-        dist = jnp.where(member, dist, jnp.inf)
-        idx = jnp.argmin(dist)
-        return jnp.where(jnp.any(member), xb[idx], centers[c])
-
-    new_centers = jax.vmap(snap)(jnp.arange(k))
-    inertia = jnp.sum(jnp.sqrt(jnp.min(d2, axis=1)) * w)
-    shift = jnp.sum((new_centers - centers) ** 2)
-    return new_centers, labels, inertia, shift
+    d1 = _d1(xb, centers)
+    labels = jnp.argmin(d1, axis=1)
+    inertia = jnp.sum(jnp.min(d1, axis=1) * w)
+    return centers, labels, inertia, n_iter
 
 
 class KMedoids(_KCluster):
@@ -50,10 +68,13 @@ class KMedoids(_KCluster):
         n_clusters: int = 8,
         init: Union[str, DNDarray] = "random",
         max_iter: int = 300,
-        tol: float = 1e-4,
         random_state: Optional[int] = None,
     ):
-        super().__init__("euclidean", n_clusters, init, max_iter, tol, random_state)
+        if init == "kmedoids++":
+            init = "probability_based"
+        # reference fixes tol=0.0 (kmedoids.py:52): iterate until the medoids
+        # stop moving or max_iter
+        super().__init__("manhattan", n_clusters, init, max_iter, 0.0, random_state)
 
     def fit(self, x: DNDarray) -> "KMedoids":
         """Medoid-update Lloyd iterations (reference kmedoids.py `fit`)."""
@@ -63,17 +84,14 @@ class KMedoids(_KCluster):
             raise ValueError("input needs to be 2D")
         dt, xb, w, centers = self._fit_buffers(x)
 
-        labels, inertia, n_iter = None, None, 0
-        for it in range(self.max_iter):
-            centers, labels, inertia, shift = _medoid_step(xb, w, centers, self.n_clusters)
-            n_iter = it + 1
-            if float(shift) <= self.tol:
-                break
+        centers, labels, inertia, n_iter = _medoid_fit(
+            xb, w, centers, self.max_iter, jnp.asarray(self.tol, xb.dtype)
+        )
 
         self._cluster_centers = DNDarray.from_logical(centers, None, x.device, x.comm, dt)
         self._labels = DNDarray(
             labels.astype(jnp.int64), (x.shape[0],), types.int64, x.split, x.device, x.comm, True
         )
         self._inertia = float(inertia)
-        self._n_iter = n_iter
+        self._n_iter = int(n_iter)
         return self

@@ -119,14 +119,21 @@ class Spectral(BaseEstimator, ClusteringMixin):
         return self
 
     def predict(self, x: DNDarray) -> DNDarray:
-        """Labels for the fitted data (reference spectral.py `predict`
-        requires the same data; the embedding is transductive, so unseen
-        samples cannot be embedded)."""
+        """Labels for ``x``: x is re-embedded by a fresh eigenspectrum
+        computation and classified against the fitted KMeans centroids
+        (reference spectral.py:174-201 — note the embedding is recomputed
+        from x's own similarity graph, so this is only meaningful for data
+        drawn from the fitted distribution; the reference carries the same
+        caveat in its docstring Warning)."""
         if self._embedding is None:
             raise RuntimeError("fit needs to be called before predict")
-        if x.shape[0] != self._embedding.shape[0]:
-            raise NotImplementedError(
-                "Spectral is transductive: predict supports only the data it was fit on "
-                f"(fit on {self._embedding.shape[0]} samples, got {x.shape[0]})"
-            )
-        return self._cluster.predict(self._embedding)
+        if not isinstance(x, DNDarray):
+            raise TypeError(f"input needs to be a DNDarray, but was {type(x)}")
+        if x.split is not None and x.split != 0:
+            raise NotImplementedError("Not implemented for other splitting-axes")
+        _, eigvec = self._spectral_embedding(x)
+        components = eigvec[:, : self.n_clusters]
+        comp = DNDarray.from_logical(
+            components._logical().astype(jnp.float32), x.split, x.device, x.comm
+        )
+        return self._cluster.predict(comp)

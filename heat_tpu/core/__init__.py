@@ -25,5 +25,6 @@ from .io import *
 from .base import *
 from . import random
 from . import linalg
+from .linalg import *
 from . import version
 from .version import version as __version__

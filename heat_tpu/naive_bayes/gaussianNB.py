@@ -65,7 +65,9 @@ class GaussianNB(BaseEstimator, ClassificationMixin):
                 raise ValueError("sample_weight length must match number of samples")
             w = w.at[: sw.shape[0]].multiply(sw)
 
-        classes = np.unique(np.asarray(yl)) if _classes is None else np.asarray(_classes)
+        # np.unique both deduplicates and SORTS — partial_fit's searchsorted
+        # moment merge below relies on classes_ being sorted
+        classes = np.unique(np.asarray(yl)) if _classes is None else np.unique(np.asarray(_classes))
         self.classes_ = DNDarray.from_logical(jnp.asarray(classes), None, x.device, x.comm)
         k = len(classes)
 
@@ -79,10 +81,9 @@ class GaussianNB(BaseEstimator, ClassificationMixin):
         sq = onehot.T @ (xl * xl)
         var = sq / jnp.maximum(counts, 1.0)[:, None] - means * means
 
-        self.epsilon_ = float(self.var_smoothing * jnp.max(jnp.var(
-            jnp.where(w[:, None] > 0, xl, jnp.nan), axis=0, where=~jnp.isnan(
-                jnp.where(w[:, None] > 0, xl, jnp.nan))
-        )))
+        self.epsilon_ = float(
+            self.var_smoothing * jnp.max(jnp.var(xl, axis=0, where=(w > 0)[:, None]))
+        )
         var = var + self.epsilon_
 
         self.theta_ = DNDarray.from_logical(means, None, x.device, x.comm)

@@ -78,7 +78,6 @@ class Lasso(BaseEstimator, RegressionMixin):
 
     def soft_threshold(self, rho: DNDarray):
         """Soft-thresholding operator (reference lasso.py:90)."""
-        from ..core import arithmetics, rounding
 
         import jax.numpy as _jnp
 

@@ -18,7 +18,6 @@ On TPU two paths replace it:
 
 from __future__ import annotations
 
-import builtins
 from functools import partial
 from typing import Callable, Optional
 
@@ -50,6 +49,41 @@ def _pairwise_euclidean(x: jax.Array, y: jax.Array) -> jax.Array:
 
 def _pairwise_manhattan(x: jax.Array, y: jax.Array) -> jax.Array:
     return jnp.sum(jnp.abs(x[:, None, :] - y[None, :, :]), axis=-1)
+
+
+def _blocked_rows(fn, x: jax.Array, y: jax.Array, budget_bytes: int = 1 << 28) -> jax.Array:
+    """Apply a pairwise *broadcast-form* block fn over row blocks of ``x`` so
+    the (block, n, k) broadcast temporary stays under ``budget_bytes`` (the
+    reference streams blocks rank-to-rank for the same reason,
+    distance.py:280-326; single-chip the stream becomes a `lax.map` over row
+    tiles). GEMM-form fns need no blocking — call them directly."""
+    m, k = x.shape
+    n = y.shape[0]
+    per_row = max(1, n * k * x.dtype.itemsize)
+    bs = max(1, min(m, budget_bytes // per_row))
+    if bs >= m:
+        return fn(x, y)
+    nb = -(-m // bs)
+    xp = jnp.pad(x, ((0, nb * bs - m), (0, 0)))
+    out = jax.lax.map(lambda xb: fn(xb, y), xp.reshape(nb, bs, k))
+    return out.reshape(nb * bs, n)[:m]
+
+
+# Stable module-level block fns (identity-stable so the jit cache below hits).
+_blocked_euclidean = partial(_blocked_rows, _pairwise_euclidean)
+_blocked_manhattan = partial(_blocked_rows, _pairwise_manhattan)
+
+
+@partial(jax.jit, static_argnums=(0, 3))
+def _local_dist(block_fn, xm: jax.Array, ym: jax.Array, dt) -> jax.Array:
+    """Single-dispatch local distance computation: cast + block fn compiled
+    as one XLA program (eager per-op dispatch costs a host round-trip each)."""
+    return block_fn(xm.astype(dt), ym.astype(dt))
+
+
+@jax.jit
+def _rbf_from_dist(d: jax.Array, gamma) -> jax.Array:
+    return jnp.exp(-gamma * d * d)
 
 
 def _ring_dist(x: DNDarray, y: DNDarray, block_fn: Callable) -> jax.Array:
@@ -136,9 +170,7 @@ def _dist(x: DNDarray, y: Optional[DNDarray], block_fn: Callable, ring_ok: bool,
         out = out[:, :n]
         return DNDarray(out, (m, n), promoted, out_split, x.device, x.comm, True)
 
-    xm = x.larray.astype(promoted.jnp_type())
-    ym = y._logical().astype(promoted.jnp_type())
-    out = block_fn(xm, ym)
+    out = _local_dist(block_fn, x.larray, y._logical(), promoted.jnp_type())
     return DNDarray(out, (m, n), promoted, out_split, x.device, x.comm, True)
 
 
@@ -148,13 +180,13 @@ def cdist(X: DNDarray, Y: Optional[DNDarray] = None, quadratic_expansion: bool =
     ``quadratic_expansion`` selects the GEMM form (reference offers the same
     switch); ``ring=True`` (extension) forces the ppermute ring kernel for
     O(n·m/p) per-chip memory when both operands are row-split."""
-    fn = _quadratic_euclidean if quadratic_expansion else _pairwise_euclidean
+    fn = _quadratic_euclidean if quadratic_expansion else _blocked_euclidean
     return _dist(X, Y, fn, ring_ok=True, ring=ring)
 
 
 def manhattan(X: DNDarray, Y: Optional[DNDarray] = None, expand: bool = False, ring: bool = False) -> DNDarray:
     """City-block distance matrix (reference distance.py:186)."""
-    return _dist(X, Y, _pairwise_manhattan, ring_ok=True, ring=ring)
+    return _dist(X, Y, _blocked_manhattan, ring_ok=True, ring=ring)
 
 
 def rbf(
@@ -166,12 +198,6 @@ def rbf(
 ) -> DNDarray:
     """Gaussian kernel matrix exp(−‖x−y‖²/2σ²) (reference distance.py:159)."""
     gamma = 1.0 / (2.0 * sigma * sigma)
-
-    def block(x, y):
-        if quadratic_expansion:
-            d = _quadratic_euclidean(x, y)
-        else:
-            d = _pairwise_euclidean(x, y)
-        return jnp.exp(-gamma * d * d)
-
-    return _dist(X, Y, block, ring_ok=True, ring=ring)
+    d = cdist(X, Y, quadratic_expansion=quadratic_expansion, ring=ring)
+    out = _rbf_from_dist(d.larray, jnp.asarray(gamma, d.larray.dtype))
+    return DNDarray(out, d.shape, d.dtype, d.split, d.device, d.comm, True)
