@@ -29,3 +29,4 @@ from . import naive_bayes
 from . import regression
 from . import spatial
 from . import utils
+from . import parallel

@@ -1,0 +1,30 @@
+"""Parallelism primitives — sequence/context parallelism as first-class ops.
+
+The reference has no attention code, but it ships the *mechanisms* that
+sequence parallelism is made of (SURVEY §5): the ring-pipelined stationary/
+circulating block schedule (reference heat/spatial/distance.py:280-326), the
+axis-aware Alltoall reshard (reference heat/core/communication.py:1180-1322 —
+exactly the Ulysses head↔sequence swap), and halo exchange (reference
+heat/core/dndarray.py:360-433). This package re-expresses those three as
+TPU-native kernels (`shard_map` + `ppermute`/`all_to_all` over the mesh) and
+builds long-context attention on top of them:
+
+* :func:`ring_pipeline` — the generic stationary/circulating schedule.
+* :func:`ring_attention` — blockwise flash attention with K/V circulated
+  around the ring (Liu et al. 2023 schedule), sequence axis sharded.
+* :func:`ulysses_attention` — all_to_all sequence↔head reshard, local
+  attention, reshard back (Jacobs et al. 2023 schedule).
+* :func:`halo_exchange` — neighbor-overlap slices for stencil ops.
+"""
+
+from .ring import ring_pipeline
+from .attention import local_attention, ring_attention, ulysses_attention
+from .halo import halo_exchange
+
+__all__ = [
+    "ring_pipeline",
+    "local_attention",
+    "ring_attention",
+    "ulysses_attention",
+    "halo_exchange",
+]

@@ -1,0 +1,225 @@
+"""Long-context attention over a sequence-sharded mesh.
+
+The reference framework has no transformer code; its parity mechanisms are
+the ring block schedule (reference heat/spatial/distance.py:280-326) and the
+axis-aware Alltoall (reference heat/core/communication.py:1180-1322). This
+module is the capability those mechanisms exist for, built TPU-first:
+
+* :func:`ring_attention` — blockwise softmax(QKᵀ)V with K/V blocks circulated
+  around the ICI ring (`ppermute`) and flash-style online renormalization, so
+  a sequence of length T sharded p ways never materializes a (T, T) matrix
+  and each chip holds O(T/p) activations.
+* :func:`ulysses_attention` — `all_to_all` swaps the sharded axis from
+  sequence to heads, runs dense local attention per head group, and swaps
+  back. Cheaper per step than the ring when heads ≥ p, at the cost of two
+  all_to_alls.
+* :func:`local_attention` — the single-device blockwise kernel both build on.
+
+Shapes follow jax convention ``(batch, seq, heads, head_dim)``; the sharded
+axis is ``seq`` (axis 1) on input and output for both distributed variants.
+All kernels are jit-pure and differentiable (the backward pass re-runs the
+ring under autodiff; `jax.checkpoint` the caller for O(T/p) memory).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _block_attn(q, k, v, m, l, o, q_start, k_start, scale, causal, kv_len_valid):
+    """One flash-attention accumulation step on local blocks.
+
+    q: (B, Tq, H, D); k, v: (B, Tk, H, D); m, l: (B, H, Tq); o like q.
+    ``q_start``/``k_start`` are the blocks' global sequence offsets (traced
+    scalars) used for causal masking; ``kv_len_valid`` masks K tail padding.
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    tk = k.shape[1]
+    k_pos = k_start + jnp.arange(tk)
+    mask = k_pos[None, :] < kv_len_valid  # (1, Tk) — valid K positions
+    if causal:
+        q_pos = q_start + jnp.arange(q.shape[1])
+        mask = mask & (k_pos[None, :] <= q_pos[:, None])
+    s = jnp.where(mask[None, None, :, :], s, NEG_INF)
+
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    # guard fully-masked rows: keep m finite so exp() stays well-defined
+    m_safe = jnp.where(m_new <= NEG_INF / 2, 0.0, m_new)
+    p = jnp.exp(s - m_safe[..., None])
+    p = jnp.where(mask[None, None, :, :], p, 0.0)
+    alpha = jnp.exp(jnp.where(m <= NEG_INF / 2, NEG_INF, m) - m_safe)
+    alpha = jnp.where(m <= NEG_INF / 2, 0.0, alpha)
+    l_new = alpha * l + p.sum(axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    o_new = o * alpha.transpose(0, 2, 1)[..., None] + pv
+    return m_new, l_new, o_new
+
+
+def _finalize(m, l, o):
+    denom = jnp.where(l == 0.0, 1.0, l)
+    return o / denom.transpose(0, 2, 1)[..., None]
+
+
+def local_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    block_size: int = 512,
+    kv_valid: Optional[int] = None,
+) -> jax.Array:
+    """Blockwise (flash) attention on one device. ``(B, T, H, D)`` layout.
+
+    K/V are processed in ``block_size`` chunks with online softmax — the same
+    accumulator the distributed variants carry around the ring, so numerics
+    are identical across all three entry points. K/V positions ``>= kv_valid``
+    are treated as padding and masked out.
+    """
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    kv_valid = tk if kv_valid is None else kv_valid
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    nblk = max(1, -(-tk // block_size))
+    pad = nblk * block_size - tk
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+
+    # derive the accumulators from q (zeros_like-style) so that when this
+    # kernel runs inside a shard_map the carry inherits q's device-varying
+    # type — a literal jnp.zeros would be replicated and break the fori_loop
+    # carry typing
+    zero_q = jnp.zeros_like(q, dtype=jnp.float32)
+    m = zero_q.sum(axis=-1).transpose(0, 2, 1) + NEG_INF  # (B, H, Tq)
+    l = zero_q.sum(axis=-1).transpose(0, 2, 1)
+    o = zero_q
+
+    def body(i, carry):
+        m, l, o = carry
+        k_start = i * block_size
+        kb = jax.lax.dynamic_slice_in_dim(k, k_start, block_size, axis=1)
+        vb = jax.lax.dynamic_slice_in_dim(v, k_start, block_size, axis=1)
+        return _block_attn(
+            q.astype(jnp.float32), kb.astype(jnp.float32),
+            vb.astype(jnp.float32), m, l, o, 0, k_start, scale, causal,
+            kv_valid,
+        )
+
+    m, l, o = jax.lax.fori_loop(0, nblk, body, (m, l, o))
+    return _finalize(m, l, o).astype(q.dtype)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    comm,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    seq_len: Optional[int] = None,
+) -> jax.Array:
+    """Ring attention over a sequence-sharded mesh (Liu et al. 2023).
+
+    ``q``, ``k``, ``v``: ``(B, T_pad, H, D)`` sharded along axis 1 over
+    ``comm``'s mesh (``T_pad`` divisible by ``comm.size``; positions
+    ``>= seq_len`` are padding and are masked out of the softmax). Each mesh
+    position keeps its Q block stationary and circulates its K/V block one
+    hop per step; the flash accumulator makes the p partial softmaxes exact.
+    Communication rides ICI and overlaps with the per-step MXU work.
+    """
+    p = comm.size
+    axis = comm.axis_name
+    b, t_pad, h, d = q.shape
+    seq_len = t_pad if seq_len is None else seq_len
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    tc = t_pad // p
+    perm = [(i, (i + 1) % p) for i in range(p)]
+
+    def kernel(qb, kb, vb):
+        rank = jax.lax.axis_index(axis)
+        m = jnp.full((b, h, tc), NEG_INF, dtype=jnp.float32)
+        l = jnp.zeros((b, h, tc), dtype=jnp.float32)
+        o = jnp.zeros((b, tc, h, d), dtype=jnp.float32)
+        # freshly-built accumulators are replicated; the scan carry must be
+        # device-varying because it mixes with the sharded q/k/v blocks
+        m, l, o = (jax.lax.pcast(a, (axis,), to="varying") for a in (m, l, o))
+        qf = qb.astype(jnp.float32)
+
+        def body(t, carry):
+            kc, vc, m, l, o = carry
+            origin = (rank - t) % p
+            m, l, o = _block_attn(
+                qf, kc.astype(jnp.float32), vc.astype(jnp.float32),
+                m, l, o, rank * tc, origin * tc, scale, causal, seq_len,
+            )
+            kc = jax.lax.ppermute(kc, axis, perm=perm)
+            vc = jax.lax.ppermute(vc, axis, perm=perm)
+            return (kc, vc, m, l, o)
+
+        kc, vc, m, l, o = jax.lax.fori_loop(0, p, body, (kb, vb, m, l, o))
+        return _finalize(m, l, o).astype(qb.dtype)
+
+    spec = comm.spec(1, 4)
+    return jax.shard_map(
+        kernel, mesh=comm.mesh, in_specs=(spec, spec, spec), out_specs=spec
+    )(q, k, v)
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    comm,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    seq_len: Optional[int] = None,
+    block_size: int = 512,
+) -> jax.Array:
+    """Ulysses sequence parallelism (Jacobs et al. 2023).
+
+    ``all_to_all`` swaps sharding sequence→heads (each position then holds
+    the full sequence for H/p heads), runs the dense blockwise kernel, and
+    swaps back. This is the TPU-native form of the reference's axis-aware
+    Alltoall reshard (reference heat/core/communication.py:1180-1322).
+    Requires ``H`` divisible by ``comm.size``.
+    """
+    p = comm.size
+    axis = comm.axis_name
+    b, t_pad, h, d = q.shape
+    if h % p != 0:
+        raise ValueError(f"heads ({h}) must divide over mesh size ({p})")
+    seq_len = t_pad if seq_len is None else seq_len
+
+    def kernel(qb, kb, vb):
+        # (B, T/p, H, D) -> (B, T, H/p, D): gather seq, scatter heads
+        a2a = functools.partial(
+            jax.lax.all_to_all, axis_name=axis, split_axis=2, concat_axis=1,
+            tiled=True,
+        )
+        qh, kh, vh = a2a(qb), a2a(kb), a2a(vb)
+        oh = local_attention(
+            qh, kh, vh, causal=causal, scale=scale, block_size=block_size,
+            kv_valid=seq_len,
+        )
+        back = functools.partial(
+            jax.lax.all_to_all, axis_name=axis, split_axis=1, concat_axis=2,
+            tiled=True,
+        )
+        return back(oh)
+
+    spec = comm.spec(1, 4)
+    out = jax.shard_map(
+        kernel, mesh=comm.mesh, in_specs=(spec, spec, spec), out_specs=spec
+    )(q, k, v)
+    return out

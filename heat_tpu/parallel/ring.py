@@ -1,0 +1,87 @@
+"""Generic ring pipeline: stationary block + circulating blocks.
+
+This is the communication schedule the reference hand-writes twice — for the
+pairwise distance matrix (reference heat/spatial/distance.py:280-326:
+stationary x-block, y-blocks circulated rank→rank+1 with Send/Recv) and for
+`linalg.outer` (reference heat/core/linalg/basics.py:1056). It is also
+exactly the ring-attention schedule (stationary Q, circulating K/V). Here it
+is one reusable `shard_map` kernel: `ppermute` moves the circulating operand
+one hop per step over ICI while the MXU works on the current block, and XLA
+overlaps the two.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+
+def ring_pipeline(
+    step_fn: Callable,
+    stationary,
+    circulating,
+    init_carry,
+    *,
+    comm,
+    shift: int = 1,
+):
+    """Run ``p`` ring steps of ``carry = step_fn(t, origin, stationary,
+    circulating, carry)`` inside one compiled `shard_map` kernel.
+
+    Parameters
+    ----------
+    step_fn : callable
+        ``(t, origin, stationary, circulating, carry) -> carry`` where ``t``
+        is the step index and ``origin`` the mesh position the circulating
+        block currently held was sourced from (both traced scalars). Must be
+        jit-pure; runs on the device-local blocks.
+    stationary : pytree of jax.Array
+        Sharded along their leading axis; never moves.
+    circulating : pytree of jax.Array
+        Sharded along their leading axis; rotated one hop per step.
+    init_carry : pytree
+        Initial accumulator; built per-shard from zeros/full shapes. Arrays
+        are promoted to device-varying automatically.
+    comm : MeshCommunication
+        Supplies mesh, axis name and size.
+    shift : int
+        Ring direction; +1 sends shard i → i+1.
+
+    Returns
+    -------
+    The final carry, as a `shard_map` output sharded along the leading axis
+    (carry leaves keep their per-shard shape).
+    """
+    p = comm.size
+    axis = comm.axis_name
+    perm = [(i, (i + shift) % p) for i in range(p)]
+
+    def kernel(stat, circ, carry):
+        rank = jax.lax.axis_index(axis)
+
+        def body(t, loop_carry):
+            circ_t, acc = loop_carry
+            # after t hops along +shift, shard r holds the block that
+            # originated at (r - t*shift) mod p
+            origin = (rank - t * shift) % p
+            acc = step_fn(t, origin, stat, circ_t, acc)
+            circ_t = jax.tree.map(
+                lambda x: jax.lax.ppermute(x, axis, perm=perm), circ_t
+            )
+            return (circ_t, acc)
+
+        _, carry = jax.lax.fori_loop(0, p, body, (circ, carry))
+        return carry
+
+    spec_of = lambda x: comm.spec(0, x.ndim)
+    in_stat_specs = jax.tree.map(spec_of, stationary)
+    in_circ_specs = jax.tree.map(spec_of, circulating)
+    carry_specs = jax.tree.map(spec_of, init_carry)
+    return jax.shard_map(
+        kernel,
+        mesh=comm.mesh,
+        in_specs=(in_stat_specs, in_circ_specs, carry_specs),
+        out_specs=carry_specs,
+    )(stationary, circulating, init_carry)
