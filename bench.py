@@ -69,8 +69,13 @@ def bench_heat_tpu():
     x = ht.random.rand(m, k, dtype=ht.float32, split=0)
 
     def cd_chain():
-        outs = [ht.spatial.cdist(x, x, quadratic_expansion=True) for _ in range(reps)]
-        return sync(outs[-1].larray)
+        # reassign one variable per rep: dispatch is in-order single-stream,
+        # so this queues identical work while letting finished 1 GB result
+        # buffers free instead of holding all `reps` alive at once
+        out = None
+        for _ in range(reps):
+            out = ht.spatial.cdist(x, x, quadratic_expansion=True)
+        return sync(out.larray)
 
     cd_chain()
     t = _best_time(cd_chain, repeats=2)

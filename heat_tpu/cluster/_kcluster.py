@@ -38,25 +38,14 @@ def _d2(xb: "jax.Array", centers: "jax.Array") -> "jax.Array":
     return jnp.maximum(x2 + c2 - 2.0 * prod, 0.0)
 
 
-def _d1(xb: "jax.Array", centers: "jax.Array", budget_bytes: int = 1 << 28) -> "jax.Array":
+def _d1(xb: "jax.Array", centers: "jax.Array") -> "jax.Array":
     """(m, k) Manhattan distances — the assignment metric of KMedians and
     KMedoids (reference kmedians.py:49, kmedoids.py:48: both fix
-    ``metric=manhattan``). L1 has no GEMM form, so the (block, k, d)
-    broadcast temporary is bounded by mapping over row blocks."""
-    m, d = xb.shape
-    k = centers.shape[0]
+    ``metric=manhattan``). Delegates to the spatial row-blocked kernel so the
+    memory-budget logic lives in one place."""
+    from ..spatial.distance import _blocked_manhattan
 
-    def block(b):
-        return jnp.sum(jnp.abs(b[:, None, :] - centers[None, :, :]), axis=-1)
-
-    per_row = max(1, k * d * xb.dtype.itemsize)
-    bs = max(1, min(m, budget_bytes // per_row))
-    if bs >= m:
-        return block(xb)
-    nb = -(-m // bs)
-    xp = jnp.pad(xb, ((0, nb * bs - m), (0, 0)))
-    out = jax.lax.map(block, xp.reshape(nb, bs, d))
-    return out.reshape(nb * bs, k)[:m]
+    return _blocked_manhattan(xb, centers)
 
 
 def _pad_weights(xb: "jax.Array", n_logical: int) -> "jax.Array":

@@ -19,13 +19,16 @@ __all__ = ["KMedians"]
 
 def _median_update(xb: jax.Array, labels: jax.Array, valid: jax.Array, centers: jax.Array):
     """Per-cluster per-dimension median over members; empty clusters keep
-    their center (reference kmedians.py `_update_centroids`)."""
+    their center (reference kmedians.py `_update_centroids`). Returns
+    ``(medians, any_member)`` so callers that need the empty-cluster mask
+    (KMedoids' snap step) don't recompute membership."""
 
     def upd(c):
         member = (labels == c) & valid
         masked = jnp.where(member[:, None], xb, jnp.nan)
         med = jnp.nanmedian(masked, axis=0)
-        return jnp.where(jnp.any(member), med, centers[c])
+        has = jnp.any(member)
+        return jnp.where(has, med, centers[c]), has
 
     return jax.vmap(upd)(jnp.arange(centers.shape[0]))
 
@@ -43,7 +46,7 @@ def _median_fit(xb: jax.Array, w: jax.Array, centers: jax.Array, max_iter: int, 
         c, it, _ = carry
         d1 = _d1(xb, c)
         labels = jnp.argmin(d1, axis=1)
-        new_c = _median_update(xb, labels, valid, c)
+        new_c, _ = _median_update(xb, labels, valid, c)
         shift = jnp.sum((new_c - c) ** 2)
         return new_c, it + 1, shift
 

@@ -43,10 +43,7 @@ def _medoid_fit(xb: jax.Array, w: jax.Array, centers: jax.Array, max_iter: int, 
         c, it, _ = carry
         d1 = _d1(xb, c)
         labels = jnp.argmin(d1, axis=1)
-        medians = _median_update(xb, labels, valid, c)
-        member_any = jax.vmap(lambda k: jnp.any((labels == k) & valid))(
-            jnp.arange(c.shape[0])
-        )
+        medians, member_any = _median_update(xb, labels, valid, c)
         new_c = jax.vmap(snap)(medians, c, member_any)
         shift = jnp.sum((new_c - c) ** 2)
         return new_c, it + 1, shift

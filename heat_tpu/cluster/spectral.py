@@ -98,6 +98,15 @@ class Spectral(BaseEstimator, ClusteringMixin):
             DNDarray.from_logical(full_vec, x.split, x.device, x.comm),
         )
 
+    def _embed(self, x: DNDarray, eigvec: DNDarray) -> DNDarray:
+        """Slice the k lowest eigenvectors and rewrap as the float32
+        clustering space — shared by fit and predict so both always classify
+        in the same embedding."""
+        components = eigvec[:, : self.n_clusters]
+        return DNDarray.from_logical(
+            components._logical().astype(jnp.float32), x.split, x.device, x.comm
+        )
+
     def fit(self, x: DNDarray) -> "Spectral":
         """Embed and cluster (reference spectral.py:134)."""
         if not isinstance(x, DNDarray):
@@ -109,10 +118,7 @@ class Spectral(BaseEstimator, ClusteringMixin):
             diff = np.diff(ev)
             self.n_clusters = int(np.argmax(diff) + 1)
             self._cluster.n_clusters = self.n_clusters
-        components = eigvec[:, : self.n_clusters]
-        comp = DNDarray.from_logical(
-            components._logical().astype(jnp.float32), x.split, x.device, x.comm
-        )
+        comp = self._embed(x, eigvec)
         self._embedding = comp
         self._cluster.fit(comp)
         self._labels = self._cluster.labels_
@@ -132,8 +138,4 @@ class Spectral(BaseEstimator, ClusteringMixin):
         if x.split is not None and x.split != 0:
             raise NotImplementedError("Not implemented for other splitting-axes")
         _, eigvec = self._spectral_embedding(x)
-        components = eigvec[:, : self.n_clusters]
-        comp = DNDarray.from_logical(
-            components._logical().astype(jnp.float32), x.split, x.device, x.comm
-        )
-        return self._cluster.predict(comp)
+        return self._cluster.predict(self._embed(x, eigvec))
