@@ -30,3 +30,5 @@ from . import regression
 from . import spatial
 from . import utils
 from . import parallel
+from . import nn
+from . import optim

@@ -1,0 +1,26 @@
+"""heat_tpu.nn — data-parallel module wrappers + flax passthrough.
+
+The reference mounts ``torch.nn`` behind a module-level ``__getattr__`` so
+``ht.nn.Conv2d`` *is* ``torch.nn.Conv2d`` (reference heat/nn/__init__.py:19-31),
+and adds its own :class:`DataParallel` wrappers on top. The TPU-native analog
+passes through to **flax.linen** (``ht.nn.Dense``, ``ht.nn.Conv`` …) — the
+module system of the JAX stack — with the distributed wrappers defined here.
+"""
+
+from . import functional
+from .data_parallel import DataParallel, DataParallelMultiGPU
+
+__all__ = ["DataParallel", "DataParallelMultiGPU", "functional"]
+
+
+def __getattr__(name):
+    """Fall through to ``flax.linen`` for anything not defined here
+    (reference heat/nn/__init__.py:19-31 does the same against torch.nn)."""
+    import flax.linen as _linen
+
+    try:
+        return getattr(_linen, name)
+    except AttributeError:
+        raise AttributeError(
+            f"module {name} not implemented in flax.linen or heat_tpu.nn"
+        ) from None

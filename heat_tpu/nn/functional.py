@@ -1,0 +1,84 @@
+"""Functional nn ops: jax.nn passthrough + distributed attention entry point.
+
+Reference parity: ``heat.nn.functional`` forwards to ``torch.nn.functional``
+(reference heat/nn/functional.py). Here unknown names resolve to ``jax.nn``
+(relu, gelu, softmax, one_hot, …); the module's own surface is the
+long-context attention front-end over :mod:`heat_tpu.parallel`.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dndarray import DNDarray
+from ..parallel import local_attention, ring_attention, ulysses_attention
+
+__all__ = ["scaled_dot_product_attention"]
+
+
+def scaled_dot_product_attention(
+    q: Union[jax.Array, DNDarray],
+    k: Union[jax.Array, DNDarray],
+    v: Union[jax.Array, DNDarray],
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    strategy: str = "auto",
+    comm=None,
+) -> Union[jax.Array, DNDarray]:
+    """softmax(QKᵀ/√d)V with ``(batch, seq, heads, head_dim)`` layout.
+
+    Dispatch: DNDarrays split along the sequence axis (axis 1) run the
+    distributed kernels — ``strategy`` picks ``"ring"`` (K/V circulated over
+    ICI, any head count) or ``"ulysses"`` (all_to_all head↔seq swap, needs
+    heads % mesh size == 0); ``"auto"`` prefers ulysses when it applies since
+    it does fewer hops. Everything else (replicated DNDarrays, raw arrays)
+    runs the single-device blockwise kernel.
+    """
+    is_dnd = isinstance(q, DNDarray)
+    if is_dnd:
+        if not (isinstance(k, DNDarray) and isinstance(v, DNDarray)):
+            raise TypeError("q, k, v must all be DNDarray or all jax.Array")
+        if not (q.split == k.split == v.split):
+            raise ValueError(
+                f"q/k/v splits must match, got {q.split}/{k.split}/{v.split}"
+            )
+        comm = q.comm
+        if q.ndim != 4:
+            raise ValueError(f"expected (B, T, H, D) inputs, got ndim={q.ndim}")
+        if q.split == 1 and comm.size > 1:
+            seq_len = q.shape[1]
+            h = q.shape[2]
+            if strategy == "auto":
+                strategy = "ulysses" if h % comm.size == 0 else "ring"
+            fn = {"ring": ring_attention, "ulysses": ulysses_attention}[strategy]
+            out = fn(
+                q._masked(0), k._masked(0), v._masked(0),
+                comm=comm, causal=causal, scale=scale, seq_len=seq_len,
+            )
+            return DNDarray(
+                out, q.shape, q.dtype, q.split, q.device, comm, True
+            )
+        if q.split not in (None, 1):
+            raise NotImplementedError(
+                f"attention over split={q.split} not supported; resplit to 1"
+            )
+        out = local_attention(
+            q._logical(), k._logical(), v._logical(), causal=causal, scale=scale
+        )
+        return DNDarray.from_logical(out, q.split, q.device, q.comm)
+
+    return local_attention(q, k, v, causal=causal, scale=scale)
+
+
+def __getattr__(name):
+    """jax.nn passthrough (reference functional.py func_getattr analog)."""
+    try:
+        return getattr(jax.nn, name)
+    except AttributeError:
+        raise AttributeError(
+            f"function {name} not implemented in jax.nn or heat_tpu.nn.functional"
+        ) from None

@@ -1,0 +1,30 @@
+"""heat_tpu.optim — distributed optimizers + optax passthrough.
+
+Reference: heat/optim/__init__.py re-exports its wrappers and falls through
+to ``torch.optim`` (:19-36). The TPU-native fallthrough target is **optax**:
+``ht.optim.adam``, ``ht.optim.sgd`` … resolve to the optax factories.
+"""
+
+from . import lr_scheduler, utils
+from .dp_optimizer import DASO, DataParallelOptimizer
+from .utils import DetectMetricPlateau
+
+__all__ = [
+    "DASO",
+    "DataParallelOptimizer",
+    "DetectMetricPlateau",
+    "lr_scheduler",
+    "utils",
+]
+
+
+def __getattr__(name):
+    """Fall through to optax (reference optim/__init__.py:19-36 pattern)."""
+    import optax as _optax
+
+    try:
+        return getattr(_optax, name)
+    except AttributeError:
+        raise AttributeError(
+            f"module {name} not implemented in optax or heat_tpu.optim"
+        ) from None

@@ -1,0 +1,82 @@
+"""Learning-rate schedules (reference: heat/optim/lr_scheduler.py).
+
+The reference re-exports every ``torch.optim.lr_scheduler`` class wrapped to
+call the underlying torch optimizer of a :class:`DataParallelOptimizer`. The
+optax world drives learning rates through *schedule functions* passed to the
+optimizer, so this module provides the torch-named factories users of the
+reference expect, each returning an optax schedule (step -> lr) that plugs
+straight into ``optax.scale_by_learning_rate`` / any optax optimizer's
+``learning_rate`` argument.
+"""
+
+from __future__ import annotations
+
+import optax
+
+__all__ = [
+    "StepLR",
+    "MultiStepLR",
+    "ExponentialLR",
+    "CosineAnnealingLR",
+    "ConstantLR",
+    "LinearLR",
+    "PolynomialLR",
+]
+
+
+def StepLR(lr: float, step_size: int, gamma: float = 0.1):
+    """lr decayed by ``gamma`` every ``step_size`` steps."""
+    return optax.exponential_decay(
+        init_value=lr, transition_steps=step_size, decay_rate=gamma, staircase=True
+    )
+
+
+def MultiStepLR(lr: float, milestones, gamma: float = 0.1):
+    """lr decayed by ``gamma`` at each milestone step."""
+    return optax.piecewise_constant_schedule(
+        init_value=lr,
+        boundaries_and_scales={int(m): gamma for m in milestones},
+    )
+
+
+def ExponentialLR(lr: float, gamma: float):
+    """lr decayed by ``gamma`` every step."""
+    return optax.exponential_decay(
+        init_value=lr, transition_steps=1, decay_rate=gamma
+    )
+
+
+def CosineAnnealingLR(lr: float, T_max: int, eta_min: float = 0.0):
+    """Cosine decay from ``lr`` to ``eta_min`` over ``T_max`` steps."""
+    return optax.cosine_decay_schedule(
+        init_value=lr, decay_steps=T_max, alpha=eta_min / lr if lr else 0.0
+    )
+
+
+def ConstantLR(lr: float, factor: float = 1.0 / 3.0, total_iters: int = 5):
+    """``lr*factor`` for the first ``total_iters`` steps, then ``lr``."""
+    return optax.piecewise_constant_schedule(
+        init_value=lr * factor,
+        boundaries_and_scales={int(total_iters): 1.0 / factor if factor else 1.0},
+    )
+
+
+def LinearLR(
+    lr: float,
+    start_factor: float = 1.0 / 3.0,
+    end_factor: float = 1.0,
+    total_iters: int = 5,
+):
+    """Linear ramp from ``lr*start_factor`` to ``lr*end_factor``."""
+    return optax.linear_schedule(
+        init_value=lr * start_factor,
+        end_value=lr * end_factor,
+        transition_steps=total_iters,
+    )
+
+
+def PolynomialLR(lr: float, total_iters: int = 5, power: float = 1.0):
+    """Polynomial decay to zero over ``total_iters`` steps."""
+    return optax.polynomial_schedule(
+        init_value=lr, end_value=0.0, power=power, transition_steps=total_iters
+    )
