@@ -38,6 +38,10 @@ def scaled_dot_product_attention(
     it does fewer hops. Everything else (replicated DNDarrays, raw arrays)
     runs the single-device blockwise kernel.
     """
+    if strategy not in ("auto", "ring", "ulysses"):
+        raise ValueError(
+            f"strategy must be 'auto', 'ring' or 'ulysses', got {strategy!r}"
+        )
     is_dnd = isinstance(q, DNDarray)
     if is_dnd:
         if not (isinstance(k, DNDarray) and isinstance(v, DNDarray)):

@@ -1,1 +1,7 @@
-"""Populated by the data-utils build stage."""
+"""heat_tpu.utils — data tooling + vision transform passthrough
+(reference: heat/utils/__init__.py)."""
+
+from . import data
+from . import vision_transforms
+
+__all__ = ["data", "vision_transforms"]

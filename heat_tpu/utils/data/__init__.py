@@ -1,1 +1,31 @@
-"""Populated by the data-utils build stage."""
+"""heat_tpu.utils.data — datasets, loaders, streaming IO, matrix gallery
+(reference: heat/utils/data/__init__.py)."""
+
+from . import matrixgallery
+from .datatools import DataLoader, Dataset, dataset_ishuffle, dataset_shuffle
+from .partial_dataset import (
+    PartialDataLoaderIter,
+    PartialDataset,
+    PartialH5Dataset,
+)
+
+__all__ = [
+    "DataLoader",
+    "Dataset",
+    "dataset_shuffle",
+    "dataset_ishuffle",
+    "PartialDataset",
+    "PartialH5Dataset",
+    "PartialDataLoaderIter",
+    "matrixgallery",
+]
+
+
+def __getattr__(name):
+    # torchvision-gated members resolve lazily so the package imports
+    # without torchvision
+    if name == "MNISTDataset":
+        from .mnist import MNISTDataset
+
+        return MNISTDataset
+    raise AttributeError(f"module heat_tpu.utils.data has no attribute {name}")

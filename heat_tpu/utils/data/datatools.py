@@ -1,0 +1,267 @@
+"""Datasets and loaders over sharded arrays (reference:
+heat/utils/data/datatools.py).
+
+The reference's :class:`DataLoader` wraps torch's loader over each rank's
+local shard and re-shuffles *across ranks* after every epoch by sending half
+of each rank's rows to the next rank and locally permuting
+(``dataset_shuffle``, reference datatools.py:246-299 — an approximate global
+shuffle built from p2p sends). Under the single-controller TPU runtime the
+global array is addressable as one sharded `jax.Array`, so the cross-process
+shuffle is *exact*: one threefry permutation gather, compiled by XLA into
+the same all-to-all traffic the reference hand-writes, with better mixing.
+``dataset_ishuffle`` keeps the reference's async contract: the gather is
+dispatched eagerly at epoch end and consumed (block-on-ready) at next epoch
+start, overlapping reshuffle communication with host-side epoch turnover.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterator, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.communication import MeshCommunication, sanitize_comm
+from ...core.dndarray import DNDarray
+
+__all__ = ["DataLoader", "Dataset", "dataset_shuffle", "dataset_ishuffle"]
+
+
+class Dataset:
+    """A dataset over one or more aligned DNDarrays (reference
+    datatools.py:143-244).
+
+    Holds ``data`` (and optionally ``targets``) split along axis 0. The
+    reference slices every rank's shard to the *minimum* shard length so all
+    ranks iterate the same number of batches (reference datatools.py:147-155
+    "slice off the remaining elements"); the analog here is trimming the
+    global length to a multiple of the mesh size at iteration time — done by
+    the DataLoader's batching, which only emits mesh-divisible batches.
+
+    Parameters
+    ----------
+    array : DNDarray
+        The samples, split=0 (or replicated).
+    targets : DNDarray, optional
+        Aligned labels.
+    ishuffle : bool
+        Use non-blocking (dispatch-early) shuffles between epochs.
+    test_set : bool
+        Never shuffle when True.
+    """
+
+    def __init__(
+        self,
+        array: DNDarray,
+        targets: Optional[DNDarray] = None,
+        ishuffle: bool = False,
+        test_set: bool = False,
+    ):
+        if not isinstance(array, DNDarray):
+            raise TypeError(f"array must be a DNDarray, got {type(array)}")
+        if array.split not in (None, 0):
+            raise ValueError(f"Dataset arrays must be split=0 or None, got {array.split}")
+        if targets is not None and not isinstance(targets, DNDarray):
+            raise TypeError(f"targets must be a DNDarray, got {type(targets)}")
+        self.htdata = array
+        self.httargets = targets
+        self.comm = array.comm
+        self.ishuffle = ishuffle
+        self.test_set = test_set
+        self._pending: Optional[List[jax.Array]] = None
+        self._rng_key = jax.random.key(0)
+
+    # -- reference-parity accessors ------------------------------------------
+
+    @property
+    def data(self) -> jax.Array:
+        """The (logical) sample buffer."""
+        return self.htdata._logical()
+
+    @property
+    def targets(self):
+        return None if self.httargets is None else self.httargets._logical()
+
+    def __len__(self) -> int:
+        return self.htdata.shape[0]
+
+    def __getitem__(self, index):
+        items = [self.data[index]]
+        if self.httargets is not None:
+            items.append(self.targets[index])
+        return tuple(items) if len(items) > 1 else items[0]
+
+    # -- shuffling ------------------------------------------------------------
+
+    def _arrays(self) -> List[DNDarray]:
+        out = [self.htdata]
+        if self.httargets is not None:
+            out.append(self.httargets)
+        return out
+
+    def Shuffle(self) -> None:
+        """Blocking global shuffle of data (and targets) along axis 0
+        (reference Dataset.Shuffle -> dataset_shuffle)."""
+        dataset_shuffle(self, [["data", "htdata"], ["targets", "httargets"]])
+
+    def Ishuffle(self) -> None:
+        """Dispatch the shuffle without waiting (reference Dataset.Ishuffle
+        -> dataset_ishuffle); harvested by the next epoch's iterator."""
+        dataset_ishuffle(self, [["data", "htdata"], ["targets", "httargets"]])
+
+
+def _shuffle_arrays(dataset, blocking: bool) -> None:
+    """Common engine: one permutation applied to every attached array."""
+    if dataset.test_set:
+        return
+    n = len(dataset)
+    dataset._rng_key, sub = jax.random.split(dataset._rng_key)
+    perm = jax.random.permutation(sub, n)
+
+    shuffled = []
+    for arr in dataset._arrays():
+        logical = arr._logical()
+        out = jnp.take(logical, perm, axis=0)
+        shuffled.append(out)
+    if blocking:
+        for arr, out in zip(dataset._arrays(), shuffled):
+            new = DNDarray.from_logical(out, arr.split, arr.device, arr.comm)
+            arr.larray = new.larray
+        jax.block_until_ready([a.larray for a in dataset._arrays()])
+        dataset._pending = None
+    else:
+        # async contract: dispatch now, harvest at next epoch start
+        dataset._pending = shuffled
+
+
+def _harvest_pending(dataset) -> None:
+    """Apply a previously dispatched Ishuffle (reference dataset_irecv,
+    datatools.py:343-375)."""
+    if dataset._pending is None:
+        return
+    for arr, out in zip(dataset._arrays(), dataset._pending):
+        new = DNDarray.from_logical(out, arr.split, arr.device, arr.comm)
+        arr.larray = new.larray
+    dataset._pending = None
+
+
+def dataset_shuffle(dataset, attrs: List[list]) -> None:
+    """Blocking cross-shard shuffle (reference datatools.py:246-299).
+
+    ``attrs`` is accepted for signature parity; the permutation is always
+    applied consistently to every array attached to the dataset."""
+    _shuffle_arrays(dataset, blocking=True)
+
+
+def dataset_ishuffle(dataset, attrs: List[list]) -> None:
+    """Non-blocking cross-shard shuffle (reference datatools.py:301-341):
+    dispatched immediately, harvested by the next iterator."""
+    _shuffle_arrays(dataset, blocking=False)
+
+
+class DataLoader:
+    """Iterable over mesh-sharded batches with inter-epoch global shuffling
+    (reference datatools.py:16-141).
+
+    Yields tuples of `jax.Array`s (data[, targets]) batch-sharded along axis
+    0 over the dataset's mesh — ready to feed a DataParallel/DASO train
+    step. Batches are always mesh-divisible: the effective batch size is
+    rounded down to a multiple of the mesh size and, like the reference
+    (which slices each rank's shard to the common minimum), at most one
+    ragged tail batch per epoch is dropped unless it is exactly divisible.
+
+    Parameters
+    ----------
+    dataset : Dataset or DNDarray
+        A DNDarray is wrapped in a :class:`Dataset` automatically.
+    batch_size : int
+        Global batch size.
+    shuffle : bool
+        Reshuffle between epochs (first epoch iterates in storage order,
+        matching the reference's shuffle-after-first-iter logic).
+    drop_last : bool
+        Drop the final non-divisible batch. Forced True when the batch
+        cannot be made mesh-divisible otherwise.
+    """
+
+    def __init__(
+        self,
+        dataset,
+        batch_size: int = 1,
+        shuffle: bool = True,
+        drop_last: bool = False,
+        collate_fn: Optional[Callable] = None,
+    ):
+        if isinstance(dataset, DNDarray):
+            dataset = Dataset(dataset)
+        if not isinstance(dataset, Dataset):
+            raise TypeError(
+                f"dataset must be a heat_tpu Dataset or DNDarray, got {type(dataset)}"
+            )
+        self.dataset = dataset
+        self.ishuffle = dataset.ishuffle
+        self.shuffle = shuffle
+        p = dataset.comm.size
+        if batch_size < p:
+            raise ValueError(
+                f"batch_size ({batch_size}) must be >= mesh size ({p})"
+            )
+        self.batch_size = (batch_size // p) * p
+        self.drop_last = drop_last
+        self.collate_fn = collate_fn
+        self._first_iter = True
+        self.last_epoch = False
+
+    def __len__(self) -> int:
+        n = len(self.dataset)
+        full, rem = divmod(n, self.batch_size)
+        if rem and not self.drop_last and rem % self.dataset.comm.size == 0:
+            return full + 1
+        return full
+
+    def _epoch_turnover(self) -> None:
+        """Shuffle logic between epochs (reference
+        _full_dataset_shuffle_iter, datatools.py:124-141)."""
+        if not self.shuffle or self.dataset.test_set:
+            return
+        if not self.ishuffle:
+            if self._first_iter:
+                self._first_iter = False
+            else:
+                self.dataset.Shuffle()
+        else:
+            # harvest the permutation dispatched at the *previous* epoch's
+            # turnover first, then dispatch the next one — reversing this
+            # order would consume the fresh dispatch synchronously and the
+            # overlap the async contract promises would never happen
+            if self._first_iter:
+                self._first_iter = False
+            else:
+                _harvest_pending(self.dataset)
+            if not self.last_epoch:
+                self.dataset.Ishuffle()
+
+    def __iter__(self) -> Iterator:
+        self._epoch_turnover()
+        comm = self.dataset.comm
+        data = self.dataset.data
+        targets = self.dataset.targets
+        n = data.shape[0]
+        bs = self.batch_size
+        nb = len(self)
+        for i in range(nb):
+            lo = i * bs
+            cur = min(bs, n - lo)
+            cur -= cur % comm.size
+            xb = jax.device_put(
+                data[lo : lo + cur], comm.sharding(0, data.ndim)
+            )
+            if targets is None:
+                batch = (xb,)
+            else:
+                yb = jax.device_put(
+                    targets[lo : lo + cur], comm.sharding(0, targets.ndim)
+                )
+                batch = (xb, yb)
+            yield self.collate_fn(*batch) if self.collate_fn else batch

@@ -1,0 +1,33 @@
+"""Synthetic test matrices (reference: heat/utils/data/matrixgallery.py)."""
+
+from __future__ import annotations
+
+from typing import Optional, Type, Union
+
+from ... import core
+from ...core.dndarray import DNDarray
+from ...core.types import datatype
+
+__all__ = ["parter"]
+
+
+def parter(
+    n: int,
+    split: Optional[int] = None,
+    device=None,
+    comm=None,
+    dtype: Type[datatype] = None,
+) -> DNDarray:
+    """The Parter matrix ``A[i, j] = 1 / (j - i + 0.5)`` — a Toeplitz matrix
+    whose singular values cluster at π (reference matrixgallery.py:15-61).
+
+    ``split`` ∈ {None, 0, 1} chooses the sharded axis of the result.
+    """
+    dtype = dtype if dtype is not None else core.float32
+    if split not in (None, 0, 1):
+        raise ValueError(f"expected split in {{None, 0, 1}}, but was {split}")
+    a = core.arange(n, dtype=dtype, device=device, comm=comm)
+    II = a.expand_dims(0)  # row index varies along axis 1
+    JJ = a.expand_dims(1)  # column index varies along axis 0
+    out = 1.0 / (II - JJ + 0.5)
+    return out if split is None else core.resplit(out, split)

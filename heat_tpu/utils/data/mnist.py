@@ -1,0 +1,73 @@
+"""MNIST dataset split across the mesh (reference: heat/utils/data/mnist.py).
+
+The reference subclasses ``torchvision.datasets.MNIST`` and keeps each
+rank's slice (reference mnist.py:16-129). torchvision is an optional
+dependency here; when present, :class:`MNISTDataset` loads via torchvision
+and wraps the arrays as a mesh-sharded :class:`heat_tpu.utils.data.Dataset`.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ...core import factories
+from .datatools import Dataset
+
+__all__ = ["MNISTDataset"]
+
+
+class MNISTDataset(Dataset):
+    """MNIST as a sharded Dataset.
+
+    Parameters
+    ----------
+    root : str
+        torchvision download/cache directory.
+    train : bool
+        Training or test split.
+    split : int or None
+        Shard axis for the image array (0 or None, as for any Dataset).
+    """
+
+    def __init__(
+        self,
+        root: str,
+        train: bool = True,
+        transform=None,
+        target_transform=None,
+        download: bool = True,
+        split: Optional[int] = 0,
+        ishuffle: bool = False,
+        test_set: bool = False,
+        comm=None,
+    ):
+        try:
+            from torchvision import datasets as tv_datasets
+        except ImportError as e:
+            raise ImportError(
+                "MNISTDataset requires torchvision, which is not installed"
+            ) from e
+        tv = tv_datasets.MNIST(
+            root,
+            train=train,
+            transform=transform,
+            target_transform=target_transform,
+            download=download,
+        )
+        if transform is not None or target_transform is not None:
+            # torchvision applies transforms in __getitem__; materialize
+            # through it so they actually take effect (reading tv.data raw
+            # would silently skip them)
+            samples = [tv[i] for i in range(len(tv))]
+            images = np.stack([np.asarray(s[0]) for s in samples]).astype(np.float32)
+            labels = np.asarray([s[1] for s in samples], dtype=np.int32)
+        else:
+            images = np.asarray(tv.data, dtype=np.float32)
+            labels = np.asarray(tv.targets, dtype=np.int32)
+        data = factories.array(images, split=split, comm=comm)
+        targets = factories.array(labels, split=split, comm=comm)
+        super().__init__(
+            data, targets=targets, ishuffle=ishuffle, test_set=test_set or not train
+        )

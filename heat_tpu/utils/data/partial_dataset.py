@@ -1,0 +1,197 @@
+"""Out-of-core streaming datasets (reference:
+heat/utils/data/partial_dataset.py).
+
+The reference's :class:`PartialH5Dataset` (reference partial_dataset.py:32)
+streams windows of an HDF5 file that is too large for memory: a background
+**loader thread** reads the next window from disk while the current one is
+being consumed, and a converter thread shapes batches (GIL caveats
+documented at :43-45). Same architecture here — a `threading.Thread` + a
+bounded `queue.Queue` of prefetched windows, with host→device transfer of
+each batch overlapped by JAX's async dispatch. Works against any mapping
+whose values support numpy-style slicing (h5py File, np.memmap, np arrays),
+so the H5-specific class is a thin subclass gated on h5py.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Iterator, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.communication import sanitize_comm
+
+__all__ = ["PartialDataset", "PartialH5Dataset", "PartialDataLoaderIter"]
+
+
+class PartialDataset:
+    """Windowed streaming dataset over sliceable columns.
+
+    Parameters
+    ----------
+    columns : dict[str, sliceable]
+        Named arrays (same leading length) — e.g. ``{"data": f["images"],
+        "targets": f["labels"]}`` for an open h5py file.
+    initial_load : int
+        Rows of the first resident window (reference ``initial_load``).
+    load_length : int
+        Rows fetched per background read (reference ``load_length``).
+    transform : callable, optional
+        Applied to each *window* dict of numpy arrays before batching.
+    """
+
+    def __init__(
+        self,
+        columns,
+        initial_load: int = 4096,
+        load_length: int = 1024,
+        transform: Optional[Callable] = None,
+        comm=None,
+    ):
+        if not columns:
+            raise ValueError("columns must be a non-empty mapping")
+        self.columns = dict(columns)
+        lengths = {k: v.shape[0] for k, v in self.columns.items()}
+        if len(set(lengths.values())) != 1:
+            raise ValueError(f"column lengths differ: {lengths}")
+        self.total_size = next(iter(lengths.values()))
+        self.initial_load = min(initial_load, self.total_size)
+        self.load_length = max(1, load_length)
+        self.transform = transform
+        self.comm = sanitize_comm(comm)
+        self.ishuffle = False
+        self.test_set = False
+        self.partial_dataset = True  # reference duck-type marker
+
+    def windows(self) -> Iterator[dict]:
+        """Yield dicts of numpy windows, prefetched by a background thread
+        (reference's loader-thread design, partial_dataset.py:20-30)."""
+        q: queue.Queue = queue.Queue(maxsize=2)
+        SENTINEL = object()
+
+        def loader():
+            # the sentinel must reach the queue on *every* exit path — a
+            # read/transform error otherwise leaves the consumer blocked on
+            # q.get() forever; exceptions travel through the queue so the
+            # consuming thread re-raises them
+            try:
+                pos = 0
+                length = self.initial_load
+                while pos < self.total_size:
+                    hi = min(pos + length, self.total_size)
+                    win = {
+                        k: np.asarray(v[pos:hi]) for k, v in self.columns.items()
+                    }
+                    if self.transform is not None:
+                        win = self.transform(win)
+                    q.put(win)
+                    pos = hi
+                    length = self.load_length
+            except BaseException as e:  # noqa: BLE001 - relayed to consumer
+                q.put(e)
+            finally:
+                q.put(SENTINEL)
+
+        t = threading.Thread(target=loader, daemon=True)
+        t.start()
+        while True:
+            win = q.get()
+            if win is SENTINEL:
+                break
+            if isinstance(win, BaseException):
+                t.join()
+                raise win
+            yield win
+        t.join()
+
+    def __len__(self) -> int:
+        return self.total_size
+
+
+class PartialH5Dataset(PartialDataset):
+    """Stream datasets out of an HDF5 file (reference partial_dataset.py:32).
+
+    Parameters
+    ----------
+    file : str
+        Path to the HDF5 file.
+    dataset_names : str or list of str
+        Dataset keys to stream (reference default ``"data"``).
+    """
+
+    def __init__(
+        self,
+        file: str,
+        comm=None,
+        dataset_names="data",
+        transform: Optional[Callable] = None,
+        initial_load: int = 4096,
+        load_length: int = 1024,
+    ):
+        try:
+            import h5py
+        except ImportError as e:  # pragma: no cover - h5py in test image
+            raise ImportError("PartialH5Dataset requires h5py") from e
+        self.file = file
+        self._h5 = h5py.File(file, "r")
+        names = [dataset_names] if isinstance(dataset_names, str) else list(dataset_names)
+        columns = {name: self._h5[name] for name in names}
+        super().__init__(
+            columns,
+            initial_load=initial_load,
+            load_length=load_length,
+            transform=transform,
+            comm=comm,
+        )
+
+    def close(self) -> None:
+        self._h5.close()
+
+
+class PartialDataLoaderIter:
+    """Batch iterator over a PartialDataset (reference
+    PartialH5DataLoaderIter, partial_dataset.py:224).
+
+    Emits mesh-sharded device batches; incomplete tails within a window are
+    carried over to the next window, the final global tail is dropped
+    (reference forces ``drop_last=True`` for partial datasets,
+    datatools.py:88-89)."""
+
+    def __init__(self, dataset: PartialDataset, batch_size: int, shuffle: bool = True, seed: int = 0):
+        p = dataset.comm.size
+        if batch_size % p:
+            raise ValueError(
+                f"batch_size ({batch_size}) must be divisible by mesh size ({p})"
+            )
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self._rng = np.random.default_rng(seed)
+
+    def __iter__(self):
+        carry: Optional[dict] = None
+        bs = self.batch_size
+        comm = self.dataset.comm
+        for win in self.dataset.windows():
+            if carry is not None:
+                win = {
+                    k: np.concatenate([carry[k], win[k]], axis=0) for k in win
+                }
+            n = next(iter(win.values())).shape[0]
+            if self.shuffle:
+                prm = self._rng.permutation(n)
+                win = {k: v[prm] for k, v in win.items()}
+            nb = n // bs
+            for i in range(nb):
+                lo = i * bs
+                yield tuple(
+                    jax.device_put(
+                        jnp.asarray(v[lo : lo + bs]), comm.sharding(0, v.ndim)
+                    )
+                    for v in win.values()
+                )
+            rem = n - nb * bs
+            carry = {k: v[n - rem :] for k, v in win.items()} if rem else None

@@ -1,0 +1,23 @@
+"""Vision transform passthrough (reference: heat/utils/vision_transforms.py
+forwards every name to ``torchvision.transforms``). torchvision is optional;
+names resolve lazily so importing this module never requires it."""
+
+from __future__ import annotations
+
+__all__ = []
+
+
+def __getattr__(name):
+    try:
+        from torchvision import transforms as _transforms
+    except ImportError as e:
+        raise ImportError(
+            f"heat_tpu.utils.vision_transforms.{name} requires torchvision, "
+            "which is not installed"
+        ) from e
+    try:
+        return getattr(_transforms, name)
+    except AttributeError:
+        raise AttributeError(
+            f"torchvision.transforms has no attribute {name}"
+        ) from None

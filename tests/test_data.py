@@ -1,0 +1,213 @@
+"""Tests for heat_tpu.utils.data — Dataset, DataLoader, shuffling, streaming.
+
+Oracle pattern (SURVEY §4): batches reassembled over an epoch must be a
+permutation of the source rows; the first epoch must be storage order
+(reference shuffle-after-first-iter semantics)."""
+
+import os
+import tempfile
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from heat_tpu.utils.data import (
+    DataLoader,
+    Dataset,
+    PartialDataLoaderIter,
+    PartialDataset,
+    PartialH5Dataset,
+    matrixgallery,
+)
+
+
+@pytest.fixture(scope="module")
+def comm():
+    return ht.get_comm()
+
+
+def make_dataset(n, d=4, comm=None, **kw):
+    x = np.arange(n * d, dtype=np.float32).reshape(n, d)
+    y = np.arange(n, dtype=np.int32)
+    data = ht.array(x, split=0, comm=comm)
+    targets = ht.array(y, split=0, comm=comm)
+    return Dataset(data, targets=targets, **kw), x, y
+
+
+def collect_epoch(loader):
+    xs, ys = [], []
+    for xb, yb in loader:
+        xs.append(np.asarray(xb))
+        ys.append(np.asarray(yb))
+    return np.concatenate(xs), np.concatenate(ys)
+
+
+class TestDataset:
+    def test_len_getitem(self, comm):
+        ds, x, y = make_dataset(24, comm=comm)
+        assert len(ds) == 24
+        xi, yi = ds[3]
+        np.testing.assert_array_equal(np.asarray(xi), x[3])
+        assert int(yi) == 3
+
+    def test_rejects_bad_types(self, comm):
+        with pytest.raises(TypeError):
+            Dataset(np.zeros((4, 4)))
+        a = ht.array(np.zeros((4, 4), dtype=np.float32), split=0, comm=comm)
+        with pytest.raises(TypeError):
+            Dataset(a, targets=np.zeros(4))
+
+    def test_shuffle_preserves_row_alignment(self, comm):
+        ds, x, y = make_dataset(32, comm=comm)
+        ds.Shuffle()
+        got_x = np.asarray(ds.data)
+        got_y = np.asarray(ds.targets)
+        # rows still aligned: row i of data must be source row got_y[i]
+        np.testing.assert_array_equal(got_x, x[got_y])
+        # and it actually permuted something (32 rows — astronomically
+        # unlikely to be identity)
+        assert not np.array_equal(got_y, y)
+
+
+class TestDataLoader:
+    def test_first_epoch_storage_order(self, comm):
+        ds, x, y = make_dataset(32, comm=comm)
+        dl = DataLoader(ds, batch_size=8)
+        gx, gy = collect_epoch(dl)
+        np.testing.assert_array_equal(gx, x)
+        np.testing.assert_array_equal(gy, y)
+
+    def test_later_epochs_shuffled_and_complete(self, comm):
+        ds, x, y = make_dataset(32, comm=comm)
+        dl = DataLoader(ds, batch_size=8)
+        collect_epoch(dl)
+        gx, gy = collect_epoch(dl)
+        assert not np.array_equal(gy, y)
+        np.testing.assert_array_equal(np.sort(gy), y)  # a permutation
+        np.testing.assert_array_equal(gx, x[gy])       # rows still aligned
+
+    def test_ishuffle_mode(self, comm):
+        ds, x, y = make_dataset(32, comm=comm, ishuffle=True)
+        dl = DataLoader(ds, batch_size=8)
+        collect_epoch(dl)
+        gx, gy = collect_epoch(dl)
+        np.testing.assert_array_equal(np.sort(gy), y)
+        np.testing.assert_array_equal(gx, x[gy])
+
+    def test_batches_are_mesh_sharded(self, comm):
+        ds, _, _ = make_dataset(4 * comm.size, comm=comm)
+        dl = DataLoader(ds, batch_size=2 * comm.size)
+        xb, yb = next(iter(dl))
+        assert len(xb.sharding.device_set) == comm.size
+
+    def test_ragged_tail(self, comm):
+        p = comm.size
+        n = 3 * p + p // 2 if p > 1 else 7
+        ds, x, _ = make_dataset(n, comm=comm)
+        dl = DataLoader(ds, batch_size=p, shuffle=False)
+        total = sum(xb.shape[0] for xb, _ in dl)
+        assert total == (n // p) * p  # only mesh-divisible rows emitted
+        dl2 = DataLoader(ds, batch_size=p, shuffle=False, drop_last=True)
+        assert len(dl2) == n // p
+
+    def test_batch_size_validation(self, comm):
+        ds, _, _ = make_dataset(16, comm=comm)
+        if comm.size > 1:
+            with pytest.raises(ValueError, match="mesh size"):
+                DataLoader(ds, batch_size=1)
+        with pytest.raises(TypeError):
+            DataLoader([1, 2, 3])
+
+    def test_test_set_never_shuffles(self, comm):
+        ds, x, y = make_dataset(16, comm=comm, test_set=True)
+        dl = DataLoader(ds, batch_size=8)
+        collect_epoch(dl)
+        gx, gy = collect_epoch(dl)
+        np.testing.assert_array_equal(gy, y)
+
+
+class TestPartialDataset:
+    def test_windows_cover_all_rows(self, comm):
+        x = np.arange(100, dtype=np.float32).reshape(50, 2)
+        ds = PartialDataset({"data": x}, initial_load=20, load_length=15, comm=comm)
+        wins = list(ds.windows())
+        assert [w["data"].shape[0] for w in wins] == [20, 15, 15]
+        np.testing.assert_array_equal(
+            np.concatenate([w["data"] for w in wins]), x
+        )
+
+    def test_iter_batches(self, comm):
+        p = comm.size
+        n = 10 * p
+        x = np.arange(n * 3, dtype=np.float32).reshape(n, 3)
+        y = np.arange(n, dtype=np.int32)
+        ds = PartialDataset(
+            {"data": x, "targets": y}, initial_load=4 * p, load_length=3 * p,
+            comm=comm,
+        )
+        it = PartialDataLoaderIter(ds, batch_size=2 * p, shuffle=False)
+        got_y = np.concatenate([np.asarray(yb) for _, yb in it])
+        # drop_last semantics: full batches only, order preserved unshuffled
+        assert got_y.shape[0] == (n // (2 * p)) * 2 * p
+        np.testing.assert_array_equal(got_y, y[: got_y.shape[0]])
+
+    def test_shuffled_batches_align(self, comm):
+        p = comm.size
+        n = 8 * p
+        x = np.arange(n, dtype=np.float32).reshape(n, 1)
+        y = np.arange(n, dtype=np.int32)
+        ds = PartialDataset({"data": x, "targets": y}, initial_load=n, comm=comm)
+        it = PartialDataLoaderIter(ds, batch_size=2 * p, shuffle=True)
+        for xb, yb in it:
+            np.testing.assert_array_equal(
+                np.asarray(xb)[:, 0], np.asarray(yb).astype(np.float32)
+            )
+
+    def test_h5(self, comm, tmp_path):
+        h5py = pytest.importorskip("h5py")
+        path = str(tmp_path / "t.h5")
+        x = np.arange(40, dtype=np.float32).reshape(20, 2)
+        with h5py.File(path, "w") as f:
+            f.create_dataset("data", data=x)
+        ds = PartialH5Dataset(path, initial_load=8, load_length=8, comm=comm)
+        wins = list(ds.windows())
+        np.testing.assert_array_equal(np.concatenate([w["data"] for w in wins]), x)
+        ds.close()
+
+    def test_validation(self, comm):
+        with pytest.raises(ValueError):
+            PartialDataset({}, comm=comm)
+        with pytest.raises(ValueError):
+            PartialDataset(
+                {"a": np.zeros((3, 1)), "b": np.zeros((4, 1))}, comm=comm
+            )
+
+
+class TestMatrixGallery:
+    @pytest.mark.parametrize("split", [None, 0, 1])
+    def test_parter(self, comm, split):
+        n = 12
+        got = matrixgallery.parter(n, split=split, comm=comm)
+        i = np.arange(n)
+        want = 1.0 / (i[None, :] - i[:, None] + 0.5)
+        np.testing.assert_allclose(got.numpy(), want, rtol=1e-6)
+        assert got.split == split
+
+    def test_parter_bad_split(self, comm):
+        with pytest.raises(ValueError):
+            matrixgallery.parter(4, split=2, comm=comm)
+
+
+class TestGatedImports:
+    def test_vision_transforms_gate(self):
+        from heat_tpu.utils import vision_transforms
+
+        try:
+            import torchvision  # noqa: F401
+
+            assert vision_transforms.Compose is not None
+        except ImportError:
+            with pytest.raises(ImportError, match="torchvision"):
+                vision_transforms.Compose
