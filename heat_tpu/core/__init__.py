@@ -23,6 +23,8 @@ from .statistics import *
 from .manipulations import *
 from .io import *
 from .base import *
+from . import tiling
+from .tiling import *
 from . import random
 from . import linalg
 from .linalg import *

@@ -1,0 +1,283 @@
+"""Tile decompositions (reference: heat/core/tiling.py, 1245 LoC).
+
+The reference maintains per-MPI-rank tile bookkeeping because every rank can
+only touch its local shard: `SplitTiles` (reference tiling.py:14) is the
+P×…×P chunk-rule grid used by `resplit_`'s Alltoallw shuffle, and
+`SquareDiagTiles` (:331) the diagonal-square grid driving tiled QR. Under
+the single-controller TPU runtime any tile is addressable as a slice of the
+sharded global array (XLA materializes the transfer), so this module keeps
+the *index calculus* — tile boundaries from the ceil chunk rule, tile →
+mesh-position ownership, start/stop arithmetic — and drops the rank-local
+get/set split: ``tiles[i, j]`` reads and ``tiles[i, j] = v`` writes the
+global array directly.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from .dndarray import DNDarray
+
+__all__ = ["SplitTiles", "SquareDiagTiles"]
+
+
+def _chunk_bounds(n: int, parts: int) -> np.ndarray:
+    """Boundaries (len parts+1) of the ceil-rule chunking of ``n`` into
+    ``parts`` — the layout rule of the framework (communication.chunk)."""
+    c = -(-n // parts) if parts else n
+    ends = np.minimum(np.arange(1, parts + 1) * c, n)
+    return np.concatenate([[0], ends])
+
+
+class SplitTiles:
+    """Chunk-rule tile grid: the array cut into ``comm.size`` blocks along
+    *every* dimension (reference tiling.py:14-330).
+
+    ``tiles[key]`` with per-dimension integer/slice keys returns the
+    corresponding block of the global array; assignment writes it back into
+    the wrapped DNDarray.
+    """
+
+    def __init__(self, arr: DNDarray):
+        if not isinstance(arr, DNDarray):
+            raise TypeError(f"arr must be a DNDarray, got {type(arr)}")
+        self.__arr = arr
+        p = arr.comm.size
+        self.__bounds = [_chunk_bounds(s, p) for s in arr.shape]
+
+    @property
+    def arr(self) -> DNDarray:
+        return self.__arr
+
+    @property
+    def lshape_map(self) -> np.ndarray:
+        return self.__arr.lshape_map
+
+    @property
+    def tile_dimensions(self) -> np.ndarray:
+        """(ndim, p) sizes of the tiles in each dimension (reference
+        tiling.py:173)."""
+        return np.stack([np.diff(b) for b in self.__bounds])
+
+    @property
+    def tile_ends_g(self) -> np.ndarray:
+        """(ndim, p) global end index of each tile per dimension (reference
+        tiling.py:162)."""
+        return np.stack([b[1:] for b in self.__bounds])
+
+    @property
+    def tile_locations(self) -> np.ndarray:
+        """Mesh position owning each tile (reference tiling.py:151): a
+        (p, …, p) grid; ownership follows the split dimension's chunk index
+        (replicated arrays are owned everywhere, marked -1)."""
+        p = self.__arr.comm.size
+        shape = (p,) * self.__arr.ndim
+        if self.__arr.split is None:
+            return np.full(shape, -1)
+        grid = np.zeros(shape, dtype=np.int64)
+        # ownership follows the chunk index along the split dimension
+        view = np.moveaxis(grid, self.__arr.split, -1)
+        view[...] = np.arange(p)
+        return grid
+
+    def get_tile_size(self, key) -> Tuple[int, ...]:
+        """Shape of the tile addressed by ``key`` (reference tiling.py:282)."""
+        slices = self.__key_to_slices(key)
+        return tuple(s.stop - s.start for s in slices)
+
+    def __key_to_slices(self, key) -> List[slice]:
+        if not isinstance(key, tuple):
+            key = (key,)
+        if len(key) > self.__arr.ndim:
+            raise ValueError(
+                f"key has {len(key)} dims, array has {self.__arr.ndim}"
+            )
+        key = key + (slice(None),) * (self.__arr.ndim - len(key))
+        out = []
+        for dim, (k, bounds) in enumerate(zip(key, self.__bounds)):
+            p = len(bounds) - 1
+            if isinstance(k, int):
+                if not -p <= k < p:
+                    raise IndexError(f"tile index {k} out of range for dim {dim}")
+                k = k % p
+                out.append(slice(int(bounds[k]), int(bounds[k + 1])))
+            elif isinstance(k, slice):
+                start, stop, stride = k.indices(p)
+                if stride != 1:
+                    raise ValueError("strided tile slices are not supported")
+                out.append(slice(int(bounds[start]), int(bounds[stop])))
+            else:
+                raise TypeError(f"invalid tile key element: {type(k)}")
+        return out
+
+    def __getitem__(self, key) -> jnp.ndarray:
+        slices = self.__key_to_slices(key)
+        return self.__arr._logical()[tuple(slices)]
+
+    def __setitem__(self, key, value) -> None:
+        slices = self.__key_to_slices(key)
+        logical = self.__arr._logical().at[tuple(slices)].set(value)
+        new = DNDarray.from_logical(
+            logical, self.__arr.split, self.__arr.device, self.__arr.comm
+        )
+        self.__arr.larray = new.larray
+
+
+class SquareDiagTiles:
+    """Square tiles along the matrix diagonal (reference tiling.py:331-1245).
+
+    Block decomposition for tiled QR: the diagonal is covered with square
+    ``tiles_per_proc``-per-chunk blocks; rows/columns beyond the diagonal
+    square inherit the adjacent boundaries. Exposes the index calculus
+    (row/col boundaries, tile map, per-process counts) plus global get/set.
+
+    Parameters
+    ----------
+    arr : DNDarray
+        2-D array, split 0 or 1.
+    tiles_per_proc : int
+        Number of diagonal tiles per mesh position (reference :375).
+    """
+
+    def __init__(self, arr: DNDarray, tiles_per_proc: int = 2):
+        if not isinstance(arr, DNDarray):
+            raise TypeError(f"arr must be a DNDarray, got {type(arr)}")
+        if arr.ndim != 2:
+            raise ValueError(f"arr must be 2D, got {arr.ndim}D")
+        if tiles_per_proc < 1:
+            raise ValueError("tiles_per_proc must be >= 1")
+        if arr.split not in (0, 1):
+            raise ValueError("SquareDiagTiles requires split 0 or 1")
+        self.__arr = arr
+        m, n = arr.shape
+        p = arr.comm.size
+        diag = min(m, n)
+        # cut the split dimension with the chunk rule, then split each chunk
+        # into tiles_per_proc tiles; clamp boundaries into the diagonal
+        # square and extend the final row/col to cover any overhang
+        split_len = m if arr.split == 0 else n
+        outer = _chunk_bounds(split_len, p)
+        inds = [0]
+        for r in range(p):
+            lo, hi = int(outer[r]), int(outer[r + 1])
+            hi_d = min(hi, diag)
+            lo_d = min(lo, diag)
+            span = hi_d - lo_d
+            if span <= 0:
+                continue
+            t = min(tiles_per_proc, span)
+            sub = _chunk_bounds(span, t) + lo_d
+            inds.extend(int(x) for x in sub[1:])
+        if inds[-1] < diag:
+            inds.append(diag)
+        # the diagonal boundaries apply to both axes; the longer axis keeps
+        # a final overhang tile
+        row_bounds = list(inds)
+        if row_bounds[-1] < m:
+            row_bounds.append(m)
+        col_bounds = list(inds)
+        if col_bounds[-1] < n:
+            col_bounds.append(n)
+        self.__row_bounds = np.asarray(row_bounds)
+        self.__col_bounds = np.asarray(col_bounds)
+        self.__tiles_per_proc = tiles_per_proc
+
+    @property
+    def arr(self) -> DNDarray:
+        return self.__arr
+
+    @property
+    def lshape_map(self) -> np.ndarray:
+        return self.__arr.lshape_map
+
+    @property
+    def row_indices(self) -> List[int]:
+        """Global start row of each tile row (reference :745)."""
+        return [int(x) for x in self.__row_bounds[:-1]]
+
+    @property
+    def col_indices(self) -> List[int]:
+        """Global start column of each tile column (reference :723)."""
+        return [int(x) for x in self.__col_bounds[:-1]]
+
+    @property
+    def tile_rows(self) -> int:
+        return len(self.__row_bounds) - 1
+
+    @property
+    def tile_columns(self) -> int:
+        return len(self.__col_bounds) - 1
+
+    def __owner_of(self, start: int) -> int:
+        split_len = self.__arr.shape[self.__arr.split]
+        p = self.__arr.comm.size
+        c = -(-split_len // p)
+        return min(start // c, p - 1) if c else 0
+
+    @property
+    def tile_rows_per_process(self) -> List[int]:
+        """Tiles owned per mesh position along the rows (reference :809)."""
+        p = self.__arr.comm.size
+        counts = [0] * p
+        if self.__arr.split == 0:
+            for s in self.__row_bounds[:-1]:
+                counts[self.__owner_of(int(s))] += 1
+        else:
+            counts = [self.tile_rows] * p
+        return counts
+
+    @property
+    def tile_columns_per_process(self) -> List[int]:
+        p = self.__arr.comm.size
+        counts = [0] * p
+        if self.__arr.split == 1:
+            for s in self.__col_bounds[:-1]:
+                counts[self.__owner_of(int(s))] += 1
+        else:
+            counts = [self.tile_columns] * p
+        return counts
+
+    @property
+    def last_diagonal_process(self) -> int:
+        """Mesh position owning the last diagonal element (reference :738)."""
+        diag = min(self.__arr.shape) - 1
+        return self.__owner_of(diag)
+
+    @property
+    def tile_map(self) -> np.ndarray:
+        """(tile_rows, tile_columns, 3) of [row_start, col_start, owner]
+        (reference :766)."""
+        tm = np.zeros((self.tile_rows, self.tile_columns, 3), dtype=np.int64)
+        for i, rs in enumerate(self.row_indices):
+            for j, cs in enumerate(self.col_indices):
+                owner = self.__owner_of(rs if self.__arr.split == 0 else cs)
+                tm[i, j] = (rs, cs, owner)
+        return tm
+
+    def get_start_stop(self, key) -> Tuple[int, int, int, int]:
+        """(row_start, row_stop, col_start, col_stop) of a (row, col) tile
+        key (reference :815)."""
+        i, j = key
+        i = i % self.tile_rows
+        j = j % self.tile_columns
+        return (
+            int(self.__row_bounds[i]),
+            int(self.__row_bounds[i + 1]),
+            int(self.__col_bounds[j]),
+            int(self.__col_bounds[j + 1]),
+        )
+
+    def __getitem__(self, key) -> jnp.ndarray:
+        r0, r1, c0, c1 = self.get_start_stop(key)
+        return self.__arr._logical()[r0:r1, c0:c1]
+
+    def __setitem__(self, key, value) -> None:
+        r0, r1, c0, c1 = self.get_start_stop(key)
+        logical = self.__arr._logical().at[r0:r1, c0:c1].set(value)
+        new = DNDarray.from_logical(
+            logical, self.__arr.split, self.__arr.device, self.__arr.comm
+        )
+        self.__arr.larray = new.larray

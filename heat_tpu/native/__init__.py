@@ -1,0 +1,120 @@
+"""Native runtime components (C++ via ctypes).
+
+The reference framework ships no first-party native code — its native layer
+is vendored (torch kernels, MPI transport; SURVEY §2). The TPU build's
+compute path is XLA; this package holds the *host-side* native pieces that
+sit around it, built lazily with the system toolchain and always shadowed by
+a pure-Python fallback so the framework works without a compiler.
+
+Current components:
+
+* ``fastcsv`` — memory-mapped, multithreaded CSV tokenizer used by
+  :func:`heat_tpu.core.io.load_csv` (the reference's per-rank byte-range
+  CSV splitting, reference heat/core/io.py:710-860, parallelized over
+  threads instead of ranks).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+import threading
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["parse_csv", "native_available"]
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "fastcsv.cpp")
+_LIB_PATH = os.path.join(_HERE, "_fastcsv.so")
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+
+def _build() -> bool:
+    """Compile fastcsv.cpp -> _fastcsv.so with g++. Returns success."""
+    try:
+        result = subprocess.run(
+            [
+                "g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
+                _SRC, "-o", _LIB_PATH,
+            ],
+            capture_output=True,
+            timeout=120,
+        )
+        return result.returncode == 0 and os.path.exists(_LIB_PATH)
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _build_failed
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _build_failed:
+            return None
+        stale = (
+            not os.path.exists(_LIB_PATH)
+            or os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC)
+        )
+        if stale and not _build():
+            _build_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            _build_failed = True
+            return None
+        lib.csv_dims.argtypes = [
+            ctypes.c_char_p, ctypes.c_char, ctypes.c_long,
+            ctypes.POINTER(ctypes.c_long), ctypes.POINTER(ctypes.c_long),
+        ]
+        lib.csv_dims.restype = ctypes.c_int
+        lib.csv_parse.argtypes = [
+            ctypes.c_char_p, ctypes.c_char, ctypes.c_long,
+            ctypes.POINTER(ctypes.c_double), ctypes.c_long, ctypes.c_long,
+        ]
+        lib.csv_parse.restype = ctypes.c_int
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    """Whether the native fastcsv library is (or can be) loaded."""
+    return _load() is not None
+
+
+def parse_csv(
+    path: str, sep: str = ",", header_lines: int = 0
+) -> Optional[np.ndarray]:
+    """Parse a numeric CSV into a float64 (rows, cols) array with the native
+    tokenizer. Returns None when the native library is unavailable (callers
+    fall back to numpy) — raises only for I/O errors on an available lib."""
+    lib = _load()
+    if lib is None:
+        return None
+    bsep_raw = sep.encode("utf-8")
+    if len(bsep_raw) != 1:
+        return None  # multi-char / non-ASCII separators: numpy fallback
+    bpath = os.fsencode(path)
+    bsep = ctypes.c_char(bsep_raw)
+    rows = ctypes.c_long()
+    cols = ctypes.c_long()
+    rc = lib.csv_dims(bpath, bsep, header_lines, ctypes.byref(rows), ctypes.byref(cols))
+    if rc != 0:
+        raise OSError(f"fastcsv: cannot read {path!r} (rc={rc})")
+    out = np.empty((rows.value, cols.value), dtype=np.float64)
+    if out.size:
+        rc = lib.csv_parse(
+            bpath, bsep, header_lines,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            rows.value, cols.value,
+        )
+        if rc != 0:
+            raise OSError(f"fastcsv: parse failed for {path!r} (rc={rc})")
+    return out

@@ -16,13 +16,11 @@ start, overlapping reshuffle communication with host-side epoch turnover.
 
 from __future__ import annotations
 
-from typing import Callable, Iterator, List, Optional, Sequence, Tuple, Union
+from typing import Callable, Iterator, List, Optional
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
-from ...core.communication import MeshCommunication, sanitize_comm
 from ...core.dndarray import DNDarray
 
 __all__ = ["DataLoader", "Dataset", "dataset_shuffle", "dataset_ishuffle"]

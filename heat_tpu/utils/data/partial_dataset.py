@@ -71,6 +71,19 @@ class PartialDataset:
         (reference's loader-thread design, partial_dataset.py:20-30)."""
         q: queue.Queue = queue.Queue(maxsize=2)
         SENTINEL = object()
+        stop = threading.Event()
+
+        def put(item) -> bool:
+            # bounded put that also watches the stop flag, so an abandoned
+            # consumer (caller broke out of the loop) can't leave this
+            # thread blocked on a full queue forever
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
 
         def loader():
             # the sentinel must reach the queue on *every* exit path — a
@@ -80,32 +93,43 @@ class PartialDataset:
             try:
                 pos = 0
                 length = self.initial_load
-                while pos < self.total_size:
+                while pos < self.total_size and not stop.is_set():
                     hi = min(pos + length, self.total_size)
                     win = {
                         k: np.asarray(v[pos:hi]) for k, v in self.columns.items()
                     }
                     if self.transform is not None:
                         win = self.transform(win)
-                    q.put(win)
+                    if not put(win):
+                        return
                     pos = hi
                     length = self.load_length
             except BaseException as e:  # noqa: BLE001 - relayed to consumer
-                q.put(e)
+                put(e)
             finally:
-                q.put(SENTINEL)
+                put(SENTINEL)
 
         t = threading.Thread(target=loader, daemon=True)
         t.start()
-        while True:
-            win = q.get()
-            if win is SENTINEL:
-                break
-            if isinstance(win, BaseException):
-                t.join()
-                raise win
-            yield win
-        t.join()
+        try:
+            while True:
+                win = q.get()
+                if win is SENTINEL:
+                    break
+                if isinstance(win, BaseException):
+                    raise win
+                yield win
+        finally:
+            # normal exhaustion or early abandonment (GeneratorExit): wake
+            # the loader, drain anything buffered, and reap the thread so
+            # repeated partial epochs can't stack blocked threads
+            stop.set()
+            try:
+                while True:
+                    q.get_nowait()
+            except queue.Empty:
+                pass
+            t.join()
 
     def __len__(self) -> int:
         return self.total_size

@@ -176,6 +176,29 @@ class TestPartialDataset:
         np.testing.assert_array_equal(np.concatenate([w["data"] for w in wins]), x)
         ds.close()
 
+    def test_early_abandonment_reaps_loader_thread(self, comm):
+        import threading
+
+        x = np.arange(4000, dtype=np.float32).reshape(2000, 2)
+        before = threading.active_count()
+        for _ in range(5):
+            ds = PartialDataset({"data": x}, initial_load=100, load_length=100,
+                                comm=comm)
+            gen = ds.windows()
+            next(gen)
+            gen.close()  # abandon mid-stream
+        assert threading.active_count() <= before + 1
+
+    def test_transform_error_propagates(self, comm):
+        x = np.zeros((50, 2), dtype=np.float32)
+
+        def bad(win):
+            raise RuntimeError("boom")
+
+        ds = PartialDataset({"data": x}, transform=bad, comm=comm)
+        with pytest.raises(RuntimeError, match="boom"):
+            list(ds.windows())
+
     def test_validation(self, comm):
         with pytest.raises(ValueError):
             PartialDataset({}, comm=comm)

@@ -1,0 +1,101 @@
+"""Tests for heat_tpu.core.io (reference: heat/core/tests/test_io.py).
+
+Oracle: numpy arrays written/read directly; roundtrips across splits."""
+
+import os
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+
+@pytest.fixture(scope="module")
+def comm():
+    return ht.get_comm()
+
+
+class TestCSV:
+    @pytest.mark.parametrize("split", [None, 0])
+    def test_roundtrip(self, comm, tmp_path, split):
+        p = str(tmp_path / "r.csv")
+        want = np.arange(60, dtype=np.float32).reshape(12, 5)
+        a = ht.array(want, split=0, comm=comm)
+        ht.save_csv(a, p)
+        b = ht.load_csv(p, split=split, comm=comm)
+        np.testing.assert_allclose(b.numpy(), want, rtol=1e-6)
+        assert b.split == split
+
+    def test_header_lines(self, comm, tmp_path):
+        p = str(tmp_path / "h.csv")
+        with open(p, "w") as f:
+            f.write("x,y\n1.5,2.5\n3.5,4.5\n")
+        a = ht.load_csv(p, header_lines=1, comm=comm)
+        np.testing.assert_allclose(a.numpy(), [[1.5, 2.5], [3.5, 4.5]])
+
+    def test_type_validation(self, comm):
+        with pytest.raises(TypeError):
+            ht.load_csv(3)
+        with pytest.raises(TypeError):
+            ht.load_csv("x.csv", sep=4)
+        with pytest.raises(TypeError):
+            ht.load_csv("x.csv", header_lines="two")
+
+
+class TestNpy:
+    def test_roundtrip(self, comm, tmp_path):
+        p = str(tmp_path / "a.npy")
+        want = np.random.default_rng(0).standard_normal((9, 3)).astype(np.float32)
+        np.save(p, want)
+        a = ht.load(p, split=0, comm=comm)
+        np.testing.assert_allclose(a.numpy(), want, rtol=1e-6)
+
+
+@pytest.mark.skipif(not ht.supports_hdf5(), reason="h5py unavailable")
+class TestHDF5:
+    @pytest.mark.parametrize("split", [None, 0, 1])
+    def test_roundtrip(self, comm, tmp_path, split):
+        p = str(tmp_path / "t.h5")
+        want = np.random.default_rng(1).standard_normal((10, 6)).astype(np.float32)
+        a = ht.array(want, split=0, comm=comm)
+        ht.save_hdf5(a, p, "data")
+        b = ht.load_hdf5(p, "data", split=split, comm=comm)
+        np.testing.assert_allclose(b.numpy(), want, rtol=1e-6)
+        assert b.split == split
+
+    def test_load_dispatch(self, comm, tmp_path):
+        p = str(tmp_path / "d.h5")
+        want = np.ones((4, 4), dtype=np.float32)
+        ht.save(ht.array(want, comm=comm), p, "data")
+        b = ht.load(p, "data", comm=comm)
+        np.testing.assert_allclose(b.numpy(), want)
+
+
+class TestCheckpoint:
+    def test_pytree_roundtrip(self, comm, tmp_path):
+        a = ht.random.randn(11, 4, split=0, comm=comm)  # ragged over 8 devs
+        w = ht.array(np.ones((4,), np.float32), comm=comm)
+        state = {"a": a, "w": w, "step": 3}
+        path = str(tmp_path / "ckpt")
+        ht.save_checkpoint(state, path)
+        back = ht.load_checkpoint(path, like=state, comm=comm)
+        np.testing.assert_allclose(back["a"].numpy(), a.numpy(), rtol=1e-6)
+        assert back["a"].split == 0
+        assert back["w"].split is None
+        assert int(back["step"]) == 3
+
+    def test_flat_restore(self, comm, tmp_path):
+        state = {"x": ht.arange(10, split=0, comm=comm)}
+        path = str(tmp_path / "ckpt2")
+        ht.save_checkpoint(state, path)
+        leaves = ht.load_checkpoint(path, comm=comm)
+        assert len(leaves) == 1
+        np.testing.assert_array_equal(leaves[0].numpy(), np.arange(10))
+
+
+class TestErrors:
+    def test_load_unknown_extension(self, comm):
+        with pytest.raises(ValueError):
+            ht.load("data.parquet")
+        with pytest.raises(TypeError):
+            ht.load(42)

@@ -1,0 +1,138 @@
+"""Tests for the native C++ fastcsv component and its io wiring.
+
+Oracle: numpy.genfromtxt on the same file."""
+
+import os
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from heat_tpu import native
+
+
+def write_csv(path, rows, cols, seed=0, header=0, sep=","):
+    rng = np.random.default_rng(seed)
+    data = rng.standard_normal((rows, cols))
+    with open(path, "w") as f:
+        for h in range(header):
+            f.write("# header line\n")
+        for r in data:
+            f.write(sep.join(f"{v:.10g}" for v in r) + "\n")
+    return data
+
+
+@pytest.fixture(scope="module")
+def built():
+    if not native.native_available():
+        pytest.skip("native toolchain unavailable")
+    return True
+
+
+class TestFastCSV:
+    def test_matches_numpy(self, built, tmp_path):
+        p = str(tmp_path / "a.csv")
+        want = write_csv(p, 100, 7)
+        got = native.parse_csv(p)
+        np.testing.assert_allclose(got, want, rtol=1e-9)
+
+    def test_header_skip(self, built, tmp_path):
+        p = str(tmp_path / "h.csv")
+        want = write_csv(p, 20, 3, header=2)
+        got = native.parse_csv(p, header_lines=2)
+        np.testing.assert_allclose(got, want, rtol=1e-9)
+
+    def test_alt_separator(self, built, tmp_path):
+        p = str(tmp_path / "s.csv")
+        want = write_csv(p, 10, 4, sep=";")
+        got = native.parse_csv(p, sep=";")
+        np.testing.assert_allclose(got, want, rtol=1e-9)
+
+    def test_missing_fields_are_nan(self, built, tmp_path):
+        p = str(tmp_path / "m.csv")
+        with open(p, "w") as f:
+            f.write("1.0,2.0,3.0\n4.0,,6.0\n7.0,8.0\n")
+        got = native.parse_csv(p)
+        assert got.shape == (3, 3)
+        np.testing.assert_allclose(got[0], [1.0, 2.0, 3.0])
+        assert np.isnan(got[1, 1]) and got[1, 2] == 6.0
+        assert np.isnan(got[2, 2])
+
+    def test_crlf_and_trailing_newlines(self, built, tmp_path):
+        p = str(tmp_path / "c.csv")
+        with open(p, "wb") as f:
+            f.write(b"1.0,2.0\r\n3.0,4.0\r\n\r\n")
+        got = native.parse_csv(p)
+        np.testing.assert_allclose(got, [[1.0, 2.0], [3.0, 4.0]])
+
+    def test_missing_file_raises(self, built, tmp_path):
+        with pytest.raises(OSError):
+            native.parse_csv(str(tmp_path / "missing.csv"))
+
+    def test_empty_file(self, built, tmp_path):
+        p = str(tmp_path / "e.csv")
+        open(p, "w").close()
+        got = native.parse_csv(p)
+        assert got.shape[0] == 0
+
+    def test_multichar_sep_falls_back(self, built, tmp_path):
+        assert native.parse_csv("whatever.csv", sep="::") is None
+
+
+class TestLoadCSVWiring:
+    def test_load_csv_native_path(self, tmp_path):
+        p = str(tmp_path / "l.csv")
+        want = write_csv(p, 50, 5, seed=3)
+        a = ht.load_csv(p, split=0)
+        assert a.shape == (50, 5)
+        np.testing.assert_allclose(a.numpy(), want.astype(np.float32), rtol=1e-6)
+
+    def test_load_csv_single_column_is_2d(self, tmp_path):
+        p = str(tmp_path / "one.csv")
+        write_csv(p, 12, 1)
+        a = ht.load_csv(p)
+        assert a.shape == (12, 1)
+
+    def test_load_csv_single_row_is_2d(self, tmp_path, monkeypatch):
+        p = str(tmp_path / "row.csv")
+        with open(p, "w") as f:
+            f.write("1.0,2.0,3.0\n")
+        a = ht.load_csv(p)
+        assert a.shape == (1, 3)
+        # numpy fallback path must agree with the native path
+        monkeypatch.setattr(native, "parse_csv", lambda *a, **k: None)
+        b = ht.load_csv(p)
+        assert b.shape == (1, 3)
+
+    def test_load_csv_fallback_single_column(self, tmp_path, monkeypatch):
+        p = str(tmp_path / "col.csv")
+        with open(p, "w") as f:
+            f.write("1.0\n2.0\n3.0\n")
+        monkeypatch.setattr(native, "parse_csv", lambda *a, **k: None)
+        a = ht.load_csv(p)
+        assert a.shape == (3, 1)
+
+    def test_non_ascii_separator_falls_back(self, built):
+        assert native.parse_csv("whatever.csv", sep="–") is None
+
+    def test_page_multiple_file_size(self, built, tmp_path):
+        # exact page-multiple file ending in a digit: the mmap fast path has
+        # no zero guard byte, exercising the heap+NUL fallback
+        p = str(tmp_path / "page.csv")
+        page = os.sysconf("SC_PAGESIZE")
+        row = b"1.5,2.5\n"
+        nrows = page // len(row)
+        with open(p, "wb") as f:
+            f.write(row * (nrows - 1))
+            pad = page - (nrows - 1) * len(row) - 4
+            f.write(b"9" * pad + b",3.5")  # last byte is a digit, no newline
+        assert os.path.getsize(p) == page
+        got = native.parse_csv(p)
+        assert got.shape == (nrows, 2)
+        assert got[-1, 1] == 3.5
+
+    def test_load_dispatch(self, tmp_path):
+        p = str(tmp_path / "d.csv")
+        want = write_csv(p, 8, 2, seed=5)
+        a = ht.load(p)
+        np.testing.assert_allclose(a.numpy(), want.astype(np.float32), rtol=1e-6)

@@ -1,0 +1,130 @@
+"""Tests for heat_tpu.core.tiling (reference: heat/core/tests/test_tiling.py).
+
+Oracle: tile boundaries recomputed with numpy from the ceil chunk rule;
+get/set round-trips against the gathered global array."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from heat_tpu.core.tiling import SplitTiles, SquareDiagTiles
+
+
+@pytest.fixture(scope="module")
+def comm():
+    return ht.get_comm()
+
+
+class TestSplitTiles:
+    def test_tile_dimensions_cover_shape(self, comm):
+        n, m = 3 * comm.size + 1, 2 * comm.size
+        a = ht.random.randn(n, m, split=0, comm=comm)
+        tiles = SplitTiles(a)
+        dims = tiles.tile_dimensions
+        assert dims.shape == (2, comm.size)
+        assert dims[0].sum() == n and dims[1].sum() == m
+        assert (tiles.tile_ends_g[0] <= n).all()
+
+    def test_getitem_matches_numpy(self, comm):
+        n = 4 * comm.size
+        a = ht.arange(n * n, split=0, comm=comm).reshape((n, n))
+        ref = a.numpy()
+        tiles = SplitTiles(a)
+        c = -(-n // comm.size)
+        for i in (0, comm.size - 1):
+            got = np.asarray(tiles[i, 0])
+            np.testing.assert_array_equal(
+                got, ref[i * c : min((i + 1) * c, n), :c]
+            )
+        # slices merge adjacent tiles
+        got = np.asarray(tiles[0:2, :])
+        np.testing.assert_array_equal(got, ref[: 2 * c, :])
+
+    def test_setitem_roundtrip(self, comm):
+        n = 2 * comm.size
+        a = ht.zeros((n, n), split=0, comm=comm)
+        tiles = SplitTiles(a)
+        block = np.full(tiles.get_tile_size((0, 0)), 7.0, dtype=np.float32)
+        tiles[0, 0] = block
+        ref = a.numpy()
+        np.testing.assert_array_equal(ref[: block.shape[0], : block.shape[1]], block)
+        assert ref.sum() == block.sum()
+
+    def test_tile_locations(self, comm):
+        a = ht.zeros((comm.size * 2, 4), split=0, comm=comm)
+        locs = SplitTiles(a).tile_locations
+        assert locs.shape == (comm.size, comm.size)
+        # ownership varies along the split dim (axis 0)
+        for r in range(comm.size):
+            assert (locs[r] == r).all()
+        b = ht.zeros((4, 4), comm=comm)  # replicated
+        assert (SplitTiles(b).tile_locations == -1).all()
+
+    def test_validation(self, comm):
+        with pytest.raises(TypeError):
+            SplitTiles(np.zeros((4, 4)))
+        a = ht.zeros((4, 4), split=0, comm=comm)
+        t = SplitTiles(a)
+        with pytest.raises(IndexError):
+            t[comm.size + 1, 0]
+        with pytest.raises(ValueError):
+            t[0, 0, 0]
+
+
+class TestSquareDiagTiles:
+    @pytest.mark.parametrize("split", [0, 1])
+    @pytest.mark.parametrize("shape", [(16, 16), (24, 12), (12, 24)])
+    def test_boundaries_cover_matrix(self, comm, split, shape):
+        a = ht.random.randn(*shape, split=split, comm=comm)
+        tiles = SquareDiagTiles(a, tiles_per_proc=2)
+        m, n = shape
+        rows = tiles.row_indices
+        cols = tiles.col_indices
+        assert rows[0] == 0 and cols[0] == 0
+        assert sorted(rows) == rows and sorted(cols) == cols
+        # reassembling all tiles reproduces the matrix
+        ref = a.numpy()
+        acc = np.zeros_like(ref)
+        for i in range(tiles.tile_rows):
+            for j in range(tiles.tile_columns):
+                r0, r1, c0, c1 = tiles.get_start_stop((i, j))
+                acc[r0:r1, c0:c1] = np.asarray(tiles[i, j])
+        np.testing.assert_allclose(acc, ref, rtol=1e-6)
+
+    def test_diag_tiles_square(self, comm):
+        n = 8 * comm.size
+        a = ht.random.randn(n, n, split=0, comm=comm)
+        tiles = SquareDiagTiles(a, tiles_per_proc=2)
+        for i in range(min(tiles.tile_rows, tiles.tile_columns)):
+            r0, r1, c0, c1 = tiles.get_start_stop((i, i))
+            assert r1 - r0 == c1 - c0  # diagonal tiles are square
+            assert r0 == c0
+
+    def test_per_process_counts(self, comm):
+        n = 4 * comm.size
+        a = ht.random.randn(n, n, split=0, comm=comm)
+        tiles = SquareDiagTiles(a, tiles_per_proc=2)
+        assert sum(tiles.tile_rows_per_process) == tiles.tile_rows
+        assert tiles.last_diagonal_process == comm.size - 1
+        tm = tiles.tile_map
+        assert tm.shape == (tiles.tile_rows, tiles.tile_columns, 3)
+        assert (tm[..., 2] < comm.size).all()
+
+    def test_setitem(self, comm):
+        n = 4 * comm.size
+        a = ht.zeros((n, n), split=0, comm=comm)
+        tiles = SquareDiagTiles(a, tiles_per_proc=1)
+        r0, r1, c0, c1 = tiles.get_start_stop((1, 1))
+        tiles[1, 1] = np.ones((r1 - r0, c1 - c0), dtype=np.float32)
+        assert a.numpy().sum() == (r1 - r0) * (c1 - c0)
+
+    def test_validation(self, comm):
+        a = ht.zeros((4, 4, 4), split=0, comm=comm)
+        with pytest.raises(ValueError):
+            SquareDiagTiles(a)
+        b = ht.zeros((4, 4), comm=comm)
+        with pytest.raises(ValueError):
+            SquareDiagTiles(b)  # replicated not allowed
+        c = ht.zeros((4, 4), split=0, comm=comm)
+        with pytest.raises(ValueError):
+            SquareDiagTiles(c, tiles_per_proc=0)
