@@ -97,10 +97,10 @@ def load_csv(
         data = np.genfromtxt(
             path, delimiter=sep, skip_header=header_lines, encoding=encoding
         )
-        if data.ndim == 1:
-            # genfromtxt collapses both single rows and single columns to
-            # 1-D; recover (rows, cols) — the reference's invariant shape —
-            # from the first data line's field count
+        if data.ndim < 2:
+            # genfromtxt collapses single rows/columns to 1-D and a single
+            # value to 0-D; recover (rows, cols) — the reference's invariant
+            # shape — from the first data line's field count
             with open(path, "r", encoding=encoding) as f:
                 for _ in range(header_lines):
                     f.readline()
@@ -193,27 +193,25 @@ def save_checkpoint(state, path: str) -> None:
     extension; the reference's checkpoint story is array save/load via HDF5,
     SURVEY §5 — orbax adds per-shard parallel writes via TensorStore/ocdbt).
 
-    DNDarrays are stored as their logical arrays plus split metadata and are
-    restored as DNDarrays by :func:`load_checkpoint`."""
+    DNDarrays are stored as their *sharded* device buffers (orbax writes one
+    TensorStore chunk per shard in parallel — no host gather) plus
+    gshape/split metadata, and are restored as DNDarrays by
+    :func:`load_checkpoint`."""
     import jax
     import orbax.checkpoint as ocp
 
     def pack(x):
         if isinstance(x, DNDarray):
             return {
-                "__dndarray__": np.asarray(x.numpy()),
+                "__dndarray__": x.larray,  # padded sharded buffer, as-is
+                "gshape": np.asarray(x.shape, dtype=np.int64),
                 "split": -1 if x.split is None else x.split,
             }
         return x
 
     packed = [pack(x) for x in jax.tree.leaves(state)]
-    structure = jax.tree.structure(state)
     with ocp.PyTreeCheckpointer() as ckptr:
-        ckptr.save(
-            os.path.abspath(path),
-            {"leaves": packed, "treedef": str(structure)},
-            force=True,
-        )
+        ckptr.save(os.path.abspath(path), {"leaves": packed}, force=True)
 
 
 def load_checkpoint(path: str, like=None, comm=None, device=None):
@@ -233,12 +231,17 @@ def load_checkpoint(path: str, like=None, comm=None, device=None):
     def unpack(x):
         if isinstance(x, dict) and "__dndarray__" in x:
             split = int(x["split"])
-            return _array(
-                np.asarray(x["__dndarray__"]),
-                split=None if split < 0 else split,
-                comm=comm,
-                device=device,
-            )
+            split = None if split < 0 else split
+            gshape = tuple(int(s) for s in np.asarray(x["gshape"]))
+            buf = np.asarray(x["__dndarray__"])
+            if split is not None:
+                # stored buffer is the padded physical layout; slice back to
+                # the logical extent before resharding (the current mesh may
+                # differ from the one that wrote the checkpoint)
+                sl = [slice(None)] * buf.ndim
+                sl[split] = slice(0, gshape[split])
+                buf = buf[tuple(sl)]
+            return _array(buf, split=split, comm=comm, device=device)
         return x
 
     leaves = [unpack(x) for x in leaves]

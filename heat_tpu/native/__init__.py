@@ -19,7 +19,6 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
-import tempfile
 import threading
 from typing import Optional
 
@@ -36,19 +35,33 @@ _build_failed = False
 
 
 def _build() -> bool:
-    """Compile fastcsv.cpp -> _fastcsv.so with g++. Returns success."""
+    """Compile fastcsv.cpp -> _fastcsv.so with g++. Returns success.
+
+    Compiles to a process-unique temp path and renames into place so
+    concurrent builders (pytest workers, data-loader processes) can't load a
+    half-written library — rename is atomic on POSIX."""
+    tmp = os.path.join(_HERE, f"._fastcsv.{os.getpid()}.so")
     try:
         result = subprocess.run(
             [
                 "g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
-                _SRC, "-o", _LIB_PATH,
+                _SRC, "-o", tmp,
             ],
             capture_output=True,
             timeout=120,
         )
-        return result.returncode == 0 and os.path.exists(_LIB_PATH)
+        if result.returncode != 0 or not os.path.exists(tmp):
+            return False
+        os.replace(tmp, _LIB_PATH)
+        return True
     except (OSError, subprocess.TimeoutExpired):
         return False
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
 
 
 def _load() -> Optional[ctypes.CDLL]:

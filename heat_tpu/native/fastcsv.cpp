@@ -20,6 +20,7 @@
 #include <cstdlib>
 #include <cstring>
 #include <fcntl.h>
+#include <locale.h>
 #include <sys/mman.h>
 #include <sys/stat.h>
 #include <thread>
@@ -105,6 +106,13 @@ void line_starts(const char* data, size_t lo, size_t hi,
     }
 }
 
+// strtod honors LC_NUMERIC; a host app running under a comma-decimal locale
+// (de_DE etc.) would silently truncate "1.5" to 1.0. Pin the C locale.
+double strtod_c(const char* s, char** end) {
+    static locale_t c_loc = newlocale(LC_NUMERIC_MASK, "C", nullptr);
+    return strtod_l(s, end, c_loc);
+}
+
 long count_fields(const char* line, size_t len, char sep) {
     if (len == 0) return 0;
     long n = 1;
@@ -135,7 +143,7 @@ void parse_rows(const char* data, size_t size, char sep,
                 continue;
             }
             char* after = nullptr;
-            double v = strtod(data + pos, &after);
+            double v = strtod_c(data + pos, &after);
             const char* stop = after;
             if (stop == data + pos || stop > data + end) {
                 // empty/non-numeric field — or strtod skipped a
