@@ -1,0 +1,65 @@
+"""Hierarchical asynchronous training with DASO (reference:
+examples/nn/imagenet-DASO.py, condensed).
+
+Shows the full DASO loop: 2-level (node x local) mesh, warmup -> cycling ->
+cooldown phases, plateau-driven skip decay, and the delayed cross-node bf16
+parameter merge. Runs on a virtual mesh:
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+        python examples/nn/daso_training.py
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import heat_tpu as ht
+from heat_tpu.optim import DASO
+
+
+def main(epochs=10, batches_per_epoch=8, batch_size=64):
+    rng = np.random.default_rng(0)
+    d = 32
+    n = batches_per_epoch * batch_size
+    x = jnp.asarray(rng.standard_normal((n, d)), jnp.float32)
+    w_true = jnp.asarray(rng.standard_normal((d, 1)), jnp.float32)
+    y = x @ w_true + 0.01 * jnp.asarray(rng.standard_normal((n, 1)), jnp.float32)
+
+    def loss_fn(params, xb, yb):
+        return jnp.mean((xb @ params["w"] - yb) ** 2)
+
+    daso = DASO(
+        optax.adam(5e-2),
+        total_epochs=epochs,
+        warmup_epochs=2,
+        cooldown_epochs=2,
+        max_global_skips=4,
+        verbose=True,
+    )
+    daso.set_loss(loss_fn)
+    daso.last_batch = batches_per_epoch - 1
+
+    params = daso.stack_params({"w": jnp.zeros((d, 1), jnp.float32)})
+    opt_state = daso.init(params)
+
+    for epoch in range(epochs):
+        total = 0.0
+        for b in range(batches_per_epoch):
+            lo = b * batch_size
+            batch = (x[lo : lo + batch_size], y[lo : lo + batch_size])
+            params, opt_state, loss = daso.step(params, opt_state, batch)
+            total += float(loss)
+        avg = total / batches_per_epoch
+        daso.epoch_loss_logic(avg)
+        print(
+            f"epoch {epoch}: loss {avg:.5f} "
+            f"(gs={daso.global_skip} ls={daso.local_skip} btw={daso.batches_to_wait})"
+        )
+
+    final = daso.unstack_params(params)
+    err = float(jnp.abs(final["w"] - w_true).max())
+    print(f"max |w - w_true| = {err:.4f}")
+
+
+if __name__ == "__main__":
+    main()

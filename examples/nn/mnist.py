@@ -1,0 +1,79 @@
+"""Data-parallel training example (reference: examples/nn/mnist.py).
+
+Trains a small MLP classifier with `heat_tpu.nn.DataParallel` +
+`heat_tpu.utils.data.DataLoader`. Uses torchvision MNIST when available and
+synthetic digit-like blobs otherwise, so the example runs in any image.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import heat_tpu as ht
+from heat_tpu.nn import DataParallel
+from heat_tpu.utils.data import DataLoader, Dataset
+
+
+def load_data(n=8192):
+    try:
+        from heat_tpu.utils.data import MNISTDataset
+
+        ds = MNISTDataset("/tmp/mnist-data", train=True)
+        return ds
+    except ImportError:
+        # synthetic 10-class blobs shaped like flattened digits
+        rng = np.random.default_rng(0)
+        protos = rng.standard_normal((10, 784)).astype(np.float32)
+        labels = rng.integers(0, 10, n).astype(np.int32)
+        images = protos[labels] + 0.3 * rng.standard_normal((n, 784)).astype(
+            np.float32
+        )
+        return Dataset(
+            ht.array(images, split=0), targets=ht.array(labels, split=0)
+        )
+
+
+def init_params(rng_key, d_in=784, d_hidden=128, n_classes=10):
+    k1, k2 = jax.random.split(rng_key)
+    return {
+        "w1": jax.random.normal(k1, (d_in, d_hidden)) * 0.05,
+        "b1": jnp.zeros((d_hidden,)),
+        "w2": jax.random.normal(k2, (d_hidden, n_classes)) * 0.05,
+        "b2": jnp.zeros((n_classes,)),
+    }
+
+
+def apply(params, x):
+    h = jax.nn.relu(x @ params["w1"] + params["b1"])
+    return h @ params["w2"] + params["b2"]
+
+
+def loss_fn(params, x, y):
+    logits = apply(params, x)
+    return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+
+
+def main(epochs=3, batch_size=256, lr=1e-3):
+    dataset = load_data()
+    loader = DataLoader(dataset, batch_size=batch_size)
+    dp = DataParallel(apply, optimizer=optax.adam(lr))
+    step = dp.make_train_step(loss_fn)
+
+    params = jax.device_put(
+        init_params(jax.random.key(0)), dp.comm.replicated()
+    )
+    opt_state = dp.optimizer.init(params)
+
+    for epoch in range(epochs):
+        total, nb = 0.0, 0
+        for xb, yb in loader:
+            xb = xb.reshape(xb.shape[0], -1) / 255.0 if xb.ndim > 2 else xb
+            params, opt_state, loss = step(params, opt_state, xb, yb)
+            total += float(loss)
+            nb += 1
+        print(f"epoch {epoch}: loss {total / nb:.4f} ({nb} batches)")
+
+
+if __name__ == "__main__":
+    main()

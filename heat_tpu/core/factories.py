@@ -135,10 +135,18 @@ def array(
         data = data[None]
 
     if is_split is not None:
-        # obj is one position's shard; global = concatenation of `size` shards
+        # reference semantics: the given array is this *process's* local
+        # shard and the global shape is inferred from all processes
+        # (factories.py:386-429, neighbor handshake). Single-controller JAX
+        # has one process, so the local portion IS the global array; on
+        # multi-host this is where make_array_from_process_local_data would
+        # assemble the shards.
+        if jax.process_count() > 1:  # pragma: no cover - multi-host only
+            raise NotImplementedError(
+                "is_split across multiple controller processes is not wired "
+                "yet; use split= with the global array"
+            )
         is_split = sanitize_axis(data.shape, is_split)
-        blocks = [data] * comm.size
-        data = jnp.concatenate(blocks, axis=is_split) if comm.size > 1 else data
         return _wrap(data, is_split, device, comm, dtype)
 
     split = sanitize_axis(data.shape, split)

@@ -110,6 +110,7 @@ void line_starts(const char* data, size_t lo, size_t hi,
 // (de_DE etc.) would silently truncate "1.5" to 1.0. Pin the C locale.
 double strtod_c(const char* s, char** end) {
     static locale_t c_loc = newlocale(LC_NUMERIC_MASK, "C", nullptr);
+    if (!c_loc) return strtod(s, end);  // newlocale failed: plain strtod
     return strtod_l(s, end, c_loc);
 }
 

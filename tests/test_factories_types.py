@@ -1,0 +1,169 @@
+"""Factories and the type system (reference: heat/core/tests/
+test_factories.py 967 LoC, test_types.py)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from .basic_test import TestCase
+
+
+class TestFactories(TestCase):
+    def test_arange(self):
+        for split in (None, 0):
+            self.assert_array_equal(ht.arange(10, split=split), np.arange(10))
+            self.assert_array_equal(
+                ht.arange(2, 20, 3, split=split), np.arange(2, 20, 3)
+            )
+
+    def test_linspace_logspace(self):
+        for split in (None, 0):
+            self.assert_array_equal(
+                ht.linspace(0, 1, 11, split=split), np.linspace(0, 1, 11),
+                rtol=1e-6,
+            )
+        self.assert_array_equal(
+            ht.logspace(0, 3, 7), np.logspace(0, 3, 7).astype(np.float32),
+            rtol=1e-5,
+        )
+
+    def test_eye_full(self):
+        for split in (None, 0, 1):
+            self.assert_array_equal(ht.eye(6, split=split), np.eye(6))
+            self.assert_array_equal(
+                ht.full((4, 5), 3.5, split=split), np.full((4, 5), 3.5)
+            )
+        self.assert_array_equal(ht.eye((4, 6)), np.eye(4, 6))
+
+    def test_zeros_ones_like(self):
+        a = ht.arange(12, split=0).reshape((3, 4))
+        self.assert_array_equal(ht.zeros_like(a), np.zeros((3, 4)))
+        self.assert_array_equal(ht.ones_like(a), np.ones((3, 4)))
+        self.assert_array_equal(ht.empty_like(a) * 0, np.zeros((3, 4)))
+        self.assert_array_equal(ht.full_like(a, 2), np.full((3, 4), 2))
+
+    def test_meshgrid(self):
+        x = np.arange(4, dtype=np.float32)
+        y = np.arange(3, dtype=np.float32)
+        got = ht.meshgrid(ht.array(x), ht.array(y))
+        want = np.meshgrid(x, y)
+        for g, w in zip(got, want):
+            self.assert_array_equal(g, w)
+
+    def test_array_is_split(self):
+        # is_split: the supplied array is this process's local portion
+        # (reference factories.py:386-429); single-controller local == global
+        n = 4 * self.comm.size
+        full = np.arange(n, dtype=np.float32)
+        b = ht.array(full, is_split=0)
+        assert b.split == 0
+        self.assert_array_equal(b, full)
+        with pytest.raises(ValueError):
+            ht.array(full, split=0, is_split=0)  # mutually exclusive
+
+    def test_array_copy_and_dtype(self):
+        a = ht.array([[1, 2], [3, 4]], dtype=ht.float32, split=0)
+        assert a.dtype == ht.float32
+        self.assert_array_equal(a, np.asarray([[1, 2], [3, 4]], dtype=np.float32))
+
+
+class TestTypes(TestCase):
+    def test_promote_types(self):
+        # reference semantics keep bit length where possible (reference
+        # types.py docstring: promote_types(int32, float32) -> float32)
+        assert ht.promote_types(ht.int32, ht.float32) == ht.float32
+        assert ht.promote_types(ht.uint8, ht.uint8) == ht.uint8
+        assert ht.promote_types(ht.float32, ht.float64) == ht.float64
+        assert ht.promote_types(ht.int8, ht.uint8) == ht.int16
+
+    def test_can_cast(self):
+        assert ht.can_cast(ht.int32, ht.int64)
+        assert not ht.can_cast(ht.float64, ht.int32)
+
+    def test_heat_type_of(self):
+        a = ht.arange(4, dtype=ht.int64)
+        assert ht.heat_type_of(a) == ht.int64
+
+    def test_finfo_iinfo(self):
+        fi = ht.finfo(ht.float32)
+        assert fi.bits == 32
+        ii = ht.iinfo(ht.int16)
+        assert ii.max == 2**15 - 1
+
+    def test_type_cast_instantiation(self):
+        # instantiating a type casts (reference types.py:85)
+        a = ht.float32(np.asarray([1.7, 2.2]))
+        assert a.dtype == ht.float32
+
+    def test_astype(self):
+        a = ht.arange(5, split=0)
+        b = a.astype(ht.float64)
+        assert b.dtype == ht.float64
+        self.assert_array_equal(b, np.arange(5, dtype=np.float64))
+
+    def test_bool_complex_public_types(self):
+        assert ht.canonical_heat_type(ht.bool) is not None
+        x = ht.array([1 + 1j], dtype=ht.complex64)
+        assert x.dtype == ht.complex64
+
+    def test_bfloat16_extension(self):
+        # TPU-native extension: bfloat16 as a public dtype (SURVEY §7 stage 2)
+        assert hasattr(ht, "bfloat16")
+        a = ht.array([1.5, 2.5], dtype=ht.bfloat16)
+        assert a.dtype == ht.bfloat16
+
+
+class TestDNDarrayBasics(TestCase):
+    def test_item_and_casts(self):
+        a = ht.array([[5.0]], split=0)
+        assert a.item() == 5.0
+        assert float(ht.array(3.5)) == 3.5
+        assert int(ht.array(3)) == 3
+        assert bool(ht.array(True))
+
+    def test_len_iter(self):
+        a = ht.arange(6, split=0)
+        assert len(a) == 6
+        vals = [float(v) for v in a]
+        assert vals == [0.0, 1.0, 2.0, 3.0, 4.0, 5.0]
+
+    def test_getitem_setitem(self):
+        m = np.arange(24, dtype=np.float32).reshape(4, 6)
+        for split in (None, 0, 1):
+            x = ht.array(m, split=split)
+            self.assert_array_equal(x[1], m[1])
+            self.assert_array_equal(x[:, 2], m[:, 2])
+            self.assert_array_equal(x[1:3, 2:5], m[1:3, 2:5])
+            np.testing.assert_allclose(x[2, 3].numpy(), m[2, 3])
+        x = ht.array(m, split=0)
+        x[0] = 42.0
+        want = m.copy()
+        want[0] = 42.0
+        self.assert_array_equal(x, want)
+
+    def test_boolean_mask(self):
+        a = np.asarray([1.0, -2.0, 3.0, -4.0], dtype=np.float32)
+        x = ht.array(a, split=0)
+        got = x[x > 0]
+        np.testing.assert_allclose(got.numpy(), a[a > 0])
+
+    def test_fill_diagonal(self):
+        x = ht.zeros((4, 4), split=0)
+        x.fill_diagonal(2.0)
+        self.assert_array_equal(x, np.eye(4) * 2)
+
+    def test_halo(self):
+        n = 2 * self.comm.size
+        x = ht.array(np.arange(n, dtype=np.float32).reshape(n, 1), split=0)
+        h = x.array_with_halos(1)
+        assert h.shape[0] >= n
+
+    def test_resplit_inplace(self):
+        m = np.arange(12, dtype=np.float32).reshape(3, 4)
+        x = ht.array(m, split=0)
+        x.resplit_(1)
+        assert x.split == 1
+        self.assert_array_equal(x, m)
+        x.resplit_(None)
+        assert x.split is None
+        self.assert_array_equal(x, m)

@@ -1,0 +1,209 @@
+"""Shape/layout manipulation ops vs the numpy oracle across splits
+(reference: heat/core/tests/test_manipulations.py, 3606 LoC — the
+comm-heaviest test module: sort/unique/topk/reshape/resplit)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from .basic_test import TestCase
+
+
+class TestShapeOps(TestCase):
+    def test_reshape(self):
+        a = np.arange(24, dtype=np.float32)
+        for split in (None, 0):
+            x = ht.array(a, split=split)
+            self.assert_array_equal(ht.reshape(x, (4, 6)), a.reshape(4, 6))
+            self.assert_array_equal(ht.reshape(x, (2, 3, 4)), a.reshape(2, 3, 4))
+        m = np.arange(24, dtype=np.float32).reshape(6, 4)
+        for split in (None, 0, 1):
+            x = ht.array(m, split=split)
+            self.assert_array_equal(ht.reshape(x, (4, 6)), m.reshape(4, 6))
+        # new_split relocation
+        y = ht.reshape(ht.array(m, split=0), (24,), new_split=0)
+        assert y.split == 0
+        self.assert_array_equal(y, m.reshape(24))
+
+    def test_flatten_ravel(self):
+        m = np.arange(12, dtype=np.float32).reshape(3, 4)
+        for split in (None, 0, 1):
+            x = ht.array(m, split=split)
+            self.assert_array_equal(ht.flatten(x), m.flatten())
+            self.assert_array_equal(ht.ravel(x), m.ravel())
+
+    def test_expand_squeeze(self):
+        m = np.arange(6, dtype=np.float32).reshape(2, 3)
+        x = ht.array(m, split=0)
+        self.assert_array_equal(ht.expand_dims(x, 1), m[:, None, :])
+        s = ht.array(m[None], split=1)
+        self.assert_array_equal(ht.squeeze(s, 0), m)
+
+    def test_moveaxis_swapaxes_rot90(self):
+        m = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+        for split in (None, 0, 1, 2):
+            x = ht.array(m, split=split)
+            self.assert_array_equal(ht.moveaxis(x, 0, 2), np.moveaxis(m, 0, 2))
+            self.assert_array_equal(ht.swapaxes(x, 0, 1), np.swapaxes(m, 0, 1))
+        sq = np.arange(16, dtype=np.float32).reshape(4, 4)
+        for split in (None, 0, 1):
+            self.assert_array_equal(ht.rot90(ht.array(sq, split=split)), np.rot90(sq))
+
+
+class TestJoinSplit(TestCase):
+    def test_concatenate_split_combos(self):
+        a = np.arange(12, dtype=np.float32).reshape(4, 3)
+        b = np.arange(12, 24, dtype=np.float32).reshape(4, 3)
+        for axis in (0, 1):
+            want = np.concatenate([a, b], axis=axis)
+            for sa in (None, 0, 1):
+                for sb in (None, sa):
+                    x = ht.array(a, split=sa)
+                    y = ht.array(b, split=sb)
+                    self.assert_array_equal(ht.concatenate([x, y], axis=axis), want)
+
+    def test_stack_family(self):
+        a = np.arange(6, dtype=np.float32).reshape(2, 3)
+        b = a + 10
+        x, y = ht.array(a, split=0), ht.array(b, split=0)
+        self.assert_array_equal(ht.stack([x, y]), np.stack([a, b]))
+        self.assert_array_equal(ht.vstack([x, y]), np.vstack([a, b]))
+        self.assert_array_equal(ht.hstack([x, y]), np.hstack([a, b]))
+        self.assert_array_equal(ht.column_stack([x, y]), np.column_stack([a, b]))
+        self.assert_array_equal(ht.row_stack([x, y]), np.vstack([a, b]))
+
+    def test_split_family(self):
+        m = np.arange(24, dtype=np.float32).reshape(4, 6)
+        x = ht.array(m, split=0)
+        for got, want in zip(ht.hsplit(x, 2), np.hsplit(m, 2)):
+            self.assert_array_equal(got, want)
+        for got, want in zip(ht.vsplit(x, 2), np.vsplit(m, 2)):
+            self.assert_array_equal(got, want)
+        t = np.arange(16, dtype=np.float32).reshape(2, 2, 4)
+        for got, want in zip(ht.dsplit(ht.array(t, split=0), 2), np.dsplit(t, 2)):
+            self.assert_array_equal(got, want)
+        for got, want in zip(ht.split(x, 2, axis=1), np.split(m, 2, axis=1)):
+            self.assert_array_equal(got, want)
+
+
+class TestRearrange(TestCase):
+    def test_flip(self):
+        m = np.arange(12, dtype=np.float32).reshape(3, 4)
+        for split in (None, 0, 1):
+            x = ht.array(m, split=split)
+            self.assert_array_equal(ht.flip(x, 0), np.flip(m, 0))
+            self.assert_array_equal(ht.fliplr(x), np.fliplr(m))
+            self.assert_array_equal(ht.flipud(x), np.flipud(m))
+
+    def test_roll(self):
+        m = np.arange(12, dtype=np.float32).reshape(3, 4)
+        for split in (None, 0, 1):
+            x = ht.array(m, split=split)
+            self.assert_array_equal(ht.roll(x, 2, axis=0), np.roll(m, 2, axis=0))
+            self.assert_array_equal(ht.roll(x, -1, axis=1), np.roll(m, -1, axis=1))
+            self.assert_array_equal(ht.roll(x, 5), np.roll(m, 5))
+
+    def test_pad(self):
+        m = np.arange(6, dtype=np.float32).reshape(2, 3)
+        for split in (None, 0, 1):
+            x = ht.array(m, split=split)
+            self.assert_array_equal(
+                ht.pad(x, ((1, 1), (2, 0)), constant_values=7),
+                np.pad(m, ((1, 1), (2, 0)), constant_values=7),
+            )
+
+    def test_repeat_tile(self):
+        a = np.asarray([1.0, 2.0, 3.0], dtype=np.float32)
+        for split in (None, 0):
+            x = ht.array(a, split=split)
+            self.assert_array_equal(ht.repeat(x, 3), np.repeat(a, 3))
+            self.assert_array_equal(ht.tile(x, 2), np.tile(a, 2))
+        m = np.arange(4, dtype=np.float32).reshape(2, 2)
+        self.assert_array_equal(
+            ht.repeat(ht.array(m, split=0), 2, axis=1), np.repeat(m, 2, axis=1)
+        )
+
+    def test_diag_diagonal(self):
+        v = np.asarray([1.0, 2.0, 3.0], dtype=np.float32)
+        self.assert_array_equal(ht.diag(ht.array(v, split=0)), np.diag(v))
+        m = np.arange(16, dtype=np.float32).reshape(4, 4)
+        for split in (None, 0, 1):
+            x = ht.array(m, split=split)
+            self.assert_array_equal(ht.diagonal(x), np.diagonal(m))
+            self.assert_array_equal(ht.diag(x, offset=1), np.diag(m, k=1))
+
+
+class TestSortSearch(TestCase):
+    def test_sort_all_splits(self):
+        rng = np.random.default_rng(3)
+        m = rng.standard_normal((7, 5)).astype(np.float32)
+        for split in (None, 0, 1):
+            for axis in (0, 1, -1):
+                x = ht.array(m, split=split)
+                got, idx = ht.sort(x, axis=axis)
+                self.assert_array_equal(got, np.sort(m, axis=axis))
+        got, idx = ht.sort(ht.array(m, split=0), axis=0, descending=True)
+        self.assert_array_equal(got, -np.sort(-m, axis=0))
+
+    def test_sort_ragged(self):
+        # length not divisible by the mesh: pad neutralization must not leak
+        n = 8 * self.comm.size + 3
+        rng = np.random.default_rng(4)
+        a = rng.standard_normal(n).astype(np.float32)
+        got, _ = ht.sort(ht.array(a, split=0))
+        self.assert_array_equal(got, np.sort(a))
+
+    def test_topk(self):
+        rng = np.random.default_rng(5)
+        a = rng.standard_normal(20).astype(np.float32)
+        for split in (None, 0):
+            x = ht.array(a, split=split)
+            vals, idx = ht.topk(x, 5)
+            np.testing.assert_allclose(
+                vals.numpy(), np.sort(a)[::-1][:5], rtol=1e-6
+            )
+            np.testing.assert_allclose(a[idx.numpy()], vals.numpy(), rtol=1e-6)
+        vals, idx = ht.topk(ht.array(a, split=0), 4, largest=False)
+        np.testing.assert_allclose(vals.numpy(), np.sort(a)[:4], rtol=1e-6)
+
+    def test_unique(self):
+        a = np.asarray([3, 1, 2, 3, 1, 7], dtype=np.int64)
+        for split in (None, 0):
+            got = ht.unique(ht.array(a, split=split), sorted=True)
+            np.testing.assert_array_equal(got.numpy(), np.unique(a))
+        got, inv = ht.unique(ht.array(a, split=0), sorted=True, return_inverse=True)
+        w, winv = np.unique(a, return_inverse=True)
+        np.testing.assert_array_equal(got.numpy()[inv.numpy()], a)
+
+    def test_nonzero_where(self):
+        a = np.asarray([[0.0, 1.0], [2.0, 0.0]], dtype=np.float32)
+        for split in (None, 0):
+            x = ht.array(a, split=split)
+            got = ht.nonzero(x)
+            want = np.stack(np.nonzero(a), axis=1)
+            np.testing.assert_array_equal(np.asarray(got.numpy()), want)
+            self.assert_array_equal(
+                ht.where(x > 0, x, ht.zeros_like(x)), np.where(a > 0, a, 0)
+            )
+
+
+class TestDistribution(TestCase):
+    def test_resplit_roundtrip(self):
+        m = np.arange(30, dtype=np.float32).reshape(5, 6)
+        x = ht.array(m, split=0)
+        for target in (1, None, 0):
+            x = ht.resplit(x, target)
+            assert x.split == target
+            self.assert_array_equal(x, m)
+
+    def test_balance_noop(self):
+        x = ht.arange(10, split=0)
+        assert x.is_balanced()
+        ht.balance(x)
+        self.assert_array_equal(x, np.arange(10))
+
+    def test_redistribute(self):
+        m = np.arange(12, dtype=np.float32)
+        x = ht.array(m, split=0)
+        ht.redistribute(x)
+        self.assert_array_equal(x, m)

@@ -1,0 +1,210 @@
+"""Elementwise / binary / reduction / scan ops vs the numpy oracle, swept
+over every split axis (reference: heat/core/tests/test_arithmetics.py,
+test_relational.py, test_rounding.py, test_exponential.py,
+test_trigonometrics.py, test_logical.py — the assert_func_equal pattern of
+basic_test.py:142)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from .basic_test import TestCase
+
+
+class TestLocalOps(TestCase):
+    """Pure elementwise ops (reference __local_op instances)."""
+
+    def test_rounding(self):
+        shape = (7, 5)
+        self.assert_func_equal(shape, ht.abs, np.abs)
+        self.assert_func_equal(shape, ht.fabs, np.fabs)
+        self.assert_func_equal(shape, ht.ceil, np.ceil)
+        self.assert_func_equal(shape, ht.floor, np.floor)
+        self.assert_func_equal(shape, ht.trunc, np.trunc)
+        self.assert_func_equal(shape, ht.round, np.round)
+        self.assert_func_equal(
+            shape, ht.clip, np.clip,
+            heat_args={"min": -10, "max": 10},
+            numpy_args={"a_min": -10, "a_max": 10},
+        )
+
+    def test_exponential(self):
+        shape = (6, 4)
+        kw = dict(low=0.1, high=20)
+        self.assert_func_equal(shape, ht.exp, np.exp, low=-3, high=3)
+        self.assert_func_equal(shape, ht.expm1, np.expm1, low=-3, high=3)
+        self.assert_func_equal(shape, ht.exp2, np.exp2, low=-3, high=3)
+        self.assert_func_equal(shape, ht.log, np.log, **kw)
+        self.assert_func_equal(shape, ht.log2, np.log2, **kw)
+        self.assert_func_equal(shape, ht.log10, np.log10, **kw)
+        self.assert_func_equal(shape, ht.log1p, np.log1p, **kw)
+        self.assert_func_equal(shape, ht.sqrt, np.sqrt, **kw)
+        self.assert_func_equal(shape, ht.square, np.square, low=-5, high=5)
+
+    def test_trigonometric(self):
+        shape = (5, 5)
+        kw = dict(low=-3, high=3)
+        for h, n in [
+            (ht.sin, np.sin), (ht.cos, np.cos), (ht.tan, np.tan),
+            (ht.sinh, np.sinh), (ht.cosh, np.cosh), (ht.tanh, np.tanh),
+            (ht.arctan, np.arctan),
+        ]:
+            self.assert_func_equal(shape, h, n, **kw)
+        self.assert_func_equal(shape, ht.arcsin, np.arcsin, low=-0.9, high=0.9)
+        self.assert_func_equal(shape, ht.arccos, np.arccos, low=-0.9, high=0.9)
+        self.assert_func_equal(shape, ht.deg2rad, np.deg2rad, low=-180, high=180)
+        self.assert_func_equal(shape, ht.rad2deg, np.rad2deg, **kw)
+
+    def test_modf(self):
+        a = np.asarray([[1.5, -2.25], [0.75, 3.0]], dtype=np.float32)
+        for split in (None, 0, 1):
+            frac, whole = ht.modf(ht.array(a, split=split))
+            nf, nw = np.modf(a)
+            self.assert_array_equal(frac, nf)
+            self.assert_array_equal(whole, nw)
+
+
+class TestBinaryOps(TestCase):
+    def _sweep_binary(self, ht_op, np_op, low=-100, high=100, ints=False):
+        rng = np.random.default_rng(1)
+        shape = (6, 4)
+        if ints:
+            a = rng.integers(low, high, size=shape).astype(np.int64)
+            b = rng.integers(1, high, size=shape).astype(np.int64)
+        else:
+            a = rng.uniform(low, high, size=shape).astype(np.float32)
+            b = rng.uniform(1, high, size=shape).astype(np.float32)
+        want = np_op(a, b)
+        for split in (None, 0, 1):
+            x = ht.array(a, split=split)
+            y = ht.array(b, split=split)
+            self.assert_array_equal(ht_op(x, y), want)
+        # scalar second operand
+        self.assert_array_equal(ht_op(ht.array(a, split=0), 3), np_op(a, 3))
+
+    def test_arithmetic(self):
+        self._sweep_binary(ht.add, np.add)
+        self._sweep_binary(ht.sub, np.subtract)
+        self._sweep_binary(ht.mul, np.multiply)
+        self._sweep_binary(ht.div, np.divide)
+        self._sweep_binary(ht.floordiv, np.floor_divide)
+        self._sweep_binary(ht.fmod, np.fmod)
+        self._sweep_binary(ht.pow, np.power, low=1, high=4)
+
+    def test_bitwise(self):
+        self._sweep_binary(ht.bitwise_and, np.bitwise_and, low=0, high=255, ints=True)
+        self._sweep_binary(ht.bitwise_or, np.bitwise_or, low=0, high=255, ints=True)
+        self._sweep_binary(ht.bitwise_xor, np.bitwise_xor, low=0, high=255, ints=True)
+        a = np.asarray([1, 2, 4, 8], dtype=np.int64)
+        self.assert_array_equal(ht.left_shift(ht.array(a, split=0), 2), a << 2)
+        self.assert_array_equal(ht.right_shift(ht.array(a, split=0), 1), a >> 1)
+        self.assert_array_equal(ht.invert(ht.array(a, split=0)), ~a)
+
+    def test_relational(self):
+        self._sweep_binary(ht.eq, np.equal)
+        self._sweep_binary(ht.ne, np.not_equal)
+        self._sweep_binary(ht.lt, np.less)
+        self._sweep_binary(ht.le, np.less_equal)
+        self._sweep_binary(ht.gt, np.greater)
+        self._sweep_binary(ht.ge, np.greater_equal)
+
+    def test_mismatched_split_raises(self):
+        a = ht.zeros((4, 4), split=0)
+        b = ht.zeros((4, 4), split=1)
+        with self.assertRaises(ValueError):
+            ht.add(a, b)
+
+    def test_broadcasting(self):
+        a = np.arange(12, dtype=np.float32).reshape(4, 3)
+        row = np.asarray([1.0, 2.0, 3.0], dtype=np.float32)
+        got = ht.add(ht.array(a, split=0), ht.array(row))
+        self.assert_array_equal(got, a + row)
+
+
+class TestReductions(TestCase):
+    def test_sum_prod(self):
+        shape = (5, 7)
+        for axis in (None, 0, 1):
+            self.assert_func_equal(
+                shape, ht.sum, np.sum,
+                heat_args={"axis": axis}, numpy_args={"axis": axis},
+                low=-5, high=5,
+            )
+        self.assert_func_equal(
+            (6,), ht.prod, np.prod, low=0.5, high=1.5
+        )
+
+    def test_cumsum_cumprod(self):
+        shape = (6, 4)
+        for axis in (0, 1):
+            self.assert_func_equal(
+                shape, ht.cumsum, np.cumsum,
+                heat_args={"axis": axis}, numpy_args={"axis": axis},
+                low=-5, high=5,
+            )
+        self.assert_func_equal(
+            (8,), ht.cumprod, np.cumprod,
+            heat_args={"axis": 0}, numpy_args={"axis": 0},
+            low=0.8, high=1.2,
+        )
+
+    def test_diff(self):
+        shape = (6, 5)
+        for axis in (0, 1):
+            self.assert_func_equal(
+                shape, ht.diff, np.diff,
+                heat_args={"axis": axis}, numpy_args={"axis": axis},
+            )
+
+
+class TestLogical(TestCase):
+    def test_any_all(self):
+        a = np.asarray([[True, False], [True, True]])
+        for split in (None, 0, 1):
+            x = ht.array(a, split=split)
+            assert bool(ht.all(x)) == a.all()
+            assert bool(ht.any(x)) == a.any()
+        for axis in (0, 1):
+            got = ht.all(ht.array(a, split=0), axis=axis)
+            self.assert_array_equal(got, a.all(axis=axis))
+
+    def test_isclose_allclose(self):
+        a = np.asarray([1.0, 2.0, 3.0], dtype=np.float32)
+        b = a + 1e-7
+        x, y = ht.array(a, split=0), ht.array(b, split=0)
+        assert bool(ht.allclose(x, y))
+        self.assert_array_equal(ht.isclose(x, y), np.isclose(a, b))
+
+    def test_isnan_isinf(self):
+        a = np.asarray([1.0, np.nan, np.inf, -np.inf], dtype=np.float32)
+        for split in (None, 0):
+            x = ht.array(a, split=split)
+            self.assert_array_equal(ht.isnan(x), np.isnan(a))
+            self.assert_array_equal(ht.isinf(x), np.isinf(a))
+            self.assert_array_equal(ht.isfinite(x), np.isfinite(a))
+        self.assert_array_equal(ht.isposinf(ht.array(a)), np.isposinf(a))
+        self.assert_array_equal(ht.isneginf(ht.array(a)), np.isneginf(a))
+
+    def test_logical_ops(self):
+        a = np.asarray([True, False, True, False])
+        b = np.asarray([True, True, False, False])
+        x, y = ht.array(a, split=0), ht.array(b, split=0)
+        self.assert_array_equal(ht.logical_and(x, y), a & b)
+        self.assert_array_equal(ht.logical_or(x, y), a | b)
+        self.assert_array_equal(ht.logical_xor(x, y), a ^ b)
+        self.assert_array_equal(ht.logical_not(x), ~a)
+
+    def test_signbit(self):
+        a = np.asarray([-1.5, 0.0, 2.0], dtype=np.float32)
+        self.assert_array_equal(ht.signbit(ht.array(a, split=0)), np.signbit(a))
+
+
+class TestComplex(TestCase):
+    def test_complex_parts(self):
+        a = np.asarray([1 + 2j, -3 - 4j], dtype=np.complex64)
+        for split in (None, 0):
+            x = ht.array(a, split=split)
+            self.assert_array_equal(ht.real(x), a.real)
+            self.assert_array_equal(ht.imag(x), a.imag)
+            self.assert_array_equal(ht.conj(x), np.conj(a))
+            self.assert_array_equal(ht.angle(x), np.angle(a).astype(np.float32))

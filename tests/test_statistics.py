@@ -1,0 +1,162 @@
+"""Statistics ops vs the numpy oracle across splits (reference:
+heat/core/tests/test_statistics.py, 1334 LoC)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from .basic_test import TestCase
+
+
+class TestArgreductions(TestCase):
+    def test_argmax_argmin(self):
+        rng = np.random.default_rng(0)
+        m = rng.standard_normal((6, 5)).astype(np.float32)
+        for split in (None, 0, 1):
+            x = ht.array(m, split=split)
+            assert int(ht.argmax(x)) == int(np.argmax(m))
+            assert int(ht.argmin(x)) == int(np.argmin(m))
+            for axis in (0, 1):
+                self.assert_array_equal(ht.argmax(x, axis=axis), np.argmax(m, axis=axis))
+                self.assert_array_equal(ht.argmin(x, axis=axis), np.argmin(m, axis=axis))
+
+    def test_argmax_ragged(self):
+        n = 4 * self.comm.size + 1
+        a = np.linspace(5, -5, n).astype(np.float32)  # max at index 0, min at tail
+        x = ht.array(a, split=0)
+        assert int(ht.argmax(x)) == 0
+        assert int(ht.argmin(x)) == n - 1
+
+    def test_max_min(self):
+        rng = np.random.default_rng(1)
+        m = rng.standard_normal((5, 6)).astype(np.float32)
+        for split in (None, 0, 1):
+            x = ht.array(m, split=split)
+            assert float(ht.max(x)) == pytest.approx(m.max())
+            assert float(ht.min(x)) == pytest.approx(m.min())
+            for axis in (0, 1):
+                self.assert_array_equal(ht.max(x, axis=axis), m.max(axis=axis))
+                self.assert_array_equal(ht.min(x, axis=axis), m.min(axis=axis))
+
+    def test_maximum_minimum(self):
+        a = np.asarray([1.0, 5.0, 3.0], dtype=np.float32)
+        b = np.asarray([2.0, 4.0, 3.0], dtype=np.float32)
+        x, y = ht.array(a, split=0), ht.array(b, split=0)
+        self.assert_array_equal(ht.maximum(x, y), np.maximum(a, b))
+        self.assert_array_equal(ht.minimum(x, y), np.minimum(a, b))
+
+
+class TestMoments(TestCase):
+    def test_mean_var_std(self):
+        rng = np.random.default_rng(2)
+        m = rng.standard_normal((8, 5)).astype(np.float32)
+        for split in (None, 0, 1):
+            x = ht.array(m, split=split)
+            assert float(ht.mean(x)) == pytest.approx(m.mean(), rel=1e-5)
+            for axis in (0, 1):
+                self.assert_array_equal(
+                    ht.mean(x, axis=axis), m.mean(axis=axis), rtol=1e-5, atol=1e-5
+                )
+                self.assert_array_equal(
+                    ht.var(x, axis=axis), m.var(axis=axis), rtol=1e-4, atol=1e-4
+                )
+                self.assert_array_equal(
+                    ht.std(x, axis=axis), m.std(axis=axis), rtol=1e-4, atol=1e-4
+                )
+                self.assert_array_equal(
+                    ht.var(x, axis=axis, ddof=1), m.var(axis=axis, ddof=1),
+                    rtol=1e-4, atol=1e-4,
+                )
+
+    def test_average_weighted(self):
+        a = np.asarray([1.0, 2.0, 3.0, 4.0], dtype=np.float32)
+        w = np.asarray([4.0, 3.0, 2.0, 1.0], dtype=np.float32)
+        x = ht.array(a, split=0)
+        got = ht.average(x, weights=ht.array(w, split=0))
+        assert float(got) == pytest.approx(np.average(a, weights=w), rel=1e-6)
+        got, wsum = ht.average(x, weights=ht.array(w, split=0), returned=True)
+        assert float(wsum) == pytest.approx(w.sum())
+
+    def test_skew_kurtosis(self):
+        rng = np.random.default_rng(3)
+        a = rng.standard_normal(64).astype(np.float32)
+        x = ht.array(a, split=0)
+        try:
+            from scipy import stats
+        except ImportError:
+            # moment formulas directly
+            mu, sd = a.mean(), a.std()
+            want_skew = ((a - mu) ** 3).mean() / sd**3
+            want_kurt = ((a - mu) ** 4).mean() / sd**4 - 3
+        else:
+            want_skew = stats.skew(a, bias=False)
+            want_kurt = stats.kurtosis(a)
+        got_skew = float(ht.skew(x, unbiased=False))
+        got_kurt = float(ht.kurtosis(x))
+        mu, sd = a.mean(), a.std()
+        assert got_skew == pytest.approx(((a - mu) ** 3).mean() / sd**3, rel=1e-3)
+        assert got_kurt == pytest.approx(((a - mu) ** 4).mean() / sd**4 - 3, rel=1e-3)
+
+    def test_cov(self):
+        rng = np.random.default_rng(4)
+        m = rng.standard_normal((4, 32)).astype(np.float32)
+        for split in (None, 0, 1):
+            x = ht.array(m, split=split)
+            self.assert_array_equal(ht.cov(x), np.cov(m), rtol=1e-4, atol=1e-4)
+        self.assert_array_equal(
+            ht.cov(ht.array(m.T, split=0), rowvar=False), np.cov(m), rtol=1e-4,
+            atol=1e-4,
+        )
+
+
+class TestOrderStatistics(TestCase):
+    def test_median_percentile(self):
+        rng = np.random.default_rng(5)
+        a = rng.standard_normal(33).astype(np.float32)  # odd length, ragged
+        for split in (None, 0):
+            x = ht.array(a, split=split)
+            assert float(ht.median(x)) == pytest.approx(np.median(a), rel=1e-5)
+            for q in (25, 50, 90):
+                assert float(ht.percentile(x, q)) == pytest.approx(
+                    np.percentile(a, q), rel=1e-4
+                )
+
+    def test_median_axis(self):
+        rng = np.random.default_rng(6)
+        m = rng.standard_normal((6, 7)).astype(np.float32)
+        for split in (None, 0, 1):
+            x = ht.array(m, split=split)
+            for axis in (0, 1):
+                self.assert_array_equal(
+                    ht.median(x, axis=axis), np.median(m, axis=axis),
+                    rtol=1e-5, atol=1e-5,
+                )
+
+
+class TestHistograms(TestCase):
+    def test_bincount(self):
+        a = np.asarray([0, 1, 1, 3, 2, 1, 7], dtype=np.int64)
+        for split in (None, 0):
+            x = ht.array(a, split=split)
+            np.testing.assert_array_equal(ht.bincount(x).numpy(), np.bincount(a))
+        w = np.linspace(0, 1, len(a)).astype(np.float32)
+        got = ht.bincount(ht.array(a, split=0), weights=ht.array(w, split=0))
+        np.testing.assert_allclose(got.numpy(), np.bincount(a, weights=w), rtol=1e-6)
+        got = ht.bincount(ht.array(a, split=0), minlength=12)
+        assert got.shape == (12,)
+
+    def test_histogram(self):
+        rng = np.random.default_rng(7)
+        a = rng.standard_normal(100).astype(np.float32)
+        for split in (None, 0):
+            x = ht.array(a, split=split)
+            hist, edges = ht.histogram(x, bins=12)
+            want_h, want_e = np.histogram(a, bins=12)
+            np.testing.assert_array_equal(hist.numpy(), want_h)
+            np.testing.assert_allclose(edges.numpy(), want_e, rtol=1e-5)
+
+    def test_histc(self):
+        a = np.asarray([0.5, 1.5, 2.5, 1.1, 0.9], dtype=np.float32)
+        x = ht.array(a, split=0)
+        got = ht.histc(x, bins=3, min=0.0, max=3.0)
+        np.testing.assert_array_equal(got.numpy(), [2, 2, 1])
