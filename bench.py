@@ -98,6 +98,40 @@ def bench_heat_tpu():
     # per iteration: assignment GEMM (2*n*k*d) + update GEMM (2*n*k*d)
     results["kmeans"] = (iters * 4.0 * ns * kc * d) / t / 1e9
 
+    # --- statistical moments: mean/var/skew/kurtosis over split rows --------
+    # (reference benchmarks/statistical_moments/config.json)
+    nm, dm, reps = 8_000_000, 64, 10
+    xm = ht.random.randn(nm, dm, dtype=ht.float32, split=0)
+
+    def moments():
+        out = None
+        for _ in range(reps):
+            mu = ht.mean(xm, axis=0)
+            va = ht.var(xm, axis=0)
+            out = mu + va
+        return sync(out.larray)
+
+    moments()
+    t = _best_time(moments, repeats=2)
+    # mean ~n*d, var ~3*n*d flops per pass
+    results["moments"] = (reps * 4.0 * nm * dm) / t / 1e9
+
+    # --- lasso: coordinate-descent sweeps (reference benchmarks/lasso) ------
+    nl, dl, sweeps = 500_000, 64, 4
+    xl = ht.random.randn(nl, dl, dtype=ht.float32, split=0)
+    wl = ht.random.randn(dl, 1, dtype=ht.float32)
+    yl = ht.matmul(xl, wl)
+
+    def lasso():
+        est = ht.regression.Lasso(lam=0.01, max_iter=sweeps, tol=0.0)
+        est.fit(xl, yl)
+        return sync(est.coef_.larray)
+
+    lasso()
+    t = _best_time(lasso, repeats=2)
+    # per sweep per coordinate: rho = x_j . residual (2n) + y_est update (2n)
+    results["lasso"] = (sweeps * dl * 4.0 * nl) / t / 1e9
+
     return results
 
 
@@ -139,6 +173,36 @@ def bench_torch_cpu():
     t = _best_time(lloyd, repeats=2)
     results["kmeans"] = (iters * 4.0 * ns * kc * d) / t / 1e9
 
+    nm, dm = 1_000_000, 64
+    xm = torch.randn(nm, dm)
+
+    def moments():
+        xm.mean(dim=0)
+        xm.var(dim=0)
+
+    moments()
+    t = _best_time(moments, repeats=2)
+    results["moments"] = (4.0 * nm * dm) / t / 1e9
+
+    nl, dl, sweeps = 100_000, 64, 2
+    xl = torch.randn(nl, dl)
+    yl = xl @ torch.randn(dl, 1)
+
+    def lasso():
+        w = torch.zeros(dl, 1)
+        y_est = xl @ w
+        for _ in range(sweeps):
+            for j in range(dl):
+                xj = xl[:, j : j + 1]
+                rho = (xj * (yl - y_est + w[j] * xj)).mean()
+                wj = torch.sign(rho) * torch.clamp(rho.abs() - 0.01, min=0.0)
+                y_est = y_est + (wj - w[j]) * xj
+                w[j] = wj
+
+    lasso()
+    t = _best_time(lasso, repeats=2)
+    results["lasso"] = (sweeps * dl * 4.0 * nl) / t / 1e9
+
     return results
 
 
@@ -153,7 +217,7 @@ def main():
     print(
         json.dumps(
             {
-                "metric": "geomean GFLOP/s (matmul, cdist, kmeans) vs torch-cpu harness baseline",
+                "metric": "geomean GFLOP/s (matmul, cdist, kmeans, moments, lasso) vs torch-cpu harness baseline",
                 "value": round(geo_ours, 2),
                 "unit": "GFLOP/s",
                 "vs_baseline": round(geo_ours / geo_base, 2),

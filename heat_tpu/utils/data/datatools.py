@@ -213,8 +213,12 @@ class DataLoader:
 
     def __len__(self) -> int:
         n = len(self.dataset)
+        p = self.dataset.comm.size
         full, rem = divmod(n, self.batch_size)
-        if rem and not self.drop_last and rem % self.dataset.comm.size == 0:
+        # the tail batch is emitted at its largest mesh-divisible size; only
+        # rem % p rows are ever lost per epoch — the same bound as the
+        # reference's per-rank slice-off (datatools.py:147-155)
+        if rem >= p and not self.drop_last:
             return full + 1
         return full
 

@@ -1,0 +1,13 @@
+#!/usr/bin/env bash
+# CI sweep (reference: Jenkinsfile:19-27 runs the whole suite under
+# `mpirun -n {1..8}`). The TPU-native analog re-runs the suite over virtual
+# CPU meshes of several sizes — divisible and ragged — so every sharding
+# path is exercised at every world size.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+for n in 1 2 3 5 8; do
+    echo "=== suite @ ${n} virtual devices ==="
+    HEAT_TPU_TEST_DEVICES=$n python -m pytest tests/ -q -p no:cacheprovider
+done
+echo "=== all device counts green ==="

@@ -76,24 +76,27 @@ class TestDataLoader:
         ds, x, y = make_dataset(32, comm=comm)
         dl = DataLoader(ds, batch_size=8)
         gx, gy = collect_epoch(dl)
-        np.testing.assert_array_equal(gx, x)
-        np.testing.assert_array_equal(gy, y)
+        # at most comm.size-1 tail rows may be dropped (reference slice-off
+        # bound); what is emitted is the storage-order prefix
+        assert len(gy) > 32 - comm.size
+        np.testing.assert_array_equal(gx, x[: len(gy)])
+        np.testing.assert_array_equal(gy, y[: len(gy)])
 
     def test_later_epochs_shuffled_and_complete(self, comm):
         ds, x, y = make_dataset(32, comm=comm)
         dl = DataLoader(ds, batch_size=8)
         collect_epoch(dl)
         gx, gy = collect_epoch(dl)
-        assert not np.array_equal(gy, y)
-        np.testing.assert_array_equal(np.sort(gy), y)  # a permutation
-        np.testing.assert_array_equal(gx, x[gy])       # rows still aligned
+        assert not np.array_equal(gy, y[: len(gy)])
+        assert len(np.unique(gy)) == len(gy) > 32 - comm.size  # no dupes
+        np.testing.assert_array_equal(gx, x[gy])  # rows still aligned
 
     def test_ishuffle_mode(self, comm):
         ds, x, y = make_dataset(32, comm=comm, ishuffle=True)
         dl = DataLoader(ds, batch_size=8)
         collect_epoch(dl)
         gx, gy = collect_epoch(dl)
-        np.testing.assert_array_equal(np.sort(gy), y)
+        assert len(np.unique(gy)) == len(gy) > 32 - comm.size
         np.testing.assert_array_equal(gx, x[gy])
 
     def test_batches_are_mesh_sharded(self, comm):
@@ -125,7 +128,7 @@ class TestDataLoader:
         dl = DataLoader(ds, batch_size=8)
         collect_epoch(dl)
         gx, gy = collect_epoch(dl)
-        np.testing.assert_array_equal(gy, y)
+        np.testing.assert_array_equal(gy, y[: len(gy)])
 
 
 class TestPartialDataset:
@@ -221,6 +224,43 @@ class TestMatrixGallery:
     def test_parter_bad_split(self, comm):
         with pytest.raises(ValueError):
             matrixgallery.parter(4, split=2, comm=comm)
+
+
+class TestOfflineUtils:
+    def test_dali_index_generation(self, tmp_path):
+        import struct
+
+        from heat_tpu.utils.data._utils import dali_tfrecord2idx
+
+        # synthetic tfrecord: [u64 len][u32 crc][payload][u32 crc] frames
+        train = tmp_path / "train"
+        val = tmp_path / "val"
+        train.mkdir()
+        val.mkdir()
+        payloads = [b"x" * 10, b"y" * 25, b"z" * 3]
+        with open(train / "part-0", "wb") as f:
+            for p in payloads:
+                f.write(struct.pack("<Q", len(p)) + b"\0" * 4 + p + b"\0" * 4)
+        open(val / "part-0", "wb").close()
+        dali_tfrecord2idx(str(train), str(tmp_path / "ti"), str(val), str(tmp_path / "vi"))
+        lines = open(tmp_path / "ti" / "part-0.idx").read().splitlines()
+        assert len(lines) == 3
+        offs = [tuple(map(int, l.split())) for l in lines]
+        # frames are contiguous: offset_{i+1} = offset_i + size_i
+        assert offs[0][0] == 0
+        for (o1, s1), (o2, _) in zip(offs, offs[1:]):
+            assert o2 == o1 + s1
+        assert offs[1][1] == 8 + 4 + 25 + 4
+        assert open(tmp_path / "vi" / "part-0.idx").read() == ""
+
+    def test_merge_gate(self):
+        from heat_tpu.utils.data._utils import merge_files_imagenet_tfrecord
+
+        try:
+            import tensorflow  # noqa: F401
+        except ImportError:
+            with pytest.raises(ImportError, match="tensorflow"):
+                merge_files_imagenet_tfrecord("/tmp/nonexistent")
 
 
 class TestGatedImports:

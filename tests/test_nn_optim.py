@@ -23,7 +23,10 @@ def comm():
     return ht.get_comm()
 
 
-def make_data(n=64, d=8, seed=0):
+def make_data(n=None, d=8, seed=0):
+    # sizes scale with the mesh so the suite passes at any device count
+    p = ht.get_comm().size
+    n = 8 * p if n is None else n
     rng = np.random.default_rng(seed)
     x = rng.standard_normal((n, d)).astype(np.float32)
     w_true = rng.standard_normal((d, 1)).astype(np.float32)
@@ -98,7 +101,7 @@ class TestDataParallel:
                 dp.shard_batch(a)
 
     def test_loss_decreases(self, comm):
-        x, y = make_data(n=128)
+        x, y = make_data(n=16 * comm.size)
         dp = DataParallel(mlp_apply, comm=comm, optimizer=optax.adam(1e-2))
         step = dp.make_train_step(mse_loss)
         p = jax.device_put(mlp_init(8, seed=1), comm.replicated())
@@ -148,7 +151,7 @@ class TestDASO:
 
     def test_warmup_matches_blocking_dp(self, comm):
         # during warmup DASO is full blocking sync: must track plain DP
-        x, y = make_data(n=64)
+        x, y = make_data()
         params0 = mlp_init(8)
         opt = optax.sgd(0.1)
 
@@ -173,13 +176,14 @@ class TestDASO:
     def test_full_schedule_trains(self, comm):
         # run through warmup -> cycling -> cooldown; loss must decrease and
         # params must be finite & synchronized at the end
-        x, y = make_data(n=64)
+        x, y = make_data()
         daso = DASO(
             optax.adam(5e-3), total_epochs=8, comm=comm,
             warmup_epochs=2, cooldown_epochs=2, max_global_skips=4,
         )
         params, losses = self._run(
-            daso, mlp_init(8, seed=2), x, y, epochs=8, batches_per_epoch=4, bs=16
+            daso, mlp_init(8, seed=2), x, y, epochs=8, batches_per_epoch=4,
+            bs=2 * comm.size,
         )
         assert losses[-1] < losses[0]
         for leaf in jax.tree.leaves(params):
@@ -188,16 +192,17 @@ class TestDASO:
     def test_gs1_drains_payload_queue(self, comm):
         # with global_skip=1 every batch is a sync batch; pending payloads
         # must be drained, not accumulated
-        x, y = make_data(n=64)
+        x, y = make_data()
         daso = DASO(optax.sgd(0.05), total_epochs=10, comm=comm)
         daso.set_loss(mse_loss)
         daso.last_batch = 7
         daso.global_skip, daso.local_skip, daso.batches_to_wait = 1, 1, 1
         sp = daso.stack_params(mlp_init(8))
         so = daso.init(sp)
+        bs = comm.size
         for b in range(8):
-            lo = (b * 8) % 64
-            sp, so, _ = daso.step(sp, so, (x[lo : lo + 8], y[lo : lo + 8]))
+            lo = (b * bs) % x.shape[0]
+            sp, so, _ = daso.step(sp, so, (x[lo : lo + bs], y[lo : lo + bs]))
             assert len(daso._prev_params) <= 1
         assert len(daso._prev_params) <= 1
 
@@ -209,7 +214,7 @@ class TestDASO:
         )
         daso.set_loss(mse_loss)
         daso.last_batch = 0
-        x, y = make_data(n=32)
+        x, y = make_data(n=4 * comm.size)
         p0 = mlp_init(8)
         sp = daso.stack_params(p0)
         so = daso.init(sp)
@@ -244,9 +249,9 @@ class TestDataParallelMultiGPU:
         net = DataParallelMultiGPU(mlp_apply, daso)
         assert daso.module is mlp_apply
         params = mlp_init(8)
-        x, _ = make_data(n=16)
+        x, _ = make_data(n=2 * comm.size)
         out = net(params, x)
-        assert out.shape == (16, 1)
+        assert out.shape == (2 * comm.size, 1)
 
 
 class TestDetectMetricPlateau:
