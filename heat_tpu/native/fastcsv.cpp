@@ -143,22 +143,24 @@ void parse_rows(const char* data, size_t size, char sep,
                 row[c++] = NAN;  // ragged short row: pad like genfromtxt
                 continue;
             }
+            // bound the field FIRST: strtod treats '\t'/' '/'\n' as skippable
+            // whitespace, so an empty field under a whitespace separator
+            // would otherwise consume the NEXT field's value ("1\t\t2" must
+            // read [1, NaN, 2], the genfromtxt oracle)
+            const char* sp = static_cast<const char*>(
+                memchr(data + pos, sep, end - pos));
+            const char* field_end = sp ? sp : data + end;
             char* after = nullptr;
             double v = strtod_c(data + pos, &after);
-            const char* stop = after;
-            if (stop == data + pos || stop > data + end) {
-                // empty/non-numeric field — or strtod skipped a
-                // whitespace-only field across the newline into the next
-                // row, which must read as missing
+            if (after == data + pos || after > field_end) {
+                // empty/non-numeric field, or strtod skipped whitespace past
+                // the separator (or the newline) into a later field/row
                 row[c] = NAN;
             } else {
                 row[c] = v;
             }
             ++c;
-            // advance to past the next separator
-            const char* sp = static_cast<const char*>(
-                memchr(data + pos, sep, end - pos));
-            if (!sp) { pos = end; } else { pos = static_cast<size_t>(sp - data) + 1; }
+            pos = sp ? static_cast<size_t>(sp - data) + 1 : end;
         }
     }
 }

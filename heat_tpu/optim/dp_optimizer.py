@@ -95,6 +95,15 @@ class DASO:
     n_nodes : int, optional
         Size of the slow (DCN) axis. Defaults to jax.process_count() when >1
         else 2 (if the device count allows), i.e. a simulated 2-node split.
+    scheduler : callable, optional
+        Schedule composed into the update rule. Without
+        ``scheduler_base_lr`` it is a *scale factor* (step -> scale,
+        typically 1.0 at step 0); with ``scheduler_base_lr`` it is an
+        *absolute-lr* schedule (heat_tpu.optim.lr_scheduler output) divided
+        by that base lr so the lr is never double-applied.
+    scheduler_base_lr : float, optional
+        The local optimizer's base learning rate; marks ``scheduler`` as
+        absolute-lr (see above).
     warmup_epochs, cooldown_epochs, stability_level, max_global_skips,
     skip_reduction_factor, local_skip_factor, verbose :
         Schedule knobs, defaults matching the reference (:136-156).
@@ -112,6 +121,7 @@ class DASO:
         warmup_epochs: int = 4,
         cooldown_epochs: int = 4,
         scheduler=None,
+        scheduler_base_lr: Optional[float] = None,
         stability_level: float = 0.05,
         max_global_skips: int = 8,
         downcast_type=jnp.bfloat16,
@@ -119,15 +129,36 @@ class DASO:
         local_skip_factor: int = 4,
         verbose: bool = False,
     ):
+        if scheduler is None and scheduler_base_lr is not None:
+            raise ValueError(
+                "scheduler_base_lr given without a scheduler — pass the "
+                "absolute-lr schedule it belongs to"
+            )
         if scheduler is not None:
             # the reference drives the lr through the torch scheduler's
             # step() each batch (reference :758-761); the optax form is a
-            # schedule function composed into the update rule
+            # schedule function composed into the update rule. The composed
+            # schedule MULTIPLIES the optimizer's already-lr-scaled update,
+            # so the contract is explicit:
+            #   * scheduler alone — a *scale-factor* schedule (step -> scale,
+            #     typically starting at 1.0);
+            #   * scheduler + scheduler_base_lr — an *absolute-lr* schedule
+            #     (the heat_tpu.optim.lr_scheduler factories' output); it is
+            #     divided by the optimizer's base lr so the lr is applied
+            #     exactly once (warmup ramps, incl. ones starting at 0, stay
+            #     exact).
             if not callable(scheduler):
                 raise TypeError(
                     "scheduler must be an optax schedule (step -> scale), "
                     f"got {type(scheduler)}"
                 )
+            if scheduler_base_lr is not None:
+                if scheduler_base_lr <= 0:
+                    raise ValueError(
+                        f"scheduler_base_lr must be positive, got {scheduler_base_lr}"
+                    )
+                base_sched, base_lr = scheduler, float(scheduler_base_lr)
+                scheduler = lambda step: base_sched(step) / base_lr  # noqa: E731
             local_optimizer = optax.chain(
                 local_optimizer, optax.scale_by_schedule(scheduler)
             )
@@ -423,10 +454,28 @@ class DASO:
             self.print0("Cooldown phase: blocking sync")
             return
 
+        # Hold at max global skip for `_gs8_waits` epochs before acting on
+        # plateau tests. NOTE: the reference's `_gs8_waited` counter is
+        # vestigial (written at reference dp_optimizer.py:396,418,424,705 but
+        # never read); this implements the documented *intent* — the plateau
+        # detector still sees every epoch's loss, only the decay is gated.
+        held = False
         if self.global_skip == self.max_gs and self.max_gs > 4:
             self._gs8_waited += 1
+            held = self._gs8_waited < self._gs8_waits
 
         stable = self.stability.test_if_improving(avg_loss)
+        if held:
+            if stable:
+                # a plateau trigger consumed mid-hold must not cost a fresh
+                # patience window after the hold expires — re-arm the
+                # detector so one more bad epoch re-triggers it
+                self.stability.num_bad_epochs = self.stability.patience
+            self.print0(
+                f"holding at gs={self.global_skip} "
+                f"({self._gs8_waited}/{self._gs8_waits} epochs)"
+            )
+            return
         if stable and self.global_skip > 1:
             self.global_skip //= self.skip_reduction_factor
             self.local_skip //= self.skip_reduction_factor

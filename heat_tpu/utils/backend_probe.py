@@ -1,0 +1,52 @@
+"""Hang-safe backend probing shared by bench.py and __graft_entry__.
+
+The TPU plugin can hang (not just fail) backend initialization, and a hung
+in-process init is unrecoverable — so the default platform is probed in a
+SUBPROCESS with a timeout, optionally retried with backoff. The reference has
+no analog (MPI init either works or aborts); this is TPU-runtime plumbing.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import time
+from typing import List, Optional, Tuple
+
+__all__ = ["probe_default_platform"]
+
+_PROBE_CODE = "import jax; d = jax.devices(); print('PROBE', d[0].platform, len(d))"
+
+
+def probe_default_platform(
+    retries: int = 1, timeout: float = 150.0
+) -> Tuple[Optional[str], int, List[str]]:
+    """Probe the default JAX platform in a subprocess.
+
+    Returns ``(platform, device_count, diagnostics)`` — ``platform`` is None
+    when every attempt failed (crash, timeout, unparseable output).
+    """
+    diags: List[str] = []
+    for attempt in range(retries):
+        if attempt:
+            time.sleep(5 * attempt)
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", _PROBE_CODE],
+                capture_output=True,
+                text=True,
+                timeout=timeout,
+            )
+            toks = r.stdout.split()
+            if r.returncode == 0 and "PROBE" in toks:
+                i = toks.index("PROBE")
+                plat, n = toks[i + 1], int(toks[i + 2])
+                diags.append(f"attempt {attempt}: ok ({plat} x{n})")
+                return plat, n, diags
+            diags.append(
+                f"attempt {attempt}: rc={r.returncode} "
+                f"stderr={r.stderr.strip()[-300:]!r}"
+            )
+        except Exception as e:  # noqa: BLE001 — the probe must never crash callers
+            diags.append(f"attempt {attempt}: {type(e).__name__}: {e}")
+    return None, 0, diags

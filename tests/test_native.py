@@ -58,6 +58,36 @@ class TestFastCSV:
         assert np.isnan(got[1, 1]) and got[1, 2] == 6.0
         assert np.isnan(got[2, 2])
 
+    def test_empty_fields_whitespace_separator(self, built, tmp_path):
+        # strtod treats '\t'/' ' as skippable whitespace: an empty field must
+        # NOT consume the next field's value (genfromtxt oracle)
+        p = str(tmp_path / "t.tsv")
+        with open(p, "w") as f:
+            f.write("1.0\t\t2.0\n3.0\t4.0\t\n\t5.0\t6.0\n")
+        got = native.parse_csv(p, sep="\t")
+        want = np.genfromtxt(p, delimiter="\t")
+        assert got.shape == want.shape
+        np.testing.assert_array_equal(np.isnan(got), np.isnan(want))
+        np.testing.assert_allclose(got[~np.isnan(got)], want[~np.isnan(want)])
+
+    def test_empty_trailing_field_does_not_cross_newline(self, built, tmp_path):
+        # trailing empty field under a whitespace sep: strtod must not skip
+        # the newline and read the next row's first value
+        p = str(tmp_path / "nl.tsv")
+        with open(p, "w") as f:
+            f.write("1.0\t\n9.0\t8.0\n")
+        got = native.parse_csv(p, sep="\t")
+        assert got[0, 0] == 1.0 and np.isnan(got[0, 1])
+        np.testing.assert_allclose(got[1], [9.0, 8.0])
+
+    def test_space_separator_empty_field(self, built, tmp_path):
+        p = str(tmp_path / "sp.txt")
+        with open(p, "w") as f:
+            f.write("1.0  2.0\n3.0 4.0 5.0\n")
+        got = native.parse_csv(p, sep=" ")
+        assert got[0, 0] == 1.0 and np.isnan(got[0, 1]) and got[0, 2] == 2.0
+        np.testing.assert_allclose(got[1], [3.0, 4.0, 5.0])
+
     def test_crlf_and_trailing_newlines(self, built, tmp_path):
         p = str(tmp_path / "c.csv")
         with open(p, "wb") as f:

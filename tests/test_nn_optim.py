@@ -227,6 +227,80 @@ class TestDASO:
                 np.asarray(got[k]), np.asarray(p0[k]), atol=1e-6
             )
 
+    def test_absolute_lr_scheduler_not_double_applied(self, comm):
+        # an absolute-lr schedule (lr_scheduler factory output) passed with
+        # scheduler_base_lr is divided by the base lr: a constant absolute
+        # schedule at exactly the base lr must match no scheduler at all
+        x, y = make_data(n=4 * comm.size)
+        p0 = mlp_init(8)
+
+        def one_step(sched, base=None):
+            daso = DASO(optax.sgd(0.5), total_epochs=4, comm=comm,
+                        scheduler=sched, scheduler_base_lr=base)
+            daso.set_loss(mse_loss)
+            daso.last_batch = 0
+            sp = daso.stack_params(p0)
+            so = daso.init(sp)
+            sp, so, _ = daso.step(sp, so, (x, y))
+            return daso.unstack_params(sp)
+
+        got = one_step(lr_scheduler.ConstantLR(0.5, factor=1.0, total_iters=1), 0.5)
+        want = one_step(None)
+        for k in want:
+            np.testing.assert_allclose(
+                np.asarray(got[k]), np.asarray(want[k]), rtol=1e-5, atol=1e-6
+            )
+
+    def test_warmup_ramp_scheduler_exact(self, comm):
+        # an absolute-lr warmup ramp (start_factor<1) with scheduler_base_lr
+        # must scale the first update by exactly start_factor — not by
+        # ramp(0)/ramp-normalized 1.0 (the s0-normalization bug)
+        x, y = make_data(n=4 * comm.size)
+        p0 = mlp_init(8)
+        lr = 0.5
+
+        def one_step(sched, base):
+            daso = DASO(optax.sgd(lr), total_epochs=4, comm=comm,
+                        scheduler=sched, scheduler_base_lr=base)
+            daso.set_loss(mse_loss)
+            daso.last_batch = 0
+            sp = daso.stack_params(p0)
+            so = daso.init(sp)
+            sp, so, _ = daso.step(sp, so, (x, y))
+            return daso.unstack_params(sp)
+
+        ramp = lr_scheduler.LinearLR(lr, start_factor=1.0 / 4, total_iters=10)
+        got = one_step(ramp, lr)
+        # oracle: plain sgd with lr/4 for the first step
+        ref = one_step(lambda step: 0.25, None)
+        for k in ref:
+            np.testing.assert_allclose(
+                np.asarray(got[k]), np.asarray(ref[k]), rtol=1e-5, atol=1e-6
+            )
+
+    def test_gs8_hold_gates_plateau_decay(self, comm):
+        # at max global skip the schedule must hold for _gs8_waits epochs
+        # before plateau-driven decay can act
+        daso = DASO(
+            optax.sgd(0.1), total_epochs=40, comm=comm,
+            warmup_epochs=0, cooldown_epochs=0, max_global_skips=8,
+        )
+        daso.epoch = 1  # past warmup
+        daso.global_skip, daso.local_skip, daso.batches_to_wait = 8, 2, 2
+
+        # prime the detector ONCE so the next call reports a plateau; the
+        # hold must re-arm consumed triggers so decay fires exactly when the
+        # hold expires, with no fresh patience window
+        daso.stability.best = 1.0
+        daso.stability.num_bad_epochs = daso.stability.patience
+
+        for i in range(daso._gs8_waits - 1):
+            daso.epoch_loss_logic(1.0)
+            assert daso.global_skip == 8, f"decayed early at hold epoch {i}"
+            daso.epoch += 1
+        daso.epoch_loss_logic(1.0)  # hold expired -> decay acts immediately
+        assert daso.global_skip < 8
+
     def test_rejects_bad_scheduler(self, comm):
         with pytest.raises(TypeError):
             DASO(optax.sgd(0.1), total_epochs=2, comm=comm, scheduler=3)
