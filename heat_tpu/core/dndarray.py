@@ -42,9 +42,25 @@ from .communication import MeshCommunication, sanitize_comm
 from .devices import Device, get_device
 from .stride_tricks import sanitize_axis
 
-__all__ = ["DNDarray"]
+__all__ = ["DNDarray", "perf_stats", "reset_perf_stats"]
 
 Scalar = Union[int, float, bool, complex]
+
+# Relayout bookkeeping (diagnostic): `logical_slices` counts physical→logical
+# tail-pad slices, `repads` counts logical→physical re-pads, `device_puts`
+# counts explicit resharding device_puts. Op chains that stay on the physical
+# buffer (the fast paths in manipulations/_operations) leave all three at 0.
+_PERF_STATS = {"logical_slices": 0, "repads": 0, "device_puts": 0}
+
+
+def perf_stats() -> dict:
+    """Snapshot of the relayout counters (see module comment)."""
+    return dict(_PERF_STATS)
+
+
+def reset_perf_stats() -> None:
+    for k in _PERF_STATS:
+        _PERF_STATS[k] = 0
 
 
 class LocalIndex:
@@ -235,6 +251,7 @@ class DNDarray:
         boundaries."""
         if self.pad_count == 0:
             return self.__array
+        _PERF_STATS["logical_slices"] += 1
         sl = tuple(slice(0, n) for n in self.__gshape)
         return self.__array[sl]
 
@@ -255,11 +272,14 @@ class DNDarray:
         split = sanitize_axis(gshape, split)
         pshape = comm.padded_shape(gshape, split)
         if pshape != gshape:
+            _PERF_STATS["repads"] += 1
             pad = [(0, p - g) for p, g in zip(pshape, gshape)]
             array = jnp.pad(array, pad)
         if split is not None and comm.size > 1:
+            _PERF_STATS["device_puts"] += 1
             array = jax.device_put(array, comm.sharding(split, len(gshape)))
         elif comm.size > 1:
+            _PERF_STATS["device_puts"] += 1
             array = jax.device_put(array, comm.replicated())
         ht_dtype = dtype if dtype is not None else types.canonical_heat_type(array.dtype)
         return cls(array, gshape, ht_dtype, split, device, comm, True)

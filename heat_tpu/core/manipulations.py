@@ -75,17 +75,55 @@ def balance(array: DNDarray, copy: bool = False) -> DNDarray:
 
 def concatenate(arrays: Sequence[DNDarray], axis: int = 0) -> DNDarray:
     """Join arrays along an existing axis (reference manipulations.py:188,
-    with the split-combination case table :377-443)."""
+    with the split-combination case table :377-443).
+
+    Split-combination rules (mirroring the reference's case table):
+
+    * all inputs replicated → replicated result;
+    * any input split → result carries that split (all split inputs must
+      agree on the axis);
+    * concatenation along a non-split axis runs on the **physical** buffers —
+      per-position pads line up, so no relayout happens (replicated inputs
+      are tail-padded to the physical extent first);
+    * concatenation along the split axis itself is relayout-inherent (the
+      reference's resplit/Alltoall cases) and goes through the logical view.
+    """
     from . import factories
 
     arrays = [a if isinstance(a, DNDarray) else factories.array(a) for a in arrays]
     if len(arrays) < 1:
         raise ValueError("need at least one array to concatenate")
     axis = sanitize_axis(arrays[0].shape, axis)
-    out_split = next((a.split for a in arrays if a.split is not None), None)
+    splits = {a.split for a in arrays if a.split is not None}
+    if len(splits) > 1:
+        raise RuntimeError(
+            f"concatenate inputs are distributed along different axes {sorted(splits)}; "
+            "resplit first (reference manipulations.py:377 raises here too)"
+        )
+    out_split = next(iter(splits), None)
     out_dtype = arrays[0].dtype
     for a in arrays[1:]:
         out_dtype = types.promote_types(out_dtype, a.dtype)
+
+    comm = arrays[0].comm
+    if out_split is not None and axis != out_split:
+        # physical fast path: pads sit at the same positions in every input
+        P = comm.padded_size(arrays[0].shape[out_split])
+        bufs = []
+        for a in arrays:
+            buf = a.larray.astype(out_dtype.jnp_type())
+            if a.split is None and buf.shape[out_split] < P:
+                pad = [(0, 0)] * a.ndim
+                pad[out_split] = (0, P - buf.shape[out_split])
+                buf = jnp.pad(buf, pad)
+            bufs.append(buf)
+        res = jnp.concatenate(bufs, axis=axis)
+        gshape = list(arrays[0].shape)
+        gshape[axis] = builtins.sum(a.shape[axis] for a in arrays)
+        return DNDarray(
+            res, tuple(gshape), out_dtype, out_split, arrays[0].device, comm, True
+        )
+
     logs = [a._logical().astype(out_dtype.jnp_type()) for a in arrays]
     res = jnp.concatenate(logs, axis=axis)
     return _rewrap(res, out_split, arrays[0], out_dtype)
@@ -94,12 +132,7 @@ def concatenate(arrays: Sequence[DNDarray], axis: int = 0) -> DNDarray:
 def column_stack(arrays: Sequence[DNDarray]) -> DNDarray:
     """Stack 1-D/2-D arrays as columns of a 2-D array (reference
     manipulations.py `column_stack`)."""
-    prepared = []
-    for a in arrays:
-        if a.ndim == 1:
-            prepared.append(_rewrap(a._logical()[:, None], a.split, a))
-        else:
-            prepared.append(a)
+    prepared = [expand_dims(a, 1) if a.ndim == 1 else a for a in arrays]
     return concatenate(prepared, axis=1)
 
 
@@ -135,13 +168,16 @@ def dsplit(x: DNDarray, indices_or_sections) -> List[DNDarray]:
 
 
 def expand_dims(a: DNDarray, axis: int) -> DNDarray:
-    """Insert a size-1 dimension (reference manipulations.py:?)."""
+    """Insert a size-1 dimension (reference manipulations.py `expand_dims`).
+    Pure metadata + a physical reshape — the pad travels with the split dim,
+    no relayout."""
     axis = sanitize_axis(tuple(a.shape) + (1,), axis)
-    res = jnp.expand_dims(a._logical(), axis)
+    res = jnp.expand_dims(a.larray, axis)
     out_split = a.split
     if out_split is not None and axis <= out_split:
         out_split += 1
-    return _rewrap(res, out_split, a)
+    gshape = a.shape[:axis] + (1,) + a.shape[axis:]
+    return DNDarray(res, gshape, a.dtype, out_split, a.device, a.comm, True)
 
 
 def flatten(a: DNDarray) -> DNDarray:
@@ -152,8 +188,19 @@ def flatten(a: DNDarray) -> DNDarray:
 
 def flip(a: DNDarray, axis=None) -> DNDarray:
     """Reverse element order along axis (reference manipulations.py:876 swaps
-    mirrored ranks p2p; relayout here)."""
-    res = jnp.flip(a._logical(), axis=axis)
+    mirrored ranks p2p). When the flip leaves the (padded) split dim alone —
+    or there is no pad — it runs on the physical buffer with no relayout;
+    flipping a padded split dim must move the tail pad and goes through the
+    logical view."""
+    if axis is None:
+        axes = tuple(range(a.ndim))
+    else:
+        ax = sanitize_axis(a.shape, axis)
+        axes = (ax,) if isinstance(ax, builtins.int) else tuple(ax)
+    if a.pad_count == 0 or a.split not in axes:
+        res = jnp.flip(a.larray, axis=axes)
+        return DNDarray(res, a.shape, a.dtype, a.split, a.device, a.comm, True)
+    res = jnp.flip(a._logical(), axis=axes)
     return _rewrap(res, a.split, a)
 
 
@@ -278,7 +325,19 @@ def resplit(arr: DNDarray, axis: Optional[int] = None) -> DNDarray:
 
 def roll(x: DNDarray, shift, axis=None) -> DNDarray:
     """Circular shift (reference manipulations.py:1980, Isend/Irecv ring
-    :2061-2069; XLA collective-permute here)."""
+    :2061-2069; XLA collective-permute here). Rolls that avoid the padded
+    split dim run on the physical buffer; a roll across the padded split dim
+    (or the flattened axis=None form) wraps through the tail pad and uses the
+    logical view."""
+    if axis is not None:
+        ax = sanitize_axis(x.shape, axis)
+        axes = (ax,) if isinstance(ax, builtins.int) else tuple(ax)
+        if x.pad_count == 0 or x.split not in axes:
+            res = jnp.roll(x.larray, shift, axis=axes)
+            return DNDarray(res, x.shape, x.dtype, x.split, x.device, x.comm, True)
+    elif x.pad_count == 0 and x.ndim == 1:
+        res = jnp.roll(x.larray, shift)
+        return DNDarray(res, x.shape, x.dtype, x.split, x.device, x.comm, True)
     res = jnp.roll(x._logical(), shift, axis=axis)
     return _rewrap(res, x.split, x)
 
@@ -295,12 +354,19 @@ def rot90(m: DNDarray, k: int = 1, axes=(0, 1)) -> DNDarray:
 
 def row_stack(arrays: Sequence[DNDarray]) -> DNDarray:
     """Stack arrays as rows (reference `row_stack`)."""
-    prepared = []
-    for a in arrays:
-        if a.ndim == 1:
-            prepared.append(_rewrap(a._logical()[None, :], None, a))
-        else:
-            prepared.append(a)
+    arrays = list(arrays)
+    if builtins.all(a.ndim == 1 for a in arrays):
+        # uniform 1-D inputs: expanded rows all carry split→1, so the
+        # concatenate below stays on the physical fast path
+        prepared = [expand_dims(a, 0) for a in arrays]
+    else:
+        prepared = []
+        for a in arrays:
+            if a.ndim == 1:
+                # align with the 2-D inputs' split frame: replicate the row
+                prepared.append(_rewrap(a._logical()[None, :], None, a))
+            else:
+                prepared.append(a)
     return concatenate(prepared, axis=0)
 
 
@@ -355,30 +421,53 @@ def squeeze(x: DNDarray, axis=None) -> DNDarray:
                 raise ValueError(f"cannot select an axis to squeeze out which has size not equal to one, got axis {a}")
     else:
         axes = tuple(d for d, s in enumerate(x.shape) if s == 1)
-    res = jnp.squeeze(x._logical(), axis=axes if axes else None)
     out_split = x.split
     if out_split is not None:
         if out_split in axes:
             out_split = None
         else:
             out_split -= builtins.sum(1 for a in axes if a < out_split)
+    if x.split not in axes:
+        # squeezed dims are size-1 and never the padded split dim — physical
+        res = jnp.squeeze(x.larray, axis=axes)
+        gshape = tuple(s for d, s in enumerate(x.shape) if d not in axes)
+        return DNDarray(res, gshape, x.dtype, out_split, x.device, x.comm, True)
+    res = jnp.squeeze(x._logical(), axis=axes if axes else None)
     return _rewrap(res, out_split, x)
 
 
 def stack(arrays: Sequence[DNDarray], axis: int = 0, out=None) -> DNDarray:
-    """Join along a new axis (reference `stack`)."""
+    """Join along a new axis (reference `stack`). When every input shares the
+    proto's split, inputs have identical physical shapes and the stack runs on
+    the physical buffers — pads line up, no relayout."""
     from . import factories
 
     arrays = [a if isinstance(a, DNDarray) else factories.array(a) for a in arrays]
-    logs = [a._logical() for a in arrays]
-    res = jnp.stack(logs, axis=axis)
+    if len(arrays) < 1:
+        raise ValueError("need at least one array to stack")
+    splits = {a.split for a in arrays if a.split is not None}
+    if len(splits) > 1:
+        raise RuntimeError(
+            f"stack inputs are distributed along different axes {sorted(splits)}; "
+            "resplit first"
+        )
     proto = arrays[0]
+    ndim_out = proto.ndim + 1
+    ax = axis % ndim_out
     out_split = proto.split
-    if out_split is not None:
-        ax = axis % res.ndim
-        if ax <= out_split:
-            out_split += 1
-    result = _rewrap(res, out_split, proto)
+    if out_split is not None and ax <= out_split:
+        out_split += 1
+    if builtins.all(a.split == proto.split and a.shape == proto.shape for a in arrays):
+        res = jnp.stack([a.larray for a in arrays], axis=ax)
+        gshape = proto.shape[:ax] + (len(arrays),) + proto.shape[ax:]
+        result = DNDarray(
+            res, gshape, types.canonical_heat_type(res.dtype), out_split,
+            proto.device, proto.comm, True,
+        )
+    else:
+        logs = [a._logical() for a in arrays]
+        res = jnp.stack(logs, axis=ax)
+        result = _rewrap(res, out_split, proto)
     if out is not None:
         out.larray = result.larray
         return out

@@ -1,0 +1,105 @@
+"""Op-chain relayout microbench (VERDICT r2 item 4).
+
+The reference's local-op principle (reference heat/core/_operations.py:281-352)
+is that ops not crossing the split axis never move data. The TPU analog:
+chains of pad-safe manipulations must stay on the physical tail-padded buffer —
+no `_logical()` slice, no re-pad, no `device_put` relayout. `dndarray.perf_stats`
+counts all three events.
+"""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from heat_tpu.core import dndarray as dnd
+
+
+@pytest.fixture(autouse=True)
+def _reset_counters():
+    dnd.reset_perf_stats()
+    yield
+    dnd.reset_perf_stats()
+
+
+def _relayouts():
+    s = dnd.perf_stats()
+    return s["logical_slices"] + s["repads"] + s["device_puts"]
+
+
+class TestOpChainRelayout:
+    def test_ten_op_chain_zero_relayout(self):
+        # 11 rows over 8 devices -> padded to 16: the funnel would slice+repad
+        # on every op; the physical fast paths must do none.
+        x = ht.arange(11 * 6, dtype=ht.float32, split=None).reshape(11, 6, new_split=0)
+        dnd.reset_perf_stats()
+
+        y = x + 1.0                      # 1 binary
+        y = ht.exp(y * 0.01)             # 2,3 local ops
+        y = ht.flip(y, 1)                # 4 flip non-split axis
+        y = ht.roll(y, 2, axis=1)        # 5 roll non-split axis
+        y = ht.expand_dims(y, 1)         # 6
+        y = ht.squeeze(y, 1)             # 7
+        y = y.transpose((1, 0))          # 8 (split 0 -> 1)
+        y = y.transpose((1, 0))          # 9 (back to split 0)
+        y = ht.sin(y)                    # 10
+
+        assert _relayouts() == 0, dnd.perf_stats()
+        assert y.split == 0
+        # correctness of the whole chain against numpy
+        ref = np.sin(
+            np.roll(
+                np.flip(np.exp((np.arange(66, dtype=np.float32).reshape(11, 6) + 1) * 0.01), 1),
+                2,
+                axis=1,
+            )
+        )
+        np.testing.assert_allclose(y.numpy(), ref, rtol=1e-5)
+
+    def test_stack_concat_chain_zero_relayout(self):
+        x = ht.arange(22, dtype=ht.float32, split=None).reshape(11, 2, new_split=0)
+        w = x * 2.0
+        dnd.reset_perf_stats()
+        s = ht.stack([x, w], axis=2)          # same split inputs: physical
+        c = ht.concatenate([x, w], axis=1)    # non-split axis: physical
+        assert _relayouts() == 0, dnd.perf_stats()
+        assert s.split == 0 and c.split == 0
+        xs = np.arange(22, dtype=np.float32).reshape(11, 2)
+        np.testing.assert_allclose(s.numpy(), np.stack([xs, 2 * xs], axis=2))
+        np.testing.assert_allclose(c.numpy(), np.concatenate([xs, 2 * xs], axis=1))
+
+    def test_concat_split_axis_relayouts_once(self):
+        # concatenation ALONG the split axis is relayout-inherent: exactly one
+        # logical round-trip, not one per input element
+        x = ht.arange(11, dtype=ht.float32, split=0)
+        w = x * 3.0
+        dnd.reset_perf_stats()
+        c = ht.concatenate([x, w], axis=0)
+        s = dnd.perf_stats()
+        assert s["repads"] <= 1
+        base = np.arange(11, dtype=np.float32)
+        np.testing.assert_allclose(c.numpy(), np.concatenate([base, 3 * base]))
+
+    def test_flip_padded_split_axis_correct(self):
+        # flipping the padded split dim goes logical but must stay correct
+        x = ht.arange(11, dtype=ht.float32, split=0)
+        np.testing.assert_allclose(ht.flip(x, 0).numpy(), np.arange(11, dtype=np.float32)[::-1])
+
+    def test_roll_padded_split_axis_correct(self):
+        x = ht.arange(11, dtype=ht.float32, split=0)
+        np.testing.assert_allclose(ht.roll(x, 3, axis=0).numpy(), np.roll(np.arange(11, dtype=np.float32), 3))
+
+    def test_divisible_flip_split_axis_physical(self):
+        # no pad: even split-axis flips stay physical
+        x = ht.arange(16, dtype=ht.float32, split=0)
+        dnd.reset_perf_stats()
+        y = ht.flip(x, 0)
+        assert _relayouts() == 0
+        np.testing.assert_allclose(y.numpy(), np.arange(16, dtype=np.float32)[::-1])
+
+    def test_reductions_after_chain_correct(self):
+        # pad-neutralization still correct after a physical-path chain
+        x = ht.arange(11 * 3, dtype=ht.float32, split=None).reshape(11, 3, new_split=0)
+        y = ht.flip(x, 1) + 1.0
+        total = ht.sum(y)
+        ref = (np.arange(33, dtype=np.float32).reshape(11, 3)[:, ::-1] + 1).sum()
+        assert abs(float(total) - ref) < 1e-3
