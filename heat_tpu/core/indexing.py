@@ -4,20 +4,35 @@ Re-design of the reference's gnarliest code path (reference:
 heat/core/dndarray.py:661-1549 `__getitem__`/`__setitem__` translate global
 keys to per-rank local keys chunk by chunk; heat/core/indexing.py nonzero/
 where). Under a single controller the global array is addressable, so
-indexing is performed on the *logical* global view with jnp/numpy semantics,
-and only the result's split metadata needs Heat's rules:
+indexing works on the global view — but the implementation picks the
+cheapest physical route:
 
-* slicing keeps the split axis distributed (possibly shifted by dropped or
-  inserted dims);
-* an integer index on the split axis collapses it → result replicated;
-* a full-shape boolean mask yields a 1-D result distributed along 0;
-* advanced (integer-array) indexing replicates (conservative; reference
-  gathers too).
+* **basic keys leaving the split dim whole** (full slice at the split
+  position) apply directly to the tail-padded physical buffer — the pad
+  travels along, no relayout;
+* **1-D integer-array keys** run as a *sharded gather*: the index vector is
+  tail-padded and the `jnp.take` is jit-compiled with the result's
+  `NamedSharding` as `out_shardings`, so XLA emits the cross-shard gather
+  and lays the result out distributed — there is never a replicated
+  intermediate (the reference keeps advanced results distributed too,
+  dndarray.py:661-1549);
+* **setitem** updates the physical buffer in place via ``.at[key].set`` with
+  the key normalized against the logical extents (pads can never be hit);
+  only truly jnp-incompatible keys (e.g. ragged boolean-mask assignment)
+  fall back to a host numpy round-trip, and that path emits a loud
+  ``UserWarning``;
+* everything else (mixed advanced keys, partial boolean masks) goes through
+  the logical view; split metadata of results follows Heat's rules:
+  slicing keeps the split axis distributed (possibly shifted by dropped or
+  inserted dims), an integer index on the split axis collapses it →
+  replicated, a full-shape boolean mask yields a 1-D split=0 result.
 """
 
 from __future__ import annotations
 
 import builtins
+import functools
+import warnings
 from typing import Any, Optional, Tuple, Union
 
 import jax
@@ -25,6 +40,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import types
+from .communication import MeshCommunication
 from .dndarray import DNDarray
 
 __all__ = ["nonzero", "where"]
@@ -41,6 +57,43 @@ def _normalize_key(key, x: DNDarray):
     return key
 
 
+def _is_int_array(k) -> bool:
+    return (
+        hasattr(k, "dtype")
+        and np.issubdtype(np.dtype(k.dtype), np.integer)
+        and getattr(k, "ndim", 0) >= 1
+    )
+
+
+def _is_bool_mask(k, x: DNDarray) -> bool:
+    return (
+        hasattr(k, "dtype")
+        and np.dtype(k.dtype) == np.bool_
+        and getattr(k, "ndim", 0) == x.ndim
+    )
+
+
+def _expand_key(key, ndim: int):
+    """Expand ellipsis / missing dims to a per-dimension key list (entries
+    may be None for newaxis; array entries pass through unchanged)."""
+    if not isinstance(key, tuple):
+        key = (key,)
+    n_specified = builtins.sum(1 for k in key if k is not None and k is not Ellipsis)
+    expanded = []
+    seen_ellipsis = False
+    for k in key:
+        if k is Ellipsis:
+            if seen_ellipsis:
+                raise IndexError("an index can only have a single ellipsis ('...')")
+            seen_ellipsis = True
+            expanded.extend([slice(None)] * (ndim - n_specified))
+        else:
+            expanded.append(k)
+    while builtins.sum(1 for k in expanded if k is not None) < ndim:
+        expanded.append(slice(None))
+    return expanded
+
+
 def _result_split(x: DNDarray, key) -> Optional[int]:
     """Split axis of an indexing result per the rules in the module
     docstring."""
@@ -49,20 +102,9 @@ def _result_split(x: DNDarray, key) -> Optional[int]:
     if not isinstance(key, tuple):
         key = (key,)
     # full-shape boolean mask
-    if len(key) == 1 and hasattr(key[0], "dtype") and np.dtype(key[0].dtype) == np.bool_ \
-            and getattr(key[0], "ndim", 0) == x.ndim:
+    if len(key) == 1 and _is_bool_mask(key[0], x):
         return 0
-    # expand ellipsis
-    n_specified = builtins.sum(1 for k in key if k is not None and k is not Ellipsis)
-    expanded = []
-    for k in key:
-        if k is Ellipsis:
-            expanded.extend([slice(None)] * (x.ndim - n_specified))
-        else:
-            expanded.append(k)
-    while builtins.sum(1 for k in expanded if k is not None) < x.ndim:
-        expanded.append(slice(None))
-
+    expanded = _expand_key(key, x.ndim)
     in_dim = 0
     out_dim = 0
     for k in expanded:
@@ -84,13 +126,151 @@ def _result_split(x: DNDarray, key) -> Optional[int]:
     return None
 
 
+@functools.lru_cache(maxsize=64)
+def _sharded_take_fn(comm: MeshCommunication, axis: int, out_split: Optional[int], ndim: int):
+    """Jit-compiled gather whose output is laid out with the result's
+    canonical NamedSharding — XLA emits the cross-shard gather + relayout as
+    one program, with no replicated intermediate."""
+
+    def take(buf, idx):
+        return jnp.take(buf, idx, axis=axis)
+
+    return jax.jit(take, out_shardings=comm.sharding(out_split, ndim))
+
+
+def _advanced_take(x: DNDarray, axis: int, idx: jax.Array) -> DNDarray:
+    """x indexed by a 1-D integer array along ``axis``, keeping the result
+    distributed (reference dndarray.py advanced getitem keeps split)."""
+    comm = x.comm
+    n = x.shape[axis]
+    idx = jnp.where(idx < 0, idx + n, idx)
+    k = int(idx.shape[0])
+    out_gshape = x.shape[:axis] + (k,) + x.shape[axis + 1 :]
+    # result split: the indexed axis stays distributed if it was the split
+    # axis; other-axis splits are carried through
+    out_split = x.split
+    P = comm.padded_size(k) if out_split == axis else k
+    if P != k:
+        idx = jnp.pad(idx, (0, P - k))  # pad entries gather row 0 — they are pad
+    # the gather reads only logical (< n) indices, so input pad rows are unread
+    fn = _sharded_take_fn(comm, axis, out_split, len(out_gshape))
+    res = fn(x.larray, idx)
+    return DNDarray(
+        res, out_gshape, x.dtype, out_split, x.device, x.comm, True
+    )
+
+
+def _normalize_basic_key_physical(expanded, x: DNDarray):
+    """Normalize an expanded basic key against the *logical* global shape so
+    it can be applied to the padded physical buffer (the pad sits at the
+    global tail of the split dim, so normalized indices never touch it)."""
+    out = []
+    d = 0
+    for k in expanded:
+        if k is None:
+            out.append(None)
+            continue
+        n = x.shape[d]
+        if isinstance(k, slice):
+            start, stop, step = k.indices(n)
+            # a normalized stop of -1 (negative step running to the front)
+            # cannot be spelled as a literal slice bound — use None
+            out.append(slice(start, stop if stop >= 0 else None, step))
+        elif isinstance(k, (builtins.int, np.integer)):
+            kk = builtins.int(k)
+            if kk < -n or kk >= n:
+                raise IndexError(
+                    f"index {kk} is out of bounds for axis {d} with size {n}"
+                )
+            out.append(kk + n if kk < 0 else kk)
+        elif _is_int_array(k):
+            # normalize negatives against the *logical* extent — on the
+            # padded physical buffer they would otherwise wrap into the pad
+            ka = jnp.asarray(k)
+            out.append(jnp.where(ka < 0, ka + n, ka))
+        else:
+            out.append(k)
+        d += 1
+    return tuple(out)
+
+
 def getitem(x: DNDarray, key) -> DNDarray:
     key = _normalize_key(key, x)
+
+    # --- sharded gather: a single 1-D integer-array key -------------------
+    if _is_int_array(key) and key.ndim == 1 and x.ndim >= 1:
+        return _advanced_take(x, 0, jnp.asarray(key))
+    if isinstance(key, tuple) and builtins.sum(1 for k in key if _is_int_array(k)) == 1:
+        arr_pos = next(i for i, k in enumerate(key) if _is_int_array(k))
+        if (
+            key[arr_pos].ndim == 1
+            and builtins.all(
+                isinstance(k, slice) and k == slice(None)
+                for i, k in enumerate(key)
+                if i != arr_pos
+            )
+            and len(key) <= x.ndim
+        ):
+            return _advanced_take(x, arr_pos, jnp.asarray(key[arr_pos]))
+
+    # --- basic keys -------------------------------------------------------
+    is_basic = not isinstance(key, tuple) and (
+        isinstance(key, (builtins.int, np.integer, slice)) or key is Ellipsis or key is None
+    )
+    if isinstance(key, tuple):
+        is_basic = builtins.all(
+            isinstance(k, (builtins.int, np.integer, slice)) or k is Ellipsis or k is None
+            for k in key
+        )
+    if is_basic:
+        expanded = _expand_key(key, x.ndim)
+        out_split = _result_split(x, key)
+        norm_key = _normalize_basic_key_physical(expanded, x)
+        # does the key leave the padded split dim whole (full slice)?
+        pad_safe = x.split is None or x.pad_count == 0
+        if not pad_safe:
+            d = 0
+            for k in expanded:
+                if k is None:
+                    continue
+                if d == x.split:
+                    pad_safe = k == slice(None)
+                    break
+                d += 1
+        if pad_safe and x.split is not None and x.pad_count:
+            # physical fast path: keep slice(None) on the split dim so the
+            # pad carries through; the result is already canonically padded
+            phys_key = []
+            d = 0
+            for k in norm_key:
+                if k is None:
+                    phys_key.append(None)
+                    continue
+                phys_key.append(slice(None) if d == x.split else k)
+                d += 1
+            result = x.larray[tuple(phys_key)]
+            gshape = _basic_result_gshape(expanded, x)
+            if result.ndim == 0:
+                return DNDarray(
+                    result, (), types.canonical_heat_type(result.dtype), None,
+                    x.device, x.comm, True,
+                )
+            if out_split is not None and out_split >= result.ndim:
+                out_split = None
+            return DNDarray(result, gshape, x.dtype, out_split, x.device, x.comm, True)
+        # logical route (pad_count==0 means this is the physical buffer too)
+        result = (x.larray if x.pad_count == 0 else x._logical())[norm_key]
+        if result.ndim == 0:
+            return DNDarray(
+                result, (), types.canonical_heat_type(result.dtype), None, x.device, x.comm, True
+            )
+        if out_split is not None and out_split >= result.ndim:
+            out_split = None
+        return DNDarray.from_logical(result, out_split, x.device, x.comm)
+
+    # --- general fallback (masks, mixed advanced keys) --------------------
     log = x._logical()
-    try:
-        result = log[key]
-    except IndexError:
-        raise
+    result = log[key]
     out_split = _result_split(x, key)
     if out_split is not None and out_split >= result.ndim:
         out_split = None
@@ -101,34 +281,87 @@ def getitem(x: DNDarray, key) -> DNDarray:
     return DNDarray.from_logical(result, out_split, x.device, x.comm)
 
 
+def _basic_result_gshape(expanded, x: DNDarray) -> Tuple[int, ...]:
+    """Logical result shape of a basic (slice/int/None) key."""
+    gshape = []
+    d = 0
+    for k in expanded:
+        if k is None:
+            gshape.append(1)
+            continue
+        n = x.shape[d]
+        if isinstance(k, slice):
+            start, stop, step = k.indices(n)
+            gshape.append(builtins.max(0, -(-(stop - start) // step) if step > 0 else -(-(start - stop) // -step)))
+        # ints drop the dim
+        d += 1
+    return tuple(gshape)
+
+
+def _host_fallback_warning(reason: str):
+    warnings.warn(
+        f"setitem: {reason} — falling back to a host numpy round-trip of the "
+        "full global array. This gathers the array to the controller; avoid "
+        "on large arrays.",
+        UserWarning,
+        stacklevel=4,
+    )
+
+
 def setitem(x: DNDarray, key, value) -> None:
     key = _normalize_key(key, x)
     if isinstance(value, DNDarray):
         value = value._logical()
-    log = x._logical()
-    is_bool_mask = (
-        hasattr(key, "dtype")
-        and np.dtype(key.dtype) == np.bool_
-        and getattr(key, "ndim", 0) == x.ndim
-    )
-    if is_bool_mask:
-        val = jnp.asarray(value, dtype=log.dtype)
-        if val.ndim == 0 or val.shape == log.shape or val.size == 1:
-            new = jnp.where(key, jnp.broadcast_to(val, log.shape) if val.ndim else val, log)
+    buf = x.larray
+
+    if _is_bool_mask(key, x):
+        val = jnp.asarray(value, dtype=buf.dtype)
+        mask = jnp.asarray(key)
+        padw = [(0, p - l) for p, l in zip(x.padded_shape, x.shape)]
+        if x.pad_count:
+            mask = jnp.pad(mask, padw, constant_values=False)
+        if val.ndim == 0 or val.size == 1:
+            new = jnp.where(mask, val.reshape(()), buf)
+        elif val.shape == x.shape:
+            valp = jnp.pad(val, padw) if x.pad_count else val
+            new = jnp.where(mask, valp, buf)
         else:
-            # ragged mask assignment — host fallback (documented eager path)
-            host = np.asarray(log)
+            # ragged mask assignment — dynamic true-count, jit-hostile
+            _host_fallback_warning("ragged boolean-mask assignment (value shape "
+                                   f"{tuple(val.shape)} vs mask)")
+            host = np.array(x._logical())
             host[np.asarray(key)] = np.asarray(val)
-            new = jnp.asarray(host)
-    else:
-        try:
-            new = log.at[key].set(jnp.asarray(value, dtype=log.dtype))
-        except (TypeError, IndexError, ValueError):
-            host = np.asarray(log)
-            host[key if not isinstance(key, jnp.ndarray) else np.asarray(key)] = np.asarray(value)
-            new = jnp.asarray(host, dtype=log.dtype)
-    repacked = DNDarray.from_logical(new, x.split, x.device, x.comm, x.dtype)
-    x._DNDarray__internal_set(repacked.larray, x.shape, x.split)
+            new = DNDarray.from_logical(
+                jnp.asarray(host), x.split, x.device, x.comm, x.dtype
+            ).larray
+        x.larray = new
+        return
+
+    # basic / integer-array keys: normalize against logical extents and
+    # update the physical buffer in place — pads are unreachable
+    try:
+        if (
+            isinstance(key, (tuple, builtins.int, np.integer, slice))
+            or key is Ellipsis
+            or _is_int_array(key)
+        ):
+            expanded = _expand_key(key, x.ndim)
+            phys_key = _normalize_basic_key_physical(expanded, x)
+        else:
+            phys_key = key
+        new = buf.at[phys_key].set(jnp.asarray(value, dtype=buf.dtype))
+        x.larray = new
+        return
+    except (TypeError, IndexError, ValueError) as e:
+        if isinstance(e, IndexError) and "out of bounds" in str(e):
+            raise
+        _host_fallback_warning(f"key {key!r} is not jnp-compatible ({e})")
+        host = np.array(x._logical())
+        host[key if not isinstance(key, jnp.ndarray) else np.asarray(key)] = np.asarray(value)
+        new = DNDarray.from_logical(
+            jnp.asarray(host, dtype=buf.dtype), x.split, x.device, x.comm, x.dtype
+        ).larray
+        x.larray = new
 
 
 def nonzero(x: DNDarray) -> DNDarray:

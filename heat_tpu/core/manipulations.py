@@ -454,10 +454,11 @@ def stack(arrays: Sequence[DNDarray], axis: int = 0, out=None) -> DNDarray:
     proto = arrays[0]
     ndim_out = proto.ndim + 1
     ax = axis % ndim_out
-    out_split = proto.split
+    in_split = next(iter(splits), None)
+    out_split = in_split
     if out_split is not None and ax <= out_split:
         out_split += 1
-    if builtins.all(a.split == proto.split and a.shape == proto.shape for a in arrays):
+    if builtins.all(a.split == in_split and a.shape == proto.shape for a in arrays):
         res = jnp.stack([a.larray for a in arrays], axis=ax)
         gshape = proto.shape[:ax] + (len(arrays),) + proto.shape[ax:]
         result = DNDarray(

@@ -1,0 +1,254 @@
+"""getitem/setitem key sweeps asserting values AND physical sharding
+(VERDICT r2 item 3; reference heat/core/dndarray.py:661-1549 keeps advanced
+results distributed — so do we)."""
+
+import warnings
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from heat_tpu.core import dndarray as dnd
+
+
+def _np(x):
+    return x.numpy()
+
+
+def _n_owning_devices(a):
+    """Number of distinct devices holding non-empty shards."""
+    return len({s.device for s in a.larray.addressable_shards})
+
+
+class TestGetitemBasic:
+    def setup_method(self):
+        self.xn = np.arange(11 * 6, dtype=np.float32).reshape(11, 6)
+        self.x = ht.array(self.xn, split=0)
+
+    def test_row_int(self):
+        r = self.x[3]
+        assert r.split is None
+        np.testing.assert_allclose(_np(r), self.xn[3])
+
+    def test_negative_row_int(self):
+        np.testing.assert_allclose(_np(self.x[-1]), self.xn[-1])
+
+    def test_scalar(self):
+        r = self.x[3, 4]
+        assert r.shape == ()
+        assert float(r) == self.xn[3, 4]
+
+    def test_row_slice_keeps_split(self):
+        r = self.x[2:9]
+        assert r.split == 0
+        np.testing.assert_allclose(_np(r), self.xn[2:9])
+
+    def test_col_int_keeps_split_physical(self):
+        dnd.reset_perf_stats()
+        r = self.x[:, 2]
+        assert r.split == 0
+        # key left the padded split dim whole -> physical fast path
+        s = dnd.perf_stats()
+        assert s["logical_slices"] == 0 and s["repads"] == 0, s
+        np.testing.assert_allclose(_np(r), self.xn[:, 2])
+
+    def test_col_slice_keeps_split_physical(self):
+        dnd.reset_perf_stats()
+        r = self.x[:, 1:4]
+        s = dnd.perf_stats()
+        assert s["logical_slices"] == 0 and s["repads"] == 0, s
+        assert r.split == 0
+        np.testing.assert_allclose(_np(r), self.xn[:, 1:4])
+
+    def test_ellipsis(self):
+        np.testing.assert_allclose(_np(self.x[..., 0]), self.xn[..., 0])
+
+    def test_newaxis(self):
+        r = self.x[None]
+        assert r.shape == (1, 11, 6)
+        np.testing.assert_allclose(_np(r), self.xn[None])
+
+    def test_step_slice(self):
+        np.testing.assert_allclose(_np(self.x[1:10:3]), self.xn[1:10:3])
+
+    def test_negative_step_slice(self):
+        np.testing.assert_allclose(_np(self.x[::-1]), self.xn[::-1])
+
+    def test_split1_row_int_physical(self):
+        xs1 = ht.array(self.xn, split=1)
+        dnd.reset_perf_stats()
+        r = xs1[3]
+        s = dnd.perf_stats()
+        assert s["logical_slices"] == 0 and s["repads"] == 0, s
+        assert r.split == 0  # split shifts down when a leading dim drops
+        np.testing.assert_allclose(_np(r), self.xn[3])
+
+    def test_int_on_split_axis_replicates(self):
+        r = self.x[5]
+        assert r.split is None
+
+
+class TestGetitemAdvanced:
+    def setup_method(self):
+        self.xn = np.arange(11 * 6, dtype=np.float32).reshape(11, 6)
+        self.x = ht.array(self.xn, split=0)
+
+    def test_index_array_result_is_split(self):
+        idx = np.array([0, 10, 3, 3, 7])
+        r = self.x[idx]
+        assert r.split == 0, "advanced-index result must stay distributed"
+        np.testing.assert_allclose(_np(r), self.xn[idx])
+
+    def test_index_array_result_is_sharded_physically(self):
+        idx = np.arange(10)
+        r = self.x[idx]
+        assert r.split == 0
+        if ht.get_comm().size > 1:
+            assert _n_owning_devices(r) > 1, "result landed on a single device"
+        np.testing.assert_allclose(_np(r), self.xn[idx])
+
+    def test_negative_index_array(self):
+        idx = np.array([-1, -11, 5])
+        r = self.x[idx]
+        np.testing.assert_allclose(_np(r), self.xn[idx])
+
+    def test_ht_index_array(self):
+        idx = ht.array([1, 2, 8], split=0)
+        r = self.x[idx]
+        assert r.split == 0
+        np.testing.assert_allclose(_np(r), self.xn[[1, 2, 8]])
+
+    def test_index_array_nonsplit_axis(self):
+        idx = np.array([5, 0, 3])
+        r = self.x[:, idx]
+        assert r.split == 0  # row split carried through
+        np.testing.assert_allclose(_np(r), self.xn[:, idx])
+
+    def test_bool_mask_full_shape(self):
+        mask = self.xn > 30
+        r = self.x[ht.array(mask, split=0)]
+        assert r.split == 0
+        np.testing.assert_allclose(_np(r), self.xn[mask])
+
+    def test_2d_index_array_replicates_conservatively(self):
+        idx = np.array([[0, 1], [2, 3]])
+        r = self.x[idx]
+        np.testing.assert_allclose(_np(r), self.xn[idx])
+
+    def test_mixed_advanced(self):
+        r = self.x[np.array([1, 2]), np.array([3, 4])]
+        np.testing.assert_allclose(_np(r), self.xn[[1, 2], [3, 4]])
+
+
+class TestSetitem:
+    def setup_method(self):
+        self.xn = np.arange(11 * 6, dtype=np.float32).reshape(11, 6)
+
+    def _fresh(self, split=0):
+        return ht.array(self.xn.copy(), split=split)
+
+    def test_scalar_set(self):
+        x = self._fresh()
+        x[3, 4] = -1.0
+        ref = self.xn.copy()
+        ref[3, 4] = -1.0
+        np.testing.assert_allclose(_np(x), ref)
+
+    def test_row_set(self):
+        x = self._fresh()
+        x[2] = np.full(6, 9.0, dtype=np.float32)
+        ref = self.xn.copy()
+        ref[2] = 9.0
+        np.testing.assert_allclose(_np(x), ref)
+
+    def test_slice_set_no_relayout(self):
+        x = self._fresh()
+        dnd.reset_perf_stats()
+        x[2:7, 1:3] = 0.5
+        s = dnd.perf_stats()
+        assert s["logical_slices"] == 0 and s["repads"] == 0, s
+        ref = self.xn.copy()
+        ref[2:7, 1:3] = 0.5
+        np.testing.assert_allclose(_np(x), ref)
+
+    def test_full_slice_set(self):
+        x = self._fresh()
+        x[:] = 1.0
+        np.testing.assert_allclose(_np(x), np.ones_like(self.xn))
+
+    def test_negative_int_set(self):
+        x = self._fresh()
+        x[-1] = 7.0
+        ref = self.xn.copy()
+        ref[-1] = 7.0
+        np.testing.assert_allclose(_np(x), ref)
+
+    def test_index_array_set_physical(self):
+        x = self._fresh()
+        dnd.reset_perf_stats()
+        x[np.array([1, -1])] = 4.0
+        s = dnd.perf_stats()
+        assert s["logical_slices"] == 0 and s["repads"] == 0, s
+        ref = self.xn.copy()
+        ref[[1, -1]] = 4.0
+        np.testing.assert_allclose(_np(x), ref)
+
+    def test_bool_mask_scalar_set(self):
+        x = self._fresh()
+        mask = self.xn > 30
+        x[ht.array(mask, split=0)] = 0.0
+        ref = self.xn.copy()
+        ref[mask] = 0.0
+        np.testing.assert_allclose(_np(x), ref)
+
+    def test_bool_mask_full_value_set(self):
+        x = self._fresh()
+        mask = self.xn % 2 == 0
+        x[ht.array(mask, split=0)] = -self.xn
+        ref = self.xn.copy()
+        ref[mask] = -self.xn[mask]
+        np.testing.assert_allclose(_np(x), ref)
+
+    def test_ragged_mask_set_warns(self):
+        x = self._fresh()
+        mask = self.xn > 60
+        vals = np.arange(mask.sum(), dtype=np.float32)
+        with pytest.warns(UserWarning, match="host numpy round-trip"):
+            x[ht.array(mask, split=0)] = vals
+        ref = self.xn.copy()
+        ref[mask] = vals
+        np.testing.assert_allclose(_np(x), ref)
+
+    def test_set_dndarray_value(self):
+        x = self._fresh()
+        v = ht.ones((6,), dtype=ht.float32)
+        x[4] = v
+        ref = self.xn.copy()
+        ref[4] = 1.0
+        np.testing.assert_allclose(_np(x), ref)
+
+    def test_out_of_bounds_raises(self):
+        x = self._fresh()
+        with pytest.raises(IndexError):
+            x[11] = 0.0
+
+    def test_split1_setitem(self):
+        x = self._fresh(split=1)
+        x[:, 3] = 2.0
+        ref = self.xn.copy()
+        ref[:, 3] = 2.0
+        np.testing.assert_allclose(_np(x), ref)
+
+
+class TestSetitemNoPadCorruption:
+    def test_pad_region_never_written_visibly(self):
+        # after many setitems, reductions must still ignore pads
+        xn = np.arange(11, dtype=np.float32)
+        x = ht.array(xn.copy(), split=0)
+        x[3:7] = 100.0
+        x[-1] = 5.0
+        ref = xn.copy()
+        ref[3:7] = 100.0
+        ref[-1] = 5.0
+        assert abs(float(ht.sum(x)) - ref.sum()) < 1e-3
+        assert float(ht.max(x)) == ref.max()
