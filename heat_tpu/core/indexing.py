@@ -138,11 +138,25 @@ def _sharded_take_fn(comm: MeshCommunication, axis: int, out_split: Optional[int
     return jax.jit(take, out_shardings=comm.sharding(out_split, ndim))
 
 
+def _check_bounds(idx, n: int, axis: int) -> None:
+    """Raise IndexError on out-of-range concrete indices (numpy parity).
+    Tracers skip the check — a data-dependent raise cannot be traced."""
+    if isinstance(idx, jax.core.Tracer) or idx.size == 0:
+        return
+    lo, hi = builtins.int(idx.min()), builtins.int(idx.max())
+    if lo < -n or hi >= n:
+        bad = lo if lo < -n else hi
+        raise IndexError(
+            f"index {bad} is out of bounds for axis {axis} with size {n}"
+        )
+
+
 def _advanced_take(x: DNDarray, axis: int, idx: jax.Array) -> DNDarray:
     """x indexed by a 1-D integer array along ``axis``, keeping the result
     distributed (reference dndarray.py advanced getitem keeps split)."""
     comm = x.comm
     n = x.shape[axis]
+    _check_bounds(idx, n, axis)
     idx = jnp.where(idx < 0, idx + n, idx)
     k = int(idx.shape[0])
     out_gshape = x.shape[:axis] + (k,) + x.shape[axis + 1 :]
@@ -187,6 +201,7 @@ def _normalize_basic_key_physical(expanded, x: DNDarray):
             # normalize negatives against the *logical* extent — on the
             # padded physical buffer they would otherwise wrap into the pad
             ka = jnp.asarray(k)
+            _check_bounds(ka, n, d)
             out.append(jnp.where(ka < 0, ka + n, ka))
         else:
             out.append(k)
@@ -226,20 +241,20 @@ def getitem(x: DNDarray, key) -> DNDarray:
         expanded = _expand_key(key, x.ndim)
         out_split = _result_split(x, key)
         norm_key = _normalize_basic_key_physical(expanded, x)
-        # does the key leave the padded split dim whole (full slice)?
-        pad_safe = x.split is None or x.pad_count == 0
-        if not pad_safe:
+        # does the key leave the split dim whole (full slice)? then the
+        # physical buffer can be indexed directly and the (possibly padded)
+        # split dim carries straight through — no relayout
+        split_whole = False
+        if x.split is not None:
             d = 0
             for k in expanded:
                 if k is None:
                     continue
                 if d == x.split:
-                    pad_safe = k == slice(None)
+                    split_whole = isinstance(k, slice) and k == slice(None)
                     break
                 d += 1
-        if pad_safe and x.split is not None and x.pad_count:
-            # physical fast path: keep slice(None) on the split dim so the
-            # pad carries through; the result is already canonically padded
+        if split_whole:
             phys_key = []
             d = 0
             for k in norm_key:
@@ -337,14 +352,45 @@ def setitem(x: DNDarray, key, value) -> None:
         x.larray = new
         return
 
+    # partial boolean mask over the leading dims: stays on device — pad the
+    # mask with False up to the physical extents it covers
+    if (
+        hasattr(key, "dtype")
+        and np.dtype(key.dtype) == np.bool_
+        and 0 < getattr(key, "ndim", 0) < x.ndim
+    ):
+        mask = jnp.asarray(key)
+        if tuple(mask.shape) == x.shape[: mask.ndim]:
+            val = jnp.asarray(value, dtype=buf.dtype)
+            if x.pad_count and x.split is not None and x.split < mask.ndim:
+                padw = [
+                    (0, x.padded_shape[d] - x.shape[d]) for d in range(mask.ndim)
+                ]
+                mask = jnp.pad(mask, padw, constant_values=False)
+            try:
+                x.larray = buf.at[mask].set(val)
+                return
+            except (TypeError, IndexError, ValueError):
+                pass  # ragged values etc. — host fallback below
+
+    def _is_bool_array(k):
+        return hasattr(k, "dtype") and np.dtype(k.dtype) == np.bool_ and getattr(k, "ndim", 0) >= 1
+
     # basic / integer-array keys: normalize against logical extents and
-    # update the physical buffer in place — pads are unreachable
+    # update the physical buffer in place — pads are unreachable. Tuple keys
+    # containing boolean arrays consume multiple dims per entry and skip the
+    # per-dim normalization (host fallback handles them).
+    normalizable = (
+        isinstance(key, (builtins.int, np.integer, slice))
+        or key is Ellipsis
+        or _is_int_array(key)
+        or (
+            isinstance(key, tuple)
+            and not builtins.any(_is_bool_array(k) for k in key)
+        )
+    )
     try:
-        if (
-            isinstance(key, (tuple, builtins.int, np.integer, slice))
-            or key is Ellipsis
-            or _is_int_array(key)
-        ):
+        if normalizable:
             expanded = _expand_key(key, x.ndim)
             phys_key = _normalize_basic_key_physical(expanded, x)
         else:

@@ -381,19 +381,97 @@ def shape(a: DNDarray) -> Tuple[int, ...]:
 def sort(a: DNDarray, axis: int = -1, descending: bool = False, out=None):
     """Global sort along axis, returning (values, indices) like the reference
     (manipulations.py:2258: parallel sample-sort — local sort, Bcast pivots,
-    partition Allreduce, Alltoallv; here one masked jnp sort, XLA's
-    distributed sort handles the split axis)."""
+    partition-matrix Allreduce, Alltoallv of values+indices).
+
+    TPU-native distributed algorithm (NOT a port of the sample-sort): when
+    the sort axis is the split axis on a multi-device mesh, a `shard_map`
+    **odd-even transposition merge-split network** runs: each shard sorts
+    locally, then ``p`` rounds of partner block exchange over ICI
+    (`ppermute`) + two-key merge (value, global index) keep every shape
+    static — the Alltoallv/dynamic-counts choreography of a sample-sort does
+    not survive XLA, a fixed merge network does. Cost: p rounds × chunk
+    bytes; the two-key sort makes ties break by global index (numpy-stable).
+    Other-axis sorts are shard-local single jnp sorts."""
     axis = sanitize_axis(a.shape, axis)
-    fill = _sort_fill(a, descending)
-    buf = a._masked(fill) if (a.split == axis and a.pad_count) else a.larray
-    idx = jnp.argsort(buf, axis=axis, stable=True, descending=descending)
-    vals = jnp.take_along_axis(buf, idx, axis=axis)
-    values = DNDarray(vals, a.shape, a.dtype, a.split, a.device, a.comm, True)
-    indices = DNDarray(idx.astype(jnp.int64), a.shape, types.int64, a.split, a.device, a.comm, True)
+    comm = a.comm
+    if a.split == axis and comm.size > 1:
+        vals, idx = _oddeven_sort_physical(a, axis, descending)
+        values = DNDarray(vals, a.shape, a.dtype, a.split, a.device, a.comm, True)
+        indices = DNDarray(idx.astype(jnp.int64), a.shape, types.int64, a.split, a.device, a.comm, True)
+    else:
+        fill = _sort_fill(a, descending)
+        buf = a._masked(fill) if (a.split == axis and a.pad_count) else a.larray
+        idx = jnp.argsort(buf, axis=axis, stable=True, descending=descending)
+        vals = jnp.take_along_axis(buf, idx, axis=axis)
+        values = DNDarray(vals, a.shape, a.dtype, a.split, a.device, a.comm, True)
+        indices = DNDarray(idx.astype(jnp.int64), a.shape, types.int64, a.split, a.device, a.comm, True)
     if out is not None:
         out.larray = values.larray
         return values, indices
     return values, indices
+
+
+def _oddeven_sort_physical(a: DNDarray, axis: int, descending: bool):
+    """Distributed sort of the physical buffer along the split axis.
+
+    Ascending two-key (value, global-index) sort; pads are filled with the
+    dtype extreme and index sentinels so they land exactly at the global
+    tail (ascending) / front (descending, flipped to the tail afterwards).
+    Returns (values, indices) physical buffers obeying the tail-pad
+    invariant.
+    """
+    comm = a.comm
+    p = comm.size
+    n = a.shape[axis]
+    fill = _sort_fill(a, descending)
+    buf = a._masked(fill) if a.pad_count else a.larray
+
+    pshape = buf.shape
+    iota = jax.lax.broadcasted_iota(jnp.int32, pshape, axis)
+    if descending:
+        # pads must sort BEFORE real ties at the front: index sentinel -1
+        idx0 = jnp.where(iota >= n, -1, iota)
+    else:
+        idx0 = iota  # pads already carry the largest global indices
+
+    c = pshape[axis] // p  # local chunk length along the sort axis
+
+    def kernel(v, i):
+        v, i = jax.lax.sort((v, i), dimension=axis, num_keys=2, is_stable=False)
+        me = comm.axis_index()
+        for r in range(p):
+            b = r % 2
+            perm = []
+            paired = set()
+            for lo in range(b, p - 1, 2):
+                perm += [(lo, lo + 1), (lo + 1, lo)]
+                paired |= {lo, lo + 1}
+            perm += [(k, k) for k in range(p) if k not in paired]
+            ov = comm.ppermute(v, perm)
+            oi = comm.ppermute(i, perm)
+            mv = jnp.concatenate([v, ov], axis=axis)
+            mi = jnp.concatenate([i, oi], axis=axis)
+            mv, mi = jax.lax.sort((mv, mi), dimension=axis, num_keys=2, is_stable=False)
+            low_v = jax.lax.slice_in_dim(mv, 0, c, axis=axis)
+            high_v = jax.lax.slice_in_dim(mv, c, 2 * c, axis=axis)
+            low_i = jax.lax.slice_in_dim(mi, 0, c, axis=axis)
+            high_i = jax.lax.slice_in_dim(mi, c, 2 * c, axis=axis)
+            is_low = (me % 2 == b) & (me + 1 < p)
+            is_high = (me >= 1) & ((me - 1) % 2 == b)
+            sel_v = jnp.where(is_low, low_v, high_v)
+            sel_i = jnp.where(is_low, low_i, high_i)
+            v = jnp.where(is_low | is_high, sel_v, v)
+            i = jnp.where(is_low | is_high, sel_i, i)
+        return v, i
+
+    spec = comm.spec(axis, a.ndim)
+    vals, idx = jax.shard_map(
+        kernel, mesh=comm.mesh, in_specs=(spec, spec), out_specs=(spec, spec)
+    )(buf, idx0)
+    if descending:
+        vals = jnp.flip(vals, axis=axis)
+        idx = jnp.flip(idx, axis=axis)
+    return vals, idx
 
 
 def split(x: DNDarray, indices_or_sections, axis: int = 0) -> List[DNDarray]:
