@@ -251,6 +251,14 @@ class DNDarray:
         boundaries."""
         if self.pad_count == 0:
             return self.__array
+        if jax.process_count() > 1:
+            # slicing off the tail pad yields a non-canonically-shardable
+            # array; on multi-host XLA would relayout it over DCN invisibly
+            # per op — refuse rather than mis-compute (SURVEY §7 stage 1)
+            raise NotImplementedError(
+                "the host-logical view of a padded array is single-controller "
+                "only; multi-host code must stay on pad-aware physical paths"
+            )
         _PERF_STATS["logical_slices"] += 1
         sl = tuple(slice(0, n) for n in self.__gshape)
         return self.__array[sl]

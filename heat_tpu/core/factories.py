@@ -138,19 +138,104 @@ def array(
         # reference semantics: the given array is this *process's* local
         # shard and the global shape is inferred from all processes
         # (factories.py:386-429, neighbor handshake). Single-controller JAX
-        # has one process, so the local portion IS the global array; on
-        # multi-host this is where make_array_from_process_local_data would
-        # assemble the shards.
-        if jax.process_count() > 1:  # pragma: no cover - multi-host only
-            raise NotImplementedError(
-                "is_split across multiple controller processes is not wired "
-                "yet; use split= with the global array"
-            )
+        # has one process, so the local portion IS the global array;
+        # multi-host assembles the shards via
+        # jax.make_array_from_process_local_data (SURVEY §7 stage 1).
         is_split = sanitize_axis(data.shape, is_split)
+        if jax.process_count() > 1:
+            return _assemble_is_split(data, is_split, device, comm, dtype)
         return _wrap(data, is_split, device, comm, dtype)
 
     split = sanitize_axis(data.shape, split)
     return _wrap(data, split, device, comm, dtype)
+
+
+def _assemble_is_split(
+    data,
+    split: int,
+    device: Device,
+    comm: MeshCommunication,
+    dtype: Optional[Type[types.datatype]],
+) -> DNDarray:
+    """Assemble a global DNDarray from per-controller-process local shards
+    (the reference's ``is_split`` neighbor handshake, factories.py:386-429).
+
+    Every process calls this with *its* block along ``split``; blocks are
+    ordered by process index. The global extent is inferred by all-gathering
+    the local shapes (the handshake analog); non-split dims must agree.
+
+    Stage-1 restriction: each process's block must coincide with its devices'
+    canonical ceil-rule chunks ``[first_dev*c, min(last_dev_end*c, n))`` —
+    the layout produced by per-host sharded data loading. Arbitrary ragged
+    blocks would need a cross-host re-chunk (DCN all-to-all) at construction
+    time; pass ``split=`` with a global array instead.
+    """
+    from jax.experimental import multihost_utils
+
+    local = np.asarray(data)
+    pidx = jax.process_index()
+    # handshake: gather (shape..., dtype code) from every process in one go
+    meta = np.asarray(list(local.shape) + [np.dtype(local.dtype).num], dtype=np.int64)
+    all_meta = np.asarray(multihost_utils.process_allgather(meta)).reshape(
+        jax.process_count(), local.ndim + 1
+    )
+    all_shapes = all_meta[:, :-1]
+    for d in range(local.ndim):
+        if d != split and len(set(all_shapes[:, d].tolist())) != 1:
+            raise ValueError(
+                f"is_split: non-split dimension {d} differs across processes: "
+                f"{sorted(set(all_shapes[:, d].tolist()))}"
+            )
+    if dtype is None and len(set(all_meta[:, -1].tolist())) != 1:
+        raise ValueError(
+            "is_split: local shard dtypes differ across processes "
+            f"(numpy dtype codes {sorted(set(all_meta[:, -1].tolist()))}); "
+            "pass dtype= explicitly"
+        )
+    n = int(all_shapes[:, split].sum())
+    gshape = tuple(local.shape[:split]) + (n,) + tuple(local.shape[split + 1 :])
+
+    c = comm.chunk_size(n)
+    mesh_positions = [
+        i for i, dev in enumerate(comm.devices) if dev.process_index == pidx
+    ]
+    if not mesh_positions or mesh_positions != list(
+        range(mesh_positions[0], mesh_positions[0] + len(mesh_positions))
+    ):
+        raise NotImplementedError(
+            "is_split requires this process's devices to be contiguous in the "
+            "communicator mesh"
+        )
+    first, count = mesh_positions[0], len(mesh_positions)
+    want_lo = min(first * c, n)
+    want_hi = min((first + count) * c, n)
+    have_lo = int(all_shapes[:pidx, split].sum())
+    have_hi = have_lo + int(local.shape[split])
+    if (have_lo, have_hi) != (want_lo, want_hi):
+        raise NotImplementedError(
+            f"is_split stage 1: process {pidx}'s block spans global rows "
+            f"[{have_lo},{have_hi}) but its devices' canonical ceil-rule "
+            f"chunks span [{want_lo},{want_hi}); re-chunk the local blocks "
+            f"to ceil({n}/{comm.size})={c} rows per device, or pass split= "
+            "with the global array"
+        )
+    phys_rows = count * c
+    if local.shape[split] < phys_rows:
+        padw = [(0, 0)] * local.ndim
+        padw[split] = (0, phys_rows - local.shape[split])
+        local = np.pad(local, padw)
+
+    ht_dtype = (
+        types.canonical_heat_type(dtype)
+        if dtype is not None
+        else types.canonical_heat_type(local.dtype)
+    )
+    local = local.astype(ht_dtype.jnp_type())
+    pshape = comm.padded_shape(gshape, split)
+    arr = jax.make_array_from_process_local_data(
+        comm.sharding(split, len(gshape)), local, pshape
+    )
+    return DNDarray(arr, gshape, ht_dtype, split, device, comm, True)
 
 
 def asarray(obj, dtype=None, copy=None, order="C", is_split=None, device=None, comm=None) -> DNDarray:

@@ -143,7 +143,8 @@ def _check_bounds(idx, n: int, axis: int) -> None:
     Tracers skip the check — a data-dependent raise cannot be traced."""
     if isinstance(idx, jax.core.Tracer) or idx.size == 0:
         return
-    lo, hi = builtins.int(idx.min()), builtins.int(idx.max())
+    # one host transfer for both extrema, not two
+    lo, hi = (builtins.int(v) for v in np.asarray(jnp.stack([idx.min(), idx.max()])))
     if lo < -n or hi >= n:
         bad = lo if lo < -n else hi
         raise IndexError(
@@ -389,25 +390,33 @@ def setitem(x: DNDarray, key, value) -> None:
             and not builtins.any(_is_bool_array(k) for k in key)
         )
     )
-    try:
-        if normalizable:
+    if normalizable:
+        try:
             expanded = _expand_key(key, x.ndim)
             phys_key = _normalize_basic_key_physical(expanded, x)
-        else:
-            phys_key = key
-        new = buf.at[phys_key].set(jnp.asarray(value, dtype=buf.dtype))
-        x.larray = new
-        return
-    except (TypeError, IndexError, ValueError) as e:
-        if isinstance(e, IndexError) and "out of bounds" in str(e):
-            raise
-        _host_fallback_warning(f"key {key!r} is not jnp-compatible ({e})")
-        host = np.array(x._logical())
-        host[key if not isinstance(key, jnp.ndarray) else np.asarray(key)] = np.asarray(value)
-        new = DNDarray.from_logical(
-            jnp.asarray(host, dtype=buf.dtype), x.split, x.device, x.comm, x.dtype
-        ).larray
-        x.larray = new
+            new = buf.at[phys_key].set(jnp.asarray(value, dtype=buf.dtype))
+            x.larray = new
+            return
+        except (TypeError, IndexError, ValueError) as e:
+            if isinstance(e, IndexError) and "out of bounds" in str(e):
+                raise
+            _host_fallback_warning(f"key {key!r} is not jnp-compatible ({e})")
+    else:
+        # un-normalizable keys (e.g. bool arrays inside a tuple) must NOT be
+        # applied to the padded physical buffer — negative/global indices
+        # would resolve against the physical extent and write pads silently
+        _host_fallback_warning(f"key {key!r} mixes mask/advanced entries")
+
+    def _np_key(k):
+        if isinstance(k, tuple):
+            return tuple(np.asarray(e) if isinstance(e, jnp.ndarray) else e for e in k)
+        return np.asarray(k) if isinstance(k, jnp.ndarray) else k
+
+    host = np.array(x._logical())
+    host[_np_key(key)] = np.asarray(value)
+    x.larray = DNDarray.from_logical(
+        jnp.asarray(host, dtype=buf.dtype), x.split, x.device, x.comm, x.dtype
+    ).larray
 
 
 def nonzero(x: DNDarray) -> DNDarray:

@@ -429,8 +429,13 @@ def _oddeven_sort_physical(a: DNDarray, axis: int, descending: bool):
     pshape = buf.shape
     iota = jax.lax.broadcasted_iota(jnp.int32, pshape, axis)
     if descending:
-        # pads must sort BEFORE real ties at the front: index sentinel -1
-        idx0 = jnp.where(iota >= n, -1, iota)
+        # descending = ascending two-key sort on (value, -index) + flip:
+        # within a tie group the NEGATED index orders descending, so after
+        # the flip ties come out in ascending index order — matching the
+        # stable single-device path regardless of mesh size. Pads (fill =
+        # dtype minimum) carry the largest iota, hence the smallest -iota:
+        # they sort to the global front and the flip sends them to the tail.
+        idx0 = -iota
     else:
         idx0 = iota  # pads already carry the largest global indices
 
@@ -470,7 +475,7 @@ def _oddeven_sort_physical(a: DNDarray, axis: int, descending: bool):
     )(buf, idx0)
     if descending:
         vals = jnp.flip(vals, axis=axis)
-        idx = jnp.flip(idx, axis=axis)
+        idx = -jnp.flip(idx, axis=axis)
     return vals, idx
 
 

@@ -10,7 +10,6 @@ on-device.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Optional
 
 import jax
@@ -23,25 +22,59 @@ from ..core.dndarray import DNDarray
 __all__ = ["Lasso"]
 
 
-@partial(jax.jit, static_argnums=())
-def _cd_epoch(xb: jax.Array, yb: jax.Array, w: jax.Array, theta: jax.Array, lam: jnp.float32):
-    """One full coordinate-descent sweep (reference lasso.py:121-171).
+@jax.jit
+def _cd_fit(xbuf: jax.Array, ybuf: jax.Array, n_logical, m_logical, lam, tol, max_iter):
+    """The whole coordinate-descent fit — input prep AND epochs — as ONE
+    compiled program, so a fit is a single dispatch + a single host sync.
+    (The reference's Python epoch loop syncs per epoch, lasso.py:121-186;
+    per-op eager dispatch also pays a host↔device round trip per op, which
+    dominated wall-clock.) Returns (theta, n_iter).
 
-    theta[0] is the unpenalized intercept (reference treats j==0 specially).
-    """
+    ``xbuf``/``ybuf`` are the *physical* (tail-padded) buffers; rows at
+    global index ≥ ``n_logical`` and columns ≥ ``m_logical`` are pad and are
+    zeroed (a feature-split input pads columns)."""
+    valid = jnp.arange(xbuf.shape[0]) < n_logical
+    validc = jnp.arange(xbuf.shape[1]) < m_logical
+    w = valid.astype(xbuf.dtype)
+    # where (not *w): pad rows/cols may hold inf/nan and 0*inf = nan
+    xclean = jnp.where(valid[:, None] & validc[None, :], xbuf, 0)
+    xb = jnp.concatenate([w[:, None], xclean], axis=1)
+    y1 = ybuf[:, 0] if ybuf.ndim == 2 else ybuf
+    yb = jnp.where(valid, y1, 0)
+    z = (w @ (xb * xb)) / jnp.sum(w)  # epoch-invariant curvature per coord
+    xt = xb.T  # coordinate rows contiguous along the minor axis
+    m = xt.shape[0]
     n = jnp.sum(w)
-    m = xb.shape[1]
 
-    def body(j, theta):
-        y_est = xb @ theta
-        xj = xb[:, j]
-        rho = jnp.sum(xj * (yb - y_est + theta[j] * xj) * w) / n
-        zj = jnp.sum(xj * xj * w) / n
+    def epoch_body(j, carry):
+        theta, y_est = carry
+        xj = jax.lax.dynamic_index_in_dim(xt, j, axis=0, keepdims=False)
+        tj = jax.lax.dynamic_index_in_dim(theta, j, keepdims=False)
+        # no ·w here: pad columns of xb (hence xj) are already zero
+        rho = jnp.sum(xj * (yb - y_est + tj * xj)) / n
         soft = jnp.sign(rho) * jnp.maximum(jnp.abs(rho) - lam, 0.0)
+        zj = jax.lax.dynamic_index_in_dim(z, j, keepdims=False)
         new_tj = jnp.where(j == 0, rho, soft) / jnp.maximum(zj, 1e-30)
-        return theta.at[j].set(new_tj)
+        y_est = y_est + (new_tj - tj) * xj
+        return jax.lax.dynamic_update_index_in_dim(theta, new_tj, j, axis=0), y_est
 
-    return jax.lax.fori_loop(0, m, body, theta)
+    def epoch(carry):
+        theta, it, _ = carry
+        new_theta, _ = jax.lax.fori_loop(
+            0, m, epoch_body, (theta, theta @ xt)
+        )
+        diff = jnp.max(jnp.abs(new_theta - theta))
+        return new_theta, it + 1, diff
+
+    def cond(carry):
+        _, it, diff = carry
+        return (it < max_iter) & (diff > tol)
+
+    theta0 = jnp.zeros((m,), dtype=xt.dtype)
+    theta, n_iter, _ = jax.lax.while_loop(
+        cond, epoch, (theta0, jnp.int32(0), jnp.asarray(jnp.inf, dtype=xt.dtype))
+    )
+    return theta, n_iter
 
 
 class Lasso(BaseEstimator, RegressionMixin):
@@ -103,25 +136,15 @@ class Lasso(BaseEstimator, RegressionMixin):
             raise ValueError("y needs to be 1D or 2D")
 
         dt = types.promote_types(x.dtype, types.float32)
-        xb = x._masked(0).astype(dt.jnp_type())
-        # prepend the intercept column of ones (weighted out on pads)
-        w = (jnp.arange(xb.shape[0]) < x.shape[0]).astype(xb.dtype)
-        ones = w[:, None]
-        xb = jnp.concatenate([ones, xb], axis=1)
-        yb = y._masked(0).astype(dt.jnp_type())
-        if yb.ndim == 2:
-            yb = yb[:, 0]
-
-        theta = jnp.zeros((xb.shape[1],), dtype=xb.dtype)
-        lam = jnp.asarray(self.lam, dtype=xb.dtype)
-        for it in range(self.max_iter):
-            new_theta = _cd_epoch(xb, yb, w, theta, lam)
-            diff = float(jnp.max(jnp.abs(new_theta - theta)))
-            theta = new_theta
-            self.n_iter = it + 1
-            if diff <= self.tol:
-                break
-
+        xbuf = x.larray.astype(dt.jnp_type())
+        ybuf = y.larray.astype(dt.jnp_type())
+        theta, n_iter = _cd_fit(
+            xbuf, ybuf, x.shape[0], x.shape[1], float(self.lam),
+            float(self.tol), int(self.max_iter),
+        )
+        self.n_iter = int(n_iter)
+        # drop pad-column coordinates (feature-split inputs pad columns)
+        theta = theta[: x.shape[1] + 1]
         self.__theta = DNDarray.from_logical(theta, None, x.device, x.comm, dt)
         return self
 
