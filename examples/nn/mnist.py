@@ -15,16 +15,16 @@ from heat_tpu.nn import DataParallel
 from heat_tpu.utils.data import DataLoader, Dataset
 
 
-def load_data(n=8192):
+def load_data(n=8192, train=True):
     try:
         from heat_tpu.utils.data import MNISTDataset
 
-        ds = MNISTDataset("/tmp/mnist-data", train=True)
+        ds = MNISTDataset("/tmp/mnist-data", train=train)
         return ds
     except ImportError:
         # synthetic 10-class blobs shaped like flattened digits
-        rng = np.random.default_rng(0)
-        protos = rng.standard_normal((10, 784)).astype(np.float32)
+        rng = np.random.default_rng(0 if train else 1)
+        protos = np.random.default_rng(42).standard_normal((10, 784)).astype(np.float32)
         labels = rng.integers(0, 10, n).astype(np.int32)
         images = protos[labels] + 0.3 * rng.standard_normal((n, 784)).astype(
             np.float32
@@ -54,10 +54,25 @@ def loss_fn(params, x, y):
     return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
 
 
+def evaluate(dp, params, dataset, batch_size=512):
+    """Accuracy over a dataset (the reference example's evaluated run)."""
+    loader = DataLoader(dataset, batch_size=batch_size)
+    correct = total = 0
+    for xb, yb in loader:
+        xb = xb.reshape(xb.shape[0], -1) / 255.0 if xb.ndim > 2 else xb
+        logits = dp(params, xb)
+        pred = jnp.argmax(logits, axis=-1)
+        correct += int(jnp.sum(pred == jnp.asarray(yb)))
+        total += int(pred.shape[0])
+    return correct / max(total, 1)
+
+
 def main(epochs=3, batch_size=256, lr=1e-3):
     dataset = load_data()
+    eval_set = load_data(n=2048, train=False)
     loader = DataLoader(dataset, batch_size=batch_size)
-    dp = DataParallel(apply, optimizer=optax.adam(lr))
+    dp = DataParallel(apply, optimizer=optax.adam(lr),
+                      blocking_parameter_updates=True)
     step = dp.make_train_step(loss_fn)
 
     params = jax.device_put(
@@ -65,6 +80,7 @@ def main(epochs=3, batch_size=256, lr=1e-3):
     )
     opt_state = dp.optimizer.init(params)
 
+    acc = 0.0
     for epoch in range(epochs):
         total, nb = 0.0, 0
         for xb, yb in loader:
@@ -72,7 +88,12 @@ def main(epochs=3, batch_size=256, lr=1e-3):
             params, opt_state, loss = step(params, opt_state, xb, yb)
             total += float(loss)
             nb += 1
-        print(f"epoch {epoch}: loss {total / nb:.4f} ({nb} batches)")
+        acc = evaluate(dp, params, eval_set)
+        print(
+            f"epoch {epoch}: loss {total / nb:.4f} ({nb} batches), "
+            f"eval accuracy {acc:.2%}"
+        )
+    return acc
 
 
 if __name__ == "__main__":

@@ -53,10 +53,16 @@ class DataParallel:
     optimizer : optax.GradientTransformation, optional
         Bound optimizer used by :meth:`make_train_step`.
     blocking_parameter_updates : bool
-        API parity with the reference (data_parallel.py:52). Both values
-        produce overlapped gradient reduction here — XLA schedules the psum
-        concurrently with backward compute either way; the flag is recorded
-        but changes nothing.
+        ``True`` (the reference's blocking mode, data_parallel.py:223-241):
+        each step applies its own globally-averaged gradients — the psum is
+        on the step's critical path.
+        ``False`` (the reference's non-blocking mode, :243-297): **explicit
+        double buffering** — step ``k`` outputs its averaged gradients and
+        applies step ``k−1``'s. Inside the compiled step the psum result is
+        only a program *output*, so XLA's latency-hiding scheduler overlaps
+        it with the optimizer compute; across steps the average is ready
+        before its first consumer. The first step applies zeros, exactly
+        like the reference's hooks returning zeros on iteration 0 (:276).
     """
 
     def __init__(
@@ -124,24 +130,68 @@ class DataParallel:
         """Build the compiled DP train step.
 
         ``loss_fn(params, *batch) -> scalar`` closes over :attr:`apply_fn`.
-        Returns ``step(params, opt_state, *batch) -> (params, opt_state,
-        loss)``; call with batch arrays sharded via :meth:`shard_batch` —
-        with the batch axis sharded and params replicated, XLA emits exactly
+        With the batch axis sharded and params replicated, XLA emits exactly
         one gradient psum per step (the reference's per-parameter Allreduce
-        hooks, fused)."""
+        hooks, fused). Call with batch arrays sharded via
+        :meth:`shard_batch`.
+
+        Blocking mode returns ``step(params, opt_state, *batch) ->
+        (params, opt_state, loss)``.
+
+        Non-blocking (double-buffered) mode returns ``step(params,
+        opt_state, pending_grads, *batch) -> (params, opt_state,
+        next_pending_grads, loss)`` — thread ``pending_grads`` through the
+        loop, seeded by :meth:`init_pending`. Step ``k`` applies step
+        ``k−1``'s global average while its own psum overlaps the optimizer
+        compute (reference data_parallel.py:243-297 semantics: global grads
+        applied just-in-time one iteration later)."""
         optimizer = optimizer if optimizer is not None else self.optimizer
         if optimizer is None:
             raise ValueError("no optimizer bound; pass one here or at init")
 
-        @jax.jit
-        def step(params, opt_state, *batch):
-            loss, grads = jax.value_and_grad(loss_fn)(params, *batch)
-            updates, opt_state = optimizer.update(grads, opt_state, params)
-            params = optax.apply_updates(params, updates)
-            return params, opt_state, loss
+        if self.blocking_parameter_updates:
+
+            @jax.jit
+            def step(params, opt_state, *batch):
+                loss, grads = jax.value_and_grad(loss_fn)(params, *batch)
+                updates, opt_state = optimizer.update(grads, opt_state, params)
+                params = optax.apply_updates(params, updates)
+                return params, opt_state, loss
+
+        else:
+
+            @jax.jit
+            def step(params, opt_state, pending_grads, *batch):
+                # trace-time guard: the 3rd argument must be a gradient
+                # pytree, catching callers using the blocking-mode arity
+                if jax.tree_util.tree_structure(
+                    pending_grads
+                ) != jax.tree_util.tree_structure(params):
+                    raise TypeError(
+                        "non-blocking (double-buffered) DataParallel step "
+                        "signature is step(params, opt_state, pending_grads, "
+                        "*batch) -> (params, opt_state, next_pending, loss); "
+                        "seed pending_grads with DataParallel.init_pending("
+                        "params), or construct with "
+                        "blocking_parameter_updates=True for the 3-tuple step"
+                    )
+                loss, grads = jax.value_and_grad(loss_fn)(params, *batch)
+                # apply the PREVIOUS step's averaged grads; this step's psum
+                # only feeds the program output — off the critical path
+                updates, opt_state = optimizer.update(
+                    pending_grads, opt_state, params
+                )
+                params = optax.apply_updates(params, updates)
+                return params, opt_state, grads, loss
 
         self._train_step = step
         return step
+
+    @staticmethod
+    def init_pending(params):
+        """Zero gradient buffer seeding the double-buffered loop (the
+        reference's iteration-0 zero-return, data_parallel.py:276)."""
+        return jax.tree_util.tree_map(jnp.zeros_like, params)
 
 
 class DataParallelMultiGPU:

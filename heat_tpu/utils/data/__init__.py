@@ -5,6 +5,7 @@ from . import matrixgallery
 from .datatools import DataLoader, Dataset, dataset_ishuffle, dataset_shuffle
 from .partial_dataset import (
     PartialDataLoaderIter,
+    PartialH5DataLoaderIter,
     PartialDataset,
     PartialH5Dataset,
 )
@@ -17,6 +18,7 @@ __all__ = [
     "PartialDataset",
     "PartialH5Dataset",
     "PartialDataLoaderIter",
+    "PartialH5DataLoaderIter",
     "matrixgallery",
 ]
 

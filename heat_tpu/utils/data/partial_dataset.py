@@ -24,7 +24,7 @@ import numpy as np
 
 from ...core.communication import sanitize_comm
 
-__all__ = ["PartialDataset", "PartialH5Dataset", "PartialDataLoaderIter"]
+__all__ = ["PartialDataset", "PartialH5Dataset", "PartialDataLoaderIter", "PartialH5DataLoaderIter"]
 
 
 class PartialDataset:
@@ -219,3 +219,7 @@ class PartialDataLoaderIter:
                 )
             rem = n - nb * bs
             carry = {k: v[n - rem :] for k, v in win.items()} if rem else None
+
+
+# reference-parity name (reference partial_dataset.py:224)
+PartialH5DataLoaderIter = PartialDataLoaderIter

@@ -67,7 +67,9 @@ class TestDataParallel:
             u, s_ref = opt.update(g, s_ref, p_ref)
             p_ref = optax.apply_updates(p_ref, u)
 
-        dp = DataParallel(mlp_apply, comm=comm, optimizer=opt)
+        dp = DataParallel(
+            mlp_apply, comm=comm, optimizer=opt, blocking_parameter_updates=True
+        )
         step = dp.make_train_step(mse_loss)
         p = jax.device_put(params0, comm.replicated())
         s = opt.init(p)
@@ -102,7 +104,10 @@ class TestDataParallel:
 
     def test_loss_decreases(self, comm):
         x, y = make_data(n=16 * comm.size)
-        dp = DataParallel(mlp_apply, comm=comm, optimizer=optax.adam(1e-2))
+        dp = DataParallel(
+            mlp_apply, comm=comm, optimizer=optax.adam(1e-2),
+            blocking_parameter_updates=True,
+        )
         step = dp.make_train_step(mse_loss)
         p = jax.device_put(mlp_init(8, seed=1), comm.replicated())
         s = dp.optimizer.init(p)
@@ -114,6 +119,73 @@ class TestDataParallel:
                 first = float(loss)
             last = float(loss)
         assert last < first
+
+
+class TestDataParallelNonBlocking:
+    """Double-buffered (overlapped) DP — reference data_parallel.py:243-297:
+    global grads are applied just-in-time one iteration later; iteration 0
+    applies zeros (:276)."""
+
+    def test_first_step_applies_zeros(self, comm):
+        x, y = make_data()
+        dp = DataParallel(mlp_apply, comm=comm, optimizer=optax.sgd(0.1))
+        assert dp.blocking_parameter_updates is False  # reference default
+        step = dp.make_train_step(mse_loss)
+        p0 = jax.device_put(mlp_init(8), comm.replicated())
+        s = dp.optimizer.init(p0)
+        xb, yb = dp.shard_batch(x, y)
+        p1, s, pending, loss = step(p0, s, dp.init_pending(p0), xb, yb)
+        for k in p0:  # zero grads applied -> params unchanged
+            np.testing.assert_array_equal(np.asarray(p1[k]), np.asarray(p0[k]))
+        # the emitted pending grads are the true global average
+        g_ref = jax.grad(mse_loss)(mlp_init(8), x, y)
+        for k in g_ref:
+            np.testing.assert_allclose(
+                np.asarray(pending[k]), np.asarray(g_ref[k]), rtol=1e-5, atol=1e-6
+            )
+
+    def test_stale_gradient_training_converges(self, comm):
+        x, y = make_data(n=16 * comm.size, seed=3)
+        dp = DataParallel(mlp_apply, comm=comm, optimizer=optax.sgd(5e-2))
+        step = dp.make_train_step(mse_loss)
+        p = jax.device_put(mlp_init(8, seed=2), comm.replicated())
+        s = dp.optimizer.init(p)
+        pending = dp.init_pending(p)
+        xb, yb = dp.shard_batch(x, y)
+        first = last = None
+        for i in range(60):
+            p, s, pending, loss = step(p, s, pending, xb, yb)
+            if i == 0:
+                first = float(loss)
+            last = float(loss)
+        assert last < first * 0.5, (first, last)
+
+    def test_second_step_matches_blocking_first_update(self, comm):
+        # nonblocking step 2 applies exactly the grads blocking step 1 applies
+        x, y = make_data(seed=5)
+        p0 = mlp_init(8, seed=5)
+        opt = optax.sgd(0.1)
+
+        dpb = DataParallel(
+            mlp_apply, comm=comm, optimizer=opt, blocking_parameter_updates=True
+        )
+        bstep = dpb.make_train_step(mse_loss)
+        pb = jax.device_put(p0, comm.replicated())
+        sb = opt.init(pb)
+        xb, yb = dpb.shard_batch(x, y)
+        pb1, sb, _ = bstep(pb, sb, xb, yb)
+
+        dpn = DataParallel(mlp_apply, comm=comm, optimizer=opt)
+        nstep = dpn.make_train_step(mse_loss)
+        pn = jax.device_put(p0, comm.replicated())
+        sn = opt.init(pn)
+        pend = dpn.init_pending(pn)
+        pn, sn, pend, _ = nstep(pn, sn, pend, xb, yb)   # applies zeros
+        pn, sn, pend, _ = nstep(pn, sn, pend, xb, yb)   # applies step-1 grads
+        for k in pb1:
+            np.testing.assert_allclose(
+                np.asarray(pn[k]), np.asarray(pb1[k]), rtol=1e-5, atol=1e-6
+            )
 
 
 class TestDataParallelOptimizer:

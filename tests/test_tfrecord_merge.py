@@ -1,0 +1,181 @@
+"""imagenet TFRecord→HDF5 merge (reference heat/utils/data/_utils.py:47-226)
+— TF-free re-design tested against a hand-encoded TFRecord."""
+
+import base64
+import io
+import os
+import struct
+
+import numpy as np
+import pytest
+
+h5py = pytest.importorskip("h5py")
+PIL = pytest.importorskip("PIL")
+from PIL import Image
+
+from heat_tpu.utils.data._utils import (
+    _parse_example,
+    dali_tfrecord2idx,
+    merge_files_imagenet_tfrecord,
+)
+
+
+# -- hand protobuf encoder (test-side oracle) ---------------------------------
+
+def _varint(n: int) -> bytes:
+    out = b""
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        out += bytes([b | (0x80 if n else 0)])
+        if not n:
+            return out
+
+
+def _ld(field: int, payload: bytes) -> bytes:
+    return _varint((field << 3) | 2) + _varint(len(payload)) + payload
+
+
+def _bytes_feature(vals) -> bytes:
+    inner = b"".join(_ld(1, v) for v in vals)
+    return _ld(1, inner)  # Feature.bytes_list
+
+
+def _int64_feature(vals) -> bytes:
+    inner = b"".join(_varint(1 << 3) + _varint(v) for v in vals)
+    return _ld(3, inner)  # Feature.int64_list
+
+
+def _float_feature(vals) -> bytes:
+    packed = b"".join(struct.pack("<f", v) for v in vals)
+    inner = _ld(1, packed)  # packed floats
+    return _ld(2, inner)  # Feature.float_list
+
+
+def _example(features: dict) -> bytes:
+    entries = b""
+    for k, feat in features.items():
+        entry = _ld(1, k.encode()) + _ld(2, feat)
+        entries += _ld(1, entry)  # Features.feature map entry
+    return _ld(1, entries)  # Example.features
+
+
+def _write_tfrecord(path, payloads):
+    with open(path, "wb") as f:
+        for p in payloads:
+            f.write(struct.pack("<Q", len(p)))
+            f.write(b"\0\0\0\0")  # length crc (unchecked)
+            f.write(p)
+            f.write(b"\0\0\0\0")  # payload crc
+
+
+def _jpeg_bytes(arr) -> bytes:
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, format="PNG")  # lossless, PIL-decodable
+    return buf.getvalue()
+
+
+def _make_example(rng, h, w, label, name):
+    img = rng.integers(0, 255, (h, w, 3), dtype=np.uint8)
+    return img, _example(
+        {
+            "image/encoded": _bytes_feature([_jpeg_bytes(img)]),
+            "image/height": _int64_feature([h]),
+            "image/width": _int64_feature([w]),
+            "image/channels": _int64_feature([3]),
+            "image/class/label": _int64_feature([label]),
+            "image/object/bbox/xmin": _float_feature([0.1]),
+            "image/object/bbox/xmax": _float_feature([0.9]),
+            "image/object/bbox/ymin": _float_feature([0.2]),
+            "image/object/bbox/ymax": _float_feature([0.8]),
+            "image/object/bbox/label": _int64_feature([label]),
+            "image/format": _bytes_feature([b"PNG"]),
+            "image/filename": _bytes_feature([name.encode()]),
+            "image/class/synset": _bytes_feature([b"n0000001"]),
+            "image/class/text": _bytes_feature([b"thing"]),
+        }
+    )
+
+
+class TestParseExample:
+    def test_roundtrip_fields(self):
+        rng = np.random.default_rng(0)
+        img, payload = _make_example(rng, 8, 6, 7, "a.png")
+        feats = _parse_example(payload)
+        assert int(feats["image/class/label"][0]) == 7
+        assert int(feats["image/height"][0]) == 8
+        assert abs(feats["image/object/bbox/xmin"][0] - 0.1) < 1e-6
+        assert feats["image/format"][0] == b"PNG"
+        arr = np.asarray(Image.open(io.BytesIO(feats["image/encoded"][0])))
+        np.testing.assert_array_equal(arr, img)
+
+
+class TestMerge:
+    def test_merge_train_and_val(self, tmp_path):
+        rng = np.random.default_rng(1)
+        imgs = []
+        train_payloads, val_payloads = [], []
+        for i in range(3):
+            img, p = _make_example(rng, 8, 6, i + 1, f"t{i}.png")
+            imgs.append(img)
+            train_payloads.append(p)
+        vimg, vp = _make_example(rng, 5, 4, 9, "v0.png")
+        val_payloads.append(vp)
+        _write_tfrecord(tmp_path / "train-00000", train_payloads)
+        _write_tfrecord(tmp_path / "val-00000", val_payloads)
+
+        merge_files_imagenet_tfrecord(str(tmp_path), str(tmp_path))
+
+        with h5py.File(tmp_path / "imagenet_merged.h5") as f:
+            assert f["images"].shape == (3,)
+            assert f["metadata"].shape == (3, 9)
+            assert f["file_info"].shape == (3, 4)
+            # labels shifted to 0-based (reference :186)
+            np.testing.assert_allclose(f["metadata"][:, 3], [0, 1, 2])
+            # decode an image back per the documented recipe
+            raw = base64.binascii.a2b_base64(f["images"][0])
+            h, w = int(f["metadata"][0, 0]), int(f["metadata"][0, 1])
+            np.testing.assert_array_equal(
+                np.frombuffer(raw, np.uint8).reshape(h, w, 3), imgs[0]
+            )
+            assert f["file_info"][0, 0] == b"PNG"
+        with h5py.File(tmp_path / "imagenet_merged_validation.h5") as f:
+            assert f["images"].shape == (1,)
+            assert f["metadata"][0, 3] == 8.0  # label 9 -> 0-based 8
+
+    def test_merge_without_bbox_uses_sentinel(self, tmp_path):
+        rng = np.random.default_rng(2)
+        img = rng.integers(0, 255, (4, 4, 3), dtype=np.uint8)
+        payload = _example(
+            {
+                "image/encoded": _bytes_feature([_jpeg_bytes(img)]),
+                "image/class/label": _int64_feature([5]),
+            }
+        )
+        _write_tfrecord(tmp_path / "train-0", [payload])
+        merge_files_imagenet_tfrecord(str(tmp_path), str(tmp_path))
+        with h5py.File(tmp_path / "imagenet_merged.h5") as f:
+            np.testing.assert_allclose(
+                f["metadata"][0], [4, 4, 3, 4, 0.0, 4.0, 0.0, 4.0, -2.0]
+            )
+
+
+class TestDaliIndex:
+    def test_index_offsets(self, tmp_path):
+        rng = np.random.default_rng(3)
+        _, p1 = _make_example(rng, 4, 4, 1, "x.png")
+        _, p2 = _make_example(rng, 4, 4, 2, "y.png")
+        (tmp_path / "train").mkdir()
+        (tmp_path / "train_idx").mkdir()
+        (tmp_path / "val").mkdir()
+        (tmp_path / "val_idx").mkdir()
+        _write_tfrecord(tmp_path / "train" / "t-0", [p1, p2])
+        dali_tfrecord2idx(
+            str(tmp_path / "train"), str(tmp_path / "train_idx"),
+            str(tmp_path / "val"), str(tmp_path / "val_idx"),
+        )
+        lines = (tmp_path / "train_idx" / "t-0.idx").read_text().splitlines()
+        assert len(lines) == 2
+        off0, len0 = map(int, lines[0].split())
+        off1, _ = map(int, lines[1].split())
+        assert off0 == 0 and off1 == len0 == 16 + len(p1)
