@@ -55,8 +55,9 @@ def loss_fn(params, x, y):
 
 
 def evaluate(dp, params, dataset, batch_size=512):
-    """Accuracy over a dataset (the reference example's evaluated run)."""
-    loader = DataLoader(dataset, batch_size=batch_size)
+    """Accuracy over a dataset (the reference example's evaluated run).
+    shuffle=False: deterministic pass so every epoch scores the same set."""
+    loader = DataLoader(dataset, batch_size=batch_size, shuffle=False)
     correct = total = 0
     for xb, yb in loader:
         xb = xb.reshape(xb.shape[0], -1) / 255.0 if xb.ndim > 2 else xb

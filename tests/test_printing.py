@@ -1,0 +1,66 @@
+"""Printing tests (reference heat/core/printing.py + its tests): __str__
+must render the logical global array — never the tail pad — and honor
+printoptions."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from heat_tpu.core import printing
+
+
+class TestPrinting:
+    def test_str_contains_values(self):
+        x = ht.arange(5, dtype=ht.int32, split=0)
+        s = str(x)
+        for v in range(5):
+            assert str(v) in s
+
+    def test_str_never_shows_pad(self):
+        # 11 over 8 devices pads to 16 — pad values (zeros) must not render
+        import re
+
+        x = ht.arange(11, dtype=ht.float32, split=0) + 100.0
+        s = str(x)
+        data = s.split("]")[0]  # strip the metadata suffix (dtype/split)
+        nums = [float(t) for t in re.findall(r"\d+\.?\d*", data)]
+        assert len(nums) == 11, s
+        assert min(nums) >= 100.0 and max(nums) <= 110.0, s
+
+    def test_repr_equals_str(self):
+        x = ht.arange(4, split=0)
+        assert repr(x) == str(x)
+
+    def test_2d_render_matches_logical(self):
+        xn = np.arange(12, dtype=np.float32).reshape(6, 2)
+        x = ht.array(xn, split=0)
+        s = str(x)
+        assert "11" in s and "0" in s
+
+    def test_scalar_render(self):
+        x = ht.array(3.5)
+        assert "3.5" in str(x)
+
+    def test_printoptions_roundtrip(self):
+        old = printing.get_printoptions()
+        try:
+            printing.set_printoptions(precision=2)
+            assert printing.get_printoptions()["precision"] == 2
+            x = ht.array(np.array([1.23456789], dtype=np.float32), split=0)
+            assert "1.23456789" not in str(x)
+        finally:
+            printing.set_printoptions(
+                precision=old["precision"],
+                threshold=old["threshold"],
+                edgeitems=old["edgeitems"],
+                linewidth=old["linewidth"],
+            )
+
+    def test_large_array_summarizes(self):
+        x = ht.arange(10_000, dtype=ht.float32, split=0)
+        s = str(x)
+        assert "..." in s
+
+    def test_empty_array(self):
+        x = ht.array(np.zeros((0,), dtype=np.float32), split=0)
+        assert "[]" in str(x).replace(" ", "")

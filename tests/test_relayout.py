@@ -89,12 +89,14 @@ class TestOpChainRelayout:
         np.testing.assert_allclose(ht.roll(x, 3, axis=0).numpy(), np.roll(np.arange(11, dtype=np.float32), 3))
 
     def test_divisible_flip_split_axis_physical(self):
-        # no pad: even split-axis flips stay physical
-        x = ht.arange(16, dtype=ht.float32, split=0)
+        # no pad: even split-axis flips stay physical (size mesh-relative so
+        # the sweep's every device count divides it)
+        n = 2 * ht.get_comm().size
+        x = ht.arange(n, dtype=ht.float32, split=0)
         dnd.reset_perf_stats()
         y = ht.flip(x, 0)
         assert _relayouts() == 0
-        np.testing.assert_allclose(y.numpy(), np.arange(16, dtype=np.float32)[::-1])
+        np.testing.assert_allclose(y.numpy(), np.arange(n, dtype=np.float32)[::-1])
 
     def test_reductions_after_chain_correct(self):
         # pad-neutralization still correct after a physical-path chain

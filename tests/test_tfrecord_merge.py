@@ -179,3 +179,15 @@ class TestDaliIndex:
         off0, len0 = map(int, lines[0].split())
         off1, _ = map(int, lines[1].split())
         assert off0 == 0 and off1 == len0 == 16 + len(p1)
+
+
+class TestTruncation:
+    def test_truncated_frame_raises(self, tmp_path):
+        rng = np.random.default_rng(5)
+        _, p1 = _make_example(rng, 4, 4, 1, "t.png")
+        path = tmp_path / "train-0"
+        _write_tfrecord(path, [p1])
+        data = path.read_bytes()
+        path.write_bytes(data[:-10])  # chop the tail
+        with pytest.raises(ValueError, match="truncated TFRecord"):
+            merge_files_imagenet_tfrecord(str(tmp_path), str(tmp_path))
