@@ -150,8 +150,11 @@ def bench_heat_tpu(errors, profile_dir=None, small=False):
         return run, reps * 2.0 * n * n * n
 
     def make_matmul_bf16():
-        # same chain in bfloat16 — the MFU-vs-peak figure
-        n, reps = (1024, 10) if small else (4096, 100)
+        # chain in bfloat16 — the MFU-vs-peak figure. 8192² operands: the
+        # 4096 chain leaves ~25% on the table to per-op overheads at steady
+        # state (the chip bursts ~0.72 MFU on the first run, then settles;
+        # 8192 steady-states at ~0.68 vs 0.50)
+        n, reps = (1024, 10) if small else (8192, 30)
         ab = (ht.random.rand(n, n, dtype=ht.float32, split=0) / float(n)).astype(ht.bfloat16)
         yb = ht.random.rand(n, n, dtype=ht.float32, split=0).astype(ht.bfloat16)
         jchain = _jit_matmul_chain(ab, yb, reps)

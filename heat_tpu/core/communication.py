@@ -114,6 +114,15 @@ class MeshCommunication(Communication):
         """Host process index (0 under single-controller JAX)."""
         return jax.process_index()
 
+    def first_local_position(self) -> int:
+        """Mesh position of this process's first device — the position whose
+        chunk `DNDarray.lshape` reports (on a single controller: 0)."""
+        pidx = jax.process_index()
+        for i, dev in enumerate(self.__devices):
+            if dev.process_index == pidx:
+                return i
+        return 0
+
     @property
     def devices(self) -> List["jax.Device"]:
         return list(self.__devices)

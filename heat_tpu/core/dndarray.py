@@ -191,8 +191,13 @@ class DNDarray:
     @property
     def lshape(self) -> Tuple[int, ...]:
         """Logical chunk shape of this process's first mesh position
-        (reference dndarray.py:170; see module docstring for the layout)."""
-        _, lshape, _ = self.__comm.chunk(self.__gshape, self.__split, self.__comm.rank)
+        (reference dndarray.py:170; see module docstring for the layout).
+        Under multi-host the position is this process's first device in the
+        mesh, not the process index — a process owning devices [2,3] of an
+        8-position mesh reports position 2's chunk."""
+        _, lshape, _ = self.__comm.chunk(
+            self.__gshape, self.__split, self.__comm.first_local_position()
+        )
         return lshape
 
     @property

@@ -26,6 +26,24 @@ __all__ = ["qr"]
 QR = collections.namedtuple("QR", "Q, R")
 
 
+def _local_tsqr(x: jax.Array, tiles: int):
+    """Local (within-shard) blocked TSQR: split the block into ``tiles``
+    row-panels, QR each, then QR the stacked R factors — the reference's
+    ``tiles_per_proc`` knob (qr.py:17: SquareDiagTiles subdivides each rank)
+    realized as a deeper on-chip reduction tree. Falls back to one dense QR
+    when the panels would be wider than tall."""
+    c, n = x.shape
+    if tiles <= 1 or c % tiles != 0 or c // tiles < n:
+        return jnp.linalg.qr(x)
+    cb = c // tiles
+    panels = x.reshape(tiles, cb, n)
+    q1, r1 = jnp.linalg.qr(panels)  # batched: (t, cb, n), (t, n, n)
+    q2, r = jnp.linalg.qr(r1.reshape(tiles * n, n))  # (t*n, n), (n, n)
+    q2b = q2.reshape(tiles, n, n)
+    q = jnp.einsum("tcn,tnk->tck", q1, q2b).reshape(c, n)
+    return q, r
+
+
 def qr(
     a: DNDarray,
     tiles_per_proc: int = 1,
@@ -34,10 +52,16 @@ def qr(
 ) -> QR:
     """Reduced QR factorization ``a = Q @ R`` (reference qr.py:17).
 
-    ``tiles_per_proc`` is accepted for API parity; the TSQR block size is the
-    mesh chunk (the reference uses it to subdivide ranks into tiles, a knob
-    the XLA schedule does not need). Column signs of Q/R are not unique —
-    compare ``Q @ R`` and ``Q.T @ Q``, as the reference tests do.
+    Row-split tall matrices (``m >= n``) run the TSQR shard_map kernel; the
+    per-shard local stage honors ``tiles_per_proc`` as a blocked local TSQR
+    (the reference's tile subdivision, re-expressed as an on-chip reduction
+    tree). Shards shorter than ``n`` still work — the local R factors are
+    ``min(chunk, n)`` tall and the replicated second-stage QR restores the
+    full ``(n, n)`` R. Wide matrices (``m < n``) and column-split inputs use
+    one global XLA QR (documented: there is no communication-avoiding
+    row-decomposition to exploit when rows fit on one shard's minor dim).
+    Column signs of Q/R are not unique — compare ``Q @ R`` and ``Q.T @ Q``,
+    as the reference tests do.
     """
     if not isinstance(a, DNDarray):
         raise TypeError(f"'a' must be a DNDarray, but was {type(a)}")
@@ -51,23 +75,25 @@ def qr(
     dt = types.promote_types(a.dtype, types.float32)
     chunk = comm.chunk_size(m)
 
-    # TSQR path: rows sharded over the mesh and every shard tall enough for a
-    # well-shaped local reduced QR
-    if a.split == 0 and comm.size > 1 and chunk >= n:
+    # TSQR path: rows sharded over the mesh, global m tall enough for a
+    # reduced (m, n) -> (m, n)(n, n) factorization
+    if a.split == 0 and comm.size > 1 and m >= n and chunk >= 1:
         buf = a._masked(0).astype(dt.jnp_type())  # zero pad rows: QR([A;0]) == ([Q;0], R)
         p = comm.size
         axis = comm.axis_name
         spec_row = comm.spec(0, 2)
+        k1 = min(chunk, n)  # local R height
 
         def kernel(x):
-            q1, r1 = jnp.linalg.qr(x)  # (c, n), (n, n)
-            rs = jax.lax.all_gather(r1, axis, tiled=True)  # (p*n, n)
-            q2, r = jnp.linalg.qr(rs)  # (p*n, n), (n, n)
+            q1, r1 = _local_tsqr(x, tiles_per_proc)  # (c, k1), (k1, n)
+            rs = jax.lax.all_gather(r1, axis, tiled=True)  # (p*k1, n)
+            q2, r = jnp.linalg.qr(rs)  # (p*k1, kk), (kk, n) with kk=min(p*k1, n)
             i = jax.lax.axis_index(axis)
-            q2_i = jax.lax.dynamic_slice_in_dim(q2, i * n, n, axis=0)  # (n, n)
-            q_i = q1 @ q2_i  # (c, n)
+            q2_i = jax.lax.dynamic_slice_in_dim(q2, i * k1, k1, axis=0)  # (k1, kk)
+            q_i = q1 @ q2_i  # (c, kk)
             return q_i, r
 
+        # kk == n always: p*k1 >= min(p*chunk, p*n) >= min(m, n) = n
         q_phys, r_tiled = jax.shard_map(
             kernel, mesh=comm.mesh, in_specs=spec_row, out_specs=(spec_row, spec_row)
         )(buf)
@@ -78,8 +104,8 @@ def qr(
         q_ht = DNDarray(q_phys, (m, n), dt, 0, a.device, comm, True)
         return QR(q_ht, r_ht)
 
-    # general path: one XLA QR over the logical view (column-split and
-    # replicated inputs; XLA gathers as needed)
+    # general path: one XLA QR over the logical view (wide matrices,
+    # column-split and replicated inputs; XLA gathers as needed)
     log = a._logical().astype(dt.jnp_type())
     q_log, r_log = jnp.linalg.qr(log)
     r_ht = DNDarray.from_logical(r_log, None if a.split != 1 else 1, a.device, comm, dt)

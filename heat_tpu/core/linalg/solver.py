@@ -81,10 +81,13 @@ def _lanczos_kernel(a: "jnp.ndarray", v0: "jnp.ndarray", m: int):
     alphas = alphas.at[0].set(alpha)
     key = jax.random.PRNGKey(0)
 
+    # breakdown threshold scaled to the compute dtype's resolution
+    eps = 1e-13 if a.dtype == jnp.float64 else 1e-6
+
     def body(i, carry):
         Vb, alphas, betas, w = carry
         beta = norm(w)
-        ok = beta > 1e-13
+        ok = beta > eps
         # breakdown: restart with a pseudo-random vector (deterministic in i)
         restart = jax.random.normal(jax.random.fold_in(key, i), (n,), dtype=a.dtype)
         v_next = jnp.where(ok, w / jnp.where(ok, beta, 1.0), restart)
@@ -107,6 +110,12 @@ def _lanczos_kernel(a: "jnp.ndarray", v0: "jnp.ndarray", m: int):
     return Vb.T, alphas, betas
 
 
+import jax as _jax
+
+# module-level jit: compiles once per (shape, dtype, m), not per call
+_lanczos_jit = _jax.jit(_lanczos_kernel, static_argnums=2)
+
+
 def lanczos(
     A: DNDarray,
     m: int,
@@ -118,11 +127,8 @@ def lanczos(
     solver.py:68: Krylov iteration with Gram-Schmidt against all previous
     Lanczos vectors, used by spectral clustering). Returns (V, T) with
     ``V (n×m)`` orthonormal Krylov basis and ``T (m×m)`` tridiagonal.
-    The iteration itself runs as one jit dispatch (see `_lanczos_kernel`)."""
-    import functools
-
-    import jax
-
+    The iteration itself runs as one jit dispatch (see `_lanczos_kernel`),
+    in the input's promoted dtype (f64 inputs iterate at f64)."""
     if not isinstance(A, DNDarray):
         raise TypeError(f"A needs to be of type ht.DNDarray, but was {type(A)}")
     if A.ndim != 2 or A.shape[0] != A.shape[1]:
@@ -131,25 +137,24 @@ def lanczos(
         raise TypeError(f"m must be a positive integer, got {m}")
 
     n = A.shape[0]
-    a_log = A._logical().astype(jnp.float32)
+    dt = types.promote_types(A.dtype, types.float32)
+    a_log = A._logical().astype(dt.jnp_type())
 
     if v0 is None:
         import numpy as _np
 
         rng = _np.random.default_rng(0)
-        v = jnp.asarray(rng.standard_normal(n), dtype=jnp.float32)
+        v = jnp.asarray(rng.standard_normal(n), dtype=dt.jnp_type())
     else:
-        v = v0._logical().astype(jnp.float32)
+        v = v0._logical().astype(dt.jnp_type())
 
-    kern = jax.jit(functools.partial(_lanczos_kernel, m=m))
-    V_mat, alphas, betas = kern(a_log, v)
+    V_mat, alphas, betas = _lanczos_jit(a_log, v, m)
 
     T_mat = (
         jnp.diag(alphas)
         + jnp.diag(betas[1:], k=1)
         + jnp.diag(betas[1:], k=-1)
     )
-    dt = types.promote_types(A.dtype, types.float32)
     V_ht = DNDarray.from_logical(V_mat.astype(dt.jnp_type()), A.split, A.device, A.comm, dt)
     T_ht = DNDarray.from_logical(T_mat.astype(dt.jnp_type()), None, A.device, A.comm, dt)
     if V_out is not None:

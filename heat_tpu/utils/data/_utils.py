@@ -30,18 +30,9 @@ def dali_tfrecord2idx(train_dir, train_idx_dir, val_dir, val_idx_dir):
             src = os.path.join(src_dir, name)
             if not os.path.isfile(src):
                 continue
-            lines = []
-            with open(src, "rb") as f:
-                while True:
-                    pos = f.tell()
-                    header = f.read(8)
-                    if len(header) < 8:
-                        break
-                    (length,) = struct.unpack("<Q", header)
-                    f.seek(4, 1)  # length crc
-                    f.seek(length, 1)
-                    f.seek(4, 1)  # payload crc
-                    lines.append(f"{pos} {f.tell() - pos}")
+            lines = [
+                f"{pos} {frame_len}" for pos, frame_len, _ in _iter_tfrecord_frames(src)
+            ]
             with open(os.path.join(idx_dir, name + ".idx"), "w") as out:
                 out.write("\n".join(lines) + ("\n" if lines else ""))
 
@@ -129,8 +120,9 @@ def _parse_example(buf):
     return feats
 
 
-def _iter_tfrecord(path):
-    """Yield raw Example payloads of a TFRecord file.
+def _iter_tfrecord_frames(path):
+    """Yield ``(offset, frame_length, payload)`` per TFRecord frame — the
+    single frame walker shared by the merge and the DALI indexer.
 
     Truncation is detected (a short frame raises ValueError naming the file
     and offset — tf.data raises DataLossError there); CRC words are skipped
@@ -152,7 +144,13 @@ def _iter_tfrecord(path):
                     f"truncated TFRecord frame in {path} at byte {pos} "
                     f"(declared {length} payload bytes)"
                 )
-            yield payload
+            yield pos, 16 + length, payload
+
+
+def _iter_tfrecord(path):
+    """Yield raw Example payloads of a TFRecord file."""
+    for _, _, payload in _iter_tfrecord_frames(path):
+        yield payload
 
 
 def merge_files_imagenet_tfrecord(folder_name, output_folder=None):

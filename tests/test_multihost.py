@@ -40,6 +40,11 @@ x = ht.array(local, is_split=0)
 assert x.shape == (n,), x.shape
 assert x.split == 0
 
+# --- lshape reports the first LOCAL device's chunk, not process index ----
+assert comm.first_local_position() == rank * 2, comm.first_local_position()
+_, exp_lshape, _ = comm.chunk((n,), 0, comm.first_local_position())
+assert x.lshape == exp_lshape, (x.lshape, exp_lshape)
+
 # --- global reductions over the assembled array (pad-neutralized) --------
 total = float(ht.sum(x).item())
 assert total == float(sum(range(n))), total
