@@ -225,6 +225,32 @@ def bench_heat_tpu(errors, profile_dir=None, small=False):
         # per sweep per coordinate: rho = x_j . residual (2n) + y_est (2n)
         return run, sweeps * dl * 4.0 * nl
 
+    def make_attention():
+        # Pallas flash-attention chain (heat_tpu.parallel.flash_attention),
+        # bf16, non-causal; detail row like matmul_bf16 (not in the geomean).
+        # (512, 1024) blocks won the v5e sweep at 2.7× the XLA path
+        from heat_tpu.parallel import flash_attention
+
+        (b, t, h, d, reps) = (1, 512, 2, 64, 2) if small else (4, 4096, 8, 128, 20)
+        key = jax.random.PRNGKey(0)
+        kq, kk, kv = jax.random.split(key, 3)
+        q = jax.random.normal(kq, (b, t, h, d), dtype=jnp.bfloat16)
+        k = jax.random.normal(kk, (b, t, h, d), dtype=jnp.bfloat16)
+        v = jax.random.normal(kv, (b, t, h, d), dtype=jnp.bfloat16)
+
+        @jax.jit
+        def chain(q, k, v):
+            def body(_, q_):
+                # keep the chain data-dependent so XLA can't dedupe reps
+                return flash_attention(q_, k, v) + q_ * jnp.bfloat16(1e-3)
+
+            return jax.lax.fori_loop(0, reps, body, q)
+
+        def run():
+            return _sync(chain(q, k, v).astype(jnp.float32))
+
+        return run, reps * 4.0 * b * h * t * t * d
+
     workloads = [
         ("matmul", make_matmul),
         ("matmul_f32", make_matmul_f32),
@@ -233,6 +259,7 @@ def bench_heat_tpu(errors, profile_dir=None, small=False):
         ("kmeans", make_kmeans),
         ("moments", make_moments),
         ("lasso", make_lasso),
+        ("attention", make_attention),
     ]
 
     results = {}
@@ -369,8 +396,12 @@ def main():
     base = bench_torch_cpu(errors)
 
     # headline geomean keeps the r02 workload set for comparability
-    # (matmul_f32/matmul_bf16 are precision-labeled detail rows)
-    f32 = {k: v for k, v in ours.items() if k not in ("matmul_bf16", "matmul_f32")}
+    # (matmul_f32/matmul_bf16/attention are labeled detail rows)
+    f32 = {
+        k: v
+        for k, v in ours.items()
+        if k not in ("matmul_bf16", "matmul_f32", "attention")
+    }
     geo_ours = float(np.exp(np.mean([np.log(v) for v in f32.values()]))) if f32 else 0.0
     # vs_baseline compares geomeans over the SAME workload subset, so a
     # partial torch failure can't skew the ratio across mismatched sets
@@ -401,6 +432,8 @@ def main():
         # true-f32 runs 6 MXU passes per product; its natural peak is ~1/3
         # of the bf16 peak — reported against bf16 peak for a single scale
         detail["matmul_truef32_vs_bf16_peak"] = round(ours["matmul_f32"] / peak, 3)
+    if peak and "attention" in ours:
+        detail["attention_mfu"] = round(ours["attention"] / peak, 3)
     if errors:
         detail["errors"] = errors
     print(json.dumps(detail), file=sys.stderr, flush=True)

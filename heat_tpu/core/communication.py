@@ -92,6 +92,7 @@ class MeshCommunication(Communication):
             devices = dev.jax_devices()
         self.__devices = list(devices)
         self.__axis = axis
+        self.__first_local_position = None
         self.__mesh = Mesh(np.asarray(self.__devices), (axis,))
 
     # -- identity ------------------------------------------------------------
@@ -116,12 +117,20 @@ class MeshCommunication(Communication):
 
     def first_local_position(self) -> int:
         """Mesh position of this process's first device — the position whose
-        chunk `DNDarray.lshape` reports (on a single controller: 0)."""
-        pidx = jax.process_index()
-        for i, dev in enumerate(self.__devices):
-            if dev.process_index == pidx:
-                return i
-        return 0
+        chunk `DNDarray.lshape` reports (on a single controller: 0).
+
+        Fixed for the mesh's lifetime, so the device-list scan runs once
+        (`lshape` consults this on every access)."""
+        cached = self.__first_local_position
+        if cached is None:
+            pidx = jax.process_index()
+            cached = 0
+            for i, dev in enumerate(self.__devices):
+                if dev.process_index == pidx:
+                    cached = i
+                    break
+            self.__first_local_position = cached
+        return cached
 
     @property
     def devices(self) -> List["jax.Device"]:

@@ -77,7 +77,7 @@ def qr(
 
     # TSQR path: rows sharded over the mesh, global m tall enough for a
     # reduced (m, n) -> (m, n)(n, n) factorization
-    if a.split == 0 and comm.size > 1 and m >= n and chunk >= 1:
+    if a.split == 0 and comm.size > 1 and m >= n:
         buf = a._masked(0).astype(dt.jnp_type())  # zero pad rows: QR([A;0]) == ([Q;0], R)
         p = comm.size
         axis = comm.axis_name

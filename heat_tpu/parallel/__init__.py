@@ -15,11 +15,14 @@ builds long-context attention on top of them:
 * :func:`ulysses_attention` — all_to_all sequence↔head reshard, local
   attention, reshard back (Jacobs et al. 2023 schedule).
 * :func:`halo_exchange` — neighbor-overlap slices for stencil ops.
+* :func:`flash_attention` — the single-chip hot path as a hand-tiled Pallas
+  TPU kernel (VMEM-resident online softmax, MXU-blocked QKᵀ/PV).
 """
 
 from .ring import ring_pipeline
 from .attention import local_attention, ring_attention, ulysses_attention
 from .halo import halo_exchange
+from .pallas_attention import flash_attention
 
 __all__ = [
     "ring_pipeline",
@@ -27,4 +30,5 @@ __all__ = [
     "ring_attention",
     "ulysses_attention",
     "halo_exchange",
+    "flash_attention",
 ]

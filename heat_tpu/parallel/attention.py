@@ -185,6 +185,7 @@ def ulysses_attention(
     scale: Optional[float] = None,
     seq_len: Optional[int] = None,
     block_size: int = 512,
+    use_pallas: bool = False,
 ) -> jax.Array:
     """Ulysses sequence parallelism (Jacobs et al. 2023).
 
@@ -192,7 +193,10 @@ def ulysses_attention(
     the full sequence for H/p heads), runs the dense blockwise kernel, and
     swaps back. This is the TPU-native form of the reference's axis-aware
     Alltoall reshard (reference heat/core/communication.py:1180-1322).
-    Requires ``H`` divisible by ``comm.size``.
+    Requires ``H`` divisible by ``comm.size``. ``use_pallas=True`` runs the
+    local step through the hand-tiled Pallas kernel
+    (:func:`heat_tpu.parallel.flash_attention`, ~2.7× the XLA path on v5e)
+    at its tuned tile sizes — ``block_size`` applies to the XLA path only.
     """
     p = comm.size
     axis = comm.axis_name
@@ -208,10 +212,17 @@ def ulysses_attention(
             tiled=True,
         )
         qh, kh, vh = a2a(qb), a2a(kb), a2a(vb)
-        oh = local_attention(
-            qh, kh, vh, causal=causal, scale=scale, block_size=block_size,
-            kv_valid=seq_len,
-        )
+        if use_pallas:
+            from .pallas_attention import flash_attention
+
+            oh = flash_attention(
+                qh, kh, vh, causal=causal, scale=scale, kv_valid=seq_len,
+            )
+        else:
+            oh = local_attention(
+                qh, kh, vh, causal=causal, scale=scale, block_size=block_size,
+                kv_valid=seq_len,
+            )
         back = functools.partial(
             jax.lax.all_to_all, axis_name=axis, split_axis=1, concat_axis=2,
             tiled=True,

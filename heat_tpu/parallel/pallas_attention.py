@@ -1,0 +1,264 @@
+"""Pallas TPU flash-attention kernel.
+
+The hot op of the long-context stack (:mod:`heat_tpu.parallel.attention`)
+hand-tiled for the TPU memory hierarchy: Q/K/V stream HBM→VMEM in
+(block_q, block_k) tiles, the online-softmax accumulators (m, l, acc) live
+in VMEM scratch across the K-block grid axis, and the QKᵀ / PV products hit
+the MXU with explicit ``preferred_element_type=float32``. The reference
+framework has no attention code at all (SURVEY §2.5); this kernel is the
+TPU-native capability its ring/Alltoall mechanisms exist to enable, and a
+drop-in replacement for the XLA-fused :func:`local_attention` path.
+
+Numerics match :func:`heat_tpu.parallel.attention.local_attention` bit-for-
+pattern (same f32 online softmax, same padding/causal mask semantics); the
+test suite asserts agreement on CPU via the Pallas interpreter. The backward
+pass recomputes through the jnp path under ``jax.custom_vjp`` — flash
+recomputation, O(T) memory, no stored (T, T) matrix.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+_LANES = 128  # TPU lane width: scratch rows are broadcast across it
+# heat_tpu enables jax_enable_x64; a Python-int 0 in an index map then traces
+# as an i64 constant, which Mosaic cannot legalize — pin index literals to i32
+_I0 = np.int32(0)
+
+
+def _flash_kernel(
+    q_ref, k_ref, v_ref, o_ref, m_s, l_s, acc_s,
+    *, scale, causal, kv_valid, block_q, block_k,
+):
+    """Grid = (B, H, num_q_blocks, num_k_blocks); last axis is sequential.
+
+    Refs arrive as (1, 1, block, D) VMEM tiles. The (m, l, acc) scratch
+    persists across the K axis — initialised at ik == 0, finalised into
+    ``o_ref`` at the last K block.
+    """
+    iq = pl.program_id(2)
+    ik = pl.program_id(3)
+    nk = pl.num_programs(3)
+    # Mosaic legalizes only f32 float constants — keep every scalar f32
+    neg_inf = jnp.float32(NEG_INF)
+    half_neg = jnp.float32(NEG_INF / 2)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_s[:] = jnp.full_like(m_s, neg_inf)
+        l_s[:] = jnp.zeros_like(l_s)
+        acc_s[:] = jnp.zeros_like(acc_s)
+
+    # causal skip: a K block strictly above the diagonal band contributes
+    # nothing — skip its MXU work entirely (DMA still streams it; the win is
+    # ~2× compute on long causal sequences)
+    if causal:
+        live = ik * block_k <= iq * block_q + (block_q - 1)
+    else:
+        live = ik >= 0  # always true, keeps one code path
+
+    @pl.when(live)
+    def _accumulate():
+        q = q_ref[0, 0].astype(jnp.float32)  # (bq, D)
+        k = k_ref[0, 0].astype(jnp.float32)  # (bk, D)
+        v = v_ref[0, 0].astype(jnp.float32)
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * jnp.float32(scale)  # (bq, bk)
+
+        k_pos = ik * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        mask = k_pos < kv_valid
+        if causal:
+            q_pos = iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            mask = mask & (k_pos <= q_pos)
+        s = jnp.where(mask, s, neg_inf)
+
+        m_prev = m_s[:, 0:1]  # (bq, 1), lanes hold copies
+        l_prev = l_s[:, 0:1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        zero = jnp.float32(0.0)
+        m_safe = jnp.where(m_new <= half_neg, zero, m_new)
+        p = jnp.where(mask, jnp.exp(s - m_safe), zero)
+        alpha = jnp.where(m_prev <= half_neg, zero, jnp.exp(m_prev - m_safe))
+        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )  # (bq, D)
+
+        m_s[:] = jnp.broadcast_to(m_new, m_s.shape)
+        l_s[:] = jnp.broadcast_to(l_new, l_s.shape)
+        acc_s[:] = acc_s[:] * alpha + pv
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        l_fin = l_s[:, 0:1]
+        denom = jnp.where(l_fin == jnp.float32(0.0), jnp.float32(1.0), l_fin)
+        o_ref[0, 0] = (acc_s[:] / denom).astype(o_ref.dtype)
+
+
+def _out_struct(shape, like):
+    """ShapeDtypeStruct matching ``like``'s dtype — inside a shard_map the
+    output must also declare how it varies over mesh axes (vma), inherited
+    from the input block."""
+    try:
+        vma = jax.typeof(like).vma
+    except (AttributeError, TypeError):
+        vma = None
+    if vma:
+        return jax.ShapeDtypeStruct(shape, like.dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, like.dtype)
+
+
+def _flash_forward(q, k, v, scale, causal, kv_valid, block_q, block_k, interpret):
+    b, h, t_q, d = q.shape
+    t_k = k.shape[2]
+
+    # clamp blocks for short sequences so padding stays one lane-tile, then
+    # pad seq lengths to block multiples and head dim to the lane width;
+    # zero-pad K/V tails are masked out via kv_valid, Q tail rows sliced off
+    block_q = min(block_q, -(-t_q // _LANES) * _LANES)
+    block_k = min(block_k, -(-t_k // _LANES) * _LANES)
+    pq = -t_q % block_q
+    pk = -t_k % block_k
+    pd = -d % _LANES
+    if pq:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, pq), (0, 0)))
+    if pk:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pk), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pk), (0, 0)))
+    if pd:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, 0), (0, pd)))
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, 0), (0, pd)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, 0), (0, pd)))
+    dp = d + pd
+
+    grid = (b, h, (t_q + pq) // block_q, (t_k + pk) // block_k)
+    kernel = functools.partial(
+        _flash_kernel,
+        scale=scale, causal=causal, kv_valid=kv_valid,
+        block_q=block_q, block_k=block_k,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, block_q, dp), lambda bi, hi, qi, ki: (bi, hi, qi, _I0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, 1, block_k, dp), lambda bi, hi, qi, ki: (bi, hi, ki, _I0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, 1, block_k, dp), lambda bi, hi, qi, ki: (bi, hi, ki, _I0),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, block_q, dp), lambda bi, hi, qi, ki: (bi, hi, qi, _I0),
+            memory_space=pltpu.VMEM,
+        ),
+        out_shape=_out_struct((b, h, t_q + pq, dp), q),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _LANES), jnp.float32),  # m
+            pltpu.VMEM((block_q, _LANES), jnp.float32),  # l
+            pltpu.VMEM((block_q, dp), jnp.float32),      # acc
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(q, k, v)
+    return out[:, :, :t_q, :d]
+
+
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8)
+)
+def _flash(q, k, v, scale, causal, kv_valid, block_q, block_k, interpret):
+    return _flash_forward(
+        q, k, v, scale, causal, kv_valid, block_q, block_k, interpret
+    )
+
+
+def _flash_fwd(q, k, v, scale, causal, kv_valid, block_q, block_k, interpret):
+    out = _flash(q, k, v, scale, causal, kv_valid, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _flash_bwd(scale, causal, kv_valid, block_q, block_k, interpret, res, g):
+    # flash recomputation: rebuild the forward through the XLA online-softmax
+    # path (same numerics) and let autodiff produce the gradients — O(T)
+    # memory, nothing saved but q/k/v
+    from .attention import local_attention
+
+    q, k, v = res
+
+    def ref_fwd(q_, k_, v_):
+        o = local_attention(
+            q_.transpose(0, 2, 1, 3), k_.transpose(0, 2, 1, 3),
+            v_.transpose(0, 2, 1, 3),
+            causal=causal, scale=scale, kv_valid=kv_valid,
+        )
+        return o.transpose(0, 2, 1, 3)
+
+    _, vjp = jax.vjp(ref_fwd, q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    kv_valid: Optional[int] = None,
+    block_q: int = 512,
+    block_k: int = 1024,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Flash attention as a hand-tiled Pallas TPU kernel.
+
+    Same contract as :func:`heat_tpu.parallel.attention.local_attention`:
+    ``(B, T, H, D)`` layout, f32 online softmax, K/V positions >= ``kv_valid``
+    masked as padding. Default (512, 1024) blocks won the v5e block sweep;
+    the jit-chained benchmark at B4·T4096·H8·D128 bf16 measures 68.2 TFLOP/s
+    (README table), 2.7× the XLA online-softmax path. Blocks are clamped for
+    short sequences. ``interpret`` defaults to True off-TPU so the same
+    tests run on the CPU mesh via the Pallas interpreter.
+    """
+    if q.ndim != 4:
+        raise ValueError(f"expected (B, T, H, D) inputs, got {q.shape}")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    d = q.shape[-1]
+    t_k = k.shape[1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    kv_valid = t_k if kv_valid is None else int(kv_valid)
+    # kernel works in (B, H, T, D); public layout is (B, T, H, D)
+    out = _flash(
+        q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+        v.transpose(0, 2, 1, 3),
+        scale, causal, kv_valid, block_q, block_k, interpret,
+    )
+    return out.transpose(0, 2, 1, 3)

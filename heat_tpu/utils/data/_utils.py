@@ -31,7 +31,8 @@ def dali_tfrecord2idx(train_dir, train_idx_dir, val_dir, val_idx_dir):
             if not os.path.isfile(src):
                 continue
             lines = [
-                f"{pos} {frame_len}" for pos, frame_len, _ in _iter_tfrecord_frames(src)
+                f"{pos} {frame_len}"
+                for pos, frame_len, _ in _iter_tfrecord_frames(src, read_payload=False)
             ]
             with open(os.path.join(idx_dir, name + ".idx"), "w") as out:
                 out.write("\n".join(lines) + ("\n" if lines else ""))
@@ -120,13 +121,17 @@ def _parse_example(buf):
     return feats
 
 
-def _iter_tfrecord_frames(path):
+def _iter_tfrecord_frames(path, read_payload=True):
     """Yield ``(offset, frame_length, payload)`` per TFRecord frame — the
     single frame walker shared by the merge and the DALI indexer.
 
-    Truncation is detected (a short frame raises ValueError naming the file
-    and offset — tf.data raises DataLossError there); CRC words are skipped
-    unverified."""
+    ``read_payload=False`` seeks over payload+CRC instead of reading it
+    (payload yields as None) — the indexer only needs offsets, so an
+    ImageNet-scale shard costs a few KB of header reads, not a full-file
+    read. Truncation is still detected (a short frame raises ValueError
+    naming the file and offset — tf.data raises DataLossError there); CRC
+    words are skipped unverified."""
+    size = os.path.getsize(path)
     with open(path, "rb") as f:
         while True:
             pos = f.tell()
@@ -136,14 +141,24 @@ def _iter_tfrecord_frames(path):
             if len(header) < 8:
                 raise ValueError(f"truncated TFRecord header in {path} at byte {pos}")
             (length,) = struct.unpack("<Q", header)
-            crc1 = f.read(4)
-            payload = f.read(length)
-            crc2 = f.read(4)
-            if len(crc1) < 4 or len(payload) < length or len(crc2) < 4:
-                raise ValueError(
-                    f"truncated TFRecord frame in {path} at byte {pos} "
-                    f"(declared {length} payload bytes)"
-                )
+            if read_payload:
+                crc1 = f.read(4)
+                payload = f.read(length)
+                crc2 = f.read(4)
+                if len(crc1) < 4 or len(payload) < length or len(crc2) < 4:
+                    raise ValueError(
+                        f"truncated TFRecord frame in {path} at byte {pos} "
+                        f"(declared {length} payload bytes)"
+                    )
+            else:
+                payload = None
+                end = pos + 16 + length
+                if end > size:
+                    raise ValueError(
+                        f"truncated TFRecord frame in {path} at byte {pos} "
+                        f"(declared {length} payload bytes)"
+                    )
+                f.seek(end)
             yield pos, 16 + length, payload
 
 
