@@ -637,18 +637,129 @@ def _sort_fill(a: DNDarray, descending: bool):
 
 
 def unique(a: DNDarray, sorted: bool = False, return_inverse: bool = False, axis: Optional[int] = None):
-    """Unique elements (reference manipulations.py:3077). Dynamic output
-    shape → eager host-path (documented; SURVEY §7 hard parts)."""
+    """Unique elements (reference manipulations.py:3077).
+
+    1-D split arrays on a multi-device mesh run the **distributed
+    algorithm** (two device programs + one scalar sync for the output
+    size): distributed sort (the odd-even merge-split network), a
+    `shard_map` boundary-mask pass (each shard compares against its left
+    neighbor's last element via `ppermute`, then an all_gather exscan
+    assigns every element its global group id), and a scatter+psum
+    compaction into the (U,)-sized split=0 result. No host gather of the
+    data — only the scalar count U crosses to the host, because output
+    *shape* is host-level metadata in this framework.
+
+    ``axis=...`` (row-unique) and 0-d/multi-dim flows keep the eager host
+    path — their dynamic output shapes have no XLA form (SURVEY §7 hard
+    parts); that path's tested ceiling is documented in PARITY.md.
+    """
+    if (
+        axis is None and a.split is not None and a.ndim == 1
+        and a.comm.size > 1 and a.shape[0] > 0
+    ):
+        return _distributed_unique(a, return_inverse)
     log = a._logical()
     if axis is not None:
         axis = sanitize_axis(a.shape, axis)
     if return_inverse:
         res, inverse = jnp.unique(log, return_inverse=True, axis=axis)
         res_ht = _rewrap(res, 0 if a.split is not None else None, a)
-        inv_ht = _rewrap(inverse, None, a)
+        # keep the inverse's layout consistent with the distributed path:
+        # 1-D split input -> split inverse
+        inv_split = 0 if (a.split is not None and a.ndim == 1 and axis is None) else None
+        inv_ht = _rewrap(inverse, inv_split, a)
         return res_ht, inv_ht
     res = jnp.unique(log, axis=axis)
     return _rewrap(res, 0 if a.split is not None else None, a)
+
+
+def _distributed_unique(a: DNDarray, return_inverse: bool):
+    """Distributed unique of a 1-D split array — see :func:`unique`.
+
+    Cost: one distributed sort (p ppermute rounds), one mask pass, and a
+    scatter+psum whose per-device memory is O(U_pad) for the values (and
+    O(N_pad) for the inverse) — the same order as the reference's
+    Allgather-based resolution, but staying on-device end to end.
+    """
+    comm = a.comm
+    p = comm.size
+    n = a.shape[0]
+    axis_name = comm.axis_name
+    spec = comm.spec(0, 1)
+
+    values, indices = sort(a)  # ascending; pads carry original tail indices
+    vbuf = values.larray
+    ibuf = indices.larray.astype(jnp.int64)  # int64: no 2^31 element ceiling
+    n_pad = vbuf.shape[0]
+    c = n_pad // p
+    inexact = jnp.issubdtype(vbuf.dtype, jnp.inexact)
+
+    def mask_kernel(v, oi):
+        rank = comm.axis_index()
+        # left neighbor's last element, one ppermute hop
+        prev_last = jax.lax.ppermute(
+            v[-1:], axis_name, [(i, (i + 1) % p) for i in range(p)]
+        )
+        left = jnp.concatenate([prev_last, v[:-1]])
+        isf = v != left
+        if inexact:
+            # numpy's equal_nan default: all NaNs collapse to one unique
+            # (NaN != NaN would otherwise count each as a fresh group)
+            isf = isf & ~(jnp.isnan(v) & jnp.isnan(left))
+        isf = isf.at[0].set(jnp.where(rank == 0, True, isf[0]))
+        # a pad's ORIGINAL index is its physical tail position >= n — robust
+        # even for float inputs whose NaNs sort past the +inf pad fill
+        isf = isf & (oi < n)
+        local_cum = jnp.cumsum(isf.astype(jnp.int64))
+        # exscan of per-shard first-counts → global group ids: gid[i] is
+        # (#firsts at sorted positions <= i) - 1, valid for EVERY element
+        totals = jax.lax.all_gather(local_cum[-1], axis_name)
+        before = jnp.where(
+            jnp.arange(p, dtype=jnp.int64) < rank, totals, 0
+        ).sum()
+        gid = before + local_cum - 1
+        return isf, gid
+
+    isf_buf, gid_buf = jax.shard_map(
+        mask_kernel, mesh=comm.mesh, in_specs=(spec, spec),
+        out_specs=(spec, spec),
+    )(vbuf, ibuf)
+
+    u = builtins.int(jnp.sum(isf_buf))  # the one host sync: the output SIZE
+    cu = comm.chunk_size(u) if u else 1
+    u_pad = cu * p
+    # psum promotes bool to int — scatter in int32 and cast back after
+    scatter_dt = jnp.int32 if vbuf.dtype == jnp.bool_ else vbuf.dtype
+
+    def compact_kernel(v, isf, gid):
+        rank = comm.axis_index()
+        tgt = jnp.where(isf, gid, u_pad)  # non-firsts → out of range → drop
+        contrib = jnp.zeros((u_pad,), scatter_dt).at[tgt].set(
+            v.astype(scatter_dt), mode="drop"
+        )
+        full = jax.lax.psum(contrib, axis_name)  # each slot written once
+        return jax.lax.dynamic_slice_in_dim(full, rank * cu, cu).astype(v.dtype)
+
+    out_buf = jax.shard_map(
+        compact_kernel, mesh=comm.mesh, in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )(vbuf, isf_buf, gid_buf)
+    res_ht = DNDarray(out_buf, (u,), a.dtype, 0, a.device, a.comm, True)
+    if not return_inverse:
+        return res_ht
+
+    def inverse_kernel(orig_idx, gid):
+        rank = comm.axis_index()
+        tgt = jnp.where(orig_idx < n, orig_idx, n_pad)  # sorted pads dropped
+        contrib = jnp.zeros((n_pad,), jnp.int64).at[tgt].set(gid, mode="drop")
+        full = jax.lax.psum(contrib, axis_name)
+        return jax.lax.dynamic_slice_in_dim(full, rank * c, c)
+
+    inv_buf = jax.shard_map(
+        inverse_kernel, mesh=comm.mesh, in_specs=(spec, spec), out_specs=spec
+    )(ibuf, gid_buf)
+    inv_ht = DNDarray(inv_buf, (n,), types.int64, 0, a.device, a.comm, True)
+    return res_ht, inv_ht
 
 
 def vsplit(x: DNDarray, indices_or_sections) -> List[DNDarray]:
