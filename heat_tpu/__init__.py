@@ -30,5 +30,6 @@ from . import regression
 from . import spatial
 from . import utils
 from . import parallel
+from . import datasets
 from . import nn
 from . import optim

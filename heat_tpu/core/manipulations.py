@@ -689,7 +689,7 @@ def _distributed_unique(a: DNDarray, return_inverse: bool):
 
     values, indices = sort(a)  # ascending; pads carry original tail indices
     vbuf = values.larray
-    ibuf = indices.larray.astype(jnp.int64)  # int64: no 2^31 element ceiling
+    ibuf = indices.larray  # int64 (sort's contract; iota itself caps at 2^31)
     n_pad = vbuf.shape[0]
     c = n_pad // p
     inexact = jnp.issubdtype(vbuf.dtype, jnp.inexact)
@@ -726,7 +726,7 @@ def _distributed_unique(a: DNDarray, return_inverse: bool):
     )(vbuf, ibuf)
 
     u = builtins.int(jnp.sum(isf_buf))  # the one host sync: the output SIZE
-    cu = comm.chunk_size(u) if u else 1
+    cu = comm.chunk_size(u)  # u >= 1: the dispatch guard requires n > 0
     u_pad = cu * p
     # psum promotes bool to int — scatter in int32 and cast back after
     scatter_dt = jnp.int32 if vbuf.dtype == jnp.bool_ else vbuf.dtype

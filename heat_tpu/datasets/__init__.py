@@ -1,0 +1,90 @@
+"""Bundled sample datasets (reference `heat/datasets/` — iris.csv,
+iris_X_train.csv …, diabetes.h5).
+
+The reference ships static data files that its tests and examples load by
+path (e.g. reference naive_bayes/tests/test_gaussiannb.py:27-32 reads
+``heat/datasets/iris_X_train.csv`` with ``sep=";"``). This package carries
+the same capability: the classic public-domain datasets as ``;``-separated
+CSVs, **generated from scikit-learn's copies** by :func:`regenerate` (run
+it to rebuild the files — nothing here is copied from the reference tree;
+diabetes ships as CSV rather than HDF5 because h5py is an optional gated
+dependency). Loader helpers return split DNDarrays directly so examples
+don't need to know the on-disk location.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+_ROOT = os.path.dirname(os.path.abspath(__file__))
+
+__all__ = ["path", "load_iris", "load_iris_split", "load_diabetes", "regenerate"]
+
+
+def path(name: str) -> str:
+    """Absolute path of a bundled dataset file, e.g. ``path('iris.csv')``."""
+    p = os.path.join(_ROOT, name)
+    if not os.path.isfile(p):
+        raise FileNotFoundError(
+            f"no bundled dataset {name!r}; run heat_tpu.datasets.regenerate() "
+            "or pick one of: "
+            + ", ".join(sorted(f for f in os.listdir(_ROOT) if f.endswith(".csv")))
+        )
+    return p
+
+
+def load_iris(split: Optional[int] = 0):
+    """Iris features (150, 4) and labels (150,) as DNDarrays."""
+    import heat_tpu as ht
+
+    X = ht.load_csv(path("iris.csv"), sep=";", split=split)
+    y = ht.load_csv(path("iris_labels.csv"), sep=";", split=split)
+    return X, y.squeeze(1).astype(ht.int64)
+
+
+def load_iris_split(split: Optional[int] = 0) -> Tuple:
+    """The bundled stratified 70/30 train/test split of iris
+    (X_train, X_test, y_train, y_test)."""
+    import heat_tpu as ht
+
+    Xtr = ht.load_csv(path("iris_X_train.csv"), sep=";", split=split)
+    Xte = ht.load_csv(path("iris_X_test.csv"), sep=";", split=split)
+    ytr = ht.load_csv(path("iris_y_train.csv"), sep=";", split=split)
+    yte = ht.load_csv(path("iris_y_test.csv"), sep=";", split=split)
+    return Xtr, Xte, ytr.squeeze(1).astype(ht.int64), yte.squeeze(1).astype(ht.int64)
+
+
+def load_diabetes(split: Optional[int] = 0):
+    """Diabetes features (442, 10) and target (442,) as DNDarrays."""
+    import heat_tpu as ht
+
+    D = ht.load_csv(path("diabetes.csv"), sep=";", split=split)
+    return D[:, :10], D[:, 10]
+
+
+def regenerate() -> None:
+    """Rebuild every bundled CSV from scikit-learn's dataset copies
+    (deterministic: fixed random_state for the train/test split)."""
+    import numpy as np
+    from sklearn import datasets as skd
+    from sklearn.model_selection import train_test_split
+
+    def wcsv(name, arr, fmt):
+        np.savetxt(os.path.join(_ROOT, name), arr, delimiter=";", fmt=fmt)
+
+    iris = skd.load_iris()
+    X, y = iris.data, iris.target
+    wcsv("iris.csv", X, "%.1f")
+    wcsv("iris_labels.csv", y.reshape(-1, 1), "%d")
+    Xtr, Xte, ytr, yte = train_test_split(
+        X, y, test_size=0.3, random_state=0, stratify=y
+    )
+    wcsv("iris_X_train.csv", Xtr, "%.1f")
+    wcsv("iris_X_test.csv", Xte, "%.1f")
+    wcsv("iris_y_train.csv", ytr.reshape(-1, 1), "%d")
+    wcsv("iris_y_test.csv", yte.reshape(-1, 1), "%d")
+
+    dia = skd.load_diabetes()
+    D = np.concatenate([dia.data, dia.target.reshape(-1, 1)], axis=1)
+    wcsv("diabetes.csv", D, "%.18e")

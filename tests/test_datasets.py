@@ -1,0 +1,47 @@
+"""Tests for the bundled datasets package (reference heat/datasets/)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+
+class TestDatasets:
+    def test_iris_shapes_and_split(self):
+        X, y = ht.datasets.load_iris()
+        assert X.shape == (150, 4) and X.split == 0
+        assert y.shape == (150,) and y.dtype == ht.int64
+        assert set(np.unique(y.numpy())) == {0, 1, 2}
+
+    def test_iris_train_test_split(self):
+        Xtr, Xte, ytr, yte = ht.datasets.load_iris_split()
+        assert Xtr.shape == (105, 4) and Xte.shape == (45, 4)
+        assert ytr.shape == (105,) and yte.shape == (45,)
+        # stratified: 15 of each class in the test third
+        assert np.bincount(yte.numpy()).tolist() == [15, 15, 15]
+
+    def test_diabetes(self):
+        D, t = ht.datasets.load_diabetes()
+        assert D.shape == (442, 10) and t.shape == (442,)
+        # sklearn's diabetes features are standardized — columns sum to ~0
+        # (f32 load: tolerance covers accumulated rounding)
+        assert abs(float(D.numpy().sum())) < 1e-4
+
+    def test_path_unknown_raises(self):
+        with pytest.raises(FileNotFoundError):
+            ht.datasets.path("nonexistent.csv")
+
+    def test_gaussiannb_iris_end_to_end(self):
+        # the reference's own use of these files (naive_bayes tests flow)
+        Xtr, Xte, ytr, yte = ht.datasets.load_iris_split()
+        nb = ht.naive_bayes.GaussianNB()
+        nb.fit(Xtr, ytr)
+        acc = float((nb.predict(Xte).numpy() == yte.numpy()).mean())
+        assert acc > 0.9
+
+    def test_kmeans_iris(self):
+        X, y = ht.datasets.load_iris()
+        km = ht.cluster.KMeans(n_clusters=3, init="kmeans++", max_iter=50,
+                               random_state=3)
+        km.fit(X)
+        assert km.cluster_centers_.shape == (3, 4)
