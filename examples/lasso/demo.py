@@ -1,0 +1,62 @@
+"""Lasso path demo on the bundled diabetes dataset (reference
+examples/lasso/demo.py — computes the coefficient path over a lambda
+sweep and plots it; here plotting is matplotlib-gated and the path
+prints as text so the demo runs headless on the mesh).
+
+Run: python examples/lasso/demo.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "../..")))
+
+import numpy as np
+
+import heat_tpu as ht
+from heat_tpu.regression import Lasso
+
+FEATURES = ["age", "sex", "bmi", "bp", "s1", "s2", "s3", "s4", "s5", "s6"]
+
+
+def main():
+    X, y = ht.datasets.load_diabetes(split=0)
+    y = y.expand_dims(1)
+
+    # column-normalize as the reference demo does before fitting
+    X = X / ht.sqrt(ht.mean(X**2, axis=0))
+
+    lamda = np.logspace(0, 4, 10) / 10
+    theta_list = []
+    for la in lamda:
+        est = Lasso(lam=float(la), max_iter=100)
+        est.fit(X, y)
+        theta_list.append(est.theta.numpy().flatten())
+    theta_lasso = np.stack(theta_list).T[1:, :]  # drop intercept row
+
+    print("lambda:    " + "  ".join(f"{la:8.3f}" for la in lamda))
+    for name, row in zip(FEATURES, theta_lasso):
+        print(f"{name:>6}: " + "  ".join(f"{v:8.4f}" for v in row))
+    nonzero = (np.abs(theta_lasso) > 1e-8).sum(axis=0)
+    print("active coefficients per lambda:", nonzero.tolist())
+
+    try:
+        from matplotlib import pyplot as plt
+
+        plt.figure(figsize=(8, 5))
+        for name, row in zip(FEATURES, theta_lasso):
+            plt.plot(lamda, row, label=name)
+        plt.xscale("log")
+        plt.xlabel("lambda")
+        plt.ylabel("coefficient")
+        plt.title("Lasso paths - heat_tpu implementation")
+        plt.legend()
+        out = os.path.join(os.path.dirname(os.path.abspath(__file__)), "lasso_paths.png")
+        plt.savefig(out, dpi=120)
+        print(f"wrote {out}")
+    except ImportError:
+        print("(matplotlib not installed - skipping the plot)")
+
+
+if __name__ == "__main__":
+    main()
