@@ -9,8 +9,16 @@ module system of the JAX stack — with the distributed wrappers defined here.
 
 from . import functional
 from .data_parallel import DataParallel, DataParallelMultiGPU
+from .transformer import MultiHeadAttention, TransformerBlock, TransformerLM
 
-__all__ = ["DataParallel", "DataParallelMultiGPU", "functional"]
+__all__ = [
+    "DataParallel",
+    "DataParallelMultiGPU",
+    "functional",
+    "MultiHeadAttention",
+    "TransformerBlock",
+    "TransformerLM",
+]
 
 
 def __getattr__(name):

@@ -12,10 +12,17 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def _run(relpath, timeout=420):
-    env = dict(os.environ)
-    env.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=2")
+    # sanitized env: repo-only PYTHONPATH and a fixed 2-device CPU mesh.
+    # Inheriting the harness environment leaks the TPU-tunnel sitecustomize
+    # (PYTHONPATH site dir + activation vars) into a CPU-forced subprocess,
+    # which can block interpreter startup on the tunnel socket; and conftest
+    # has already pinned XLA_FLAGS for the parent, which would override the
+    # device count intended here.
+    keep = ("PATH", "HOME", "LANG", "LC_ALL", "TMPDIR", "TEMP", "TMP")
+    env = {k: os.environ[k] for k in keep if k in os.environ}
+    env["PYTHONPATH"] = REPO
     env["JAX_PLATFORMS"] = "cpu"
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
     return subprocess.run(
         [sys.executable, os.path.join(REPO, relpath)],
         capture_output=True, text=True, timeout=timeout, env=env, cwd=REPO,
