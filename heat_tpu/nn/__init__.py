@@ -10,11 +10,13 @@ module system of the JAX stack — with the distributed wrappers defined here.
 from . import functional
 from .data_parallel import DataParallel, DataParallelMultiGPU
 from .transformer import MultiHeadAttention, TransformerBlock, TransformerLM
+from .moe import MoEMLP
 
 __all__ = [
     "DataParallel",
     "DataParallelMultiGPU",
     "functional",
+    "MoEMLP",
     "MultiHeadAttention",
     "TransformerBlock",
     "TransformerLM",

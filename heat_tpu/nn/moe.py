@@ -1,0 +1,92 @@
+"""Mixture-of-experts layer — expert parallelism (ep) over the mesh.
+
+The reference has no MoE (its parallelism is DP-only, SURVEY §2.5); this
+is the TPU-native strategy expressed the XLA way: routing builds static
+``(tokens, experts, capacity)`` dispatch/combine tensors (Switch top-1,
+capacity-factor bounded — over-capacity tokens drop to the residual,
+standard behavior), the dispatch/expert/combine contractions are three
+einsums, and a single ``with_sharding_constraint`` on the expert axis
+makes XLA insert the token all_to_alls — no hand-written collective
+choreography, exactly the "let the compiler place the collectives"
+design stance of the framework (SURVEY §7).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class MoEMLP(nn.Module):
+    """Switch-style top-1 MoE feed-forward: gate → dispatch → per-expert
+    SwiGLU-free MLP (silu) → combine. ``(B, T, D)`` in and out.
+
+    Pass ``comm=`` to shard the expert axis over the mesh (``n_experts``
+    divisible by ``comm.size``); without it the layer is a single-shard
+    reference implementation with identical numerics.
+    """
+
+    n_experts: int
+    d_ff: int
+    capacity_factor: float = 1.25
+    comm: Optional[Any] = None
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        b, t, d = x.shape
+        n_tok = b * t
+        xt = x.reshape(n_tok, d)
+
+        logits = nn.Dense(
+            self.n_experts, use_bias=False, dtype=self.dtype, name="gate"
+        )(xt)
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        expert = jnp.argmax(probs, axis=-1)  # (N,) top-1
+        gate_w = jnp.take_along_axis(probs, expert[:, None], axis=-1)[:, 0]
+
+        cap = int(math.ceil(n_tok / self.n_experts * self.capacity_factor))
+        e_onehot = jax.nn.one_hot(expert, self.n_experts, dtype=jnp.float32)
+        # 1-indexed arrival position of each token within its expert queue
+        pos = jnp.cumsum(e_onehot, axis=0) * e_onehot
+        keep = (pos > 0) & (pos <= cap)
+        pos0 = jnp.clip(pos - 1.0, 0.0, cap - 1.0).astype(jnp.int32)
+        slot = jax.nn.one_hot(pos0, cap, dtype=jnp.float32)  # (N, E, C)
+        dispatch = slot * keep[..., None].astype(jnp.float32)
+        combine = dispatch * gate_w[:, None, None]
+
+        w_in = self.param(
+            "w_in",
+            nn.initializers.lecun_normal(),
+            (self.n_experts, d, self.d_ff),
+            self.dtype,
+        )
+        w_out = self.param(
+            "w_out",
+            nn.initializers.lecun_normal(),
+            (self.n_experts, self.d_ff, d),
+            self.dtype,
+        )
+
+        expert_in = jnp.einsum("nd,nec->ecd", xt.astype(self.dtype), dispatch.astype(self.dtype))
+        expert_in = self._shard_experts(expert_in)
+        h = nn.silu(jnp.einsum("ecd,edf->ecf", expert_in, self._shard_experts(w_in)))
+        expert_out = jnp.einsum("ecf,efd->ecd", h, self._shard_experts(w_out))
+        out = jnp.einsum("ecd,nec->nd", expert_out, combine.astype(self.dtype))
+        return out.reshape(b, t, d)
+
+    def _shard_experts(self, arr):
+        if self.comm is None:
+            return arr
+        if self.n_experts % self.comm.size:
+            raise ValueError(
+                f"n_experts {self.n_experts} not divisible by mesh size "
+                f"{self.comm.size}"
+            )
+        return jax.lax.with_sharding_constraint(
+            arr, self.comm.sharding(0, arr.ndim)
+        )

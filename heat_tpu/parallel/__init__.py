@@ -17,12 +17,15 @@ builds long-context attention on top of them:
 * :func:`halo_exchange` — neighbor-overlap slices for stencil ops.
 * :func:`flash_attention` — the single-chip hot path as a hand-tiled Pallas
   TPU kernel (VMEM-resident online softmax, MXU-blocked QKᵀ/PV).
+* :func:`pipeline_apply` — GPipe pipeline parallelism: one stage per mesh
+  position, microbatch activations hopping the ring via `ppermute`.
 """
 
 from .ring import ring_pipeline
 from .attention import local_attention, ring_attention, ulysses_attention
 from .halo import halo_exchange
 from .pallas_attention import flash_attention
+from .pipeline import pipeline_apply, stack_stage_params
 
 __all__ = [
     "ring_pipeline",
@@ -31,4 +34,6 @@ __all__ = [
     "ulysses_attention",
     "halo_exchange",
     "flash_attention",
+    "pipeline_apply",
+    "stack_stage_params",
 ]

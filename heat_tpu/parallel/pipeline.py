@@ -1,0 +1,119 @@
+"""Pipeline parallelism (pp) over the mesh axis.
+
+The reference implements data parallelism only (SURVEY §2.5: "no pipeline
+parallelism"); this is the TPU-native strategy built on the same mesh
+machinery: stages live one-per-mesh-position (their params stacked with a
+leading stage dim sharded over the axis), microbatch activations hop
+stage→stage over ICI with `ppermute`, and the whole GPipe schedule —
+S + M - 1 ticks for S stages and M microbatches — is a single
+`lax.fori_loop` inside one `shard_map`, so XLA overlaps each tick's
+compute with the next hop's transfer.
+
+Differentiable end to end (autodiff re-runs the loop; `jax.checkpoint`
+the stage fn for long pipelines). The multichip dryrun
+(`__graft_entry__.py`) runs a pipelined forward+backward as its pp
+layout.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+def stack_stage_params(params_list: Sequence[Any]):
+    """Stack per-stage pytrees into one pytree with leading stage dim
+    (shard it over the mesh axis with ``comm.sharding(0, leaf.ndim)``)."""
+    return jax.tree_util.tree_map(lambda *ls: jnp.stack(ls), *params_list)
+
+
+def pipeline_apply(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stacked_params: Any,
+    x: jax.Array,
+    *,
+    comm,
+    n_microbatches: int,
+) -> jax.Array:
+    """Apply ``stage_{p-1} ∘ … ∘ stage_0`` to ``x`` with the GPipe schedule.
+
+    ``stage_fn(params, h) -> h`` must preserve the activation shape (the
+    classic homogeneous-pipeline contract). ``stacked_params`` leaves carry
+    a leading dim of size ``comm.size`` (stage-major, sharded or
+    replicated — the kernel slices its own stage either way). ``x`` is the
+    full batch ``(B, ...)``, ``B`` divisible by ``n_microbatches``; the
+    result is replicated (every position holds the full output after the
+    final psum).
+    """
+    p = comm.size
+    axis = comm.axis_name
+    m = n_microbatches
+    b = x.shape[0]
+    if b % m:
+        raise ValueError(f"batch {b} not divisible into {m} microbatches")
+    leaves = jax.tree_util.tree_leaves(stacked_params)
+    if leaves and leaves[0].shape[0] != p:
+        # a 2p stack would silently shard 2 stages per position and run
+        # only the first of each — reject any mismatch loudly
+        raise ValueError(
+            f"stacked_params carry {leaves[0].shape[0]} stages for a "
+            f"{p}-position mesh; exactly one stage per position is required"
+        )
+    mb = b // m
+    micro = x.reshape(m, mb, *x.shape[1:])
+    fwd_perm = [(i, (i + 1) % p) for i in range(p)]
+
+    def kernel(params_blk, micro_all):
+        # params_blk leaves: (1, ...) when sharded — this position's stage
+        params = jax.tree_util.tree_map(lambda l: l[0], params_blk)
+        s = comm.axis_index()
+        act = jnp.zeros((mb,) + micro.shape[2:], micro.dtype)
+        out = jnp.zeros_like(micro_all)
+        # fresh accumulators are replicated; the loop carry mixes with
+        # device-varying values (same pcast pattern as ring_attention)
+        act, out = (
+            jax.lax.pcast(a, (axis,), to="varying") for a in (act, out)
+        )
+
+        def tick(t, carry):
+            act, out = carry
+            # stage 0 injects microbatch t (if any remain)
+            inject = jax.lax.dynamic_index_in_dim(
+                micro_all, jnp.minimum(t, m - 1), keepdims=False
+            )
+            inject = jax.lax.pcast(inject, (axis,), to="varying")
+            act = jnp.where((s == 0) & (t < m), inject, act)
+            mth = t - s  # microbatch index flowing through this stage now
+            active = (mth >= 0) & (mth < m)
+            computed = stage_fn(params, act)
+            h = jnp.where(active, computed, act)
+            # last stage collects its finished microbatch
+            out = jax.lax.cond(
+                (s == p - 1) & active,
+                lambda o: jax.lax.dynamic_update_index_in_dim(
+                    o, h, jnp.maximum(mth, 0), axis=0
+                ),
+                lambda o: o,
+                out,
+            )
+            act = jax.lax.ppermute(h, axis, fwd_perm)
+            return act, out
+
+        act, out = jax.lax.fori_loop(0, p + m - 1, tick, (act, out))
+        # only the last position ever wrote `out` (others carry their zero
+        # init), so the psum both collects and replicates the result
+        return jax.lax.psum(out, axis)
+
+    from jax.sharding import PartitionSpec as P
+
+    pspec = jax.tree_util.tree_map(lambda l: comm.spec(0, l.ndim), stacked_params)
+
+    out = jax.shard_map(
+        kernel,
+        mesh=comm.mesh,
+        in_specs=(pspec, P()),
+        out_specs=P(),
+    )(stacked_params, micro)
+    return out.reshape(b, *x.shape[1:])
