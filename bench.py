@@ -52,7 +52,7 @@ _PEAK_BF16_TFLOPS = {
 }
 
 
-def _probe_platform(retries=2, timeout=150):
+def _probe_platform(retries=5, timeout=150):
     """Probe backend init via the shared hang-safe subprocess helper.
 
     Returns (platform_or_None, diagnostics): the platform name when init

@@ -4,3 +4,4 @@ from .basics import *
 from .qr import *
 from .solver import *
 from .svd import *
+from .quant import *

@@ -1,0 +1,136 @@
+"""Int8 quantized matmul — a Pallas TPU kernel for the inference hot path.
+
+The v5e MXU runs int8 at ~2× its bf16 rate (394 vs 197 TOPS peak); the
+reference framework has no quantization support at all, so this is a pure
+capability extension on the framework's hottest op. Design:
+
+* :func:`quantize_int8` — symmetric per-row/per-column absmax scaling to
+  int8 (the standard W8A8 inference recipe).
+* :func:`int8_matmul` — hand-tiled Pallas GEMM: int8 tiles stream
+  HBM→VMEM, products accumulate in an int32 VMEM scratch across the K
+  grid axis (no overflow: 127·127·K fits int32 for K ≤ 2^17 per tile
+  chain), and the f32 rescale (row scale × column scale) fuses into the
+  final write.
+* :func:`matmul_int8` — convenience: quantize both operands, multiply,
+  return f32 — one call to compare against `ht.matmul` accuracy/perf.
+
+Off-TPU the kernel runs under the Pallas interpreter (same program), so
+the CPU test mesh exercises the exact kernel.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["quantize_int8", "int8_matmul", "matmul_int8"]
+
+_I0 = np.int32(0)  # index-map literal pinned to i32 (x64 mode, see pallas_attention)
+
+
+def quantize_int8(x: jax.Array, axis: int) -> Tuple[jax.Array, jax.Array]:
+    """Symmetric absmax int8 quantization along ``axis``.
+
+    Returns ``(q, scale)`` with ``q ≈ x / scale`` in int8 and ``scale``
+    shaped like ``x`` with ``axis`` reduced (kept as size 1).
+    """
+    absmax = jnp.max(jnp.abs(x), axis=axis, keepdims=True)
+    scale = jnp.where(absmax == 0, 1.0, absmax / 127.0).astype(jnp.float32)
+    q = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def _q_kernel(a_ref, b_ref, sa_ref, sb_ref, o_ref, acc_s):
+    ik = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        acc_s[:] = jnp.zeros_like(acc_s)
+
+    acc_s[:] += jax.lax.dot_general(
+        a_ref[:], b_ref[:], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        scale = sa_ref[:] * sb_ref[:]  # (bm, 1) * (1, bn) -> (bm, bn)
+        o_ref[:] = (acc_s[:].astype(jnp.float32) * scale).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("block_m", "block_n", "block_k", "interpret", "out_dtype")
+)
+def int8_matmul(
+    qa: jax.Array,
+    sa: jax.Array,
+    qb: jax.Array,
+    sb: jax.Array,
+    *,
+    block_m: int = 512,
+    block_n: int = 512,
+    block_k: int = 512,
+    out_dtype=jnp.float32,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """``(qa @ qb) * (sa * sb)`` with int8 MXU accumulation in int32.
+
+    ``qa``: (M, K) int8 with per-row scales ``sa`` (M, 1);
+    ``qb``: (K, N) int8 with per-column scales ``sb`` (1, N).
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    m, k = qa.shape
+    k2, n = qb.shape
+    if k != k2:
+        raise ValueError(f"contraction mismatch: {qa.shape} @ {qb.shape}")
+    # int8 MXU tiles want (32, 128) minimums; clamp blocks to padded dims
+    block_m = min(block_m, -(-m // 32) * 32)
+    block_n = min(block_n, -(-n // 128) * 128)
+    block_k = min(block_k, -(-k // 128) * 128)
+    pm, pn, pk = -m % block_m, -n % block_n, -k % block_k
+    if pm or pk:
+        qa = jnp.pad(qa, ((0, pm), (0, pk)))
+        sa = jnp.pad(sa, ((0, pm), (0, 0)), constant_values=1.0)
+    if pk or pn:
+        qb = jnp.pad(qb, ((0, pk), (0, pn)))
+        sb = jnp.pad(sb, ((0, 0), (0, pn)), constant_values=1.0)
+    grid = ((m + pm) // block_m, (n + pn) // block_n, (k + pk) // block_k)
+
+    out = pl.pallas_call(
+        _q_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_m, block_k), lambda i, j, kk: (i, kk),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_k, block_n), lambda i, j, kk: (kk, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_m, 1), lambda i, j, kk: (i, _I0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_n), lambda i, j, kk: (_I0, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((block_m, block_n), lambda i, j, kk: (i, j),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((m + pm, n + pn), out_dtype),
+        scratch_shapes=[pltpu.VMEM((block_m, block_n), jnp.int32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(qa, qb, sa, sb)
+    return out[:m, :n]
+
+
+def matmul_int8(a: jax.Array, b: jax.Array, **kw) -> jax.Array:
+    """Quantize-then-multiply convenience: W8A8 GEMM of two float arrays."""
+    qa, sa = quantize_int8(a, axis=1)
+    qb, sb = quantize_int8(b, axis=0)
+    return int8_matmul(qa, sa, qb, sb, **kw)

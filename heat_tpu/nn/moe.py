@@ -50,11 +50,12 @@ class MoEMLP(nn.Module):
         gate_w = jnp.take_along_axis(probs, expert[:, None], axis=-1)[:, 0]
 
         cap = int(math.ceil(n_tok / self.n_experts * self.capacity_factor))
-        e_onehot = jax.nn.one_hot(expert, self.n_experts, dtype=jnp.float32)
-        # 1-indexed arrival position of each token within its expert queue
-        pos = jnp.cumsum(e_onehot, axis=0) * e_onehot
+        e_onehot_i = jax.nn.one_hot(expert, self.n_experts, dtype=jnp.int32)
+        # 1-indexed arrival position of each token within its expert queue —
+        # integer cumsum: an f32 one loses exact positions past 2^24 tokens
+        pos = jnp.cumsum(e_onehot_i, axis=0) * e_onehot_i
         keep = (pos > 0) & (pos <= cap)
-        pos0 = jnp.clip(pos - 1.0, 0.0, cap - 1.0).astype(jnp.int32)
+        pos0 = jnp.clip(pos - 1, 0, cap - 1)
         slot = jax.nn.one_hot(pos0, cap, dtype=jnp.float32)  # (N, E, C)
         dispatch = slot * keep[..., None].astype(jnp.float32)
         combine = dispatch * gate_w[:, None, None]

@@ -53,13 +53,17 @@ def pipeline_apply(
     b = x.shape[0]
     if b % m:
         raise ValueError(f"batch {b} not divisible into {m} microbatches")
-    leaves = jax.tree_util.tree_leaves(stacked_params)
-    if leaves and leaves[0].shape[0] != p:
+    bad = [
+        l.shape[:1]
+        for l in jax.tree_util.tree_leaves(stacked_params)
+        if l.shape[:1] != (p,)
+    ]
+    if bad:
         # a 2p stack would silently shard 2 stages per position and run
-        # only the first of each — reject any mismatch loudly
+        # only the first of each — reject any mismatched leaf loudly
         raise ValueError(
-            f"stacked_params carry {leaves[0].shape[0]} stages for a "
-            f"{p}-position mesh; exactly one stage per position is required"
+            f"stacked_params leaves carry leading dims {sorted(set(bad))} for "
+            f"a {p}-position mesh; exactly one stage per position is required"
         )
     mb = b // m
     micro = x.reshape(m, mb, *x.shape[1:])

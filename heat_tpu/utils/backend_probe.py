@@ -29,7 +29,9 @@ def probe_default_platform(
     diags: List[str] = []
     for attempt in range(retries):
         if attempt:
-            time.sleep(5 * attempt)
+            # a wedged accelerator tunnel can take minutes to recycle —
+            # back off hard rather than burning the attempts in 10s
+            time.sleep(min(30 * attempt, 120))
         try:
             r = subprocess.run(
                 [sys.executable, "-c", _PROBE_CODE],

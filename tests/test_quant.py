@@ -1,0 +1,89 @@
+"""Tests for the int8 quantized matmul Pallas kernel.
+
+Off-TPU the kernel runs under the Pallas interpreter — the same program
+that compiles to Mosaic on chip. Oracle: float matmul within symmetric-
+quantization error bounds, and an exact integer oracle on the int32
+accumulation path.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from heat_tpu.core.linalg import int8_matmul, matmul_int8, quantize_int8
+
+
+class TestQuantize:
+    def test_roundtrip_error_bounded(self):
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.standard_normal((64, 32)), jnp.float32)
+        q, s = quantize_int8(x, axis=1)
+        assert q.dtype == jnp.int8 and s.shape == (64, 1)
+        err = np.abs(np.asarray(q, np.float32) * np.asarray(s) - np.asarray(x))
+        # symmetric absmax: per-row error <= scale/2
+        assert (err <= np.asarray(s) / 2 + 1e-7).all()
+
+    def test_zero_row_safe(self):
+        x = jnp.zeros((4, 8), jnp.float32)
+        q, s = quantize_int8(x, axis=1)
+        assert np.asarray(q).sum() == 0 and np.isfinite(np.asarray(s)).all()
+
+
+class TestInt8Matmul:
+    def test_integer_exact(self):
+        # integers well inside int8: quantization is exact, result must be too
+        rng = np.random.default_rng(1)
+        a = jnp.asarray(rng.integers(-7, 8, (40, 24)), jnp.float32)
+        b = jnp.asarray(rng.integers(-7, 8, (24, 56)), jnp.float32)
+        # scale=1 quantization: feed ints directly
+        out = int8_matmul(a.astype(jnp.int8), jnp.ones((40, 1), jnp.float32),
+                          b.astype(jnp.int8), jnp.ones((1, 56), jnp.float32),
+                          block_m=32, block_n=128, block_k=128)
+        ref = np.asarray(a, np.int64) @ np.asarray(b, np.int64)
+        np.testing.assert_array_equal(np.asarray(out), ref.astype(np.float32))
+
+    def test_matches_float_matmul(self):
+        rng = np.random.default_rng(2)
+        a = jnp.asarray(rng.standard_normal((96, 64)), jnp.float32)
+        b = jnp.asarray(rng.standard_normal((64, 80)), jnp.float32)
+        out = matmul_int8(a, b, block_m=32, block_n=128, block_k=128)
+        ref = np.asarray(a) @ np.asarray(b)
+        # W8A8 error: ~1% relative on randn data at K=64
+        rel = np.abs(np.asarray(out) - ref) / (np.abs(ref) + 1e-3)
+        assert np.median(rel) < 0.02, float(np.median(rel))
+
+    def test_multi_k_block_accumulation(self):
+        # K spans several grid steps: the int32 scratch carry must be exact
+        rng = np.random.default_rng(3)
+        a = jnp.asarray(rng.integers(-3, 4, (32, 512)), jnp.float32)
+        b = jnp.asarray(rng.integers(-3, 4, (512, 128)), jnp.float32)
+        out = int8_matmul(a.astype(jnp.int8), jnp.ones((32, 1), jnp.float32),
+                          b.astype(jnp.int8), jnp.ones((1, 128), jnp.float32),
+                          block_m=32, block_n=128, block_k=128)
+        ref = np.asarray(a, np.int64) @ np.asarray(b, np.int64)
+        np.testing.assert_array_equal(np.asarray(out), ref.astype(np.float32))
+
+    def test_ragged_shapes_pad(self):
+        rng = np.random.default_rng(4)
+        a = jnp.asarray(rng.standard_normal((37, 45)), jnp.float32)
+        b = jnp.asarray(rng.standard_normal((45, 51)), jnp.float32)
+        out = matmul_int8(a, b)
+        assert out.shape == (37, 51)
+        ref = np.asarray(a) @ np.asarray(b)
+        rel = np.abs(np.asarray(out) - ref) / (np.abs(ref) + 1e-3)
+        assert np.median(rel) < 0.05
+
+    def test_mismatch_raises(self):
+        a = jnp.zeros((4, 8), jnp.int8)
+        b = jnp.zeros((9, 4), jnp.int8)
+        with pytest.raises(ValueError, match="contraction mismatch"):
+            int8_matmul(a, jnp.ones((4, 1), jnp.float32),
+                        b, jnp.ones((1, 4), jnp.float32))
+
+    def test_bf16_output(self):
+        rng = np.random.default_rng(5)
+        a = jnp.asarray(rng.standard_normal((32, 32)), jnp.float32)
+        b = jnp.asarray(rng.standard_normal((32, 32)), jnp.float32)
+        out = matmul_int8(a, b, out_dtype=jnp.bfloat16)
+        assert out.dtype == jnp.bfloat16
