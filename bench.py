@@ -251,6 +251,39 @@ def bench_heat_tpu(errors, profile_dir=None, small=False):
 
         return run, reps * 4.0 * b * h * t * t * d
 
+    def make_matmul_int8():
+        # W8A8 Pallas GEMM chain (heat_tpu.core.linalg.int8_matmul) — the
+        # int8 MXU runs ~2x bf16 peak on v5e; detail row (not in geomean).
+        from heat_tpu.core.linalg import int8_matmul, quantize_int8
+
+        n, reps = (256, 2) if small else (8192, 30)
+        key = jax.random.PRNGKey(0)
+        ka, kb = jax.random.split(key)
+        # normalize a's scale by sqrt(n) (the sibling chains' rho(a)<1
+        # trick): empirically neutral for the requantized chain — scales
+        # hover in [1e-2, 5e-2] for 30 reps instead of running to f32 inf
+        # (unnormalized) or collapsing to all-zero int8 (divide by n)
+        qa, sa = quantize_int8(jax.random.normal(ka, (n, n), jnp.float32), axis=1)
+        sa = sa / jnp.sqrt(jnp.float32(n))
+        qb, sb = quantize_int8(jax.random.normal(kb, (n, n), jnp.float32), axis=0)
+
+        @jax.jit
+        def chain(qa, sa, qb, sb):
+            def body(_, carry):
+                # requantize the running product so the chain stays int8 and
+                # data-dependent (XLA cannot hoist the GEMM out of the loop)
+                qc, sc = carry
+                y = int8_matmul(qa, sa, qc, sc, out_dtype=jnp.float32)
+                return quantize_int8(y, axis=0)
+
+            q, s = jax.lax.fori_loop(0, reps, body, (qb, sb))
+            return s
+
+        def run():
+            return _sync(chain(qa, sa, qb, sb))
+
+        return run, reps * 2.0 * n * n * n
+
     workloads = [
         ("matmul", make_matmul),
         ("matmul_f32", make_matmul_f32),
@@ -260,6 +293,7 @@ def bench_heat_tpu(errors, profile_dir=None, small=False):
         ("moments", make_moments),
         ("lasso", make_lasso),
         ("attention", make_attention),
+        ("matmul_int8", make_matmul_int8),
     ]
 
     results = {}
@@ -400,7 +434,7 @@ def main():
     f32 = {
         k: v
         for k, v in ours.items()
-        if k not in ("matmul_bf16", "matmul_f32", "attention")
+        if k not in ("matmul_bf16", "matmul_f32", "attention", "matmul_int8")
     }
     geo_ours = float(np.exp(np.mean([np.log(v) for v in f32.values()]))) if f32 else 0.0
     # vs_baseline compares geomeans over the SAME workload subset, so a
@@ -432,8 +466,17 @@ def main():
         # true-f32 runs 6 MXU passes per product; its natural peak is ~1/3
         # of the bf16 peak — reported against bf16 peak for a single scale
         detail["matmul_truef32_vs_bf16_peak"] = round(ours["matmul_f32"] / peak, 3)
-    if peak and "attention" in ours:
-        detail["attention_mfu"] = round(ours["attention"] / peak, 3)
+    # attention and int8 run unsharded on device 0 (plain jax arrays),
+    # unlike the split=0 rows — their MFU denominators are one chip's peak
+    peak_single = peak / max(n_devices, 1) if peak else None
+    if peak_single and "attention" in ours:
+        detail["attention_mfu"] = round(ours["attention"] / peak_single, 3)
+    if peak_single and "matmul_int8" in ours:
+        # int8 MXU peak is ~2x bf16; >1.0 here means "faster than one
+        # chip's best bf16 GEMM could ever be"
+        detail["matmul_int8_vs_bf16_peak"] = round(
+            ours["matmul_int8"] / peak_single, 3
+        )
     if errors:
         detail["errors"] = errors
     print(json.dumps(detail), file=sys.stderr, flush=True)

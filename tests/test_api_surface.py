@@ -1,0 +1,178 @@
+"""Coverage for public API names no other test exercises directly —
+aliases, constants, sanitation helpers, estimator introspection, device
+plumbing. Oracle: numpy (SURVEY §4) or the aliased canonical function.
+"""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+
+class TestConstantsAndAliases:
+    def test_inf_aliases(self):
+        assert ht.Inf == ht.Infinity == ht.Infty == float("inf")
+
+    def test_euler(self):
+        assert abs(ht.Euler - np.e) < 1e-12
+
+    def test_trig_aliases(self):
+        x = ht.array([0.1, 0.5, -0.3])
+        for alias, canon in [
+            (ht.acos, ht.arccos), (ht.asin, ht.arcsin), (ht.atan, ht.arctan),
+            (ht.asinh, ht.arcsinh), (ht.atanh, ht.arctanh),
+        ]:
+            np.testing.assert_allclose(
+                alias(x).numpy(), canon(x).numpy(), rtol=1e-6
+            )
+        xe = ht.array([1.5, 2.0])
+        np.testing.assert_allclose(
+            ht.acosh(xe).numpy(), ht.arccosh(xe).numpy(), rtol=1e-6
+        )
+
+    def test_atan2_alias_and_values(self):
+        y = ht.array([1.0, -1.0, 0.5])
+        x = ht.array([1.0, 2.0, -0.5])
+        np.testing.assert_allclose(
+            ht.atan2(y, x).numpy(), np.arctan2(y.numpy(), x.numpy()), rtol=1e-6
+        )
+        np.testing.assert_allclose(
+            ht.arctan2(y, x).numpy(), ht.atan2(y, x).numpy(), rtol=1e-6
+        )
+
+    def test_degrees_radians(self):
+        x = ht.array([0.0, np.pi / 2, np.pi])
+        np.testing.assert_allclose(ht.degrees(x).numpy(), [0, 90, 180], atol=1e-5)
+        d = ht.array([0.0, 90.0, 180.0])
+        np.testing.assert_allclose(
+            ht.radians(d).numpy(), [0, np.pi / 2, np.pi], atol=1e-6
+        )
+
+    def test_logaddexp(self):
+        a = ht.array([1.0, 100.0, -5.0])
+        b = ht.array([2.0, 100.0, -4.0])
+        np.testing.assert_allclose(
+            ht.logaddexp(a, b).numpy(), np.logaddexp(a.numpy(), b.numpy()),
+            rtol=1e-6,
+        )
+        np.testing.assert_allclose(
+            ht.logaddexp2(a, b).numpy(), np.logaddexp2(a.numpy(), b.numpy()),
+            rtol=1e-6,
+        )
+
+    def test_cumproduct_alias(self):
+        x = ht.array([1.0, 2.0, 3.0], split=0)
+        np.testing.assert_allclose(
+            ht.cumproduct(x, 0).numpy(), ht.cumprod(x, 0).numpy()
+        )
+
+    def test_bitwise_not_alias(self):
+        x = ht.array([0, 1, 5], dtype=ht.int32)
+        np.testing.assert_array_equal(
+            ht.bitwise_not(x).numpy(), ht.invert(x).numpy()
+        )
+
+    def test_conjugate_iscomplex_isreal(self):
+        z = ht.array([1 + 2j, 3 - 4j])
+        np.testing.assert_allclose(
+            ht.conjugate(z).numpy(), np.conj(z.numpy())
+        )
+        assert bool(ht.iscomplex(z).numpy().all())
+        r = ht.array([1.0, 2.0])
+        assert bool(ht.isreal(r).numpy().all())
+
+
+class TestTypeSurface:
+    def test_complex_aliases(self):
+        assert ht.cfloat is ht.complex64
+        assert ht.csingle is ht.complex64
+        assert ht.cdouble is ht.complex128
+        assert ht.half is ht.float16
+        assert ht.ubyte is ht.uint8
+
+    def test_uint_types_roundtrip(self):
+        for dt, npdt in [(ht.uint16, np.uint16), (ht.uint32, np.uint32),
+                         (ht.uint64, np.uint64)]:
+            x = ht.array([0, 3, 7], dtype=dt)
+            assert x.numpy().dtype == npdt
+
+    def test_hierarchy_predicates(self):
+        assert issubclass(ht.uint8, ht.unsignedinteger)
+        assert issubclass(ht.int32, ht.signedinteger)
+        assert issubclass(ht.float32, ht.number)
+        assert issubclass(ht.flexible, ht.datatype)
+        assert ht.heat_type_is_exact(ht.int64)
+        assert ht.heat_type_is_inexact(ht.float32)
+        assert ht.heat_type_is_complexfloating(ht.complex64)
+
+    def test_result_type(self):
+        assert ht.result_type(ht.int32, ht.float32) == ht.float64 or \
+            ht.result_type(ht.int32, ht.float32) == ht.float32
+
+
+class TestSanitation:
+    def test_sanitize_axis(self):
+        assert ht.sanitize_axis((4, 5), -1) == 1
+        with pytest.raises(ValueError):
+            ht.sanitize_axis((4, 5), 3)
+
+    def test_sanitize_shape(self):
+        assert ht.sanitize_shape(5) == (5,)
+        assert ht.sanitize_shape((2, 3)) == (2, 3)
+
+    def test_broadcast_shape(self):
+        assert ht.broadcast_shape((4, 1), (1, 5)) == (4, 5)
+        with pytest.raises(ValueError):
+            ht.broadcast_shape((3,), (4,))
+
+    def test_sanitize_infinity(self):
+        x = ht.array([1, 2], dtype=ht.int32)
+        assert ht.sanitize_infinity(x) == np.iinfo(np.int32).max
+
+
+class TestEstimatorIntrospection:
+    def test_mixin_predicates(self):
+        km = ht.cluster.KMeans(n_clusters=2)
+        assert ht.is_estimator(km)
+        assert ht.is_classifier(ht.naive_bayes.GaussianNB())
+        assert ht.is_regressor(ht.regression.Lasso())
+        assert not ht.is_classifier(km)
+
+    def test_get_set_params_roundtrip(self):
+        km = ht.cluster.KMeans(n_clusters=3)
+        params = km.get_params()
+        assert params["n_clusters"] == 3
+        km.set_params(n_clusters=5)
+        assert km.get_params()["n_clusters"] == 5
+
+
+class TestDevicePlumbing:
+    def test_device_singletons(self):
+        assert isinstance(ht.cpu, ht.Device)
+        d = ht.get_device()
+        assert isinstance(d, ht.Device)
+
+    def test_use_device_roundtrip(self):
+        prev = ht.get_device()
+        ht.use_device(ht.cpu)
+        assert ht.get_device() is ht.cpu
+        ht.use_device(prev)
+
+    def test_sanitize_device(self):
+        assert ht.sanitize_device(None) is ht.get_device()
+        assert ht.sanitize_device(ht.cpu) is ht.cpu
+
+
+class TestLinalgExtras:
+    def test_vecdot(self):
+        a = ht.array([1.0, 2.0, 3.0], split=0)
+        b = ht.array([4.0, 5.0, 6.0], split=0)
+        np.testing.assert_allclose(float(ht.vecdot(a, b).numpy()), 32.0)
+
+    def test_projection(self):
+        a = ht.array([1.0, 0.0])
+        b = ht.array([2.0, 0.0])
+        np.testing.assert_allclose(ht.linalg.projection(a, b).numpy(), [1.0, 0.0])
+
+    def test_supports_netcdf_flag(self):
+        assert isinstance(ht.supports_netcdf(), bool)
