@@ -430,7 +430,7 @@ def main():
     base = bench_torch_cpu(errors)
 
     # headline geomean keeps the r02 workload set for comparability
-    # (matmul_f32/matmul_bf16/attention are labeled detail rows)
+    # (matmul_f32/matmul_bf16/attention/matmul_int8 are labeled detail rows)
     f32 = {
         k: v
         for k, v in ours.items()

@@ -11,6 +11,7 @@ from . import functional
 from .data_parallel import DataParallel, DataParallelMultiGPU
 from .transformer import MultiHeadAttention, TransformerBlock, TransformerLM
 from .moe import MoEMLP
+from .quant_dense import QuantDense
 
 __all__ = [
     "DataParallel",
@@ -18,6 +19,7 @@ __all__ = [
     "functional",
     "MoEMLP",
     "MultiHeadAttention",
+    "QuantDense",
     "TransformerBlock",
     "TransformerLM",
 ]

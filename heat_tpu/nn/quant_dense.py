@@ -1,0 +1,50 @@
+"""Int8 inference modules — W8A8 Dense over the Pallas quantized GEMM.
+
+The reference has no quantization support; on TPU the int8 MXU path runs
+~2× the bf16 rate (v5e: 394 vs 197 TOPS peak), so this is a pure
+capability extension on the framework's inference hot path. Weights
+quantize per-output-channel at call time (cheap, cacheable by jit);
+activations quantize per-row. The matmul itself is
+:func:`heat_tpu.core.linalg.int8_matmul` — int8 tiles, int32 VMEM
+accumulation, fused f32 rescale.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class QuantDense(nn.Module):
+    """Drop-in W8A8 variant of ``nn.Dense`` (no bias by default, matching
+    the transformer blocks). Params stay float (training runs full
+    precision elsewhere); quantization happens in the forward, so a
+    trained float checkpoint loads directly.
+    """
+
+    features: int
+    use_bias: bool = False
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        from ..core.linalg import int8_matmul, quantize_int8
+
+        d_in = x.shape[-1]
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(), (d_in, self.features),
+            jnp.float32,
+        )
+        lead = x.shape[:-1]
+        xf = x.reshape(-1, d_in).astype(jnp.float32)
+        qx, sx = quantize_int8(xf, axis=1)
+        qw, sw = quantize_int8(kernel, axis=0)
+        y = int8_matmul(qx, sx, qw, sw, out_dtype=jnp.float32)
+        if self.use_bias:
+            bias = self.param(
+                "bias", nn.initializers.zeros, (self.features,), jnp.float32
+            )
+            y = y + bias
+        return y.reshape(*lead, self.features).astype(self.dtype)
