@@ -91,6 +91,11 @@ def int8_matmul(
     k2, n = qb.shape
     if k != k2:
         raise ValueError(f"contraction mismatch: {qa.shape} @ {qb.shape}")
+    if m == 0 or n == 0 or k == 0:
+        # empty operand: same contract as jnp.matmul (zeros output; a zero
+        # contraction dim contributes nothing) — the tiling below assumes
+        # at least one tile
+        return jnp.zeros((m, n), out_dtype)
     # int8 MXU tiles want (32, 128) minimums; clamp blocks to padded dims
     block_m = min(block_m, -(-m // 32) * 32)
     block_n = min(block_n, -(-n // 128) * 128)

@@ -22,6 +22,15 @@ class QuantDense(nn.Module):
     the transformer blocks). Params stay float (training runs full
     precision elsewhere); quantization happens in the forward, so a
     trained float checkpoint loads directly.
+
+    Note the cost of that convenience: the kernel re-quantizes on every
+    call (under jit the kernel is a traced argument, so the absmax/round
+    pass is part of the compiled step — it is NOT folded away). For a
+    serving path where the weights are frozen, pre-quantize once and call
+    the GEMM directly::
+
+        qw, sw = quantize_int8(params[...]["kernel"], axis=0)
+        y = int8_matmul(qx, sx, qw, sw)
     """
 
     features: int

@@ -53,3 +53,10 @@ class TestExamples:
     def test_kclustering_demo(self):
         r = _run("examples/cluster/demo_kclustering.py")
         assert r.returncode == 0, r.stderr[-1500:]
+
+    def test_lm_training(self):
+        # flagship LM converging on the 3-gram task (asserts internally
+        # that held-out perplexity at least halves from the uniform start)
+        r = _run("examples/nn/lm_training.py", timeout=560)
+        assert r.returncode == 0, r.stderr[-1500:]
+        assert "converged: perplexity" in r.stdout

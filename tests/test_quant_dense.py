@@ -50,3 +50,11 @@ class TestQuantDense:
         params = qd.init(jax.random.PRNGKey(3), x)
         out = jax.jit(qd.apply)(params, x)
         assert np.isfinite(np.asarray(out)).all()
+
+    def test_empty_batch(self):
+        # drop-in contract: nn.Dense returns (0, F) for an empty batch
+        x = jnp.ones((0, 8), jnp.float32)
+        qd = QuantDense(features=4)
+        params = qd.init(jax.random.PRNGKey(4), jnp.ones((1, 8), jnp.float32))
+        out = qd.apply(params, x)
+        assert out.shape == (0, 4)
