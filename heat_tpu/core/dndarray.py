@@ -131,6 +131,7 @@ class DNDarray:
                 f"{tuple(self.__array.shape)}"
             )
         self.__array = array
+        self._invalidate_halo()
 
     @property
     def lloc(self) -> LocalIndex:
@@ -349,6 +350,7 @@ class DNDarray:
             )
         self.__array = casted
         self.__dtype = dtype
+        self._invalidate_halo()
         return self
 
     def cpu(self) -> "DNDarray":
@@ -397,6 +399,7 @@ class DNDarray:
             self._logical(), axis, self.__device, self.__comm, self.__dtype
         )
         self.__array = new.larray
+        self._invalidate_halo()
         self.__split = axis
         self.__lshape_map = None
         return self
@@ -434,28 +437,31 @@ class DNDarray:
         log = self._logical().at[idx, idx].set(jnp.asarray(value, self.__array.dtype))
         new = DNDarray.from_logical(log, self.__split, self.__device, self.__comm, self.__dtype)
         self.__array = new.larray
+        self._invalidate_halo()
         return self
 
     # ---------------------------------------------------------------- halos
 
-    def get_halo(self, halo_size: int) -> None:
-        """Fetch boundary slices of neighboring shards (reference
-        dndarray.py:360: Isend/Irecv with prev/next rank). Stores the result
-        for :meth:`array_with_halos`."""
-        self.__halo = self.array_with_halos(halo_size)
-
-    def array_with_halos(self, halo_size: int) -> jax.Array:
-        """Physical buffer where every shard is extended with ``halo_size``
-        rows of both neighbors along the split axis (zero-filled at the global
-        edges; the reference leaves edge ranks one-sided, dndarray.py:333).
-        Implemented as a `shard_map` + two `ppermute` shifts over ICI."""
-        if self.__split is None or self.__comm.size == 1:
-            return self.__array
-        if halo_size <= 0:
-            raise ValueError(f"halo_size needs to be a positive integer, got {halo_size}")
+    def __halo_exchange(self, halo_size: int):
+        """The one halo kernel: ``(from_prev, from_next)`` neighbor slices,
+        sharded like the array. Pads are masked to zero BEFORE slicing so a
+        non-divisible split dim can never leak unspecified pad values into a
+        neighbor's halo (the module's pad invariant); positions with no
+        neighbor get zero blocks, consistent with the zero-filled edges."""
+        if not isinstance(halo_size, builtins.int) or halo_size <= 0:
+            raise ValueError(
+                f"halo_size needs to be a positive integer, got {halo_size}"
+            )
         comm = self.__comm
         s = self.__split
         n = comm.size
+        min_chunk = int(self.lshape_map[:, s].min())
+        if halo_size > min_chunk:
+            raise ValueError(
+                f"halo_size {halo_size} exceeds the smallest local chunk "
+                f"({min_chunk}) along split {s}"
+            )
+        buf = self._masked(0) if self.pad_count else self.__array
 
         def kernel(x):
             lo = jax.lax.slice_in_dim(x, 0, halo_size, axis=s)
@@ -466,12 +472,77 @@ class DNDarray:
             from_next = jax.lax.ppermute(
                 lo, comm.axis_name, perm=[(i + 1, i) for i in range(n - 1)]
             )
-            return jnp.concatenate([from_prev, x, from_next], axis=s)
+            return from_prev, from_next
 
         spec = comm.spec(s, self.ndim)
-        return jax.shard_map(kernel, mesh=comm.mesh, in_specs=spec, out_specs=spec)(
-            self.__array
-        )
+        return jax.shard_map(
+            kernel, mesh=comm.mesh, in_specs=spec, out_specs=(spec, spec)
+        )(buf)
+
+    def get_halo(self, halo_size: int) -> None:
+        """Fetch boundary slices of neighboring shards (reference
+        dndarray.py:360: Isend/Irecv with prev/next rank). Stores the
+        neighbor slices for :attr:`halo_prev` / :attr:`halo_next` — computed
+        once here, so the property reads are cached-array lookups."""
+        if self.__split is None or self.__comm.size == 1:
+            self.__halo_prev = self.__halo_next = None
+            return
+        self.__halo_prev, self.__halo_next = self.__halo_exchange(halo_size)
+
+    def _invalidate_halo(self) -> None:
+        """Drop cached halos — called by every storage mutator so a stale
+        fetch can never be served after resplit_/setitem/fill_diagonal."""
+        self.__halo_prev = self.__halo_next = None
+
+    @property
+    def halo_prev(self) -> Optional[jax.Array]:
+        """Slice received from the previous mesh position by the last
+        :meth:`get_halo` (reference dndarray.py ``halo_prev``), as a sharded
+        ``(…, halo_size, …)`` buffer — one block per position, zero at the
+        global edge. ``None`` before any halo fetch (or after a mutation
+        invalidated it)."""
+        return getattr(self, "_DNDarray__halo_prev", None)
+
+    @property
+    def halo_next(self) -> Optional[jax.Array]:
+        """Slice received from the next mesh position — see :attr:`halo_prev`."""
+        return getattr(self, "_DNDarray__halo_next", None)
+
+    def stride(self) -> Tuple[int, ...]:
+        """Element strides of the local shard, C-order (reference delegates
+        to ``torch.Tensor.stride``)."""
+        return self.strides
+
+    @property
+    def strides(self) -> Tuple[int, ...]:
+        """Element strides of the local shard, C-order."""
+        lshape = self.lshape
+        strides = []
+        acc = 1
+        for dim in reversed(lshape):
+            strides.append(acc)
+            acc *= max(dim, 1)
+        return tuple(reversed(strides))
+
+    def array_with_halos(self, halo_size: int) -> jax.Array:
+        """Physical buffer where every shard is extended with ``halo_size``
+        rows of both neighbors along the split axis (zero-filled at the
+        global edges and in masked pad positions; the reference leaves edge
+        ranks one-sided, dndarray.py:333). Built on the same exchange kernel
+        as :meth:`get_halo`."""
+        if self.__split is None or self.__comm.size == 1:
+            return self.__array
+        comm = self.__comm
+        s = self.__split
+        from_prev, from_next = self.__halo_exchange(halo_size)
+
+        def concat(hp, x, hn):
+            return jnp.concatenate([hp, x, hn], axis=s)
+
+        spec = comm.spec(s, self.ndim)
+        return jax.shard_map(
+            concat, mesh=comm.mesh, in_specs=(spec, spec, spec), out_specs=spec
+        )(from_prev, self.__array, from_next)
 
     # ------------------------------------------------------------- printing
 
@@ -509,6 +580,7 @@ class DNDarray:
         self.__gshape = tuple(gshape)
         self.__split = split
         self.__lshape_map = None
+        self._invalidate_halo()
 
     # (arithmetic/relational/etc. dunders are attached by the op modules at
     # import time — same pattern as the reference, which assigns them at the
