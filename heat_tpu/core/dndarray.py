@@ -442,57 +442,52 @@ class DNDarray:
 
     # ---------------------------------------------------------------- halos
 
-    def __halo_exchange(self, halo_size: int):
-        """The one halo kernel: ``(from_prev, from_next)`` neighbor slices,
-        sharded like the array. Pads are masked to zero BEFORE slicing so a
-        non-divisible split dim can never leak unspecified pad values into a
-        neighbor's halo (the module's pad invariant); positions with no
-        neighbor get zero blocks, consistent with the zero-filled edges."""
+    def __check_halo_size(self, halo_size: int) -> None:
+        """Uniform validation regardless of device count, so code tested on
+        one device fails the same way on a pod."""
         if not isinstance(halo_size, builtins.int) or halo_size <= 0:
             raise ValueError(
                 f"halo_size needs to be a positive integer, got {halo_size}"
             )
-        comm = self.__comm
-        s = self.__split
-        n = comm.size
-        min_chunk = int(self.lshape_map[:, s].min())
-        if halo_size > min_chunk:
-            raise ValueError(
-                f"halo_size {halo_size} exceeds the smallest local chunk "
-                f"({min_chunk}) along split {s}"
-            )
+        if self.__split is not None and self.__comm.size > 1:
+            min_chunk = int(self.lshape_map[:, self.__split].min())
+            if halo_size > min_chunk:
+                raise ValueError(
+                    f"halo_size {halo_size} exceeds the smallest local chunk "
+                    f"({min_chunk}) along split {self.__split}"
+                )
+
+    def __halo_parts(self, halo_size: int):
+        """``(from_prev, from_next)`` neighbor slices via the shared ring
+        kernel (:func:`heat_tpu.parallel.halo.halo_exchange`). Pads are
+        masked to zero BEFORE slicing so a non-divisible split dim can never
+        leak unspecified pad values into a neighbor's halo (the module's pad
+        invariant); edge positions get zero blocks."""
+        from ..parallel.halo import halo_exchange
+
         buf = self._masked(0) if self.pad_count else self.__array
-
-        def kernel(x):
-            lo = jax.lax.slice_in_dim(x, 0, halo_size, axis=s)
-            hi = jax.lax.slice_in_dim(x, x.shape[s] - halo_size, x.shape[s], axis=s)
-            from_prev = jax.lax.ppermute(
-                hi, comm.axis_name, perm=[(i, i + 1) for i in range(n - 1)]
-            )
-            from_next = jax.lax.ppermute(
-                lo, comm.axis_name, perm=[(i + 1, i) for i in range(n - 1)]
-            )
-            return from_prev, from_next
-
-        spec = comm.spec(s, self.ndim)
-        return jax.shard_map(
-            kernel, mesh=comm.mesh, in_specs=spec, out_specs=(spec, spec)
-        )(buf)
+        return halo_exchange(
+            buf, halo_size, comm=self.__comm, axis=self.__split,
+            return_parts=True,
+        )
 
     def get_halo(self, halo_size: int) -> None:
         """Fetch boundary slices of neighboring shards (reference
         dndarray.py:360: Isend/Irecv with prev/next rank). Stores the
         neighbor slices for :attr:`halo_prev` / :attr:`halo_next` — computed
         once here, so the property reads are cached-array lookups."""
+        self.__check_halo_size(halo_size)
         if self.__split is None or self.__comm.size == 1:
             self.__halo_prev = self.__halo_next = None
             return
-        self.__halo_prev, self.__halo_next = self.__halo_exchange(halo_size)
+        self.__halo_prev, self.__halo_next = self.__halo_parts(halo_size)
+        self.__halo_fetched_size = halo_size
 
     def _invalidate_halo(self) -> None:
         """Drop cached halos — called by every storage mutator so a stale
         fetch can never be served after resplit_/setitem/fill_diagonal."""
         self.__halo_prev = self.__halo_next = None
+        self.__halo_fetched_size = None
 
     @property
     def halo_prev(self) -> Optional[jax.Array]:
@@ -529,20 +524,27 @@ class DNDarray:
         rows of both neighbors along the split axis (zero-filled at the
         global edges and in masked pad positions; the reference leaves edge
         ranks one-sided, dndarray.py:333). Built on the same exchange kernel
-        as :meth:`get_halo`."""
+        as :meth:`get_halo`; halos cached by a matching ``get_halo`` are
+        reused instead of re-running the exchange."""
+        self.__check_halo_size(halo_size)
         if self.__split is None or self.__comm.size == 1:
             return self.__array
         comm = self.__comm
         s = self.__split
-        from_prev, from_next = self.__halo_exchange(halo_size)
+        cached = (
+            getattr(self, "_DNDarray__halo_prev", None) is not None
+            and getattr(self, "_DNDarray__halo_fetched_size", None) == halo_size
+        )
+        if cached:
+            spec = comm.spec(s, self.ndim)
+            return jax.shard_map(
+                lambda hp, x, hn: jnp.concatenate([hp, x, hn], axis=s),
+                mesh=comm.mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            )(self.__halo_prev, self.__array, self.__halo_next)
+        from ..parallel.halo import halo_exchange
 
-        def concat(hp, x, hn):
-            return jnp.concatenate([hp, x, hn], axis=s)
-
-        spec = comm.spec(s, self.ndim)
-        return jax.shard_map(
-            concat, mesh=comm.mesh, in_specs=(spec, spec, spec), out_specs=spec
-        )(from_prev, self.__array, from_next)
+        buf = self._masked(0) if self.pad_count else self.__array
+        return halo_exchange(buf, halo_size, comm=comm, axis=s)
 
     # ------------------------------------------------------------- printing
 

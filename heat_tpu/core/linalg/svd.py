@@ -50,6 +50,30 @@ def svd(a: DNDarray, full_matrices: bool = False, compute_uv: bool = True):
         v_ht = DNDarray.from_logical(vt_log.T.astype(dt.jnp_type()), None, a.device, a.comm, dt)
         return SVD(u, s_ht, v_ht)
 
+    if compute_uv and a.split == 1 and a.comm.size > 1 and n > m and not full_matrices:
+        # wide column-split: A^T is tall row-split — run the TSQR path there
+        # and swap the factors (A = U S V^T  <=>  A^T = V S U^T)
+        from .basics import transpose
+
+        res = svd(transpose(a), full_matrices=False, compute_uv=True)
+        return SVD(res.V, res.S, res.U)
+
+    if not compute_uv and a.comm.size > 1 and (
+        (a.split == 0 and m >= n) or (a.split == 1 and n > m)
+    ):
+        # singular values only: they equal R's from the TSQR — no Q needed.
+        # Wide column-split transposes into the tall row-split form first
+        # (singular values are transpose-invariant).
+        if a.split == 1:
+            from .basics import transpose
+
+            a = transpose(a)
+        _, r = _qr(a, calc_q=False)
+        s_log = jnp.linalg.svd(
+            r._logical().astype(dt.jnp_type()), compute_uv=False
+        )
+        return DNDarray.from_logical(s_log, None, a.device, a.comm, dt)
+
     log = a._logical().astype(dt.jnp_type())
     if not compute_uv:
         s_log = jnp.linalg.svd(log, compute_uv=False)

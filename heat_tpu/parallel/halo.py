@@ -22,6 +22,7 @@ def halo_exchange(
     comm,
     axis: int = 0,
     wrap: bool = False,
+    return_parts: bool = False,
 ) -> jax.Array:
     """Return per-shard blocks extended with neighbor halos along ``axis``.
 
@@ -29,7 +30,9 @@ def halo_exchange(
     sharded the same way with each local block grown by up to ``2*halo_size``
     rows: ``halo_size`` from the previous shard prepended and ``halo_size``
     from the next appended. Terminal shards get zero-filled halos unless
-    ``wrap=True`` (periodic boundary).
+    ``wrap=True`` (periodic boundary). ``return_parts=True`` skips the
+    concatenation and returns ``(from_prev, from_next)`` — the form
+    :meth:`DNDarray.get_halo` caches.
     """
     p = comm.size
     name = comm.axis_name
@@ -51,9 +54,12 @@ def halo_exchange(
             zero = jnp.zeros_like(from_prev)
             from_prev = jnp.where(rank == 0, zero, from_prev)
             from_next = jnp.where(rank == p - 1, zero, from_next)
+        if return_parts:
+            return from_prev, from_next
         return jnp.concatenate([from_prev, xb, from_next], axis=axis)
 
     spec = comm.spec(axis, x.ndim)
+    out_specs = (spec, spec) if return_parts else spec
     return jax.shard_map(
-        kernel, mesh=comm.mesh, in_specs=(spec,), out_specs=spec
+        kernel, mesh=comm.mesh, in_specs=(spec,), out_specs=out_specs
     )(x)

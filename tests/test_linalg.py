@@ -254,3 +254,39 @@ class TestQRExtended(TestCase):
         q, r = ht.linalg.qr(ht.array(an, split=0), calc_q=False)
         assert q is None
         np.testing.assert_allclose(np.abs(r.numpy()), np.abs(np.linalg.qr(an)[1]), atol=1e-4)
+
+
+class TestSVDExtensions:
+    """Wide split=1 SVD (transpose trick) and values-only TSQR path."""
+
+    def test_wide_split1_reconstructs(self):
+        comm = ht.get_comm()
+        rng = np.random.default_rng(31)
+        an = rng.standard_normal((12, 8 * max(comm.size, 2))).astype(np.float32)
+        a = ht.array(an, split=1)
+        u, s, v = ht.linalg.svd(a)
+        rec = u.numpy() @ np.diag(s.numpy()) @ v.numpy().T
+        np.testing.assert_allclose(rec, an, rtol=1e-3, atol=1e-3)
+        np.testing.assert_allclose(
+            np.sort(s.numpy())[::-1], np.linalg.svd(an, compute_uv=False),
+            rtol=1e-4, atol=1e-4,
+        )
+
+    def test_values_only_tall_split0(self):
+        comm = ht.get_comm()
+        rng = np.random.default_rng(37)
+        an = rng.standard_normal((16 * max(comm.size, 2), 6)).astype(np.float32)
+        a = ht.array(an, split=0)
+        s = ht.linalg.svd(a, compute_uv=False)
+        np.testing.assert_allclose(
+            s.numpy(), np.linalg.svd(an, compute_uv=False), rtol=1e-4, atol=1e-4
+        )
+
+    def test_values_only_wide_split1(self):
+        comm = ht.get_comm()
+        rng = np.random.default_rng(41)
+        an = rng.standard_normal((6, 16 * max(comm.size, 2))).astype(np.float32)
+        s = ht.linalg.svd(ht.array(an, split=1), compute_uv=False)
+        np.testing.assert_allclose(
+            s.numpy(), np.linalg.svd(an, compute_uv=False), rtol=1e-4, atol=1e-4
+        )
