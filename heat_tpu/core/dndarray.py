@@ -535,15 +535,17 @@ class DNDarray:
             getattr(self, "_DNDarray__halo_prev", None) is not None
             and getattr(self, "_DNDarray__halo_fetched_size", None) == halo_size
         )
+        # both paths take the pad-masked center so the result is identical
+        # whether or not a prior get_halo populated the cache
+        buf = self._masked(0) if self.pad_count else self.__array
         if cached:
             spec = comm.spec(s, self.ndim)
             return jax.shard_map(
                 lambda hp, x, hn: jnp.concatenate([hp, x, hn], axis=s),
                 mesh=comm.mesh, in_specs=(spec, spec, spec), out_specs=spec,
-            )(self.__halo_prev, self.__array, self.__halo_next)
+            )(self.__halo_prev, buf, self.__halo_next)
         from ..parallel.halo import halo_exchange
 
-        buf = self._masked(0) if self.pad_count else self.__array
         return halo_exchange(buf, halo_size, comm=comm, axis=s)
 
     # ------------------------------------------------------------- printing

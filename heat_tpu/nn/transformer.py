@@ -137,6 +137,7 @@ class TransformerLM(nn.Module):
     attn_impl: str = "local"
     comm: Optional[Any] = None
     block_size: Optional[int] = None  # None = each impl's tuned default
+    remat: bool = False  # checkpoint each block: O(L) -> O(1) activations
     dtype: Any = jnp.float32
 
     @nn.compact
@@ -152,8 +153,11 @@ class TransformerLM(nn.Module):
             jnp.arange(tokens.shape[-1])
         )
         x = x + pos[None]
+        # rematerialization trades backward-pass FLOPs for activation
+        # memory — the standard long-context recipe (HBM is the bottleneck)
+        block_cls = nn.remat(TransformerBlock) if self.remat else TransformerBlock
         for i in range(self.num_layers):
-            x = TransformerBlock(
+            x = block_cls(
                 self.num_heads, self.mlp_ratio, self.attn_impl, True,
                 self.comm, self.block_size, self.dtype, name=f"block{i}",
             )(x)

@@ -255,10 +255,17 @@ class TestHaloAndStrides:
         comm = ht.get_comm()
         if comm.size == 1:
             return
-        x = ht.array(np.arange(4 * comm.size, dtype=np.float32), split=0)
+        # pad-bearing, pads poisoned: cached and uncached paths must agree
+        # exactly (both mask the center block)
+        n = 4 * comm.size - 1
+        x = ht.array(np.arange(n, dtype=np.float32) + 10, split=0)
+        x.lloc[n:] = -777.0
+        fresh = np.asarray(x.array_with_halos(1))  # uncached
         x.get_halo(1)
-        ext = x.array_with_halos(1)
-        assert ext.shape[0] == (4 + 2) * comm.size
-        # uncached path (different size) must agree with a fresh exchange
+        cached = np.asarray(x.array_with_halos(1))  # cached reuse
+        np.testing.assert_array_equal(cached, fresh)
+        assert -777.0 not in set(cached.tolist())
+        assert cached.shape[0] == (4 + 2) * comm.size
+        # different size bypasses the cache
         ext2 = x.array_with_halos(2)
         assert ext2.shape[0] == (4 + 4) * comm.size

@@ -152,3 +152,35 @@ class TestTransformerLM:
             params, state, l = step(params, state)
             l0 = l0 if l0 is not None else float(l)
         assert float(l) < l0
+
+
+class TestRemat:
+    def test_remat_same_numerics_and_grads(self):
+        import optax
+
+        lm = TransformerLM(vocab_size=17, d_model=16, num_heads=2, num_layers=2,
+                           max_len=32, attn_impl="local", block_size=8)
+        lm_r = TransformerLM(vocab_size=17, d_model=16, num_heads=2, num_layers=2,
+                             max_len=32, attn_impl="local", block_size=8,
+                             remat=True)
+        toks = jnp.arange(32).reshape(2, 16) % 17
+        params = lm.init(jax.random.PRNGKey(11), toks)
+        np.testing.assert_allclose(
+            np.asarray(lm.apply(params, toks)),
+            np.asarray(lm_r.apply(params, toks)), rtol=1e-6, atol=1e-6,
+        )
+
+        def loss(m):
+            def f(p):
+                logits = m.apply(p, toks[:, :-1])
+                return optax.softmax_cross_entropy_with_integer_labels(
+                    logits, toks[:, 1:]
+                ).mean()
+            return f
+
+        g = jax.grad(loss(lm))(params)
+        gr = jax.grad(loss(lm_r))(params)
+        for a, b in zip(jax.tree_util.tree_leaves(g),
+                        jax.tree_util.tree_leaves(gr)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-6)
