@@ -19,6 +19,9 @@ builds long-context attention on top of them:
   TPU kernel (VMEM-resident online softmax, MXU-blocked QKᵀ/PV).
 * :func:`pipeline_apply` — GPipe pipeline parallelism: one stage per mesh
   position, microbatch activations hopping the ring via `ppermute`.
+* :func:`shard_pytree` / :func:`constrain_pytree` — FSDP/ZeRO-style
+  parameter and optimizer-state sharding (largest divisible axis per
+  leaf; XLA inserts the use-site all-gathers).
 """
 
 from .ring import ring_pipeline
@@ -26,6 +29,7 @@ from .attention import local_attention, ring_attention, ulysses_attention
 from .halo import halo_exchange
 from .pallas_attention import flash_attention
 from .pipeline import pipeline_apply, stack_stage_params
+from .fsdp import constrain_pytree, replicate_pytree, shard_pytree
 
 __all__ = [
     "ring_pipeline",
@@ -36,4 +40,7 @@ __all__ = [
     "flash_attention",
     "pipeline_apply",
     "stack_stage_params",
+    "shard_pytree",
+    "constrain_pytree",
+    "replicate_pytree",
 ]

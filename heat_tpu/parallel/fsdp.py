@@ -1,0 +1,72 @@
+"""Parameter/optimizer-state sharding over the mesh (FSDP/ZeRO building
+blocks).
+
+The reference replicates model state on every rank (its DP keeps full
+parameter copies; SURVEY §2.5). On TPU, HBM is the bottleneck — sharding
+each large leaf over the mesh and letting XLA insert the all-gathers at
+use sites is the standard recipe (fully-sharded data parallelism). These
+helpers are deliberately thin: placement is just a `NamedSharding` per
+leaf, and XLA does the rest.
+
+* :func:`shard_pytree` — `device_put` each leaf with its largest
+  mesh-divisible axis sharded (small or indivisible leaves replicate).
+  Use on params and optimizer state once, outside jit.
+* :func:`constrain_pytree` — the in-jit form (`with_sharding_constraint`)
+  for pinning intermediate state to the same layout.
+* :func:`replicate_pytree` — the inverse, for host export/checkpoint
+  interchange.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+__all__ = ["shard_pytree", "constrain_pytree", "replicate_pytree"]
+
+
+def _leaf_sharding(leaf, comm, min_size):
+    """Sharding for one leaf: biggest axis divisible by the mesh size, or
+    replicated when the leaf is small/indivisible/scalar. Non-array leaves
+    (Python scalars in a train state — step counters etc.) replicate."""
+    p = comm.size
+    ndim = getattr(leaf, "ndim", 0)
+    size = getattr(leaf, "size", 1)
+    if ndim == 0 or size < min_size:
+        return comm.sharding(None, ndim)
+    axes = sorted(range(ndim), key=lambda a: -leaf.shape[a])
+    for ax in axes:
+        if leaf.shape[ax] % p == 0 and leaf.shape[ax] >= p:
+            return comm.sharding(ax, ndim)
+    return comm.sharding(None, ndim)
+
+
+def shard_pytree(tree: Any, comm, *, min_size: int = 1024) -> Any:
+    """Place every leaf on the mesh with its largest divisible axis sharded.
+
+    Leaves smaller than ``min_size`` elements (or with no axis divisible by
+    the mesh size) replicate — sharding tiny tensors costs more in
+    collectives than it saves in HBM.
+    """
+    return jax.tree_util.tree_map(
+        lambda l: jax.device_put(l, _leaf_sharding(l, comm, min_size)), tree
+    )
+
+
+def constrain_pytree(tree: Any, comm, *, min_size: int = 1024) -> Any:
+    """`with_sharding_constraint` per leaf with the same placement rule —
+    use inside a jitted step to keep updated params/opt-state sharded."""
+    return jax.tree_util.tree_map(
+        lambda l: jax.lax.with_sharding_constraint(
+            l, _leaf_sharding(l, comm, min_size)
+        ),
+        tree,
+    )
+
+
+def replicate_pytree(tree: Any, comm) -> Any:
+    """`device_put` every leaf replicated (checkpoint/export layout)."""
+    return jax.tree_util.tree_map(
+        lambda l: jax.device_put(l, comm.replicated()), tree
+    )

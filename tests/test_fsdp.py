@@ -1,0 +1,100 @@
+"""Tests for FSDP-style pytree sharding helpers."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import heat_tpu as ht
+from heat_tpu.parallel import constrain_pytree, replicate_pytree, shard_pytree
+
+
+@pytest.fixture(scope="module")
+def comm():
+    return ht.get_comm()
+
+
+class TestShardPytree:
+    def test_large_leaves_shard_small_replicate(self, comm):
+        p = comm.size
+        tree = {
+            "w": jnp.ones((8 * p, 64)),           # large, divisible -> shard
+            "b": jnp.ones((7,)),                   # small -> replicate
+            # large enough to pass the size gate but no axis divisible by
+            # p>1 (61 is prime, p+1 = 1 mod p) -> the indivisible fallback
+            "odd": jnp.ones((p + 1 if p > 1 else 3, 61)),
+            "scalar": jnp.float32(1.0),
+            "pystep": 3,                           # non-array leaf
+        }
+        sharded = shard_pytree(tree, comm, min_size=32)
+        assert int(np.asarray(sharded["pystep"])) == 3
+        if p > 1:
+            w_devs = {s.device for s in sharded["w"].addressable_shards}
+            assert len(w_devs) == p
+            # exactly one axis sharded: per-shard element count is total/p
+            shard_shape = sharded["w"].addressable_shards[0].data.shape
+            assert np.prod(shard_shape) == tree["w"].size // p
+        for name in ("b", "odd"):
+            sh = sharded[name].addressable_shards
+            assert all(s_.data.shape == tree[name].shape for s_ in sh)
+
+    def test_values_preserved(self, comm):
+        rng = np.random.default_rng(0)
+        tree = {"w": jnp.asarray(rng.standard_normal((4 * comm.size, 8)))}
+        sharded = shard_pytree(tree, comm, min_size=1)
+        np.testing.assert_array_equal(np.asarray(sharded["w"]), np.asarray(tree["w"]))
+
+    def test_replicate_roundtrip(self, comm):
+        tree = {"w": jnp.ones((4 * comm.size, 16))}
+        sharded = shard_pytree(tree, comm, min_size=1)
+        rep = replicate_pytree(sharded, comm)
+        sh = rep["w"].addressable_shards
+        assert all(s.data.shape == (4 * comm.size, 16) for s in sh)
+
+    def test_sharded_train_step_matches_replicated(self, comm):
+        # ZeRO-ish: params+opt state sharded; jitted step with constraint
+        # must produce the same numbers as the replicated baseline
+        p = comm.size
+        rng = np.random.default_rng(1)
+        w0 = jnp.asarray(rng.standard_normal((8 * p, 4)), jnp.float32)
+        x = jnp.asarray(rng.standard_normal((16, 8 * p)), jnp.float32)
+        y = jnp.asarray(rng.standard_normal((16, 4)), jnp.float32)
+        opt = optax.adam(1e-2)
+
+        def loss(params):
+            return ((x @ params["w"] - y) ** 2).mean()
+
+        def make_step(constrain):
+            @jax.jit
+            def step(params, state):
+                l, g = jax.value_and_grad(loss)(params)
+                u, state = opt.update(g, state)
+                params = optax.apply_updates(params, u)
+                if constrain:
+                    params = constrain_pytree(params, comm, min_size=1)
+                return params, state, l
+            return step
+
+        params_r = {"w": w0}
+        state_r = opt.init(params_r)
+        params_s = shard_pytree({"w": w0}, comm, min_size=1)
+        state_s = shard_pytree(opt.init(params_s), comm, min_size=1)
+
+        step_r, step_s = make_step(False), make_step(True)
+        for _ in range(3):
+            params_r, state_r, lr_ = step_r(params_r, state_r)
+            params_s, state_s, ls_ = step_s(params_s, state_s)
+        # ZeRO claim: the Adam moments must come out of the jitted step
+        # sharded too, not silently replicated (the HBM blow-up FSDP
+        # exists to prevent)
+        if p > 1:
+            mu = state_s[0].mu["w"]
+            mu_devs = {sh.device for sh in mu.addressable_shards}
+            assert len(mu_devs) == p, "optimizer state fell back to replicated"
+        np.testing.assert_allclose(np.asarray(params_r["w"]),
+                                   np.asarray(params_s["w"]), rtol=1e-5, atol=1e-6)
+        assert abs(float(lr_) - float(ls_)) < 1e-5
+        if p > 1:
+            devs = {s.device for s in params_s["w"].addressable_shards}
+            assert len(devs) == p  # stayed sharded through the step
