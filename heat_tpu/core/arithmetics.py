@@ -25,6 +25,7 @@ __all__ = [
     "bitwise_xor",
     "cumprod",
     "cumproduct",
+    "copysign",
     "cumsum",
     "diff",
     "div",
@@ -32,6 +33,7 @@ __all__ = [
     "floordiv",
     "floor_divide",
     "fmod",
+    "hypot",
     "invert",
     "left_shift",
     "mod",
@@ -245,3 +247,15 @@ DNDarray.sum = lambda self, axis=None, out=None, keepdims=False: sum(self, axis,
 DNDarray.prod = lambda self, axis=None, out=None, keepdims=False: prod(self, axis, out, keepdims)
 DNDarray.cumsum = lambda self, axis, dtype=None, out=None: cumsum(self, axis, dtype, out)
 DNDarray.cumprod = lambda self, axis, dtype=None, out=None: cumprod(self, axis, dtype, out)
+
+
+def copysign(a, b, out=None) -> DNDarray:
+    """Magnitude of ``a`` with the sign of ``b`` (extension: numpy surface
+    the reference lacks)."""
+    return binary_op(jnp.copysign, a, b, out)
+
+
+def hypot(a, b, out=None) -> DNDarray:
+    """Elementwise ``sqrt(a**2 + b**2)`` (extension: numpy surface the
+    reference lacks)."""
+    return binary_op(jnp.hypot, a, b, out)

@@ -10,7 +10,7 @@ from . import types
 from ._operations import binary_op, local_op
 from .dndarray import DNDarray
 
-__all__ = ["abs", "absolute", "ceil", "clip", "fabs", "floor", "modf", "round", "trunc"]
+__all__ = ["abs", "absolute", "ceil", "clip", "fabs", "floor", "modf", "round", "sign", "trunc"]
 
 
 def abs(x, out=None, dtype=None) -> DNDarray:
@@ -35,6 +35,12 @@ def clip(x: DNDarray, min, max, out=None) -> DNDarray:
     if min is None and max is None:
         raise ValueError("either min or max must be set")
     return local_op(lambda a: jnp.clip(a, min, max), x, out)
+
+
+def sign(x, out=None) -> DNDarray:
+    """Elementwise sign indicator (extension: numpy surface the reference
+    lacks; its closest is logical.signbit)."""
+    return local_op(jnp.sign, x, out)
 
 
 def fabs(x, out=None) -> DNDarray:

@@ -60,3 +60,17 @@ class TestExamples:
         r = _run("examples/nn/lm_training.py", timeout=560)
         assert r.returncode == 0, r.stderr[-1500:]
         assert "converged: perplexity" in r.stdout
+
+    def test_mnist_demo(self):
+        r = _run("examples/nn/mnist.py", timeout=300)
+        assert r.returncode == 0, r.stderr[-1500:]
+        assert "eval accuracy" in r.stdout
+
+    def test_daso_training_demo(self):
+        r = _run("examples/nn/daso_training.py", timeout=300)
+        assert r.returncode == 0, r.stderr[-1500:]
+
+    def test_ring_attention_demo(self):
+        r = _run("examples/long_context/ring_attention_demo.py", timeout=300)
+        assert r.returncode == 0, r.stderr[-1500:]
+        assert "max |diff|" in r.stdout
