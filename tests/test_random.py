@@ -77,3 +77,31 @@ class TestDistributions(TestCase):
         ht.random.seed(9)
         got = ht.random.rand(n, split=0).numpy()
         np.testing.assert_array_equal(got, base)
+
+
+class TestRandomEdges:
+    def test_permutation_is_permutation(self):
+        ht.random.seed(123)
+        p = ht.random.permutation(50)
+        assert sorted(p.numpy().tolist()) == list(range(50))
+
+    def test_randperm_seeded_deterministic(self):
+        ht.random.seed(7)
+        a = ht.random.randperm(32).numpy()
+        ht.random.seed(7)
+        b = ht.random.randperm(32).numpy()
+        np.testing.assert_array_equal(a, b)
+
+    def test_randint_bounds_and_dtype(self):
+        ht.random.seed(11)
+        x = ht.random.randint(5, 15, (200,), split=0)
+        xv = x.numpy()
+        assert xv.min() >= 5 and xv.max() < 15
+        assert np.issubdtype(xv.dtype, np.integer)
+        assert issubclass(x.dtype, ht.integer)
+
+    def test_normal_moments(self):
+        ht.random.seed(13)
+        x = ht.random.normal(2.0, 0.5, (20000,), split=0)
+        assert abs(float(x.mean().numpy()) - 2.0) < 0.02
+        assert abs(float(x.std().numpy()) - 0.5) < 0.02

@@ -160,3 +160,66 @@ class TestHistograms(TestCase):
         x = ht.array(a, split=0)
         got = ht.histc(x, bins=3, min=0.0, max=3.0)
         np.testing.assert_array_equal(got.numpy(), [2, 2, 1])
+
+
+class TestStatisticsEdges:
+    """Edge cases: ddof, keepdims, vector-q percentile, multi-axis."""
+
+    def test_var_std_ddof(self):
+        rng = np.random.default_rng(51)
+        xn = rng.standard_normal((37, 5))
+        x = ht.array(xn, split=0)
+        for ddof in (0, 1):
+            np.testing.assert_allclose(
+                ht.var(x, axis=0, ddof=ddof).numpy(),
+                np.var(xn, axis=0, ddof=ddof), rtol=1e-6,
+            )
+            np.testing.assert_allclose(
+                ht.std(x, axis=0, ddof=ddof).numpy(),
+                np.std(xn, axis=0, ddof=ddof), rtol=1e-6,
+            )
+
+    def test_percentile_multiple_qs(self):
+        rng = np.random.default_rng(53)
+        xn = rng.standard_normal(101)
+        x = ht.array(xn, split=0)
+        for q in (0, 25, 50, 75, 100):
+            np.testing.assert_allclose(
+                np.asarray(ht.percentile(x, q).numpy()),
+                np.percentile(xn, q), rtol=1e-6, atol=1e-8,
+            )
+        # vector q exercises the ndim>0 result construction branch
+        qs = [0, 25, 50, 75, 100]
+        np.testing.assert_allclose(
+            ht.percentile(x, qs).numpy(), np.percentile(xn, qs),
+            rtol=1e-6, atol=1e-8,
+        )
+
+    def test_mean_multiaxis_all_splits(self):
+        rng = np.random.default_rng(57)
+        xn = rng.standard_normal((6, 7, 8))
+        for split in (None, 0, 1, 2):
+            x = ht.array(xn, split=split)
+            np.testing.assert_allclose(
+                ht.mean(x, axis=(0, 2)).numpy(), xn.mean(axis=(0, 2)),
+                rtol=1e-6, err_msg=f"split={split}",
+            )
+
+    def test_cov_rowvar_false_all_splits(self):
+        rng = np.random.default_rng(59)
+        xn = rng.standard_normal((40, 4))
+        for split in (None, 0, 1):
+            x = ht.array(xn, split=split)
+            np.testing.assert_allclose(
+                ht.cov(x, rowvar=False).numpy(), np.cov(xn, rowvar=False),
+                rtol=1e-5, atol=1e-8, err_msg=f"split={split}",
+            )
+
+    def test_bincount_weights(self):
+        xn = np.array([0, 1, 1, 3, 2, 1, 7], dtype=np.int64)
+        wn = np.arange(7, dtype=np.float64)
+        x = ht.array(xn, split=0)
+        w = ht.array(wn, split=0)
+        np.testing.assert_allclose(
+            ht.bincount(x, weights=w).numpy(), np.bincount(xn, weights=wn)
+        )
