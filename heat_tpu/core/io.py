@@ -25,7 +25,15 @@ from .devices import sanitize_device
 from .dndarray import DNDarray
 from .factories import array as _array
 
-__all__ = ["load", "load_csv", "save", "save_csv", "supports_hdf5", "supports_netcdf"]
+__all__ = [
+    "load",
+    "load_csv",
+    "load_npy",
+    "save",
+    "save_csv",
+    "supports_hdf5",
+    "supports_netcdf",
+]
 
 try:  # pragma: no cover - availability depends on environment
     import h5py
@@ -204,7 +212,9 @@ def save_checkpoint(state, path: str) -> None:
         if isinstance(x, DNDarray):
             return {
                 "__dndarray__": x.larray,  # padded sharded buffer, as-is
-                "gshape": np.asarray(x.shape, dtype=np.int64),
+                # length-prefixed so 0-d arrays don't produce a zero-size
+                # metadata array (orbax refuses those)
+                "gshape": np.asarray((x.ndim,) + tuple(x.shape), dtype=np.int64),
                 "split": -1 if x.split is None else x.split,
             }
         return x
@@ -232,7 +242,14 @@ def load_checkpoint(path: str, like=None, comm=None, device=None):
         if isinstance(x, dict) and "__dndarray__" in x:
             split = int(x["split"])
             split = None if split < 0 else split
-            gshape = tuple(int(s) for s in np.asarray(x["gshape"]))
+            meta = np.asarray(x["gshape"])
+            buf_ndim = np.asarray(x["__dndarray__"]).ndim
+            if meta.size == buf_ndim + 1 and int(meta[0]) == buf_ndim:
+                # length-prefixed record: [ndim, *shape]
+                gshape = tuple(int(s) for s in meta[1 : 1 + int(meta[0])])
+            else:
+                # pre-prefix record: the raw shape
+                gshape = tuple(int(s) for s in meta)
             buf = np.asarray(x["__dndarray__"])
             if split is not None:
                 # stored buffer is the padded physical layout; slice back to

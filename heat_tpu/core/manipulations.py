@@ -617,9 +617,18 @@ def topk(a: DNDarray, k: int, dim: int = -1, largest: bool = True, sorted: bool 
         vals = jnp.take_along_axis(moved, idx, axis=-1)
     vals = jnp.moveaxis(vals, -1, dim)
     idx = jnp.moveaxis(idx, -1, dim)
-    out_shape = tuple(k if d == dim else s for d, s in enumerate(a.shape))
-    values = DNDarray.from_logical(vals, None if a.split == dim else a.split, a.device, a.comm, a.dtype)
-    indices = DNDarray.from_logical(idx.astype(jnp.int64), None if a.split == dim else a.split, a.device, a.comm, types.int64)
+    if a.split is not None and a.split != dim:
+        # physical fast path: the split axis kept its padded layout, so the
+        # result is a physical buffer (pad rows hold pad top-k values) — wrap
+        # it directly with the logical gshape, as flip/roll do
+        out_gshape = tuple(k if d == dim else s for d, s in enumerate(a.shape))
+        values = DNDarray(vals, out_gshape, a.dtype, a.split, a.device, a.comm, a.balanced)
+        indices = DNDarray(
+            idx.astype(jnp.int64), out_gshape, types.int64, a.split, a.device, a.comm, a.balanced
+        )
+    else:
+        values = DNDarray.from_logical(vals, None, a.device, a.comm, a.dtype)
+        indices = DNDarray.from_logical(idx.astype(jnp.int64), None, a.device, a.comm, types.int64)
     if out is not None:
         out[0].larray = values.larray
         out[1].larray = indices.larray

@@ -1,0 +1,269 @@
+"""Deep checks for the core utility layers — stride_tricks sanitation
+calculus, memory copy semantics, sanitation guards, complex math across
+splits, exponential/trig accuracy grids, and DNDarray container contracts
+(reference heat/core/tests/{test_stride_tricks,test_sanitation,
+test_memory,test_complex_math,test_exponential}.py)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from heat_tpu.core import stride_tricks, sanitation, memory
+from .basic_test import TestCase
+
+
+class TestStrideTricks(TestCase):
+    def test_broadcast_shape_table(self):
+        cases = [
+            ((3, 1), (1, 4), (3, 4)),
+            ((5,), (5,), (5,)),
+            ((2, 3, 4), (3, 4), (2, 3, 4)),
+            ((1,), (7, 1), (7, 1)),
+            ((4, 1, 6), (1, 5, 6), (4, 5, 6)),
+            ((), (3,), (3,)),
+        ]
+        for a, b, want in cases:
+            assert stride_tricks.broadcast_shape(a, b) == want
+
+    def test_broadcast_shape_rejects_mismatch(self):
+        with pytest.raises(ValueError):
+            stride_tricks.broadcast_shape((3,), (4,))
+
+    def test_sanitize_axis_forms(self):
+        assert stride_tricks.sanitize_axis((3, 4), -1) == 1
+        assert stride_tricks.sanitize_axis((3, 4), None) is None
+        assert stride_tricks.sanitize_axis((2, 3, 4), (0, -1)) == (0, 2)
+
+    def test_sanitize_axis_rejects_out_of_range(self):
+        with pytest.raises(ValueError):
+            stride_tricks.sanitize_axis((3, 4), 2)
+        with pytest.raises(ValueError):
+            stride_tricks.sanitize_axis((3, 4), -3)
+
+    def test_sanitize_shape_scalar_and_sequence(self):
+        assert stride_tricks.sanitize_shape(5) == (5,)
+        assert stride_tricks.sanitize_shape([2, 3]) == (2, 3)
+
+    def test_sanitize_shape_rejects_negative(self):
+        with pytest.raises(ValueError):
+            stride_tricks.sanitize_shape((2, -3))
+
+    def test_sanitize_slice_clamps(self):
+        s = stride_tricks.sanitize_slice(slice(None, None, None), 5)
+        assert (s.start, s.stop, s.step) == (0, 5, 1)
+        s = stride_tricks.sanitize_slice(slice(-3, None), 5)
+        assert s.start == 2
+
+
+class TestSanitationGuards(TestCase):
+    def test_sanitize_in_accepts_dndarray(self):
+        sanitation.sanitize_in(ht.arange(3))
+
+    def test_sanitize_in_rejects_numpy(self):
+        with pytest.raises(TypeError):
+            sanitation.sanitize_in(np.arange(3))
+
+    def test_sanitize_infinity_int_vs_float(self):
+        assert sanitation.sanitize_infinity(ht.arange(3, dtype=ht.int32)) == np.iinfo(np.int32).max
+        assert sanitation.sanitize_infinity(ht.ones(3, dtype=ht.float32)) == float("inf")
+
+    def test_sanitize_sequence(self):
+        assert sanitation.sanitize_sequence((1, 2)) == [1, 2]
+        assert sanitation.sanitize_sequence([3]) == [3]
+        with pytest.raises(TypeError):
+            sanitation.sanitize_sequence(5)
+
+    def test_sanitize_out_shape_mismatch(self):
+        out = ht.zeros((2, 2), split=0)
+        with pytest.raises(ValueError):
+            sanitation.sanitize_out(out, (3, 3), 0, out.device)
+
+    def test_sanitize_out_type(self):
+        with pytest.raises(TypeError):
+            sanitation.sanitize_out(np.zeros(3), (3,), None, None)
+
+
+class TestMemorySemantics(TestCase):
+    def test_copy_is_independent(self):
+        x = ht.arange(6, split=0)
+        y = memory.copy(x)
+        x[0] = 99
+        np.testing.assert_array_equal(y.numpy(), np.arange(6))
+        assert y.split == x.split and y.dtype == x.dtype
+
+    def test_copy_preserves_layout(self):
+        p = self.comm.size
+        x = ht.ones((p + 1, 3), split=0)
+        y = ht.copy(x)
+        assert tuple(y.larray.shape) == tuple(x.larray.shape)
+
+    def test_sanitize_memory_layout_noop_c(self):
+        x = ht.arange(4, split=0)
+        y = memory.sanitize_memory_layout(x, "C")
+        self.assert_array_equal(y, np.arange(4))
+
+
+class TestComplexDeep(TestCase):
+    def _z(self):
+        rng = np.random.default_rng(51)
+        re = rng.standard_normal(2 * self.comm.size + 1).astype(np.float32)
+        im = rng.standard_normal(2 * self.comm.size + 1).astype(np.float32)
+        return (re + 1j * im).astype(np.complex64)
+
+    def test_real_imag_conj_roundtrip(self):
+        z = self._z()
+        for split in (None, 0):
+            x = ht.array(z, split=split)
+            self.assert_array_equal(ht.real(x), z.real, rtol=1e-6)
+            self.assert_array_equal(ht.imag(x), z.imag, rtol=1e-6)
+            got = ht.conj(x)
+            np.testing.assert_allclose(got.numpy(), np.conj(z), rtol=1e-6)
+
+    def test_angle_deg_and_rad(self):
+        z = np.asarray([1 + 0j, 0 + 1j, -1 + 0j, 1 + 1j], dtype=np.complex64)
+        x = ht.array(z, split=0)
+        np.testing.assert_allclose(
+            ht.angle(x).numpy(), np.angle(z), rtol=1e-6, atol=1e-6
+        )
+        np.testing.assert_allclose(
+            ht.angle(x, deg=True).numpy(), np.degrees(np.angle(z)), rtol=1e-5
+        )
+
+    def test_abs_complex(self):
+        z = self._z()
+        x = ht.array(z, split=0)
+        np.testing.assert_allclose(ht.abs(x).numpy(), np.abs(z), rtol=1e-5)
+
+    def test_iscomplex_isreal(self):
+        z = np.asarray([1 + 1j, 2 + 0j], dtype=np.complex64)
+        x = ht.array(z, split=0)
+        np.testing.assert_array_equal(
+            ht.iscomplex(x).numpy().astype(bool), [True, False]
+        )
+        np.testing.assert_array_equal(
+            ht.isreal(x).numpy().astype(bool), [False, True]
+        )
+
+    def test_complex_arithmetic(self):
+        z = self._z()
+        x = ht.array(z, split=0)
+        got = ht.mul(x, ht.conj(x))
+        np.testing.assert_allclose(got.numpy().real, np.abs(z) ** 2, rtol=1e-5)
+        np.testing.assert_allclose(got.numpy().imag, 0.0, atol=1e-5)
+
+
+class TestExponentialAccuracy(TestCase):
+    def test_exp_log_inverses(self):
+        p = self.comm.size
+        a = np.linspace(0.1, 5.0, 2 * p + 3).astype(np.float32)
+        for split in (None, 0):
+            x = ht.array(a, split=split)
+            self.assert_array_equal(ht.exp(ht.log(x)), a, rtol=1e-5)
+            self.assert_array_equal(ht.log(ht.exp(x)), a, rtol=1e-5)
+
+    def test_expm1_log1p_small_values(self):
+        a = np.asarray([1e-8, 1e-6, 1e-4], dtype=np.float64)
+        x = ht.array(a, split=0)
+        self.assert_array_equal(ht.expm1(x), np.expm1(a), rtol=1e-12)
+        self.assert_array_equal(ht.log1p(x), np.log1p(a), rtol=1e-12)
+
+    def test_exp2_log2_log10(self):
+        a = np.asarray([1.0, 2.0, 8.0, 100.0], dtype=np.float32)
+        x = ht.array(a, split=0)
+        self.assert_array_equal(ht.log2(x), np.log2(a), rtol=1e-6)
+        self.assert_array_equal(ht.log10(x), np.log10(a), rtol=1e-6)
+        self.assert_array_equal(ht.exp2(ht.log2(x)), a, rtol=1e-5)
+
+    def test_sqrt_square(self):
+        a = np.asarray([1.0, 4.0, 9.0, 2.0], dtype=np.float32)
+        x = ht.array(a, split=0)
+        self.assert_array_equal(ht.sqrt(x), np.sqrt(a), rtol=1e-6)
+        self.assert_array_equal(ht.square(x), a * a, rtol=1e-6)
+        self.assert_array_equal(ht.sqrt(ht.square(x)), a, rtol=1e-5)
+
+    def test_logaddexp2(self):
+        a = np.asarray([1.0, 5.0], dtype=np.float32)
+        b = np.asarray([2.0, 5.0], dtype=np.float32)
+        got = ht.logaddexp2(ht.array(a, split=0), ht.array(b, split=0))
+        np.testing.assert_allclose(got.numpy(), np.logaddexp2(a, b), rtol=1e-5)
+
+    def test_logaddexp_overflow_safe(self):
+        a = np.asarray([1000.0, -1000.0], dtype=np.float32)
+        b = np.asarray([1000.0, -999.0], dtype=np.float32)
+        got = ht.logaddexp(ht.array(a, split=0), ht.array(b, split=0))
+        want = np.logaddexp(a, b)
+        np.testing.assert_allclose(got.numpy(), want, rtol=1e-5)
+
+
+class TestTrigAccuracy(TestCase):
+    def test_inverse_identities(self):
+        a = np.linspace(-0.99, 0.99, 11).astype(np.float32)
+        x = ht.array(a, split=0)
+        self.assert_array_equal(ht.sin(ht.arcsin(x)), a, rtol=1e-5)
+        self.assert_array_equal(ht.cos(ht.arccos(x)), a, rtol=1e-4, atol=1e-5)
+        self.assert_array_equal(ht.tan(ht.arctan(x)), a, rtol=1e-5)
+
+    def test_arctan2_quadrants(self):
+        y = np.asarray([1.0, 1.0, -1.0, -1.0], dtype=np.float32)
+        x = np.asarray([1.0, -1.0, 1.0, -1.0], dtype=np.float32)
+        got = ht.arctan2(ht.array(y, split=0), ht.array(x, split=0))
+        np.testing.assert_allclose(got.numpy(), np.arctan2(y, x), rtol=1e-6)
+
+    def test_hyperbolic_inverses(self):
+        a = np.linspace(-2, 2, 9).astype(np.float32)
+        x = ht.array(a, split=0)
+        self.assert_array_equal(ht.sinh(ht.arcsinh(x)), a, rtol=1e-5, atol=1e-6)
+        self.assert_array_equal(ht.tanh(ht.arctanh(ht.array(np.linspace(-0.9, 0.9, 9).astype(np.float32)))), np.linspace(-0.9, 0.9, 9), rtol=1e-5)
+
+    def test_deg_rad_roundtrip(self):
+        a = np.asarray([0.0, 90.0, 180.0, 360.0], dtype=np.float32)
+        x = ht.array(a, split=0)
+        self.assert_array_equal(ht.rad2deg(ht.deg2rad(x)), a, rtol=1e-5)
+
+
+class TestContainerContracts(TestCase):
+    def test_len_matches_first_dim(self):
+        p = self.comm.size
+        x = ht.ones((p + 2, 3), split=0)
+        assert len(x) == p + 2
+
+    def test_iter_yields_rows(self):
+        m = np.arange(6, dtype=np.float32).reshape(3, 2)
+        x = ht.array(m, split=0)
+        rows = [r.numpy() for r in x]
+        np.testing.assert_array_equal(np.stack(rows), m)
+
+    def test_tolist_item(self):
+        m = np.arange(4, dtype=np.float32).reshape(2, 2)
+        x = ht.array(m, split=0)
+        assert x.tolist() == m.tolist()
+        assert ht.array(3.5).item() == 3.5
+
+    def test_repr_str_no_pad_leak(self):
+        p = self.comm.size
+        x = ht.arange(p + 1, split=0)  # padded physical tail
+        s = str(x)
+        assert str(p) in s  # last logical value present
+        assert "DNDarray" in repr(x) or "[" in s
+
+    def test_bool_ambiguous_raises(self):
+        with pytest.raises((ValueError, TypeError)):
+            bool(ht.arange(4))
+
+    def test_is_balanced_and_balance(self):
+        x = ht.arange(3 * self.comm.size + 1, split=0)
+        assert isinstance(x.is_balanced(), bool)
+        x.balance_()
+        self.assert_array_equal(x, np.arange(3 * self.comm.size + 1))
+
+    def test_gshape_equals_shape(self):
+        x = ht.ones((4, 5), split=1)
+        assert tuple(x.gshape) == tuple(x.shape) == (4, 5)
+
+    def test_fill_diagonal(self):
+        m = np.zeros((4, 4), dtype=np.float32)
+        x = ht.array(m, split=0)
+        x.fill_diagonal(3.0)
+        want = m.copy()
+        np.fill_diagonal(want, 3.0)
+        self.assert_array_equal(x, want)

@@ -1,0 +1,265 @@
+"""Deep factory/type-system sweeps — argument grids for every factory
+across splits and dtypes, promotion-table spot checks against numpy, and
+uneven-extent layout assertions (reference heat/core/tests/test_factories.py
++ test_types.py drive the same grids per rank)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from .basic_test import TestCase
+
+
+class TestArangeGrid(TestCase):
+    def test_arg_forms(self):
+        for args in [(7,), (2, 9), (1, 10, 2), (10, 1, -3), (0, 1, 0.25)]:
+            want = np.arange(*args)
+            for split in (None, 0):
+                got = ht.arange(*args, split=split)
+                self.assert_array_equal(got, want.astype(got.numpy().dtype))
+
+    def test_dtype_override(self):
+        got = ht.arange(5, dtype=ht.float64, split=0)
+        assert got.dtype == ht.float64
+        self.assert_array_equal(got, np.arange(5, dtype=np.float64))
+
+    def test_empty_range(self):
+        got = ht.arange(3, 3, split=0)
+        assert tuple(got.shape) == (0,)
+
+    def test_uneven_vs_mesh(self):
+        p = self.comm.size
+        got = ht.arange(2 * p + 1, split=0)
+        self.assert_array_equal(got, np.arange(2 * p + 1))
+
+
+class TestLinLogSpaceGrid(TestCase):
+    def test_linspace_endpoint_toggle(self):
+        for endpoint in (True, False):
+            want = np.linspace(0.0, 1.0, 7, endpoint=endpoint)
+            got = ht.linspace(0.0, 1.0, 7, endpoint=endpoint, split=0)
+            self.assert_array_equal(got, want.astype(np.float32), rtol=1e-6)
+
+    def test_linspace_retstep(self):
+        got, step = ht.linspace(0, 10, 5, retstep=True)
+        _, wstep = np.linspace(0, 10, 5, retstep=True)
+        np.testing.assert_allclose(float(step), wstep)
+
+    def test_linspace_descending(self):
+        want = np.linspace(5, -5, 11).astype(np.float32)
+        self.assert_array_equal(ht.linspace(5, -5, 11, split=0), want, rtol=1e-6)
+
+    def test_logspace_base(self):
+        for base in (10.0, 2.0, np.e):
+            want = np.logspace(0, 3, 8, base=base).astype(np.float32)
+            got = ht.logspace(0, 3, 8, base=base, split=0)
+            self.assert_array_equal(got, want, rtol=1e-5)
+
+    def test_single_point(self):
+        got = ht.linspace(4.0, 9.0, 1)
+        np.testing.assert_allclose(got.numpy(), [4.0])
+
+
+class TestEyeFullGrid(TestCase):
+    def test_eye_rectangular_both_ways(self):
+        p = self.comm.size
+        for shape in ((p + 1, 4), (3, p + 2), (p + 1,)):
+            for split in (None, 0) + ((1,) if len(shape) > 1 else ()):
+                got = ht.eye(shape, split=split)
+                want = np.eye(*shape) if len(shape) > 1 else np.eye(shape[0])
+                self.assert_array_equal(got, want.astype(np.float32))
+
+    def test_full_scalar_and_dtype(self):
+        p = self.comm.size
+        got = ht.full((p + 2, 3), 7, dtype=ht.int64, split=0)
+        assert got.dtype == ht.int64
+        self.assert_array_equal(got, np.full((p + 2, 3), 7, dtype=np.int64))
+
+    def test_empty_has_layout(self):
+        p = self.comm.size
+        got = ht.empty((p + 3, 2), split=0)
+        assert tuple(got.shape) == (p + 3, 2)
+        assert got.split == 0
+
+    def test_like_family_overrides(self):
+        p = self.comm.size
+        proto = ht.ones((p + 1, 3), dtype=ht.float32, split=0)
+        z = ht.zeros_like(proto)
+        assert z.split == 0 and z.dtype == ht.float32
+        self.assert_array_equal(z, np.zeros((p + 1, 3)))
+        f = ht.full_like(proto, 3.5, dtype=ht.float64)
+        assert f.dtype == ht.float64
+        self.assert_array_equal(f, np.full((p + 1, 3), 3.5))
+        o = ht.ones_like(proto, split=1)
+        assert o.split == 1
+        e = ht.empty_like(proto)
+        assert tuple(e.shape) == (p + 1, 3)
+
+
+class TestMeshgridGrid(TestCase):
+    def test_xy_vs_ij(self):
+        a = np.arange(3, dtype=np.float32)
+        b = np.arange(4, dtype=np.float32)
+        for indexing in ("xy", "ij"):
+            want = np.meshgrid(a, b, indexing=indexing)
+            got = ht.meshgrid(ht.array(a), ht.array(b), indexing=indexing)
+            for g, w in zip(got, want):
+                self.assert_array_equal(g, w)
+
+    def test_three_inputs(self):
+        xs = [np.arange(k + 2, dtype=np.float32) for k in range(3)]
+        want = np.meshgrid(*xs, indexing="ij")
+        got = ht.meshgrid(*[ht.array(x) for x in xs], indexing="ij")
+        for g, w in zip(got, want):
+            self.assert_array_equal(g, w)
+
+    def test_rejects_bad_indexing(self):
+        with pytest.raises((ValueError, TypeError)):
+            ht.meshgrid(ht.arange(2), indexing="bad")
+
+
+class TestArrayFactoryDeep(TestCase):
+    def test_nested_lists_and_scalars(self):
+        self.assert_array_equal(ht.array([[1, 2], [3, 4]]), np.asarray([[1, 2], [3, 4]]))
+        s = ht.array(5.0)
+        assert tuple(s.shape) == ()
+        assert float(s) == 5.0
+
+    def test_copy_semantics(self):
+        a = np.arange(4, dtype=np.float32)
+        x = ht.array(a, split=0)
+        a[0] = 99  # mutating the source must not change the DNDarray
+        np.testing.assert_array_equal(x.numpy(), [0, 1, 2, 3])
+
+    def test_from_dndarray_keeps_split(self):
+        # split=None is "unspecified" for a DNDarray input: distribution is
+        # preserved (replication is an explicit resplit)
+        x = ht.arange(6, split=0)
+        y = ht.array(x)
+        assert y.split == 0
+        self.assert_array_equal(y, np.arange(6))
+        z = ht.array(x, split=1) if x.ndim > 1 else ht.resplit(x, None)
+        assert z.split is None
+        self.assert_array_equal(z, np.arange(6))
+
+    def test_from_dndarray_dtype_cast(self):
+        x = ht.arange(6, split=0)
+        y = ht.array(x, dtype=ht.float32)
+        assert y.dtype == ht.float32 and y.split == 0
+        self.assert_array_equal(y, np.arange(6, dtype=np.float32))
+
+    def test_asarray_passthrough(self):
+        x = ht.arange(5, split=0)
+        assert ht.asarray(x) is x
+
+    def test_ndmin_like_rank_preserved(self):
+        m = np.arange(6, dtype=np.float32).reshape(2, 3, 1)
+        x = ht.array(m, split=1)
+        assert x.ndim == 3
+
+    def test_bool_input(self):
+        a = np.asarray([True, False, True])
+        x = ht.array(a, split=0)
+        assert x.dtype == ht.bool
+        np.testing.assert_array_equal(x.numpy().astype(bool), a)
+
+
+class TestPromotionTable(TestCase):
+    """Spot-check the promotion lattice. The framework keeps the
+    reference's torch-style lattice (types.py promote_types): mixing ints
+    with a float yields THAT float width (int32+float32 → float32), unlike
+    numpy's value-based inflation to float64."""
+
+    PAIRS = [
+        (np.uint8, np.int8, ht.int16),
+        (np.int32, np.float32, ht.float32),   # numpy would say float64
+        (np.int64, np.float32, ht.float32),   # numpy would say float64
+        (np.float32, np.float64, ht.float64),
+        (np.uint8, np.float32, ht.float32),
+        (np.bool_, np.int8, ht.int8),
+        (np.bool_, np.float64, ht.float64),
+    ]
+
+    def test_pairs_match_lattice(self):
+        for a, b, want in self.PAIRS:
+            got = ht.promote_types(a, b)
+            assert got == want, (a, b, got, want)
+
+    def test_result_type_with_arrays(self):
+        x = ht.ones(3, dtype=ht.int32)
+        y = ht.ones(3, dtype=ht.float64)
+        assert ht.result_type(x, y) == ht.float64
+
+    def test_can_cast_hierarchy(self):
+        assert ht.can_cast(ht.int32, ht.int64)
+        assert ht.can_cast(ht.float32, ht.float64)
+        assert not ht.can_cast(ht.float64, ht.int32)
+
+    def test_finfo_iinfo_fields(self):
+        fi = ht.finfo(ht.float32)
+        assert fi.bits == 32 and fi.max > 1e38
+        ii = ht.iinfo(ht.int16)
+        assert ii.min == -(2**15) and ii.max == 2**15 - 1
+
+    def test_issubdtype(self):
+        assert ht.issubdtype(ht.float32, ht.floating)
+        assert ht.issubdtype(ht.int64, ht.integer)
+        assert not ht.issubdtype(ht.float32, ht.integer)
+
+
+class TestAstypeGrid(TestCase):
+    def test_every_cast_pair(self):
+        src = np.asarray([0.0, 1.7, -2.3, 100.0], dtype=np.float64)
+        x = ht.array(src, split=0)
+        for target, np_target in [
+            (ht.float32, np.float32), (ht.int32, np.int32),
+            (ht.int64, np.int64), (ht.bool, np.bool_),
+            (ht.float64, np.float64),
+        ]:
+            got = x.astype(target)
+            assert got.dtype == target
+            np.testing.assert_array_equal(
+                got.numpy(), src.astype(np_target), err_msg=str(target)
+            )
+        # float→unsigned of a negative value is platform-defined (XLA
+        # saturates, numpy wraps) — test the well-defined range only
+        pos = ht.array(np.asarray([0.0, 1.7, 100.0]), split=0)
+        np.testing.assert_array_equal(
+            pos.astype(ht.uint8).numpy(), np.asarray([0, 1, 100], dtype=np.uint8)
+        )
+
+    def test_astype_keeps_split_and_shape(self):
+        p = self.comm.size
+        x = ht.ones((p + 1, 2), split=0)
+        got = x.astype(ht.int8)
+        assert got.split == 0 and tuple(got.shape) == (p + 1, 2)
+
+    def test_scalar_cast_dunder(self):
+        x = ht.array(3.7)
+        assert int(x) == 3
+        assert abs(float(x) - 3.7) < 1e-6
+        assert bool(ht.array(1.0)) is True
+        assert complex(ht.array(2.0)) == 2.0 + 0j
+
+    def test_cast_multielement_raises(self):
+        with pytest.raises((TypeError, ValueError)):
+            float(ht.arange(3))
+
+
+class TestDeviceRegistry(TestCase):
+    def test_singletons(self):
+        assert ht.get_device() is ht.get_device()
+
+    def test_use_device_roundtrip(self):
+        dev = ht.get_device()
+        ht.use_device(dev)
+        assert ht.get_device() is dev
+
+    def test_device_attributes(self):
+        dev = ht.get_device()
+        assert hasattr(dev, "device_type")
+        assert "Device" in type(dev).__name__ or repr(dev)
+
+    def test_factory_accepts_device(self):
+        x = ht.ones(3, device=ht.get_device())
+        self.assert_array_equal(x, np.ones(3))

@@ -1,0 +1,260 @@
+"""Deep linear-algebra sweeps — matmul over non-divisible extents × dtypes,
+batched/vector edge shapes, norm/trace/tri argument grids, and solver
+convergence checks (reference heat/core/linalg/tests/test_basics.py sweeps
+splits the same way; the SUMMA path there is replaced by XLA-sharded GEMMs,
+basics.py:108-778)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from .basic_test import TestCase
+
+
+class TestMatmulUneven(TestCase):
+    """All nine (a.split, b.split) combos on shapes that never divide the
+    mesh — the padded-GEMM masking must neutralize every tail."""
+
+    def _sweep(self, a, b, rtol=1e-4):
+        want = a @ b
+        for sa in (None, 0, 1):
+            for sb in (None, 0, 1):
+                x = ht.array(a, split=sa)
+                y = ht.array(b, split=sb)
+                got = ht.matmul(x, y)
+                self.assert_array_equal(got, want, rtol=rtol, atol=1e-3)
+
+    def test_uneven_square(self):
+        p = self.comm.size
+        n = p + 3
+        rng = np.random.default_rng(31)
+        a = rng.standard_normal((n, n)).astype(np.float32)
+        b = rng.standard_normal((n, n)).astype(np.float32)
+        self._sweep(a, b)
+
+    def test_rectangular_chain_shapes(self):
+        p = self.comm.size
+        rng = np.random.default_rng(32)
+        a = rng.standard_normal((2 * p + 1, p + 2)).astype(np.float32)
+        b = rng.standard_normal((p + 2, 3 * p - 1)).astype(np.float32)
+        self._sweep(a, b)
+
+    def test_inner_dim_smaller_than_mesh(self):
+        p = self.comm.size
+        if p < 3:
+            pytest.skip("needs >2 devices")
+        rng = np.random.default_rng(33)
+        a = rng.standard_normal((p + 1, 2)).astype(np.float32)
+        b = rng.standard_normal((2, p + 1)).astype(np.float32)
+        self._sweep(a, b)
+
+    def test_float64(self):
+        p = self.comm.size
+        rng = np.random.default_rng(34)
+        a = rng.standard_normal((p + 1, p)).astype(np.float64)
+        b = rng.standard_normal((p, p + 2)).astype(np.float64)
+        self._sweep(a, b, rtol=1e-10)
+
+    def test_result_dtype_promotion(self):
+        a = np.ones((3, 3), dtype=np.float32)
+        b = np.ones((3, 3), dtype=np.float64)
+        got = ht.matmul(ht.array(a, split=0), ht.array(b, split=0))
+        assert got.dtype == ht.float64
+
+    def test_matmul_associativity_chain(self):
+        # (AB)C == A(BC) through the framework across splits
+        rng = np.random.default_rng(35)
+        n = self.comm.size + 2
+        A = rng.standard_normal((n, n)).astype(np.float64)
+        B = rng.standard_normal((n, n)).astype(np.float64)
+        C = rng.standard_normal((n, n)).astype(np.float64)
+        x = ht.array(A, split=0)
+        y = ht.array(B, split=1)
+        z = ht.array(C, split=0)
+        left = ht.matmul(ht.matmul(x, y), z)
+        right = ht.matmul(x, ht.matmul(y, z))
+        np.testing.assert_allclose(left.numpy(), right.numpy(), rtol=1e-8)
+        np.testing.assert_allclose(left.numpy(), A @ B @ C, rtol=1e-8)
+
+
+class TestMatVecShapes(TestCase):
+    def test_matvec_all_splits(self):
+        p = self.comm.size
+        rng = np.random.default_rng(36)
+        m = rng.standard_normal((p + 1, p + 2)).astype(np.float32)
+        v = rng.standard_normal(p + 2).astype(np.float32)
+        want = m @ v
+        for sm in (None, 0, 1):
+            for sv in (None, 0):
+                got = ht.matmul(ht.array(m, split=sm), ht.array(v, split=sv))
+                self.assert_array_equal(got, want, rtol=1e-4, atol=1e-4)
+
+    def test_vecmat_all_splits(self):
+        p = self.comm.size
+        rng = np.random.default_rng(37)
+        v = rng.standard_normal(p + 1).astype(np.float32)
+        m = rng.standard_normal((p + 1, 3)).astype(np.float32)
+        want = v @ m
+        for sv in (None, 0):
+            for sm in (None, 0, 1):
+                got = ht.matmul(ht.array(v, split=sv), ht.array(m, split=sm))
+                self.assert_array_equal(got, want, rtol=1e-4, atol=1e-4)
+
+    def test_vecvec_inner(self):
+        p = self.comm.size
+        a = np.arange(2 * p + 1, dtype=np.float32)
+        got = ht.dot(ht.array(a, split=0), ht.array(a, split=0))
+        np.testing.assert_allclose(float(got), float(a @ a), rtol=1e-5)
+
+    def test_outer_uneven(self):
+        p = self.comm.size
+        a = np.arange(p + 1, dtype=np.float32)
+        b = np.arange(p + 2, dtype=np.float32) - 1
+        for sa in (None, 0):
+            for sb in (None, 0):
+                got = ht.outer(ht.array(a, split=sa), ht.array(b, split=sb))
+                self.assert_array_equal(got, np.outer(a, b))
+
+
+class TestNormGrid(TestCase):
+    def _m(self):
+        rng = np.random.default_rng(38)
+        return rng.standard_normal((self.comm.size + 1, 4)).astype(np.float32)
+
+    def test_fro_default(self):
+        m = self._m()
+        for split in (None, 0, 1):
+            got = ht.norm(ht.array(m, split=split))
+            np.testing.assert_allclose(float(got), np.linalg.norm(m), rtol=1e-5)
+
+    def test_vector_orders(self):
+        v = np.asarray([3.0, -4.0, 12.0], dtype=np.float32)
+        x = ht.array(v, split=0)
+        for ord_ in (1, 2, np.inf):
+            np.testing.assert_allclose(
+                float(ht.vector_norm(x, ord=ord_)),
+                np.linalg.norm(v, ord=ord_),
+                rtol=1e-6,
+            )
+
+    def test_matrix_norm_axis(self):
+        m = self._m()
+        x = ht.array(m, split=0)
+        got = ht.vector_norm(x, axis=1)
+        self.assert_array_equal(got, np.linalg.norm(m, axis=1), rtol=1e-5)
+
+
+class TestTriTraceGrid(TestCase):
+    def test_tril_triu_offsets(self):
+        p = self.comm.size
+        m = np.arange((p + 1) * (p + 1), dtype=np.float32).reshape(p + 1, p + 1)
+        for split in (None, 0, 1):
+            x = ht.array(m, split=split)
+            for k in (-2, -1, 0, 1, 2):
+                self.assert_array_equal(ht.tril(x, k), np.tril(m, k))
+                self.assert_array_equal(ht.triu(x, k), np.triu(m, k))
+
+    def test_trace_rectangular(self):
+        m = np.arange(15, dtype=np.float32).reshape(3, 5)
+        for split in (None, 0, 1):
+            got = ht.trace(ht.array(m, split=split))
+            np.testing.assert_allclose(float(got), np.trace(m), rtol=1e-6)
+
+    def test_transpose_3d_axes(self):
+        t = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+        for split in (None, 0, 1, 2):
+            x = ht.array(t, split=split)
+            self.assert_array_equal(
+                ht.transpose(x, (2, 0, 1)), np.transpose(t, (2, 0, 1))
+            )
+
+
+class TestQRDeep(TestCase):
+    def test_orthonormal_columns_uneven(self):
+        p = self.comm.size
+        rng = np.random.default_rng(39)
+        a = rng.standard_normal((8 * p + 3, 5)).astype(np.float32)
+        q, r = ht.qr(ht.array(a, split=0))
+        qn = q.numpy()
+        np.testing.assert_allclose(qn.T @ qn, np.eye(5), atol=1e-4)
+        np.testing.assert_allclose(qn @ r.numpy(), a, atol=1e-3)
+
+    def test_r_upper_triangular(self):
+        rng = np.random.default_rng(40)
+        a = rng.standard_normal((6 * self.comm.size, 4)).astype(np.float32)
+        _, r = ht.qr(ht.array(a, split=0))
+        rn = r.numpy()
+        np.testing.assert_allclose(rn, np.triu(rn), atol=1e-5)
+
+    def test_identity_input(self):
+        n = 2 * self.comm.size
+        q, r = ht.qr(ht.eye(n, split=0))
+        np.testing.assert_allclose(
+            np.abs(q.numpy() @ r.numpy()), np.eye(n), atol=1e-5
+        )
+
+    def test_rank_deficient_reconstructs(self):
+        # QR must still reconstruct A when columns are linearly dependent
+        p = self.comm.size
+        rng = np.random.default_rng(41)
+        col = rng.standard_normal((4 * p, 1)).astype(np.float32)
+        a = np.concatenate([col, 2 * col, rng.standard_normal((4 * p, 1)).astype(np.float32)], axis=1)
+        q, r = ht.qr(ht.array(a, split=0))
+        np.testing.assert_allclose(q.numpy() @ r.numpy(), a, atol=1e-3)
+
+
+class TestSVDDeep(TestCase):
+    def test_singular_values_match_numpy(self):
+        p = self.comm.size
+        rng = np.random.default_rng(42)
+        a = rng.standard_normal((6 * p + 1, 4)).astype(np.float32)
+        got = ht.svd(ht.array(a, split=0), compute_uv=False)
+        np.testing.assert_allclose(
+            got.numpy(), np.linalg.svd(a, compute_uv=False), rtol=1e-3, atol=1e-3
+        )
+
+    def test_low_rank_spectrum(self):
+        # rank-2 matrix: exactly two non-negligible singular values
+        p = self.comm.size
+        rng = np.random.default_rng(43)
+        u = rng.standard_normal((5 * p, 2)).astype(np.float32)
+        v = rng.standard_normal((2, 6)).astype(np.float32)
+        s = ht.svd(ht.array(u @ v, split=0), compute_uv=False).numpy()
+        assert (s[2:] < 1e-3 * s[0]).all()
+
+    def test_reconstruction_tall(self):
+        p = self.comm.size
+        rng = np.random.default_rng(44)
+        a = rng.standard_normal((4 * p + 2, 3)).astype(np.float32)
+        u, s, v = ht.svd(ht.array(a, split=0))  # returns V, not Vᵀ
+        np.testing.assert_allclose(
+            u.numpy() @ np.diag(s.numpy()) @ v.numpy().T, a, atol=1e-3
+        )
+
+
+class TestSolverDeep(TestCase):
+    def test_cg_spd_random(self):
+        p = self.comm.size
+        rng = np.random.default_rng(45)
+        n = 3 * p
+        b_ = rng.standard_normal((n, n)).astype(np.float64)
+        A = b_ @ b_.T + n * np.eye(n)
+        x_true = rng.standard_normal(n).astype(np.float64)
+        rhs = A @ x_true
+        got = ht.cg(
+            ht.array(A, split=0), ht.array(rhs, split=0),
+            ht.array(np.zeros(n), split=0),
+        )
+        np.testing.assert_allclose(got.numpy(), x_true, rtol=1e-4, atol=1e-5)
+
+    def test_lanczos_tridiagonalizes(self):
+        p = self.comm.size
+        rng = np.random.default_rng(46)
+        n = 3 * p
+        b_ = rng.standard_normal((n, n)).astype(np.float64)
+        A = (b_ + b_.T) / 2 + n * np.eye(n)
+        V, T = ht.lanczos(ht.array(A, split=0), m=n)
+        Vn, Tn = V.numpy(), T.numpy()
+        # V orthonormal, V^T A V == T
+        np.testing.assert_allclose(Vn.T @ Vn, np.eye(n), atol=1e-6)
+        np.testing.assert_allclose(Vn.T @ A @ Vn, Tn, atol=1e-5)

@@ -1,0 +1,480 @@
+"""Deep case tables for shape/layout manipulations — the reference's
+comm-heaviest suite (heat/core/tests/test_manipulations.py, 3,606 LoC)
+systematically sweeps split axes × uneven extents × argument variants.
+These tables do the same against the numpy oracle, with extents chosen
+relative to the mesh size so tail-padding is always in play.
+"""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from .basic_test import TestCase
+
+
+def _uneven(p):
+    """An extent that never divides the mesh (ceil-rule tail exercised)."""
+    return 2 * p + 3
+
+
+class TestConcatenateTable(TestCase):
+    """Reference concatenate resolves a 3-way split-combination case table
+    (reference manipulations.py:377-443). Sweep it exhaustively, with
+    extents that do not divide the mesh."""
+
+    def _table(self, axis):
+        p = self.comm.size
+        n = _uneven(p)
+        a = np.arange(n * 3, dtype=np.float32).reshape(n, 3)
+        b = -np.arange(2 * n * 3, dtype=np.float32).reshape(2 * n, 3)
+        if axis == 1:
+            a, b = a.T.copy(), b.T.copy()
+        want = np.concatenate([a, b], axis=axis)
+        for sa in (None, 0, 1):
+            for sb in (None, 0, 1):
+                x = ht.array(a, split=sa)
+                y = ht.array(b, split=sb)
+                if sa is not None and sb is not None and sa != sb:
+                    # mismatched distribution axes raise, as in the
+                    # reference's case table (manipulations.py:377)
+                    with pytest.raises(RuntimeError):
+                        ht.concatenate([x, y], axis=axis)
+                    continue
+                got = ht.concatenate([x, y], axis=axis)
+                self.assert_array_equal(got, want)
+
+    def test_axis0_all_split_combos(self):
+        self._table(0)
+
+    def test_axis1_all_split_combos(self):
+        self._table(1)
+
+    def test_three_arrays(self):
+        p = self.comm.size
+        n = p + 1
+        parts = [
+            np.full((n + i, 2), float(i), dtype=np.float32) for i in range(3)
+        ]
+        want = np.concatenate(parts, axis=0)
+        for splits in ((0, 0, 0), (None, 0, 0), (1, None, 1), (None, None, 0)):
+            arrs = [ht.array(part, split=s) for part, s in zip(parts, splits)]
+            self.assert_array_equal(ht.concatenate(arrs, axis=0), want)
+
+    def test_result_split_preserved_on_concat_axis(self):
+        p = self.comm.size
+        a = np.ones((p + 1, 2), dtype=np.float32)
+        out = ht.concatenate(
+            [ht.array(a, split=0), ht.array(a, split=0)], axis=0
+        )
+        assert out.split == 0
+
+    def test_dtype_promotion(self):
+        a = np.arange(4, dtype=np.int32)
+        b = np.arange(4, dtype=np.float64)
+        out = ht.concatenate([ht.array(a, split=0), ht.array(b, split=0)])
+        assert out.dtype == ht.float64
+        self.assert_array_equal(out, np.concatenate([a, b]))
+
+    def test_1d_and_3d(self):
+        p = self.comm.size
+        v = np.arange(p + 2, dtype=np.float32)
+        self.assert_array_equal(
+            ht.concatenate([ht.array(v, split=0), ht.array(v, split=0)]),
+            np.concatenate([v, v]),
+        )
+        t = np.arange(2 * (p + 1) * 3, dtype=np.float32).reshape(2, p + 1, 3)
+        for axis in (0, 1, 2):
+            want = np.concatenate([t, t], axis=axis)
+            got = ht.concatenate(
+                [ht.array(t, split=1), ht.array(t, split=1)], axis=axis
+            )
+            self.assert_array_equal(got, want)
+
+    def test_rejects_shape_mismatch(self):
+        a = ht.ones((4, 3), split=0)
+        b = ht.ones((4, 4), split=0)
+        with pytest.raises((ValueError, TypeError)):
+            ht.concatenate([a, b], axis=0)
+
+
+class TestReshapeTable(TestCase):
+    def test_uneven_to_matrix_and_back(self):
+        p = self.comm.size
+        n = 4 * p + 4  # divisible by 4, not by p (for p=8: 36... check)
+        # pick a product with several factorizations, never mesh-divisible
+        n = 6 * (p + 1)
+        a = np.arange(n, dtype=np.float32)
+        for split in (None, 0):
+            x = ht.array(a, split=split)
+            for shp in ((n,), (6, p + 1), (2, 3, p + 1), (p + 1, 6)):
+                self.assert_array_equal(ht.reshape(x, shp), a.reshape(shp))
+
+    def test_minus_one_inference(self):
+        a = np.arange(24, dtype=np.float32)
+        x = ht.array(a, split=0)
+        self.assert_array_equal(ht.reshape(x, (4, -1)), a.reshape(4, -1))
+        self.assert_array_equal(ht.reshape(x, (-1, 2)), a.reshape(-1, 2))
+
+    def test_new_split_every_axis(self):
+        p = self.comm.size
+        m = np.arange(4 * (p + 1), dtype=np.float32).reshape(4, p + 1)
+        x = ht.array(m, split=1)
+        for new_split in (0, 1):
+            y = ht.reshape(x, (p + 1, 4), new_split=new_split)
+            assert y.split == new_split
+            self.assert_array_equal(y, m.reshape(p + 1, 4))
+        # new_split omitted → distribution axis carries over
+        y = ht.reshape(x, (p + 1, 4))
+        assert y.split == 1
+        self.assert_array_equal(y, m.reshape(p + 1, 4))
+
+    def test_shape_as_varargs(self):
+        a = np.arange(12, dtype=np.float32)
+        self.assert_array_equal(ht.reshape(ht.array(a, split=0), 3, 4), a.reshape(3, 4))
+
+    def test_rejects_bad_size(self):
+        with pytest.raises((ValueError, TypeError)):
+            ht.reshape(ht.arange(7, split=0), (2, 4))
+
+
+class TestRollTable(TestCase):
+    def test_tuple_shifts_axes(self):
+        p = self.comm.size
+        m = np.arange((p + 1) * 4, dtype=np.float32).reshape(p + 1, 4)
+        for split in (None, 0, 1):
+            x = ht.array(m, split=split)
+            self.assert_array_equal(
+                ht.roll(x, (1, 2), axis=(0, 1)), np.roll(m, (1, 2), axis=(0, 1))
+            )
+            self.assert_array_equal(
+                ht.roll(x, (-2, 5), axis=(1, 0)), np.roll(m, (-2, 5), axis=(1, 0))
+            )
+
+    def test_shift_larger_than_extent(self):
+        n = self.comm.size + 2
+        a = np.arange(n, dtype=np.float32)
+        x = ht.array(a, split=0)
+        for s in (n, 3 * n + 1, -2 * n - 1):
+            self.assert_array_equal(ht.roll(x, s, axis=0), np.roll(a, s, axis=0))
+
+    def test_flattened_roll_on_matrix(self):
+        m = np.arange(12, dtype=np.float32).reshape(3, 4)
+        for split in (None, 0, 1):
+            self.assert_array_equal(
+                ht.roll(ht.array(m, split=split), 7), np.roll(m, 7)
+            )
+
+
+class TestPadTable(TestCase):
+    def test_scalar_and_per_axis_widths(self):
+        p = self.comm.size
+        m = np.arange((p + 1) * 3, dtype=np.float32).reshape(p + 1, 3)
+        for split in (None, 0, 1):
+            x = ht.array(m, split=split)
+            self.assert_array_equal(ht.pad(x, 1), np.pad(m, 1))
+            self.assert_array_equal(
+                ht.pad(x, ((2, 0), (0, 3))), np.pad(m, ((2, 0), (0, 3)))
+            )
+
+    def test_constant_values(self):
+        a = np.ones((2, 2), dtype=np.float32)
+        got = ht.pad(ht.array(a, split=0), ((1, 1), (1, 1)), constant_values=-5)
+        self.assert_array_equal(got, np.pad(a, 1, constant_values=-5))
+
+    def test_pad_then_sum_consistency(self):
+        # pad must not disturb pad-neutralized reductions downstream
+        p = self.comm.size
+        a = np.arange(p + 1, dtype=np.float32)
+        y = ht.pad(ht.array(a, split=0), (1, 2))
+        assert float(ht.sum(y)) == float(np.pad(a, (1, 2)).sum())
+
+
+class TestRepeatTile(TestCase):
+    def test_array_valued_repeats(self):
+        a = np.asarray([4.0, 5.0, 6.0], dtype=np.float32)
+        reps = np.asarray([1, 2, 3])
+        got = ht.repeat(ht.array(a, split=0), reps)
+        self.assert_array_equal(got, np.repeat(a, reps))
+
+    def test_repeat_axis_combinations(self):
+        p = self.comm.size
+        m = np.arange((p + 1) * 2, dtype=np.float32).reshape(p + 1, 2)
+        for split in (None, 0, 1):
+            x = ht.array(m, split=split)
+            for axis in (0, 1):
+                self.assert_array_equal(
+                    ht.repeat(x, 2, axis=axis), np.repeat(m, 2, axis=axis)
+                )
+
+    def test_tile_expands_rank(self):
+        a = np.asarray([1.0, 2.0], dtype=np.float32)
+        x = ht.array(a, split=0)
+        self.assert_array_equal(ht.tile(x, (3, 2)), np.tile(a, (3, 2)))
+
+    def test_tile_matrix(self):
+        m = np.arange(6, dtype=np.float32).reshape(2, 3)
+        for split in (None, 0, 1):
+            self.assert_array_equal(
+                ht.tile(ht.array(m, split=split), (2, 2)), np.tile(m, (2, 2))
+            )
+
+
+class TestSqueezeExpandTable(TestCase):
+    def test_squeeze_all_singletons(self):
+        t = np.arange(6, dtype=np.float32).reshape(1, 2, 1, 3, 1)
+        x = ht.array(t, split=1)
+        self.assert_array_equal(ht.squeeze(x), t.squeeze())
+
+    def test_squeeze_specific_axis_preserves_split(self):
+        p = self.comm.size
+        t = np.arange(p + 1, dtype=np.float32).reshape(1, p + 1)
+        x = ht.array(t, split=1)
+        out = ht.squeeze(x, 0)
+        assert out.split == 0  # split axis renumbered after removal
+        self.assert_array_equal(out, t.squeeze(0))
+
+    def test_expand_dims_positions(self):
+        p = self.comm.size
+        a = np.arange(p + 2, dtype=np.float32)
+        x = ht.array(a, split=0)
+        for axis in (0, 1, -1):
+            out = ht.expand_dims(x, axis)
+            self.assert_array_equal(out, np.expand_dims(a, axis))
+        assert ht.expand_dims(x, 0).split == 1  # split shifted right
+
+    def test_squeeze_rejects_nonsingleton(self):
+        x = ht.ones((2, 3), split=0)
+        with pytest.raises((ValueError, TypeError)):
+            ht.squeeze(x, 0)
+
+
+class TestStackTable(TestCase):
+    def test_stack_axis_sweep(self):
+        p = self.comm.size
+        m = np.arange((p + 1) * 2, dtype=np.float32).reshape(p + 1, 2)
+        for split in (None, 0, 1):
+            xs = [ht.array(m + i, split=split) for i in range(3)]
+            want3 = np.stack([m, m + 1, m + 2])
+            for axis in (0, 1, 2, -1):
+                self.assert_array_equal(
+                    ht.stack(xs, axis=axis), np.stack([m, m + 1, m + 2], axis=axis)
+                )
+            self.assert_array_equal(ht.stack(xs), want3)
+
+    def test_dstack_equivalent(self):
+        a = np.arange(6, dtype=np.float32).reshape(2, 3)
+        b = a * 2
+        got = ht.stack([ht.array(a, split=0), ht.array(b, split=0)], axis=2)
+        self.assert_array_equal(got, np.stack([a, b], axis=2))
+
+    def test_hstack_on_1d(self):
+        p = self.comm.size
+        v = np.arange(p + 1, dtype=np.float32)
+        got = ht.hstack([ht.array(v, split=0), ht.array(-v, split=0)])
+        self.assert_array_equal(got, np.hstack([v, -v]))
+
+
+class TestSplitTable(TestCase):
+    def test_index_list_sections(self):
+        p = self.comm.size
+        n = 3 * (p + 1)
+        m = np.arange(n * 2, dtype=np.float32).reshape(n, 2)
+        x = ht.array(m, split=0)
+        cuts = [p + 1, 2 * (p + 1)]
+        for got, want in zip(ht.split(x, cuts, axis=0), np.split(m, cuts, axis=0)):
+            self.assert_array_equal(got, want)
+
+    def test_vsplit_hsplit_dsplit_uneven_source(self):
+        p = self.comm.size
+        t = np.arange(4 * (p + 1) * 2, dtype=np.float32).reshape(4, p + 1, 2)
+        x = ht.array(t, split=1)
+        for got, want in zip(ht.vsplit(x, 2), np.vsplit(t, 2)):
+            self.assert_array_equal(got, want)
+        for got, want in zip(ht.dsplit(x, 2), np.dsplit(t, 2)):
+            self.assert_array_equal(got, want)
+
+    def test_split_rejects_uneven_sections(self):
+        x = ht.arange(7, split=0)
+        with pytest.raises((ValueError, TypeError)):
+            ht.split(x, 2)
+
+
+class TestFlipRotTable(TestCase):
+    def test_flip_multi_axis(self):
+        p = self.comm.size
+        t = np.arange((p + 1) * 6, dtype=np.float32).reshape(p + 1, 2, 3)
+        for split in (None, 0, 2):
+            x = ht.array(t, split=split)
+            for axis in (None, 0, (0, 2), (1,)):
+                self.assert_array_equal(ht.flip(x, axis), np.flip(t, axis))
+
+    def test_rot90_k_sweep(self):
+        m = np.arange(12, dtype=np.float32).reshape(3, 4)
+        for split in (None, 0, 1):
+            x = ht.array(m, split=split)
+            for k in (0, 1, 2, 3, 4, -1):
+                self.assert_array_equal(ht.rot90(x, k), np.rot90(m, k))
+
+    def test_rot90_axes(self):
+        t = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+        x = ht.array(t, split=0)
+        self.assert_array_equal(
+            ht.rot90(x, 1, axes=(1, 2)), np.rot90(t, 1, axes=(1, 2))
+        )
+
+
+class TestSortDeep(TestCase):
+    def test_sort_index_gather_matches(self):
+        # returned indices must reproduce the sorted values via take
+        rng = np.random.default_rng(11)
+        n = 4 * self.comm.size + 1
+        a = rng.standard_normal(n).astype(np.float32)
+        got, idx = ht.sort(ht.array(a, split=0))
+        np.testing.assert_allclose(a[idx.numpy()], np.sort(a), rtol=1e-6)
+
+    def test_sort_with_duplicates_stable_order(self):
+        a = np.asarray([3, 1, 3, 1, 2, 2, 3, 1] * self.comm.size, dtype=np.float32)
+        got, idx = ht.sort(ht.array(a, split=0))
+        self.assert_array_equal(got, np.sort(a))
+        # stability: ties keep ascending original index
+        i = idx.numpy()
+        v = got.numpy()
+        for k in range(len(v) - 1):
+            if v[k] == v[k + 1]:
+                assert i[k] < i[k + 1]
+
+    def test_sort_descending_every_axis(self):
+        rng = np.random.default_rng(12)
+        m = rng.standard_normal((self.comm.size + 1, 5)).astype(np.float32)
+        for split in (None, 0, 1):
+            for axis in (0, 1):
+                got, _ = ht.sort(ht.array(m, split=split), axis=axis, descending=True)
+                self.assert_array_equal(got, -np.sort(-m, axis=axis))
+
+    def test_sort_int_dtype(self):
+        rng = np.random.default_rng(13)
+        a = rng.integers(-50, 50, size=3 * self.comm.size + 2).astype(np.int32)
+        got, _ = ht.sort(ht.array(a, split=0))
+        np.testing.assert_array_equal(got.numpy(), np.sort(a))
+
+    def test_topk_matrix_dims(self):
+        rng = np.random.default_rng(14)
+        m = rng.standard_normal((self.comm.size + 1, 6)).astype(np.float32)
+        for split in (None, 0, 1):
+            vals, idx = ht.topk(ht.array(m, split=split), 3, dim=1)
+            np.testing.assert_allclose(
+                vals.numpy(), -np.sort(-m, axis=1)[:, :3], rtol=1e-6
+            )
+
+
+class TestUniqueDeep(TestCase):
+    def test_unique_inverse_reconstructs_across_sizes(self):
+        rng = np.random.default_rng(15)
+        for n in (1, self.comm.size, 5 * self.comm.size + 3):
+            a = rng.integers(0, 7, size=n).astype(np.int64)
+            got, inv = ht.unique(ht.array(a, split=0), sorted=True, return_inverse=True)
+            np.testing.assert_array_equal(got.numpy(), np.unique(a))
+            np.testing.assert_array_equal(got.numpy()[inv.numpy()], a)
+
+    def test_unique_all_identical(self):
+        a = np.full(2 * self.comm.size + 1, 4.0, dtype=np.float32)
+        got = ht.unique(ht.array(a, split=0), sorted=True)
+        np.testing.assert_array_equal(got.numpy(), [4.0])
+
+    def test_unique_already_distinct(self):
+        n = self.comm.size + 2
+        a = np.arange(n, dtype=np.float32)[::-1].copy()
+        got = ht.unique(ht.array(a, split=0), sorted=True)
+        np.testing.assert_array_equal(got.numpy(), np.arange(n))
+
+    def test_unique_axis_rows(self):
+        m = np.asarray([[1, 2], [3, 4], [1, 2], [5, 6]], dtype=np.float32)
+        got = ht.unique(ht.array(m, split=0), sorted=True, axis=0)
+        np.testing.assert_array_equal(got.numpy(), np.unique(m, axis=0))
+
+    def test_unique_result_is_split(self):
+        a = np.arange(4 * self.comm.size, dtype=np.float32) % 5
+        got = ht.unique(ht.array(a, split=0), sorted=True)
+        assert got.split == 0
+
+
+class TestDiagTable(TestCase):
+    def test_diag_offsets_both_ways(self):
+        m = np.arange(25, dtype=np.float32).reshape(5, 5)
+        for split in (None, 0, 1):
+            x = ht.array(m, split=split)
+            for k in (-2, -1, 0, 1, 2):
+                self.assert_array_equal(ht.diag(x, offset=k), np.diag(m, k=k))
+
+    def test_diag_vector_to_matrix_offsets(self):
+        v = np.asarray([1.0, 2.0, 3.0], dtype=np.float32)
+        for split in (None, 0):
+            x = ht.array(v, split=split)
+            for k in (-1, 0, 2):
+                self.assert_array_equal(ht.diag(x, offset=k), np.diag(v, k=k))
+
+    def test_diagonal_rectangular(self):
+        m = np.arange(12, dtype=np.float32).reshape(3, 4)
+        for split in (None, 0, 1):
+            x = ht.array(m, split=split)
+            for k in (-1, 0, 1, 2):
+                self.assert_array_equal(
+                    ht.diagonal(x, offset=k), np.diagonal(m, offset=k)
+                )
+
+    def test_diagonal_3d_planes(self):
+        t = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+        x = ht.array(t, split=0)
+        self.assert_array_equal(
+            ht.diagonal(x, dim1=1, dim2=2), np.diagonal(t, axis1=1, axis2=2)
+        )
+
+
+class TestResplitChains(TestCase):
+    def test_full_cycle_uneven_matrix(self):
+        p = self.comm.size
+        m = np.arange((p + 1) * (p + 2), dtype=np.float32).reshape(p + 1, p + 2)
+        x = ht.array(m, split=0)
+        for target in (1, None, 1, 0, None, 0):
+            x = ht.resplit(x, target)
+            assert x.split == target
+            self.assert_array_equal(x, m)
+
+    def test_resplit_3d_middle_axis(self):
+        p = self.comm.size
+        t = np.arange(2 * (p + 1) * 3, dtype=np.float32).reshape(2, p + 1, 3)
+        x = ht.array(t, split=0)
+        x = ht.resplit(x, 1)
+        assert x.split == 1
+        self.assert_array_equal(x, t)
+        x = ht.resplit(x, 2)
+        assert x.split == 2
+        self.assert_array_equal(x, t)
+
+    def test_method_resplit_inplace(self):
+        m = np.arange(12, dtype=np.float32).reshape(3, 4)
+        x = ht.array(m, split=0)
+        x.resplit_(1)
+        assert x.split == 1
+        self.assert_array_equal(x, m)
+
+
+class TestMoveSwapDeep(TestCase):
+    def test_moveaxis_multi(self):
+        t = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+        for split in (None, 0, 1, 2):
+            x = ht.array(t, split=split)
+            self.assert_array_equal(
+                ht.moveaxis(x, [0, 1], [1, 0]), np.moveaxis(t, [0, 1], [1, 0])
+            )
+            self.assert_array_equal(
+                ht.moveaxis(x, -1, 0), np.moveaxis(t, -1, 0)
+            )
+
+    def test_swapaxes_split_follows(self):
+        p = self.comm.size
+        m = np.arange((p + 1) * 3, dtype=np.float32).reshape(p + 1, 3)
+        x = ht.array(m, split=0)
+        out = ht.swapaxes(x, 0, 1)
+        assert out.split == 1  # the split axis moved with the swap
+        self.assert_array_equal(out, m.T)

@@ -1,0 +1,334 @@
+"""Deep case tables for the op machinery — binary-op split/broadcast/dtype
+combinations, reduction axis sweeps with uneven extents, and scan ops along
+the split axis (reference heat/core/tests/test_arithmetics.py +
+test_operations.py sweep every op across splits and dtypes)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from .basic_test import TestCase
+
+
+class TestBinarySplitCombos(TestCase):
+    """The binary wrapper must handle every (lhs split, rhs split)
+    combination the reference accepts: equal splits, one replicated side,
+    and scalars (reference _operations.py binary sanitation)."""
+
+    def _sweep(self, op, np_op, a, b):
+        want = np_op(a, b)
+        combos = [(None, None), (0, 0), (0, None), (None, 0)]
+        if a.ndim > 1:
+            combos += [(1, 1), (1, None), (None, 1)]
+        for sa, sb in combos:
+            x = ht.array(a, split=sa)
+            y = ht.array(b, split=sb)
+            self.assert_array_equal(op(x, y), want)
+
+    def test_add_matrix_combos(self):
+        p = self.comm.size
+        a = np.arange((p + 1) * 3, dtype=np.float32).reshape(p + 1, 3)
+        self._sweep(ht.add, np.add, a, a * 0.5)
+
+    def test_mul_vector_combos(self):
+        p = self.comm.size
+        a = np.arange(2 * p + 3, dtype=np.float32) + 1
+        self._sweep(ht.mul, np.multiply, a, 1.0 / a)
+
+    def test_pow_combos(self):
+        a = np.linspace(0.5, 2.0, 12, dtype=np.float32).reshape(4, 3)
+        self._sweep(ht.pow, np.power, a, a)
+
+    def test_floordiv_mod_int(self):
+        a = np.arange(1, 13, dtype=np.int32).reshape(4, 3)
+        b = np.full_like(a, 5)
+        self._sweep(ht.floor_divide, np.floor_divide, a, b)
+        self._sweep(ht.mod, np.mod, a, b)
+
+    def test_scalar_operands_both_sides(self):
+        p = self.comm.size
+        a = np.arange(p + 2, dtype=np.float32)
+        x = ht.array(a, split=0)
+        self.assert_array_equal(x + 3, a + 3)
+        self.assert_array_equal(3 + x, 3 + a)
+        self.assert_array_equal(x - 1.5, a - 1.5)
+        self.assert_array_equal(1.5 - x, 1.5 - a)
+        self.assert_array_equal(x * 2, a * 2)
+        self.assert_array_equal(2 / (x + 1), 2 / (a + 1))
+        self.assert_array_equal(x**2, a**2)
+        self.assert_array_equal(2**ht.array(a[:4], split=0), 2 ** a[:4])
+
+    def test_broadcast_row_and_column(self):
+        p = self.comm.size
+        m = np.arange((p + 1) * 4, dtype=np.float32).reshape(p + 1, 4)
+        row = np.arange(4, dtype=np.float32)
+        col = np.arange(p + 1, dtype=np.float32).reshape(p + 1, 1)
+        for split in (None, 0, 1):
+            x = ht.array(m, split=split)
+            self.assert_array_equal(x + ht.array(row), m + row)
+            self.assert_array_equal(x * ht.array(col, split=0 if split == 0 else None), m * col)
+
+    def test_broadcast_rank_mismatch(self):
+        m = np.arange(12, dtype=np.float32).reshape(3, 4)
+        v = np.arange(4, dtype=np.float32)
+        got = ht.add(ht.array(m, split=0), ht.array(v, split=None))
+        self.assert_array_equal(got, m + v)
+
+
+class TestDtypePromotionOps(TestCase):
+    def test_int_float_promote(self):
+        a = np.arange(6, dtype=np.int32)
+        b = np.arange(6, dtype=np.float32)
+        out = ht.add(ht.array(a, split=0), ht.array(b, split=0))
+        assert out.dtype == ht.float32
+        self.assert_array_equal(out, a + b)
+
+    def test_f32_f64_promote(self):
+        a = np.ones(4, dtype=np.float32)
+        b = np.ones(4, dtype=np.float64)
+        out = ht.mul(ht.array(a, split=0), ht.array(b, split=0))
+        assert out.dtype == ht.float64
+
+    def test_bool_int_promote(self):
+        a = np.asarray([True, False, True])
+        b = np.asarray([1, 2, 3], dtype=np.int64)
+        out = ht.add(ht.array(a, split=0), ht.array(b, split=0))
+        assert out.dtype == ht.int64
+        self.assert_array_equal(out, a + b)
+
+    def test_division_always_floats(self):
+        a = np.asarray([1, 2, 3], dtype=np.int32)
+        out = ht.div(ht.array(a, split=0), ht.array(a, split=0))
+        assert out.dtype in (ht.float32, ht.float64)
+        np.testing.assert_allclose(out.numpy(), np.ones(3), rtol=1e-6)
+
+
+class TestReductionAxisSweep(TestCase):
+    def _cases(self):
+        p = self.comm.size
+        rng = np.random.default_rng(21)
+        t = rng.uniform(-2, 2, size=(p + 1, 3, 4)).astype(np.float32)
+        return t
+
+    def test_sum_every_axis_every_split(self):
+        t = self._cases()
+        for split in (None, 0, 1, 2):
+            x = ht.array(t, split=split)
+            for axis in (None, 0, 1, 2, (0, 1), (1, 2), (0, 2)):
+                got = ht.sum(x, axis=axis)
+                want = t.sum(axis=axis)
+                if isinstance(got, ht.DNDarray) and got.ndim:
+                    self.assert_array_equal(got, want, rtol=1e-4, atol=1e-4)
+                else:
+                    np.testing.assert_allclose(float(got), float(want), rtol=1e-4)
+
+    def test_keepdims_shapes(self):
+        t = self._cases()
+        x = ht.array(t, split=0)
+        for axis in (0, 1, (0, 2)):
+            got = ht.sum(x, axis=axis, keepdims=True)
+            self.assert_array_equal(
+                got, t.sum(axis=axis, keepdims=True), rtol=1e-4, atol=1e-4
+            )
+
+    def test_prod_along_split(self):
+        p = self.comm.size
+        a = np.linspace(0.9, 1.1, p + 3).astype(np.float32)
+        got = ht.prod(ht.array(a, split=0))
+        np.testing.assert_allclose(float(got), float(np.prod(a)), rtol=1e-5)
+
+    def test_mean_max_min_uneven(self):
+        t = self._cases()
+        for split in (None, 0, 1):
+            x = ht.array(t, split=split)
+            np.testing.assert_allclose(float(ht.mean(x)), t.mean(), rtol=1e-5)
+            np.testing.assert_allclose(float(ht.max(x)), t.max(), rtol=1e-6)
+            np.testing.assert_allclose(float(ht.min(x)), t.min(), rtol=1e-6)
+
+    def test_reduction_empty_axis_tuple_matches_numpy(self):
+        t = self._cases()
+        x = ht.array(t, split=0)
+        got = ht.sum(x, axis=())
+        self.assert_array_equal(got, t.sum(axis=()), rtol=1e-6)
+
+
+class TestScanOps(TestCase):
+    def test_cumsum_along_split_uneven(self):
+        p = self.comm.size
+        a = np.arange(3 * p + 2, dtype=np.float32)
+        for split in (None, 0):
+            got = ht.cumsum(ht.array(a, split=split), axis=0)
+            self.assert_array_equal(got, np.cumsum(a), rtol=1e-5)
+
+    def test_cumsum_matrix_both_axes(self):
+        p = self.comm.size
+        m = np.arange((p + 1) * 3, dtype=np.float32).reshape(p + 1, 3)
+        for split in (None, 0, 1):
+            x = ht.array(m, split=split)
+            for axis in (0, 1):
+                self.assert_array_equal(
+                    ht.cumsum(x, axis=axis), np.cumsum(m, axis=axis), rtol=1e-5
+                )
+
+    def test_cumprod_stability(self):
+        a = np.full(10, 1.01, dtype=np.float32)
+        got = ht.cumprod(ht.array(a, split=0), axis=0)
+        self.assert_array_equal(got, np.cumprod(a), rtol=1e-5)
+
+    def test_diff_orders_and_axes(self):
+        p = self.comm.size
+        m = np.cumsum(
+            np.arange((p + 1) * 4, dtype=np.float32).reshape(p + 1, 4), axis=0
+        )
+        for split in (None, 0, 1):
+            x = ht.array(m, split=split)
+            for n in (1, 2):
+                for axis in (0, 1):
+                    self.assert_array_equal(
+                        ht.diff(x, n=n, axis=axis), np.diff(m, n=n, axis=axis)
+                    )
+
+
+class TestInplaceOperators(TestCase):
+    def test_iadd_isub(self):
+        a = np.arange(6, dtype=np.float32)
+        x = ht.array(a.copy(), split=0)
+        x += 2
+        self.assert_array_equal(x, a + 2)
+        x -= 1
+        self.assert_array_equal(x, a + 1)
+
+    def test_imul_idiv(self):
+        a = np.arange(1, 7, dtype=np.float32)
+        x = ht.array(a.copy(), split=0)
+        x *= 3
+        self.assert_array_equal(x, a * 3)
+        x /= 3
+        self.assert_array_equal(x, a, rtol=1e-6)
+
+    def test_inplace_with_array_rhs(self):
+        a = np.arange(6, dtype=np.float32)
+        x = ht.array(a.copy(), split=0)
+        x += ht.array(a, split=0)
+        self.assert_array_equal(x, 2 * a)
+
+
+class TestUnaryEdgeValues(TestCase):
+    def test_sign_zero_and_negzero(self):
+        a = np.asarray([-3.0, -0.0, 0.0, 5.0], dtype=np.float32)
+        got = ht.sign(ht.array(a, split=0))
+        np.testing.assert_array_equal(got.numpy(), np.sign(a))
+
+    def test_clip_scalar_and_array_bounds(self):
+        p = self.comm.size
+        a = np.linspace(-5, 5, p + 3).astype(np.float32)
+        x = ht.array(a, split=0)
+        self.assert_array_equal(ht.clip(x, -1, 1), np.clip(a, -1, 1))
+        self.assert_array_equal(ht.clip(x, None, 0), np.clip(a, None, 0))
+        self.assert_array_equal(ht.clip(x, 0, None), np.clip(a, 0, None))
+
+    def test_round_decimals(self):
+        a = np.asarray([1.2345, -2.718, 3.14159], dtype=np.float32)
+        x = ht.array(a, split=0)
+        self.assert_array_equal(ht.round(x, 2), np.round(a, 2), rtol=1e-5)
+
+    def test_trunc_ceil_floor_negative(self):
+        a = np.asarray([-1.7, -0.2, 0.2, 1.7], dtype=np.float32)
+        x = ht.array(a, split=0)
+        self.assert_array_equal(ht.trunc(x), np.trunc(a))
+        self.assert_array_equal(ht.ceil(x), np.ceil(a))
+        self.assert_array_equal(ht.floor(x), np.floor(a))
+
+    def test_abs_int_preserves_dtype(self):
+        a = np.asarray([-3, -1, 2], dtype=np.int32)
+        got = ht.abs(ht.array(a, split=0))
+        assert got.dtype == ht.int32
+        np.testing.assert_array_equal(got.numpy(), np.abs(a))
+
+
+class TestShiftOps(TestCase):
+    def test_left_right_shift(self):
+        a = np.asarray([1, 2, 4, 8], dtype=np.int32)
+        x = ht.array(a, split=0)
+        self.assert_array_equal(ht.left_shift(x, 2), a << 2)
+        self.assert_array_equal(ht.right_shift(x, 1), a >> 1)
+
+    def test_bitwise_table(self):
+        a = np.asarray([0b1100, 0b1010], dtype=np.int32)
+        b = np.asarray([0b1010, 0b0110], dtype=np.int32)
+        x, y = ht.array(a, split=0), ht.array(b, split=0)
+        self.assert_array_equal(ht.bitwise_and(x, y), a & b)
+        self.assert_array_equal(ht.bitwise_or(x, y), a | b)
+        self.assert_array_equal(ht.bitwise_xor(x, y), a ^ b)
+        self.assert_array_equal(ht.bitwise_not(x), ~a)
+
+
+class TestRelationalSweep(TestCase):
+    def test_all_six_across_splits(self):
+        p = self.comm.size
+        rng = np.random.default_rng(22)
+        a = rng.integers(0, 4, size=(p + 1, 3)).astype(np.float32)
+        b = rng.integers(0, 4, size=(p + 1, 3)).astype(np.float32)
+        pairs = [
+            (ht.eq, np.equal), (ht.ne, np.not_equal), (ht.lt, np.less),
+            (ht.le, np.less_equal), (ht.gt, np.greater), (ht.ge, np.greater_equal),
+        ]
+        for split in (None, 0, 1):
+            x = ht.array(a, split=split)
+            y = ht.array(b, split=split)
+            for hop, nop in pairs:
+                got = hop(x, y)
+                np.testing.assert_array_equal(
+                    got.numpy().astype(bool), nop(a, b)
+                )
+
+    def test_comparison_operators_dunder(self):
+        a = np.asarray([1.0, 2.0, 3.0], dtype=np.float32)
+        x = ht.array(a, split=0)
+        np.testing.assert_array_equal((x < 2).numpy().astype(bool), a < 2)
+        np.testing.assert_array_equal((x >= 2).numpy().astype(bool), a >= 2)
+        np.testing.assert_array_equal((x == 2).numpy().astype(bool), a == 2)
+        np.testing.assert_array_equal((x != 2).numpy().astype(bool), a != 2)
+
+
+class TestLogicalReductionSplits(TestCase):
+    def test_any_all_axis_uneven(self):
+        p = self.comm.size
+        m = np.zeros((p + 1, 3), dtype=bool)
+        m[0, 0] = True
+        m[-1, 2] = True
+        for split in (None, 0, 1):
+            x = ht.array(m, split=split)
+            np.testing.assert_array_equal(
+                ht.any(x, axis=0).numpy().astype(bool), m.any(axis=0)
+            )
+            np.testing.assert_array_equal(
+                ht.all(x, axis=1).numpy().astype(bool), m.all(axis=1)
+            )
+            assert bool(ht.any(x)) is True
+            assert bool(ht.all(x)) is False
+
+    def test_isclose_tolerance_grid(self):
+        a = np.asarray([1.0, 1.0001, 1.01], dtype=np.float32)
+        b = np.ones(3, dtype=np.float32)
+        x, y = ht.array(a, split=0), ht.array(b, split=0)
+        for rtol in (1e-5, 1e-3, 1e-1):
+            np.testing.assert_array_equal(
+                ht.isclose(x, y, rtol=rtol).numpy().astype(bool),
+                np.isclose(a, b, rtol=rtol),
+            )
+
+    def test_nan_inf_classification(self):
+        a = np.asarray([np.nan, np.inf, -np.inf, 0.0, 1.0], dtype=np.float32)
+        x = ht.array(a, split=0)
+        np.testing.assert_array_equal(ht.isnan(x).numpy().astype(bool), np.isnan(a))
+        np.testing.assert_array_equal(ht.isinf(x).numpy().astype(bool), np.isinf(a))
+        np.testing.assert_array_equal(
+            ht.isfinite(x).numpy().astype(bool), np.isfinite(a)
+        )
+        np.testing.assert_array_equal(
+            ht.isposinf(x).numpy().astype(bool), np.isposinf(a)
+        )
+        np.testing.assert_array_equal(
+            ht.isneginf(x).numpy().astype(bool), np.isneginf(a)
+        )
