@@ -25,6 +25,9 @@ Workloads (BASELINE.json configs):
   * moments     — mean/var over split rows (statistical_moments bench)
   * lasso       — coordinate-descent sweeps (lasso bench; incremental-residual
                   epochs, one jit per sweep)
+  * lm_step     — flagship TransformerLM training step (fwd+bwd+AdamW in one
+                  jit, bf16, Pallas flash core); detail row with model-flops
+                  MFU
 
 Headline metric: geometric-mean achieved GFLOP/s across completed f32
 workloads. `--profile DIR` additionally captures a jax.profiler trace of the
@@ -284,6 +287,64 @@ def bench_heat_tpu(errors, profile_dir=None, small=False):
 
         return run, reps * 2.0 * n * n * n
 
+    def make_lm_step():
+        # flagship-model training step: TransformerLM fwd+bwd+AdamW in one
+        # jit, bf16 activations, Pallas flash core on TPU (the XLA blockwise
+        # core elsewhere — the Pallas kernel would run interpret-mode off-TPU
+        # and stall at full size). Detail row (not in the geomean); counted
+        # flops are 6·matmul_params·tokens (fwd 2 + bwd 4) over the
+        # matmul-participating params only — the embed/pos gather tables
+        # contribute no GEMM flops and are excluded, attention flops are
+        # also excluded; the two roughly offset, making the reported MFU a
+        # fair (not padded) estimate.
+        import optax
+
+        from heat_tpu.nn import TransformerLM
+
+        on_tpu = jax.devices()[0].platform == "tpu"
+        (v, dm, nh, nl, b, t, reps) = (
+            (256, 128, 4, 2, 2, 128, 2) if small else (32768, 1024, 16, 12, 8, 1024, 8)
+        )
+        lm = TransformerLM(
+            vocab_size=v, d_model=dm, num_heads=nh, num_layers=nl,
+            max_len=t, attn_impl="flash" if on_tpu else "local",
+            remat=True, dtype=jnp.bfloat16,
+        )
+        key = jax.random.PRNGKey(0)
+        toks = jax.random.randint(key, (b, t), 0, v, dtype=jnp.int32)
+        params = lm.init(key, toks)
+        opt = optax.adamw(1e-3)
+        opt_state = opt.init(params)
+        n_params = sum(
+            int(np.prod(leaf.shape))
+            for path, leaf in jax.tree_util.tree_leaves_with_path(params)
+            if not any(
+                getattr(k, "key", None) in ("embed", "pos") for k in path
+            )
+        )
+
+        def loss_fn(p, tk):
+            logits = lm.apply(p, tk)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits[:, :-1].astype(jnp.float32), tk[:, 1:]
+            ).mean()
+
+        @jax.jit
+        def steps(p, s, tk):
+            def body(_, carry):
+                p_, s_ = carry
+                _, g = jax.value_and_grad(loss_fn)(p_, tk)
+                u, s_ = opt.update(g, s_, p_)
+                return optax.apply_updates(p_, u), s_
+
+            return jax.lax.fori_loop(0, reps, body, (p, s))
+
+        def run():
+            p, _ = steps(params, opt_state, toks)
+            return _sync(jax.tree.leaves(p)[0].astype(jnp.float32))
+
+        return run, reps * 6.0 * n_params * b * t
+
     workloads = [
         ("matmul", make_matmul),
         ("matmul_f32", make_matmul_f32),
@@ -294,6 +355,7 @@ def bench_heat_tpu(errors, profile_dir=None, small=False):
         ("lasso", make_lasso),
         ("attention", make_attention),
         ("matmul_int8", make_matmul_int8),
+        ("lm_step", make_lm_step),
     ]
 
     results = {}
@@ -434,7 +496,7 @@ def main():
     f32 = {
         k: v
         for k, v in ours.items()
-        if k not in ("matmul_bf16", "matmul_f32", "attention", "matmul_int8")
+        if k not in ("matmul_bf16", "matmul_f32", "attention", "matmul_int8", "lm_step")
     }
     geo_ours = float(np.exp(np.mean([np.log(v) for v in f32.values()]))) if f32 else 0.0
     # vs_baseline compares geomeans over the SAME workload subset, so a
@@ -477,6 +539,10 @@ def main():
         detail["matmul_int8_vs_bf16_peak"] = round(
             ours["matmul_int8"] / peak_single, 3
         )
+    if peak_single and "lm_step" in ours:
+        # model-flops utilization of the full training step (6·N·T counted
+        # flops over matmul-participating params; attention excluded)
+        detail["lm_step_mfu"] = round(ours["lm_step"] / peak_single, 3)
     if errors:
         detail["errors"] = errors
     print(json.dumps(detail), file=sys.stderr, flush=True)
