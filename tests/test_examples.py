@@ -74,3 +74,10 @@ class TestExamples:
         r = _run("examples/long_context/ring_attention_demo.py", timeout=300)
         assert r.returncode == 0, r.stderr[-1500:]
         assert "max |diff|" in r.stdout
+
+    def test_scaleout_tour(self):
+        # pipeline/expert/FSDP schedules each check against their oracle
+        # internally; the script asserts and exits non-zero on mismatch
+        r = _run("examples/nn/scaleout_tour.py", timeout=420)
+        assert r.returncode == 0, r.stderr[-1500:]
+        assert "all three schedules match" in r.stdout
