@@ -41,6 +41,7 @@ __all__ = [
     "seed",
     "set_state",
     "standard_normal",
+    "uniform",
 ]
 
 # global generator state (reference random.py:39-42)
@@ -106,6 +107,26 @@ def normal(mean=0.0, std=1.0, shape=None, dtype=types.float32, split=None, devic
         raise ValueError("dtype must be a float type")
     data = jax.random.normal(_next_key(), shape, dtype=dtype.jnp_type())
     data = data * jnp.asarray(std, data.dtype) + jnp.asarray(mean, data.dtype)
+    return _wrap(data, split, device, comm, dtype)
+
+
+def uniform(low=0.0, high=1.0, size=None, dtype=types.float32, split=None, device=None, comm=None) -> DNDarray:
+    """Uniform samples in [low, high) (numpy-style extension; the
+    reference's uniform surface is ``rand``/``random_sample``, reference
+    random.py:396). Array-valued bounds broadcast, as in numpy."""
+    if size is None:
+        # numpy semantics: sample shape follows the broadcast bounds
+        shape = np.broadcast_shapes(np.shape(low), np.shape(high))
+    else:
+        shape = sanitize_shape(size)
+    dtype = types.canonical_heat_type(dtype)
+    if not issubclass(dtype, types.floating):
+        raise ValueError("dtype must be a float type")
+    jt = dtype.jnp_type()
+    data = jax.random.uniform(
+        _next_key(), shape, dtype=jt,
+        minval=jnp.asarray(low, jt), maxval=jnp.asarray(high, jt),
+    )
     return _wrap(data, split, device, comm, dtype)
 
 

@@ -1,0 +1,292 @@
+"""Deep checks for the user-facing surface layers — print-option grids,
+tiling calculus edge cases, communicator spec/chunk grids on 3-D shapes,
+nn/optim passthrough integrity, data tools edge behavior, matrixgallery
+(reference heat/core/tests/test_printing.py + test_tiling.py +
+utils/data/tests)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from heat_tpu.core.tiling import SplitTiles, SquareDiagTiles
+from .basic_test import TestCase
+
+
+class TestPrintOptionsGrid(TestCase):
+    def setUp(self):
+        self._saved = ht.get_printoptions()
+
+    def tearDown(self):
+        np.set_printoptions(**{
+            k: self._saved[k]
+            for k in ("precision", "threshold", "edgeitems", "linewidth")
+        })
+
+    def test_precision_controls_rendering(self):
+        x = ht.array(np.asarray([1.23456789], dtype=np.float64), split=0)
+        ht.set_printoptions(precision=2)
+        assert "1.23" in str(x) and "1.2346" not in str(x)
+        ht.set_printoptions(precision=6)
+        assert "1.234568" in str(x)
+
+    def test_profiles(self):
+        x = ht.arange(2000, dtype=ht.float32, split=0)
+        ht.set_printoptions(profile="default")
+        short = str(x)
+        assert "..." in short  # summarized past threshold
+        ht.set_printoptions(profile="full")
+        full = str(x)
+        assert len(full) > 10 * len(short)  # all 2000 values rendered
+        ht.set_printoptions(profile="short")
+        assert ht.get_printoptions()["precision"] == 2
+
+    def test_threshold_and_edgeitems(self):
+        x = ht.arange(100, dtype=ht.float32, split=0)
+        ht.set_printoptions(threshold=10, edgeitems=2)
+        s = str(x)
+        assert "..." in s
+        assert "0." in s and "99." in s  # both edges survive
+
+    def test_options_roundtrip_dict(self):
+        ht.set_printoptions(precision=5, linewidth=120)
+        opts = ht.get_printoptions()
+        assert opts["precision"] == 5 and opts["linewidth"] == 120
+
+    def test_split_invariant_rendering(self):
+        # the value text must not depend on the layout (the trailing
+        # metadata names the split, so compare up to the dtype suffix)
+        m = np.arange(12, dtype=np.float32).reshape(3, 4)
+        strs = {
+            str(ht.array(m, split=s)).split("dtype")[0] for s in (None, 0, 1)
+        }
+        assert len(strs) == 1
+
+
+class TestSplitTilesDeep(TestCase):
+    def test_uneven_every_dim(self):
+        # both extents indivisible: tile grid must still cover exactly
+        p = self.comm.size
+        n, m = p + 1, 2 * p + 3
+        x = ht.zeros((n, m), split=0)
+        tiles = SplitTiles(x)
+        dims = tiles.tile_dimensions
+        assert dims[0].sum() == n and dims[1].sum() == m
+        # every (i, j) tile stitches back into the full array
+        acc = np.zeros((n, m), dtype=np.float32)
+        r = 0
+        for i in range(p):
+            c = 0
+            ri = int(dims[0][i])
+            for j in range(p):
+                cj = int(dims[1][j])
+                if ri and cj:
+                    acc[r : r + ri, c : c + cj] = np.asarray(tiles[i, j])
+                c += cj
+            r += ri
+        np.testing.assert_array_equal(acc, x.numpy())
+
+    def test_set_then_get_roundtrip_uneven(self):
+        p = self.comm.size
+        x = ht.zeros((2 * p + 1, 3), split=0)
+        tiles = SplitTiles(x)
+        shape = tiles.get_tile_size((p - 1, 0))
+        if 0 in shape:
+            pytest.skip("tail tile empty at this mesh size")
+        block = np.full(shape, 7.0, dtype=np.float32)
+        tiles[p - 1, 0] = block
+        np.testing.assert_array_equal(np.asarray(tiles[p - 1, 0]), block)
+        assert float(x.numpy().sum()) == block.sum()
+
+
+class TestSquareDiagTilesDeep(TestCase):
+    def test_uneven_tall_boundaries(self):
+        p = self.comm.size
+        m, n = 5 * p + 2, 7
+        x = ht.zeros((m, n), split=0)
+        t = SquareDiagTiles(x, tiles_per_proc=2)
+        rows = [int(v) for v in np.asarray(t.row_indices)]
+        cols = [int(v) for v in np.asarray(t.col_indices)]
+        assert rows[0] == 0 and cols[0] == 0
+        assert all(b > a for a, b in zip(rows, rows[1:]))
+        assert all(b > a for a, b in zip(cols, cols[1:]))
+
+    def test_tile_get_matches_global_slice(self):
+        p = self.comm.size
+        m = 4 * p
+        a = np.arange(m * m, dtype=np.float32).reshape(m, m)
+        x = ht.array(a, split=0)
+        t = SquareDiagTiles(x, tiles_per_proc=1)
+        blk = np.asarray(t[0, 0])
+        np.testing.assert_array_equal(blk, a[: blk.shape[0], : blk.shape[1]])
+
+
+class TestCommSpec3D(TestCase):
+    def test_spec_every_axis(self):
+        comm = self.comm
+        from jax.sharding import PartitionSpec
+
+        for ndim in (1, 2, 3, 4):
+            for ax in range(ndim):
+                s = comm.spec(ax, ndim)
+                expect = [None] * ndim
+                expect[ax] = comm.axis_name
+                assert s == PartitionSpec(*expect)
+
+    def test_chunk_3d_middle_axis(self):
+        comm = self.comm
+        n = 2 * comm.size + 1
+        covered = []
+        for r in range(comm.size):
+            off, lshape, sl = comm.chunk((3, n, 2), 1, r)
+            assert lshape[0] == 3 and lshape[2] == 2
+            covered.extend(range(off, off + lshape[1]))
+        assert covered == list(range(n))
+
+    def test_padded_shape_3d(self):
+        comm = self.comm
+        p = comm.size
+        got = comm.padded_shape((2, p + 1, 3), 1)
+        assert got == (2, comm.padded_size(p + 1), 3)
+
+    def test_lshape_map_3d(self):
+        comm = self.comm
+        n = 3 * comm.size + 1
+        m = comm.lshape_map((2, 4, n), 2)
+        assert m.shape == (comm.size, 3)
+        assert m[:, 2].sum() == n
+        assert (m[:, 0] == 2).all() and (m[:, 1] == 4).all()
+
+
+class TestNamespacePassthroughs(TestCase):
+    """The reference's nn/optim modules are dynamic torch passthroughs
+    (reference nn/__init__.py:19-31); here they forward to flax/optax —
+    the passthrough must expose the target library's surface faithfully."""
+
+    def test_nn_forwards_flax(self):
+        import flax.linen as fnn
+
+        assert ht.nn.Dense is fnn.Dense
+        assert ht.nn.Conv is fnn.Conv
+        assert ht.nn.LayerNorm is fnn.LayerNorm
+
+    def test_nn_native_overrides_win(self):
+        from heat_tpu.nn.transformer import TransformerLM
+
+        assert ht.nn.TransformerLM is TransformerLM
+
+    def test_optim_forwards_optax(self):
+        import optax
+
+        assert ht.optim.adam is optax.adam
+        assert ht.optim.sgd is optax.sgd
+
+    def test_functional_forwards_jax_nn(self):
+        import jax
+
+        assert ht.nn.functional.relu is jax.nn.relu
+        assert ht.nn.functional.softmax is jax.nn.softmax
+
+    def test_unknown_attribute_raises(self):
+        with pytest.raises(AttributeError):
+            ht.nn.definitely_not_a_module_xyz
+        with pytest.raises(AttributeError):
+            ht.optim.definitely_not_an_optimizer_xyz
+
+
+class TestDataToolsEdges(TestCase):
+    def test_loader_batches_partition_dataset(self):
+        p = self.comm.size
+        n = 4 * p
+        x = ht.arange(n, dtype=ht.float32, split=0)
+        dl = ht.utils.data.DataLoader(x, batch_size=p, shuffle=False)
+        seen = []
+        for (batch,) in dl:
+            seen.extend(np.asarray(batch).ravel().tolist())
+        assert sorted(seen) == list(range(n))
+
+    def test_loader_with_targets_alignment(self):
+        p = self.comm.size
+        n = 4 * p
+        x = ht.arange(n, dtype=ht.float32, split=0)
+        y = ht.arange(n, dtype=ht.float32, split=0) * 10
+        ds = ht.utils.data.Dataset(x, targets=y)
+        dl = ht.utils.data.DataLoader(ds, batch_size=2 * p, shuffle=True)
+        for _ in range(2):  # epoch 2 is shuffled; alignment must survive
+            for xb, yb in dl:
+                np.testing.assert_allclose(
+                    np.asarray(yb), np.asarray(xb) * 10, rtol=1e-6
+                )
+
+    def test_shuffle_changes_order_preserves_multiset(self):
+        p = self.comm.size
+        n = 8 * p
+        x = ht.arange(n, dtype=ht.float32, split=0)
+        ds = ht.utils.data.Dataset(x)
+        before = np.asarray(ds.data).copy()
+        ht.utils.data.dataset_shuffle(ds, [["data", None]])
+        after = np.asarray(ds.data)
+        assert sorted(after.tolist()) == sorted(before.tolist())
+
+    def test_test_set_flag_rejects_shuffle(self):
+        x = ht.arange(4 * self.comm.size, dtype=ht.float32, split=0)
+        ds = ht.utils.data.Dataset(x, test_set=True)
+        before = np.asarray(ds.data).copy()
+        ds.Shuffle()  # reference-parity name; must be a no-op on test sets
+        np.testing.assert_array_equal(np.asarray(ds.data), before)
+
+    def test_matrixgallery_parter_formula(self):
+        n = 2 * self.comm.size
+        for split in (None, 0, 1):
+            x = ht.utils.data.matrixgallery.parter(n, split=split)
+            i = np.arange(n)[:, None]
+            j = np.arange(n)[None, :]
+            want = 1.0 / (j - i + 0.5)
+            np.testing.assert_allclose(x.numpy(), want, rtol=1e-5)
+
+
+class TestRandomExtendedGrid(TestCase):
+    def test_uniform_bounds_grid(self):
+        ht.random.seed(99)
+        for lo, hi in [(0.0, 1.0), (-3.0, 3.0), (10.0, 11.0)]:
+            x = ht.random.uniform(lo, hi, (4 * self.comm.size,), split=0)
+            v = x.numpy()
+            assert (v >= lo).all() and (v < hi).all()
+
+    def test_uniform_array_bounds_broadcast(self):
+        ht.random.seed(11)
+        lo = np.asarray([0.0, 10.0, -5.0], dtype=np.float32)
+        hi = np.asarray([1.0, 20.0, -4.0], dtype=np.float32)
+        x = ht.random.uniform(lo, hi)  # shape follows the broadcast bounds
+        assert tuple(x.shape) == (3,)
+        v = x.numpy()
+        assert ((v >= lo) & (v < hi)).all()
+        y = ht.random.uniform(lo, hi, (4, 3), split=0)
+        assert tuple(y.shape) == (4, 3)
+        assert ((y.numpy() >= lo) & (y.numpy() < hi)).all()
+
+    def test_normal_shifted_moments(self):
+        ht.random.seed(7)
+        x = ht.random.normal(5.0, 2.0, (20000,), split=0)
+        v = x.numpy()
+        assert abs(v.mean() - 5.0) < 0.1
+        assert abs(v.std() - 2.0) < 0.1
+
+    def test_randint_full_range_hit(self):
+        ht.random.seed(3)
+        x = ht.random.randint(0, 4, (1000,), split=0)
+        assert set(np.unique(x.numpy()).tolist()) == {0, 1, 2, 3}
+
+    def test_rand_shape_forms(self):
+        a = ht.random.rand(6)
+        assert tuple(a.shape) == (6,)
+        b = ht.random.rand(2, 3, split=0)
+        assert tuple(b.shape) == (2, 3) and b.split == 0
+
+    def test_state_restores_stream(self):
+        ht.random.seed(42)
+        _ = ht.random.randn(5)
+        st = ht.random.get_state()
+        a = ht.random.randn(7, split=0).numpy()
+        ht.random.set_state(st)
+        b = ht.random.randn(7, split=0).numpy()
+        np.testing.assert_array_equal(a, b)
