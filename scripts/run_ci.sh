@@ -1,13 +1,53 @@
 #!/usr/bin/env bash
 # CI sweep (reference: Jenkinsfile:19-27 runs the whole suite under
-# `mpirun -n {1..8}`). The TPU-native analog re-runs the suite over virtual
-# CPU meshes of several sizes — divisible and ragged — so every sharding
-# path is exercised at every world size.
+# `mpirun -n {1..8}` with coverage, then merges the per-size coverage files
+# and archives junit XML, Jenkinsfile:33-44). The TPU-native analog re-runs
+# the suite over virtual CPU meshes of several sizes — divisible and ragged
+# — so every sharding path is exercised at every world size.
+#
+# Usage:
+#   scripts/run_ci.sh                 # plain sweep (1 2 3 5 8)
+#   CI_REPORT_DIR=out scripts/run_ci.sh
+#       # + junit XML per device count (out/junit_<n>.xml) and, when the
+#       # `coverage` module is available, per-size coverage data merged
+#       # into one report (out/coverage.txt) — the Jenkinsfile analog
+#   HEAT_TPU_CI_SIZES="2 8" scripts/run_ci.sh   # custom size list
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-for n in 1 2 3 5 8; do
+SIZES=${HEAT_TPU_CI_SIZES:-"1 2 3 5 8"}
+REPORT=${CI_REPORT_DIR:-}
+
+have_coverage=0
+if [ -n "$REPORT" ]; then
+    mkdir -p "$REPORT"
+    # drop artifacts of previous (possibly aborted or differently-sized)
+    # runs so the merge below only sees this sweep's data
+    rm -f "$REPORT"/.coverage* "$REPORT"/junit_*.xml "$REPORT"/coverage.txt
+    if python -c "import coverage" 2>/dev/null; then
+        have_coverage=1
+    fi
+fi
+
+for n in $SIZES; do
     echo "=== suite @ ${n} virtual devices ==="
-    HEAT_TPU_TEST_DEVICES=$n python -m pytest tests/ -q -p no:cacheprovider
+    args=(-q -p no:cacheprovider)
+    if [ -n "$REPORT" ]; then
+        args+=("--junitxml=${REPORT}/junit_${n}.xml")
+    fi
+    if [ "$have_coverage" = 1 ]; then
+        HEAT_TPU_TEST_DEVICES=$n COVERAGE_FILE="${REPORT}/.coverage.${n}" \
+            python -m coverage run --source=heat_tpu -m pytest tests/ "${args[@]}"
+    else
+        HEAT_TPU_TEST_DEVICES=$n python -m pytest tests/ "${args[@]}"
+    fi
 done
+
+if [ "$have_coverage" = 1 ]; then
+    # merge the per-size coverage files, as the reference CI merges its
+    # 8 mpirun passes (Jenkinsfile:33-44 / codecov)
+    (cd "$REPORT" && python -m coverage combine .coverage.* \
+        && python -m coverage report --include='*/heat_tpu/*' > coverage.txt \
+        && tail -1 coverage.txt)
+fi
 echo "=== all device counts green ==="
