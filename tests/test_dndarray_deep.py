@@ -149,6 +149,8 @@ class TestArrayProtocol(TestCase):
 class TestPerfCounters(TestCase):
     def test_relayout_advances_counters_then_reset(self):
         p = self.comm.size
+        if p < 2:
+            pytest.skip("1-device resplit is a no-op — nothing to count")
         reset_perf_stats()
         # an uneven resplit must go through the logical view: at least one
         # pad-slice or re-pad or device_put is mandatory

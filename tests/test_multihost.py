@@ -60,6 +60,45 @@ except NotImplementedError:
 else:
     raise AssertionError("misaligned is_split blocks must raise")
 
+# ======= stage 2: real compute across the two hosts =======================
+# Verification discipline: results of cross-host ops are checked through
+# replicated scalars (psum'd reductions / .item()) — gathering a
+# non-fully-addressable array to one host is exactly what multi-host
+# forbids, and the guards enforce that.
+
+# elementwise chain on the split array (physical path, no relayout)
+y = (x * 2.0 + 1.0) / 2.0
+assert abs(float(ht.sum(y).item()) - (sum(range(n)) + 0.5 * n)) < 1e-4
+
+# 2-D assembly + axis reduction: rows [0,6) on proc0, [6,10) on proc1
+m2 = np.stack([local, 10.0 * local], axis=1)  # (local_rows, 2)
+X2 = ht.array(m2, is_split=0)
+assert X2.shape == (n, 2) and X2.split == 0
+col = ht.sum(X2, axis=0)  # replicated (2,)
+s0, s1 = float(col[0].item()), float(col[1].item())
+assert abs(s0 - sum(range(n))) < 1e-3 and abs(s1 - 10.0 * sum(range(n))) < 1e-2
+
+# distributed matmul: (n,2) split=0 @ (2,2) replicated -> (n,2) split=0
+W = ht.array(np.asarray([[1.0, 1.0], [0.0, 1.0]], dtype=np.float32))
+P = ht.matmul(X2, W)
+assert P.split == 0 and P.shape == (n, 2)
+# column sums of the product: [sum(x), sum(x) + 10 sum(x)]
+pc = ht.sum(P, axis=0)
+assert abs(float(pc[0].item()) - sum(range(n))) < 1e-3
+assert abs(float(pc[1].item()) - 11.0 * sum(range(n))) < 1e-2
+
+# mean/var over the split axis (pad-neutralized cross-host reductions)
+mu = float(ht.mean(x).item())
+assert abs(mu - (n - 1) / 2.0) < 1e-5, mu
+
+# distributed sort across the hosts: descending input, shard_map network
+rev = ht.array(local[::-1].copy(), is_split=0)  # locally reversed blocks
+sorted_x, _ = ht.sort(rev)
+# correctness via an on-device comparison against the assembled ascending
+# array (both split=0): max |sorted - x| == 0
+diff = float(ht.max(ht.abs(sorted_x - x)).item())
+assert diff == 0.0, diff
+
 print(f"RANK{rank}_OK", flush=True)
 """
 
