@@ -31,6 +31,7 @@ __all__ = [
     "load_npy",
     "save",
     "save_csv",
+    "supports_checkpoint",
     "supports_hdf5",
     "supports_netcdf",
 ]
@@ -53,6 +54,16 @@ except ImportError:
 def supports_hdf5() -> bool:
     """Whether h5py is available (reference io.py `supports_hdf5`)."""
     return __HDF5
+
+
+def supports_checkpoint() -> bool:
+    """Whether orbax-backed checkpointing is available."""
+    try:  # lazy probe: orbax pulls tensorstore — only needed to checkpoint
+        import orbax.checkpoint  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
 
 
 def supports_netcdf() -> bool:
@@ -205,6 +216,10 @@ def save_checkpoint(state, path: str) -> None:
     TensorStore chunk per shard in parallel — no host gather) plus
     gshape/split metadata, and are restored as DNDarrays by
     :func:`load_checkpoint`."""
+    if not supports_checkpoint():
+        raise RuntimeError(
+            "checkpointing requires orbax (pip install 'heat_tpu[checkpoint]')"
+        )
     import jax
     import orbax.checkpoint as ocp
 
@@ -231,6 +246,10 @@ def load_checkpoint(path: str, like=None, comm=None, device=None):
     pass any pytree with the same structure (e.g. the state object the
     checkpoint was created from). Without it a flat leaf list is returned.
     DNDarray leaves come back re-sharded over ``comm``."""
+    if not supports_checkpoint():
+        raise RuntimeError(
+            "checkpointing requires orbax (pip install 'heat_tpu[checkpoint]')"
+        )
     import jax
     import orbax.checkpoint as ocp
 

@@ -146,7 +146,20 @@ def average(x: DNDarray, axis=None, weights: Optional[DNDarray] = None, returned
     num = arithmetics.sum(arithmetics.mul(x, w), axis)
     den = arithmetics.sum(w, axis)
     avg = arithmetics.div(num, den)
-    return (avg, den) if returned else avg
+    if returned:
+        if tuple(den.shape) != tuple(avg.shape):
+            # numpy contract: sum_of_weights carries the average's shape
+            from . import factories
+
+            den = arithmetics.mul(
+                den,
+                factories.ones(
+                    avg.shape, dtype=den.dtype, split=avg.split,
+                    device=x.device, comm=x.comm,
+                ),
+            )
+        return avg, den
+    return avg
 
 
 def bincount(x: DNDarray, weights: Optional[DNDarray] = None, minlength: int = 0) -> DNDarray:

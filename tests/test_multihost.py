@@ -90,6 +90,8 @@ assert abs(float(pc[1].item()) - 11.0 * sum(range(n))) < 1e-2
 # mean/var over the split axis (pad-neutralized cross-host reductions)
 mu = float(ht.mean(x).item())
 assert abs(mu - (n - 1) / 2.0) < 1e-5, mu
+va = float(ht.var(x).item())
+assert abs(va - float(np.var(np.arange(n)))) < 1e-4, va
 
 # distributed sort across the hosts: descending input, shard_map network
 rev = ht.array(local[::-1].copy(), is_split=0)  # locally reversed blocks

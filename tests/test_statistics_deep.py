@@ -1,0 +1,237 @@
+"""Deep statistics sweeps — arg-reductions, moments, and order statistics
+over axis × split × keepdims grids with uneven extents; weighted variants;
+scipy-free higher-moment oracles (reference
+heat/core/tests/test_statistics.py, 1,334 LoC)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from .basic_test import TestCase
+
+
+def _skew_np(a, axis=None, bias=True):
+    m = a.mean(axis=axis, keepdims=True)
+    d = a - m
+    m2 = (d**2).mean(axis=axis)
+    m3 = (d**3).mean(axis=axis)
+    g = m3 / np.power(m2, 1.5)
+    if bias:
+        return g
+    n = a.shape[axis] if axis is not None else a.size
+    return np.sqrt(n * (n - 1)) / (n - 2) * g
+
+
+def _kurt_np(a, axis=None, fisher=True):
+    m = a.mean(axis=axis, keepdims=True)
+    d = a - m
+    m2 = (d**2).mean(axis=axis)
+    m4 = (d**4).mean(axis=axis)
+    k = m4 / m2**2
+    return k - 3.0 if fisher else k
+
+
+class TestArgReductionGrid(TestCase):
+    def _t(self):
+        rng = np.random.default_rng(61)
+        return rng.standard_normal((self.comm.size + 1, 4, 3)).astype(np.float32)
+
+    def test_argmax_argmin_every_axis_split(self):
+        t = self._t()
+        for split in (None, 0, 1, 2):
+            x = ht.array(t, split=split)
+            for axis in (0, 1, 2):
+                np.testing.assert_array_equal(
+                    ht.argmax(x, axis=axis).numpy(), t.argmax(axis=axis)
+                )
+                np.testing.assert_array_equal(
+                    ht.argmin(x, axis=axis).numpy(), t.argmin(axis=axis)
+                )
+
+    def test_global_argmax_flat_index(self):
+        t = self._t()
+        for split in (None, 0, 1):
+            x = ht.array(t, split=split)
+            assert int(ht.argmax(x)) == int(t.argmax())
+            assert int(ht.argmin(x)) == int(t.argmin())
+
+    def test_argmax_ties_first_wins(self):
+        a = np.asarray([1.0, 3.0, 3.0, 0.0], dtype=np.float32)
+        for split in (None, 0):
+            assert int(ht.argmax(ht.array(a, split=split))) == 1
+
+    def test_max_min_keepdims(self):
+        t = self._t()
+        x = ht.array(t, split=0)
+        got = ht.max(x, axis=1, keepdims=True)
+        self.assert_array_equal(got, t.max(axis=1, keepdims=True))
+        got = ht.min(x, axis=(0, 2), keepdims=True)
+        self.assert_array_equal(got, t.min(axis=(0, 2), keepdims=True))
+
+
+class TestMomentsGrid(TestCase):
+    def _m(self):
+        rng = np.random.default_rng(62)
+        return rng.uniform(-3, 3, size=(2 * self.comm.size + 1, 5)).astype(np.float32)
+
+    def test_mean_std_var_axis_grid(self):
+        m = self._m()
+        for split in (None, 0, 1):
+            x = ht.array(m, split=split)
+            for axis in (None, 0, 1):
+                np.testing.assert_allclose(
+                    np.asarray(ht.mean(x, axis=axis).numpy() if axis is not None else float(ht.mean(x))),
+                    m.mean(axis=axis), rtol=1e-4, atol=1e-5,
+                )
+                np.testing.assert_allclose(
+                    np.asarray(ht.var(x, axis=axis).numpy() if axis is not None else float(ht.var(x))),
+                    m.var(axis=axis), rtol=1e-3, atol=1e-4,
+                )
+
+    def test_skew_bias_toggle(self):
+        m = self._m()
+        x = ht.array(m, split=0)
+        np.testing.assert_allclose(
+            np.asarray(ht.skew(x, axis=0, unbiased=False).numpy()),
+            _skew_np(m.astype(np.float64), axis=0, bias=True),
+            rtol=1e-3, atol=1e-3,
+        )
+
+    def test_kurtosis_fisher_toggle(self):
+        m = self._m()
+        x = ht.array(m, split=0)
+        for fisher in (True, False):
+            np.testing.assert_allclose(
+                np.asarray(ht.kurtosis(x, axis=0, fisher=fisher).numpy()),
+                _kurt_np(m.astype(np.float64), axis=0, fisher=fisher),
+                rtol=1e-3, atol=1e-3,
+            )
+
+    def test_moments_constant_input(self):
+        a = np.full(3 * self.comm.size, 2.5, dtype=np.float32)
+        x = ht.array(a, split=0)
+        assert abs(float(ht.mean(x)) - 2.5) < 1e-6
+        assert abs(float(ht.var(x))) < 1e-6
+
+
+class TestAverageWeighted(TestCase):
+    def test_weighted_axis_and_returned(self):
+        p = self.comm.size
+        m = np.arange((p + 1) * 3, dtype=np.float32).reshape(p + 1, 3)
+        w = np.arange(1, p + 2, dtype=np.float32)
+        x = ht.array(m, split=0)
+        wx = ht.array(w, split=0)
+        got, wsum = ht.average(x, axis=0, weights=wx, returned=True)
+        want = np.average(m, axis=0, weights=w)
+        np.testing.assert_allclose(got.numpy(), want, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(wsum.numpy()), np.full(3, w.sum()), rtol=1e-6)
+
+    def test_unweighted_matches_mean(self):
+        m = np.arange(12, dtype=np.float32).reshape(4, 3)
+        x = ht.array(m, split=1)
+        np.testing.assert_allclose(
+            ht.average(x, axis=1).numpy(), m.mean(axis=1), rtol=1e-6
+        )
+
+
+class TestOrderStatisticsGrid(TestCase):
+    def _a(self):
+        rng = np.random.default_rng(63)
+        return rng.standard_normal(4 * self.comm.size + 3).astype(np.float32)
+
+    def test_median_even_odd_lengths(self):
+        for extra in (0, 1):
+            a = self._a()[: len(self._a()) - extra]
+            for split in (None, 0):
+                got = float(ht.median(ht.array(a, split=split)))
+                np.testing.assert_allclose(got, np.median(a), rtol=1e-5)
+
+    def test_percentile_interpolations(self):
+        a = self._a()
+        x = ht.array(a, split=0)
+        for q in (0, 25, 50, 75, 100):
+            for method in ("linear", "lower", "higher", "nearest", "midpoint"):
+                got = float(ht.percentile(x, q, interpolation=method))
+                want = float(np.percentile(a, q, method=method))
+                np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_percentile_axis_keepdims(self):
+        p = self.comm.size
+        m = np.random.default_rng(64).standard_normal((p + 2, 6)).astype(np.float32)
+        x = ht.array(m, split=0)
+        got = ht.percentile(x, 30, axis=1, keepdims=True)
+        want = np.percentile(m, 30, axis=1, keepdims=True)
+        np.testing.assert_allclose(np.asarray(got.numpy()), want, rtol=1e-4, atol=1e-5)
+
+
+class TestHistogramGrid(TestCase):
+    def test_histogram_bins_and_range(self):
+        rng = np.random.default_rng(65)
+        a = rng.uniform(-4, 4, size=6 * self.comm.size).astype(np.float32)
+        for split in (None, 0):
+            x = ht.array(a, split=split)
+            for bins, rng_ in [(10, None), (5, (-2.0, 2.0)), (16, (-4.0, 4.0))]:
+                hist, edges = ht.histogram(x, bins=bins, range=rng_)
+                whist, wedges = np.histogram(a, bins=bins, range=rng_)
+                np.testing.assert_array_equal(np.asarray(hist.numpy()), whist)
+                np.testing.assert_allclose(np.asarray(edges.numpy()), wedges, rtol=1e-5)
+
+    def test_histc_torch_semantics(self):
+        a = np.asarray([0.5, 1.5, 2.5, 2.5, 3.5], dtype=np.float32)
+        got = ht.histc(ht.array(a, split=0), bins=4, min=0.0, max=4.0)
+        np.testing.assert_array_equal(np.asarray(got.numpy()), [1, 1, 2, 1])
+
+    def test_bincount_minlength_weights(self):
+        v = np.asarray([0, 1, 1, 3], dtype=np.int64)
+        w = np.asarray([0.5, 1.0, 1.0, 2.0], dtype=np.float32)
+        for split in (None, 0):
+            x = ht.array(v, split=split)
+            got = ht.bincount(x, minlength=6)
+            np.testing.assert_array_equal(
+                np.asarray(got.numpy()), np.bincount(v, minlength=6)
+            )
+            gw = ht.bincount(x, weights=ht.array(w, split=split))
+            np.testing.assert_allclose(
+                np.asarray(gw.numpy()), np.bincount(v, weights=w), rtol=1e-6
+            )
+
+
+class TestCovGrid(TestCase):
+    def test_cov_bias_ddof_combinations(self):
+        rng = np.random.default_rng(66)
+        m = rng.standard_normal((4, 5 * self.comm.size)).astype(np.float32)
+        x = ht.array(m, split=1)
+        np.testing.assert_allclose(
+            ht.cov(x).numpy(), np.cov(m), rtol=1e-3, atol=1e-4
+        )
+        np.testing.assert_allclose(
+            ht.cov(x, bias=True).numpy(), np.cov(m, bias=True), rtol=1e-3, atol=1e-4
+        )
+        np.testing.assert_allclose(
+            ht.cov(x, ddof=0).numpy(), np.cov(m, ddof=0), rtol=1e-3, atol=1e-4
+        )
+
+    def test_cov_with_y(self):
+        rng = np.random.default_rng(67)
+        a = rng.standard_normal(3 * self.comm.size).astype(np.float32)
+        b = 2 * a + rng.standard_normal(len(a)).astype(np.float32) * 0.1
+        got = ht.cov(ht.array(a, split=0), ht.array(b, split=0))
+        want = np.cov(a, b)
+        np.testing.assert_allclose(got.numpy(), want, rtol=1e-2, atol=1e-3)
+
+
+class TestMaximumMinimumGrid(TestCase):
+    def test_pairwise_with_broadcast(self):
+        p = self.comm.size
+        a = np.arange((p + 1) * 3, dtype=np.float32).reshape(p + 1, 3)
+        b = np.full(3, p * 1.5, dtype=np.float32)
+        for split in (None, 0, 1):
+            x = ht.array(a, split=split)
+            self.assert_array_equal(ht.maximum(x, ht.array(b)), np.maximum(a, b))
+            self.assert_array_equal(ht.minimum(x, ht.array(b)), np.minimum(a, b))
+
+    def test_nan_propagation(self):
+        a = np.asarray([1.0, np.nan, 3.0], dtype=np.float32)
+        b = np.asarray([2.0, 2.0, 2.0], dtype=np.float32)
+        got = ht.maximum(ht.array(a, split=0), ht.array(b, split=0)).numpy()
+        assert np.isnan(got[1])
