@@ -1,0 +1,107 @@
+"""Direct unit tests for the torch-named LR schedule factories — each is
+checked step-by-step against the reference scheduler's formula (reference
+heat/optim/lr_scheduler.py wraps torch.optim.lr_scheduler; here each
+factory returns an optax step→lr schedule with the same trajectory)."""
+
+import numpy as np
+
+from heat_tpu.optim import lr_scheduler
+
+
+def _trace(sched, n):
+    return [float(sched(i)) for i in range(n)]
+
+
+class TestStepLR:
+    def test_staircase_decay(self):
+        s = lr_scheduler.StepLR(1.0, step_size=3, gamma=0.1)
+        got = _trace(s, 9)
+        want = [1.0] * 3 + [0.1] * 3 + [0.01] * 3
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_gamma_default(self):
+        s = lr_scheduler.StepLR(0.5, step_size=1)
+        np.testing.assert_allclose(_trace(s, 3), [0.5, 0.05, 0.005], rtol=1e-6)
+
+
+class TestMultiStepLR:
+    def test_milestones(self):
+        s = lr_scheduler.MultiStepLR(1.0, milestones=[2, 5], gamma=0.1)
+        got = _trace(s, 7)
+        want = [1.0, 1.0, 0.1, 0.1, 0.1, 0.01, 0.01]
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_single_milestone(self):
+        s = lr_scheduler.MultiStepLR(2.0, milestones=[1], gamma=0.5)
+        np.testing.assert_allclose(_trace(s, 3), [2.0, 1.0, 1.0], rtol=1e-6)
+
+
+class TestExponentialLR:
+    def test_per_step_decay(self):
+        s = lr_scheduler.ExponentialLR(1.0, gamma=0.9)
+        got = _trace(s, 5)
+        want = [0.9**i for i in range(5)]
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+class TestCosineAnnealingLR:
+    def test_endpoints_and_midpoint(self):
+        lr, T = 2.0, 10
+        s = lr_scheduler.CosineAnnealingLR(lr, T_max=T)
+        assert abs(float(s(0)) - lr) < 1e-6
+        assert abs(float(s(T))) < 1e-6
+        # torch formula: eta_min + (lr-eta_min)*(1+cos(pi*t/T))/2
+        mid = lr * (1 + np.cos(np.pi * 5 / T)) / 2
+        np.testing.assert_allclose(float(s(5)), mid, rtol=1e-5)
+
+    def test_eta_min_floor(self):
+        s = lr_scheduler.CosineAnnealingLR(1.0, T_max=4, eta_min=0.2)
+        assert abs(float(s(4)) - 0.2) < 1e-6
+        assert all(float(s(i)) >= 0.2 - 1e-6 for i in range(8))
+
+
+class TestConstantLR:
+    def test_factor_then_full(self):
+        s = lr_scheduler.ConstantLR(1.0, factor=0.25, total_iters=3)
+        got = _trace(s, 6)
+        want = [0.25] * 3 + [1.0] * 3
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+class TestLinearLR:
+    def test_ramp(self):
+        s = lr_scheduler.LinearLR(1.0, start_factor=0.0, end_factor=1.0, total_iters=4)
+        got = _trace(s, 6)
+        np.testing.assert_allclose(got, [0.0, 0.25, 0.5, 0.75, 1.0, 1.0], rtol=1e-6)
+
+    def test_default_third_start(self):
+        s = lr_scheduler.LinearLR(3.0)
+        assert abs(float(s(0)) - 1.0) < 1e-6
+        assert abs(float(s(5)) - 3.0) < 1e-6
+
+
+class TestPolynomialLR:
+    def test_linear_power(self):
+        s = lr_scheduler.PolynomialLR(1.0, total_iters=4, power=1.0)
+        np.testing.assert_allclose(_trace(s, 5), [1.0, 0.75, 0.5, 0.25, 0.0], atol=1e-6)
+
+    def test_quadratic_power(self):
+        s = lr_scheduler.PolynomialLR(1.0, total_iters=2, power=2.0)
+        np.testing.assert_allclose(float(s(1)), 0.25, rtol=1e-5)
+
+
+class TestOptaxIntegration:
+    def test_schedule_drives_sgd(self):
+        import jax.numpy as jnp
+        import optax
+
+        sched = lr_scheduler.StepLR(0.1, step_size=2, gamma=0.5)
+        opt = optax.sgd(learning_rate=sched)
+        params = {"w": jnp.ones(())}
+        state = opt.init(params)
+        lrs_applied = []
+        for _ in range(4):
+            g = {"w": jnp.ones(())}
+            upd, state = opt.update(g, state)
+            lrs_applied.append(-float(upd["w"]))
+        np.testing.assert_allclose(lrs_applied, [0.1, 0.1, 0.05, 0.05], rtol=1e-6)

@@ -1,0 +1,86 @@
+"""Direct unit tests of DetectMetricPlateau — the DASO schedule driver
+(reference heat/optim/utils.py DetectMetricPlateau: min/max modes, rel/abs
+thresholds, patience, cooldown, get/set_state round-trip for checkpoint
+resume)."""
+
+import numpy as np
+import pytest
+
+from heat_tpu.optim import DetectMetricPlateau
+
+
+class TestModesAndThresholds:
+    def test_min_mode_improvement_resets_patience(self):
+        d = DetectMetricPlateau(mode="min", patience=2, threshold=1e-4)
+        assert not d.test_if_improving(1.0)  # first call primes best
+        assert not d.test_if_improving(0.5)  # improving
+        assert not d.test_if_improving(0.6)  # worse 1
+        assert not d.test_if_improving(0.6)  # worse 2
+        assert d.test_if_improving(0.6)  # patience exceeded -> plateau
+
+    def test_max_mode(self):
+        d = DetectMetricPlateau(mode="max", patience=1, threshold=1e-4)
+        d.test_if_improving(0.1)
+        assert not d.test_if_improving(0.5)  # improving accuracy
+        assert not d.test_if_improving(0.4)  # worse 1
+        assert d.test_if_improving(0.4)  # plateau
+
+    def test_rel_threshold_scales_with_best(self):
+        # rel mode: improvement must beat best*(1-threshold)
+        d = DetectMetricPlateau(mode="min", threshold_mode="rel",
+                                threshold=0.1, patience=0)
+        d.test_if_improving(100.0)
+        assert d.test_if_improving(95.0)  # <10% better: counts as plateau
+        d2 = DetectMetricPlateau(mode="min", threshold_mode="rel",
+                                 threshold=0.1, patience=0)
+        d2.test_if_improving(100.0)
+        assert not d2.test_if_improving(80.0)  # 20% better: improvement
+
+    def test_abs_threshold(self):
+        d = DetectMetricPlateau(mode="min", threshold_mode="abs",
+                                threshold=0.5, patience=0)
+        d.test_if_improving(10.0)
+        assert not d.test_if_improving(9.0)  # 1.0 > 0.5: improvement
+        assert d.test_if_improving(8.8)  # 0.2 < 0.5: plateau
+
+    def test_invalid_threshold_mode_raises(self):
+        # (invalid *mode* is already covered in test_nn_optim.py)
+        with pytest.raises(ValueError):
+            DetectMetricPlateau(threshold_mode="percent")
+
+
+class TestCooldown:
+    def test_cooldown_suppresses_detection(self):
+        d = DetectMetricPlateau(mode="min", patience=0, cooldown=2)
+        d.test_if_improving(1.0)
+        assert d.test_if_improving(2.0)  # plateau fires, cooldown starts
+        assert d.in_cooldown
+        assert not d.test_if_improving(3.0)  # suppressed
+        assert not d.test_if_improving(3.0)  # suppressed (last cooldown step)
+        assert d.test_if_improving(3.0)  # cooldown over: fires again
+
+
+class TestStateRoundtrip:
+    def test_checkpoint_resume_same_decisions(self):
+        a = DetectMetricPlateau(mode="min", patience=1, threshold=1e-4)
+        seq = [1.0, 0.9, 0.95, 0.95, 0.8, 0.85, 0.85]
+        half = 4
+        for v in seq[:half]:
+            a.test_if_improving(v)
+        st = a.get_state()
+        b = DetectMetricPlateau(mode="min", patience=1, threshold=1e-4)
+        b.set_state(st)
+        for v in seq[half:]:
+            assert a.test_if_improving(v) == b.test_if_improving(v)
+
+    def test_reset_clears_history(self):
+        d = DetectMetricPlateau(mode="min", patience=0)
+        d.test_if_improving(1.0)
+        assert d.test_if_improving(2.0)
+        d.reset()
+        assert not d.test_if_improving(5.0)  # fresh best, no plateau
+
+    def test_is_better_contract(self):
+        d = DetectMetricPlateau(mode="min", threshold_mode="abs", threshold=0.0)
+        assert d.is_better(0.9, 1.0)
+        assert not d.is_better(1.0, 0.9)
