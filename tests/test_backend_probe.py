@@ -3,18 +3,14 @@ bench.py and the driver entry (no reference analog: MPI init either works
 or aborts; a wedged TPU tunnel hangs, so probing happens in a timed
 subprocess)."""
 
-import sys
+import subprocess
 from unittest import mock
-
-import pytest
 
 from heat_tpu.utils import backend_probe
 from heat_tpu.utils.backend_probe import probe_default_platform
 
 
 def _completed(rc=0, stdout="", stderr=""):
-    import subprocess
-
     return subprocess.CompletedProcess([], rc, stdout=stdout, stderr=stderr)
 
 
@@ -47,8 +43,6 @@ class TestProbeParsing:
         assert "rc=1" in diags[0] and "no backend" in diags[0]
 
     def test_timeout_returns_none(self):
-        import subprocess
-
         with mock.patch.object(
             backend_probe.subprocess, "run",
             side_effect=subprocess.TimeoutExpired(cmd="x", timeout=1),
@@ -101,9 +95,8 @@ class TestRetrySchedule:
         # tunnel whose init hangs — sanitizing keeps this deterministic
         # and fast, the same trick tests/test_examples.py uses)
         import os
-        import subprocess as sp
 
-        real_run = sp.run
+        real_run = subprocess.run
 
         def run_sanitized(cmd, **kw):
             env = {

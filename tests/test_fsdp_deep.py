@@ -1,0 +1,114 @@
+"""Deep checks of the FSDP/ZeRO placement rule — axis selection, min_size
+boundary, indivisible-leaf replication, in-jit constraints, and layout of
+real optimizer state (complements tests/test_fsdp.py's value/train-step
+checks)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from heat_tpu.parallel import constrain_pytree, replicate_pytree, shard_pytree
+
+
+@pytest.fixture(scope="module")
+def comm():
+    return ht.get_comm()
+
+
+def _sharded_axis(arr):
+    """Which axis the NamedSharding splits, or None."""
+    spec = arr.sharding.spec
+    for i, s in enumerate(spec):
+        if s is not None:
+            return i
+    return None
+
+
+class TestPlacementRule:
+    def test_largest_divisible_axis_wins(self, comm):
+        p = comm.size
+        if p < 2:
+            pytest.skip("needs >1 device")
+        leaf = jnp.zeros((2 * p, 8 * p))  # both divisible; axis 1 larger
+        out = shard_pytree({"w": leaf}, comm, min_size=1)
+        assert _sharded_axis(out["w"]) == 1
+
+    def test_indivisible_axes_replicate(self, comm):
+        p = comm.size
+        if p < 2:
+            pytest.skip("needs >1 device")
+        leaf = jnp.zeros((p + 1, p + 1))
+        out = shard_pytree({"w": leaf}, comm, min_size=1)
+        assert _sharded_axis(out["w"]) is None
+
+    def test_min_size_boundary(self, comm):
+        p = comm.size
+        if p < 2:
+            pytest.skip("needs >1 device")
+        small = jnp.zeros((p,))  # size p < min_size -> replicated
+        large = jnp.zeros((p * 200,))
+        out = shard_pytree({"s": small, "l": large}, comm, min_size=p * 100)
+        assert _sharded_axis(out["s"]) is None
+        assert _sharded_axis(out["l"]) == 0
+        # exactly at the threshold: size == min_size is NOT "smaller" — shards
+        exact = jnp.zeros((p * 100,))
+        out = shard_pytree({"e": exact}, comm, min_size=p * 100)
+        assert _sharded_axis(out["e"]) == 0
+
+    def test_scalar_and_python_leaves(self, comm):
+        out = shard_pytree({"step": jnp.asarray(3), "lr": 0.1}, comm)
+        assert int(out["step"]) == 3
+        assert abs(float(out["lr"]) - 0.1) < 1e-7
+
+    def test_nested_structure_preserved(self, comm):
+        tree = {"a": {"b": [jnp.ones((4,)), jnp.ones((2, 2))]}, "c": jnp.ones(())}
+        out = shard_pytree(tree, comm)
+        assert set(out) == {"a", "c"}
+        assert isinstance(out["a"]["b"], list) and len(out["a"]["b"]) == 2
+
+
+class TestOptimizerStateLayout:
+    def test_adam_moments_shard_like_params(self, comm):
+        import optax
+
+        p = comm.size
+        if p < 2:
+            pytest.skip("needs >1 device")
+        params = {"w": jnp.ones((4 * p, 8)), "b": jnp.ones((8,))}
+        state = optax.adam(1e-3).init(params)
+        sp = shard_pytree(params, comm, min_size=1)
+        ss = shard_pytree(state, comm, min_size=1)
+        mu = ss[0].mu
+        # the first moment of w shards along w's biggest divisible axis
+        assert _sharded_axis(mu["w"]) == 0
+        np.testing.assert_allclose(np.asarray(mu["w"]), 0.0)
+
+
+class TestConstrainInJit:
+    def test_constraint_holds_through_jit(self, comm):
+        p = comm.size
+        if p < 2:
+            pytest.skip("needs >1 device")
+        x = shard_pytree({"w": jnp.ones((4 * p, 4))}, comm, min_size=1)
+
+        @jax.jit
+        def step(t):
+            t = {"w": t["w"] * 2.0}
+            return constrain_pytree(t, comm, min_size=1)
+
+        out = step(x)
+        assert _sharded_axis(out["w"]) == 0
+        np.testing.assert_allclose(np.asarray(out["w"]), 2.0)
+
+
+class TestReplicateRoundtrip:
+    def test_values_and_layout(self, comm):
+        p = comm.size
+        rng = np.random.default_rng(71)
+        w = rng.standard_normal((2 * p, 3)).astype(np.float32)
+        sh = shard_pytree({"w": jnp.asarray(w)}, comm, min_size=1)
+        rep = replicate_pytree(sh, comm)
+        assert _sharded_axis(rep["w"]) is None
+        np.testing.assert_allclose(np.asarray(rep["w"]), w, rtol=1e-6)
