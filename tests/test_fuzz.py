@@ -13,13 +13,24 @@ import pytest
 
 import heat_tpu as ht
 
-# (name, numpy oracle, positive_domain_only)
+# (name, numpy oracle, domain) — domain picks the input sampler:
+# "real" = standard normal, "pos" = |x|+0.1, "unit" = open (-1, 1)
 UNARY = [
-    ("abs", np.abs, False), ("exp", np.exp, False), ("sqrt", None, True),
-    ("floor", np.floor, False), ("ceil", np.ceil, False),
-    ("trunc", np.trunc, False), ("sin", np.sin, False),
-    ("tanh", np.tanh, False), ("log1p", None, True),
-    ("square", np.square, False), ("sign", np.sign, False),
+    ("abs", np.abs, "real"), ("exp", np.exp, "real"), ("sqrt", None, "pos"),
+    ("floor", np.floor, "real"), ("ceil", np.ceil, "real"),
+    ("trunc", np.trunc, "real"), ("sin", np.sin, "real"),
+    ("tanh", np.tanh, "real"), ("log1p", None, "pos"),
+    ("square", np.square, "real"), ("sign", np.sign, "real"),
+    ("cos", np.cos, "real"), ("tan", np.tan, "real"),
+    ("sinh", np.sinh, "real"), ("cosh", np.cosh, "real"),
+    ("arctan", np.arctan, "real"), ("arcsinh", np.arcsinh, "real"),
+    ("expm1", np.expm1, "real"), ("exp2", np.exp2, "real"),
+    ("log", None, "pos"), ("log2", None, "pos"), ("log10", None, "pos"),
+    ("rad2deg", np.rad2deg, "real"), ("deg2rad", np.deg2rad, "real"),
+    ("fabs", np.fabs, "real"), ("neg", np.negative, "real"),
+    ("positive", np.positive, "real"),
+    ("arcsin", np.arcsin, "unit"), ("arccos", np.arccos, "unit"),
+    ("arctanh", np.arctanh, "unit"),
 ]
 BINARY = [
     ("add", np.add, False), ("sub", np.subtract, False),
@@ -48,15 +59,18 @@ def shapes(rng, n=3):
     return out
 
 
-@pytest.mark.parametrize("name,npf,pos", UNARY)
-def test_unary_fuzz(name, npf, pos):
+@pytest.mark.parametrize("name,npf,domain", UNARY)
+def test_unary_fuzz(name, npf, domain):
     rng = np.random.default_rng(_seed(name))
     f = getattr(ht, name)
     npf = npf if npf is not None else getattr(np, name)
     for shape in shapes(rng):
-        xn = rng.standard_normal(shape).astype(np.float64)
-        if pos:
-            xn = np.abs(xn) + 0.1  # domain-restricted ops
+        if domain == "unit":
+            xn = rng.uniform(-0.95, 0.95, size=shape)
+        else:
+            xn = rng.standard_normal(shape).astype(np.float64)
+            if domain == "pos":
+                xn = np.abs(xn) + 0.1  # domain-restricted ops
         for split in [None] + list(range(len(shape))):
             x = ht.array(xn, split=split)
             np.testing.assert_allclose(
