@@ -304,7 +304,47 @@ def percentile(x: DNDarray, q, axis=None, out=None, interpolation: str = "linear
     gather). Result replicated."""
     log = x._logical()
     qa = jnp.asarray(q, dtype=jnp.float64)
-    res = jnp.percentile(log, qa, axis=axis, method=interpolation, keepdims=keepdims)
+    q_shape = tuple(qa.shape)
+    if qa.ndim > 1:
+        # numpy accepts n-D q with the q dims leading the result; jnp only
+        # takes rank<=1 — flatten here, restore the q shape at the end
+        qa = qa.ravel()
+    ax = sanitize_axis(x.shape, axis) if axis is not None else None
+    if interpolation == "nearest":
+        # jnp.percentile's 'nearest' rounds half positions down; numpy
+        # rounds half to even — select from the sorted values with
+        # jnp.round (which IS half-to-even). Works for any axis form by
+        # collapsing the reduced axes into one; NaN propagation restored
+        # explicitly (jnp.sort pushes NaN to the end).
+        axes = (
+            tuple(range(log.ndim))
+            if ax is None
+            else ((ax,) if isinstance(ax, builtins.int) else tuple(ax))
+        )
+        rest = log.ndim - len(axes)
+        moved = jnp.moveaxis(log, axes, tuple(range(rest, log.ndim)))
+        arr2 = moved.reshape(moved.shape[:rest] + (-1,))
+        n = arr2.shape[-1]
+        srt = jnp.sort(arr2, axis=-1)
+        idx = jnp.round(qa / 100.0 * (n - 1)).astype(jnp.int32)
+        res = jnp.take(srt, idx, axis=-1)
+        if qa.ndim:
+            res = jnp.moveaxis(res, -1, 0)  # the q dim leads, as in numpy
+        nanmask = jnp.isnan(arr2).any(axis=-1)
+        res = jnp.where(nanmask, jnp.nan, res)
+        if keepdims:
+            # re-insert length-1 dims at the original reduced positions
+            # (shifted by one when a leading q dim is present)
+            off = 1 if qa.ndim else 0
+            # result currently carries the non-reduced dims in their
+            # original relative order — map each kept dim back, inserting
+            # the reduced ones
+            for a in sorted(axes):
+                res = jnp.expand_dims(res, a + off)
+    else:
+        res = jnp.percentile(log, qa, axis=axis, method=interpolation, keepdims=keepdims)
+    if len(q_shape) > 1:
+        res = res.reshape(q_shape + tuple(res.shape[1:]))
     res = res.astype(jnp.float64)
     out_arr = (
         DNDarray(res, tuple(res.shape), types.canonical_heat_type(res.dtype), None, x.device, x.comm, True)

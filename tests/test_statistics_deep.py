@@ -155,6 +155,28 @@ class TestOrderStatisticsGrid(TestCase):
                 want = float(np.percentile(a, q, method=method))
                 np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
 
+    def test_percentile_nearest_full_matrix(self):
+        # numpy rounds half positions to even; axis tuples, n-D q, keepdims,
+        # and NaN propagation must all match (regression: the jnp 'nearest'
+        # delegation rounded half positions down)
+        rng = np.random.default_rng(68)
+        t = rng.standard_normal((3, 4, 5)).astype(np.float32)
+        x = ht.array(t, split=0)
+        for axis in (None, 1, (0, 1), (1, 2)):
+            for q in (50, [25, 50], [[10, 20], [30, 40]]):
+                for kd in (False, True):
+                    g = ht.percentile(x, q, axis=axis, interpolation="nearest", keepdims=kd)
+                    g = np.asarray(g.numpy())
+                    w = np.percentile(t, q, axis=axis, method="nearest", keepdims=kd)
+                    np.testing.assert_allclose(g, w, rtol=1e-6, err_msg=f"{axis} {q} {kd}")
+        tn = t.copy()
+        tn[1, 2, 3] = np.nan
+        xn = ht.array(tn, split=0)
+        for axis in (None, 1, (1, 2)):
+            g = np.asarray(ht.percentile(xn, 50, axis=axis, interpolation="nearest").numpy())
+            w = np.percentile(tn, 50, axis=axis, method="nearest")
+            np.testing.assert_allclose(g, w, rtol=1e-6, equal_nan=True)
+
     def test_percentile_axis_keepdims(self):
         p = self.comm.size
         m = np.random.default_rng(64).standard_normal((p + 2, 6)).astype(np.float32)
