@@ -225,6 +225,25 @@ def _flash_bwd(scale, causal, kv_valid, block_q, block_k, interpret, res, g):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+def _resolve_interpret(x) -> bool:
+    """True when the kernel must run in the Pallas interpreter.
+
+    Resolved from where the computation actually runs, not the global
+    default backend: a concrete input's device platform wins, because in a
+    mixed-platform process (a forced virtual CPU mesh alongside a live TPU
+    backend, e.g. the multichip dryrun after a real-chip compile check)
+    ``jax.default_backend()`` says "tpu" while the arrays live on CPU.
+    Tracers carry no placement, so they fall back to the default backend.
+    """
+    try:
+        platforms = {d.platform for d in x.devices()}
+        if platforms:
+            return platforms != {"tpu"}
+    except Exception:
+        pass
+    return jax.default_backend() != "tpu"
+
+
 def flash_attention(
     q: jax.Array,
     k: jax.Array,
@@ -250,7 +269,7 @@ def flash_attention(
     if q.ndim != 4:
         raise ValueError(f"expected (B, T, H, D) inputs, got {q.shape}")
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        interpret = _resolve_interpret(q)
     d = q.shape[-1]
     t_k = k.shape[1]
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
