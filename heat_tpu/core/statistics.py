@@ -326,7 +326,12 @@ def percentile(x: DNDarray, q, axis=None, out=None, interpolation: str = "linear
         arr2 = moved.reshape(moved.shape[:rest] + (-1,))
         n = arr2.shape[-1]
         srt = jnp.sort(arr2, axis=-1)
-        idx = jnp.round(qa / 100.0 * (n - 1)).astype(jnp.int32)
+        # indices are host-computable (q and n are static) — np.round is
+        # exact half-to-even, while jnp.round under the TPU backend's
+        # emulated float64 mis-rounds exact half positions
+        idx = jnp.asarray(
+            np.round(np.asarray(qa) / 100.0 * (n - 1)).astype(np.int32)
+        )
         res = jnp.take(srt, idx, axis=-1)
         if qa.ndim:
             res = jnp.moveaxis(res, -1, 0)  # the q dim leads, as in numpy

@@ -177,6 +177,18 @@ class TestOrderStatisticsGrid(TestCase):
             w = np.percentile(tn, 50, axis=axis, method="nearest")
             np.testing.assert_allclose(g, w, rtol=1e-6, equal_nan=True)
 
+    def test_percentile_nearest_exact_half_positions(self):
+        # q/100*(n-1) landing on exact .5 must round half-to-even on every
+        # backend (regression: on-device rounding under the TPU backend's
+        # emulated float64 mis-rounds exact halves — round(0.5) came out -1,
+        # wrapping the take to the LAST element)
+        for n, qs in ((6, [10, 30, 50, 70, 90]), (16, [10, 30, 50, 70, 90]), (11, [5, 15, 25, 35, 45, 55, 65, 75, 85, 95])):
+            a = np.arange(float(n))
+            x = ht.array(a, split=0)
+            got = np.asarray(ht.percentile(x, qs, interpolation="nearest").numpy())
+            want = np.percentile(a, qs, method="nearest")
+            np.testing.assert_array_equal(got, want, err_msg=f"n={n}")
+
     def test_percentile_axis_keepdims(self):
         p = self.comm.size
         m = np.random.default_rng(64).standard_normal((p + 2, 6)).astype(np.float32)
