@@ -9,9 +9,12 @@ framework has no attention code at all (SURVEY §2.5); this kernel is the
 TPU-native capability its ring/Alltoall mechanisms exist to enable, and a
 drop-in replacement for the XLA-fused :func:`local_attention` path.
 
-Numerics match :func:`heat_tpu.parallel.attention.local_attention` bit-for-
-pattern (same f32 online softmax, same padding/causal mask semantics); the
-test suite asserts agreement on CPU via the Pallas interpreter. The backward
+Numerics: same f32 online softmax and padding/causal mask semantics as
+:func:`heat_tpu.parallel.attention.local_attention`. For f32 inputs the two
+paths agree to tight tolerance (asserted on CPU via the Pallas
+interpreter); for bf16 inputs the MXU dots run in bf16 with f32
+accumulation (and p rounds to bf16 before the PV product — standard flash
+practice), so agreement is to bf16 tolerance, also asserted. The backward
 pass recomputes through the jnp path under ``jax.custom_vjp`` — flash
 recomputation, O(T) memory, no stored (T, T) matrix.
 """
@@ -68,13 +71,17 @@ def _flash_kernel(
 
     @pl.when(live)
     def _accumulate():
-        q = q_ref[0, 0].astype(jnp.float32)  # (bq, D)
-        k = k_ref[0, 0].astype(jnp.float32)  # (bk, D)
-        v = v_ref[0, 0].astype(jnp.float32)
+        # MXU dots run in the INPUT dtype with f32 accumulation
+        # (preferred_element_type): bf16 inputs hit the full-rate bf16 MXU
+        # (an up-front astype(f32) would force true-f32 passes at ~1/4 the
+        # throughput); f32 inputs keep exact f32 passes. Softmax stays f32.
+        q = q_ref[0, 0]  # (bq, D)
+        k = k_ref[0, 0]  # (bk, D)
+        v = v_ref[0, 0]
 
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * jnp.float32(scale)  # (bq, bk)
+        ) * jnp.float32(scale)  # (bq, bk), f32
 
         k_pos = ik * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1
@@ -96,9 +103,13 @@ def _flash_kernel(
         p = jnp.where(mask, jnp.exp(s - m_safe), zero)
         alpha = jnp.where(m_prev <= half_neg, zero, jnp.exp(m_prev - m_safe))
         l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        # PV in v's dtype (standard flash practice): for bf16 v the f32
+        # probabilities round to bf16 on the way into the MXU, accumulating
+        # in f32 — covered by the bf16 agreement tolerance; f32 v unchanged
+        p_mx = p if v.dtype == jnp.float32 else p.astype(v.dtype)
         pv = jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
-        )  # (bq, D)
+            p_mx, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )  # (bq, D), f32
 
         m_s[:] = jnp.broadcast_to(m_new, m_s.shape)
         l_s[:] = jnp.broadcast_to(l_new, l_s.shape)
