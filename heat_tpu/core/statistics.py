@@ -298,19 +298,85 @@ def minimum(x1, x2, out=None) -> DNDarray:
     return binary_op(jnp.minimum, x1, x2, out)
 
 
+_PERCENTILE_METHODS = ("linear", "lower", "higher", "midpoint", "nearest")
+
+
+def _percentile_sorted_distributed(x: DNDarray, qa, interpolation: str):
+    """Distributed percentile of a 1-D split array — beats the reference's
+    gather (reference statistics.py:1406-1441 collects per-rank partials on
+    rank 0): the data never replicates. Distributed sort along the split
+    axis (odd-even merge network over ICI), then a sharded gather of the
+    2-3 order statistics each q needs; only O(q) scalars leave the mesh.
+    Returns a replicated jnp vector of shape (len(q),), float64."""
+    from . import logical as lg
+    from . import manipulations
+
+    n = x.shape[0]
+    q_flat = np.atleast_1d(np.asarray(qa, dtype=np.float64))
+    vals, _ = manipulations.sort(x)
+    # bracketing order statistics; indices are host-computable (q, n static).
+    # np.round is exact half-to-even — numpy's 'nearest' rule
+    pos = q_flat / 100.0 * (n - 1)
+    i0 = np.floor(pos).astype(np.int64)
+    i1 = np.ceil(pos).astype(np.int64)
+    inear = np.round(pos).astype(np.int64)
+    picked = vals[np.concatenate([i0, i1, inear])]  # sharded gather
+    pl = picked._logical().astype(jnp.float64)
+    m = len(q_flat)
+    v0, v1, vn = pl[:m], pl[m : 2 * m], pl[2 * m :]
+    if interpolation == "linear":
+        res = v0 + (v1 - v0) * jnp.asarray(pos - i0)
+    elif interpolation == "lower":
+        res = v0
+    elif interpolation == "higher":
+        res = v1
+    elif interpolation == "midpoint":
+        res = (v0 + v1) / 2.0
+    else:  # nearest — gate guarantees membership in _PERCENTILE_METHODS
+        res = vn
+    if jnp.issubdtype(x.dtype.jnp_type(), jnp.floating):
+        # numpy: any NaN anywhere makes every percentile NaN (the sort
+        # pushed NaNs to the global tail, so the picks alone can't tell)
+        nan_any = lg.any(lg.isnan(x)).larray
+        res = jnp.where(nan_any, jnp.nan, res)
+    return res
+
+
 def percentile(x: DNDarray, q, axis=None, out=None, interpolation: str = "linear", keepdims: bool = False) -> DNDarray:
-    """q-th percentile (reference statistics.py:1406-1441 gathers per-rank
-    partials; here one jnp.percentile over the logical view — XLA handles the
-    gather). Result replicated."""
-    log = x._logical()
+    """q-th percentile. On a 1-D split array reduced over its only axis this
+    is a DISTRIBUTED algorithm (sort + order-statistic gather, see
+    :func:`_percentile_sorted_distributed`); otherwise one jnp.percentile
+    over the logical view (reference statistics.py:1406-1441 gathers
+    per-rank partials). Result replicated either way."""
     qa = jnp.asarray(q, dtype=jnp.float64)
+    qv = np.asarray(qa)
+    if np.any(~((qv >= 0.0) & (qv <= 100.0))):
+        # numpy raises on every path (incl. NaN q, which compares False to
+        # both bounds); jnp.percentile does not — check here
+        raise ValueError("percentiles must be in the range [0, 100]")
     q_shape = tuple(qa.shape)
     if qa.ndim > 1:
         # numpy accepts n-D q with the q dims leading the result; jnp only
         # takes rank<=1 — flatten here, restore the q shape at the end
         qa = qa.ravel()
     ax = sanitize_axis(x.shape, axis) if axis is not None else None
-    if interpolation == "nearest":
+    if (
+        x.split is not None
+        and x.ndim == 1
+        and x.comm.size > 1
+        and x.shape[0] > 0
+        and qa.size > 0
+        and (ax is None or ax == 0 or ax == (0,))
+        and interpolation in _PERCENTILE_METHODS
+    ):
+        res = _percentile_sorted_distributed(x, qa, interpolation)
+        if not qa.ndim:
+            res = res[0]
+        if keepdims:
+            res = res[..., None]  # the single reduced dim
+        # falls through to the shared reshape/astype/wrap/out epilogue
+    elif interpolation == "nearest":
+        log = x._logical()
         # jnp.percentile's 'nearest' rounds half positions down; numpy
         # rounds half to even — select from the sorted values with
         # jnp.round (which IS half-to-even). Works for any axis form by
@@ -347,7 +413,7 @@ def percentile(x: DNDarray, q, axis=None, out=None, interpolation: str = "linear
             for a in sorted(axes):
                 res = jnp.expand_dims(res, a + off)
     else:
-        res = jnp.percentile(log, qa, axis=axis, method=interpolation, keepdims=keepdims)
+        res = jnp.percentile(x._logical(), qa, axis=axis, method=interpolation, keepdims=keepdims)
     if len(q_shape) > 1:
         res = res.reshape(q_shape + tuple(res.shape[1:]))
     res = res.astype(jnp.float64)

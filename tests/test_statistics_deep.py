@@ -269,3 +269,107 @@ class TestMaximumMinimumGrid(TestCase):
         b = np.asarray([2.0, 2.0, 2.0], dtype=np.float32)
         got = ht.maximum(ht.array(a, split=0), ht.array(b, split=0)).numpy()
         assert np.isnan(got[1])
+
+
+class TestDistributedPercentile(TestCase):
+    """The 1-D split fast path: distributed sort + order-statistic gather
+    (statistics._percentile_sorted_distributed) — the data never
+    replicates, unlike the reference's rank-0 gather
+    (reference statistics.py:1406-1441)."""
+
+    def _spy(self):
+        """Patch the fast path with a call counter; returns (counter, undo)."""
+        from heat_tpu.core import statistics as st
+
+        calls = []
+        orig = st._percentile_sorted_distributed
+
+        def spy(*a, **k):
+            calls.append(1)
+            return orig(*a, **k)
+
+        st._percentile_sorted_distributed = spy
+        return calls, lambda: setattr(st, "_percentile_sorted_distributed", orig)
+
+    def test_fast_path_taken_and_numpy_exact(self):
+        rng = np.random.default_rng(71)
+        a = rng.standard_normal(5 * self.comm.size + 3)
+        x = ht.array(a, split=0)
+        calls, undo = self._spy()
+        try:
+            for method in ("linear", "lower", "higher", "midpoint", "nearest"):
+                for q in (0.0, 37.5, 100.0, [10, 50, 99.5], [[0, 25], [75, 100]]):
+                    got = ht.percentile(x, q, interpolation=method).numpy()
+                    want = np.percentile(a, q, method=method)
+                    np.testing.assert_allclose(got, want, rtol=1e-12, err_msg=f"{method} {q}")
+        finally:
+            undo()
+        if self.comm.size > 1:
+            assert len(calls) == 25, "distributed fast path not taken"
+        # replicated input must NOT take the sorted path
+        calls2, undo2 = self._spy()
+        try:
+            ht.percentile(ht.array(a, split=None), 50)
+        finally:
+            undo2()
+        assert not calls2
+
+    def test_axis_forms_keepdims_and_median(self):
+        rng = np.random.default_rng(72)
+        a = rng.standard_normal(4 * self.comm.size + 1)
+        x = ht.array(a, split=0)
+        np.testing.assert_allclose(
+            ht.percentile(x, 30, axis=0, keepdims=True).numpy(),
+            np.percentile(a, 30, axis=0, keepdims=True),
+        )
+        np.testing.assert_allclose(
+            ht.percentile(x, [30, 60], keepdims=True).numpy(),
+            np.percentile(a, [30, 60], keepdims=True),
+        )
+        np.testing.assert_allclose(ht.median(x).numpy(), np.median(a))
+
+    def test_nan_makes_every_percentile_nan(self):
+        a = np.arange(3.0 * self.comm.size)
+        a[1] = np.nan
+        import warnings
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            got = ht.percentile(ht.array(a, split=0), [0, 50, 100]).numpy()
+        assert np.isnan(got).all()
+
+    def test_integer_input_and_out_param(self):
+        rng = np.random.default_rng(73)
+        a = rng.integers(-50, 50, 4 * self.comm.size + 2)
+        x = ht.array(a, split=0)
+        np.testing.assert_allclose(
+            ht.percentile(x, [12.5, 88.0]).numpy(), np.percentile(a, [12.5, 88.0])
+        )
+        out = ht.zeros(2, dtype=ht.float64)
+        r = ht.percentile(x, [25.0, 75.0], out=out)
+        np.testing.assert_allclose(out.numpy(), np.percentile(a, [25.0, 75.0]))
+        assert r is out
+
+    def test_out_of_range_q_raises(self):
+        x = ht.arange(3 * self.comm.size, split=0)
+        with pytest.raises(ValueError):
+            ht.percentile(x, 100.5)
+        with pytest.raises(ValueError):
+            ht.percentile(x, [-0.1, 50.0])
+
+    def test_split_none_agreement(self):
+        rng = np.random.default_rng(74)
+        a = rng.standard_normal(6 * self.comm.size)
+        qs = [5, 37, 50, 93]
+        for method in ("linear", "nearest"):
+            d = ht.percentile(ht.array(a, split=0), qs, interpolation=method).numpy()
+            r = ht.percentile(ht.array(a, split=None), qs, interpolation=method).numpy()
+            np.testing.assert_allclose(d, r, rtol=1e-9)
+
+    def test_empty_q_and_nan_q(self):
+        x = ht.arange(3 * self.comm.size, split=0)
+        r = ht.percentile(x, [])
+        assert r.shape == (0,)
+        for bad in (float("nan"), [50.0, float("nan")]):
+            with pytest.raises(ValueError):
+                ht.percentile(x, bad)
