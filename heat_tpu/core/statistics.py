@@ -162,12 +162,81 @@ def average(x: DNDarray, axis=None, weights: Optional[DNDarray] = None, returned
     return avg
 
 
+def _aligned_weights_buf(x: DNDarray, weights):
+    """``weights`` as a physical buffer aligned with ``x``'s shards (resplit
+    if laid out differently), or None. Pads need no masking here — callers
+    zero them via the validity mask."""
+    if weights is None:
+        return None
+    if isinstance(weights, DNDarray):
+        if tuple(weights.shape) != tuple(x.shape):
+            raise ValueError("weights must have the same shape as the input")
+        if weights.split != x.split:
+            weights = weights.resplit(x.split)
+        return weights.larray
+    w = np.asarray(weights)
+    if tuple(w.shape) != tuple(x.shape):
+        raise ValueError("weights must have the same shape as the input")
+    from . import factories
+
+    # route raw arrays through the factory so they pick up x's tail padding
+    # and sharding (a bare device_put of the logical shape would not divide
+    # over the mesh when x is padded)
+    return factories.array(w, split=x.split, device=x.device, comm=x.comm).larray
+
+
+def _valid_weights(x: DNDarray, wbuf):
+    """Per-element weights over the PHYSICAL shape: the given weights (or 1)
+    at logical positions, 0 at tail pads — how pad entries drop out of a
+    scatter/histogram without any gather."""
+    dt = wbuf.dtype if wbuf is not None else jnp.float64
+    ones = jnp.ones(x.larray.shape, dtype=dt) if wbuf is None else wbuf.astype(dt)
+    if x.pad_count == 0:
+        return ones
+    idx = jax.lax.broadcasted_iota(jnp.int32, x.larray.shape, x.split)
+    return jnp.where(idx < x.shape[x.split], ones, jnp.zeros((), dtype=dt))
+
+
 def bincount(x: DNDarray, weights: Optional[DNDarray] = None, minlength: int = 0) -> DNDarray:
     """Occurrence counts of non-negative ints (reference statistics.py:375:
-    local bincount + Allreduce). Result is replicated."""
+    local bincount + Allreduce). Result is replicated.
+
+    On a split array this is DISTRIBUTED: a `shard_map` kernel scatter-adds
+    each shard's physical buffer into its local (nbins,) histogram (pads
+    carry weight 0) and one psum over ICI combines them — only the global
+    max crosses to the host (to size the output). The replicated jnp path
+    handles the rest."""
     if x.ndim != 1:
         raise ValueError("object too deep for desired array")
+    if x.split is not None and x.comm.size > 1 and x.size > 0:
+        comm = x.comm
+        # one fused pass + one host sync for both extremes (pads masked to 0
+        # are harmless: they can't fake a negative or beat the true max of a
+        # non-negative domain)
+        mbuf = x._masked(0)
+        mn, mx = (builtins.int(v) for v in np.asarray(jnp.stack([jnp.min(mbuf), jnp.max(mbuf)])))
+        if mn < 0:
+            raise ValueError("bincount: input must have no negative elements")
+        nbins = builtins.max(mx + 1, builtins.int(minlength))
+        wbuf = _aligned_weights_buf(x, weights)
+        vw = _valid_weights(x, wbuf)
+        acc = jnp.float64 if weights is not None else jnp.int64
+        buf = x._masked(0)
+
+        def kernel(vals, w):
+            h = jnp.zeros((nbins,), dtype=acc).at[vals].add(w.astype(acc))
+            return comm.psum(h)
+
+        spec = comm.spec(0, 1)
+        hist = jax.shard_map(
+            kernel, mesh=comm.mesh, in_specs=(spec, spec),
+            out_specs=comm.spec(None, 1),
+        )(buf, vw)
+        return DNDarray.from_logical(hist, None, x.device, x.comm)
     log = x._logical()
+    if x.size > 0 and builtins.int(jnp.min(log)) < 0:
+        # numpy raises; jnp.bincount silently drops negatives
+        raise ValueError("bincount: input must have no negative elements")
     w = weights._logical() if isinstance(weights, DNDarray) else weights
     res = jnp.bincount(log, weights=w, minlength=minlength)
     return DNDarray.from_logical(res, None, x.device, x.comm)
@@ -211,15 +280,65 @@ def cov(m: DNDarray, y: Optional[DNDarray] = None, rowvar: bool = True, bias: bo
     return arithmetics.div(c, fact)
 
 
+def _hist_distributed(x: DNDarray, bins, lo, hi, weights):
+    """Histogram counts of a split array as a DISTRIBUTED algorithm: each
+    shard histograms its (raveled) physical buffer locally — tail pads carry
+    weight 0, binning is order-independent so ANY split axis works — and one
+    psum over ICI combines the per-shard counts (the reference's local hist
+    + Allreduce, statistics.py:375/:509, as one shard_map kernel). Returns
+    the replicated (nbins,) float64 counts."""
+    comm = x.comm
+    wbuf = _aligned_weights_buf(x, weights)
+    vw = _valid_weights(x, wbuf)
+    buf = x._masked(0)
+    if hasattr(bins, "__len__"):
+        edges = np.asarray(bins, dtype=np.float64)
+    else:
+        edges = np.linspace(float(lo), float(hi), builtins.int(bins) + 1)
+
+    def kernel(vals, w):
+        # bin in float64 against float64 edges on EVERY path (weighted,
+        # unweighted, distributed, replicated): jnp.histogram's binning
+        # dtype otherwise shifts with the weights argument, making the same
+        # f32 data land differently per path. The f64 comparison is the
+        # exact binning; numpy's f32 uniform-bin fast path computes indices
+        # in f32 and may differ by O(1) counts on edge-straddling values
+        # (numpy f32 disagrees with numpy f64 on the same data) — we match
+        # numpy exactly for f64 input and match exact-comparison semantics
+        # for everything else
+        h, _ = jnp.histogram(
+            vals.ravel().astype(jnp.float64), bins=edges, weights=w.ravel()
+        )
+        return comm.psum(h)
+
+    spec = comm.spec(x.split, x.ndim)
+    return jax.shard_map(
+        kernel, mesh=comm.mesh, in_specs=(spec, spec), out_specs=comm.spec(None, 1)
+    )(buf, vw)
+
+
 def histc(input: DNDarray, bins: int = 100, min: float = 0.0, max: float = 0.0, out=None) -> DNDarray:
-    """Histogram with equal-width bins in [min, max] (reference
-    statistics.py `histc`; local hist + Allreduce). Replicated result."""
-    log = input._logical().ravel()
+    """Histogram with equal-width bins in [min, max]; values outside the
+    range are ignored (reference statistics.py `histc`; local hist +
+    Allreduce). Replicated result; distributed algorithm on split inputs
+    (:func:`_hist_distributed`)."""
     lo, hi = float(min), float(max)
     if lo == 0.0 and hi == 0.0:
-        lo = float(jnp.min(log))
-        hi = float(jnp.max(log))
-    hist, _ = jnp.histogram(log, bins=bins, range=(lo, hi))
+        # the min/max PARAMETERS shadow this module's reductions — reach
+        # them through the module namespace
+        lo = globals()["min"](input).item()
+        hi = globals()["max"](input).item()
+    if lo > hi:
+        raise ValueError("max must be larger than min in range parameter")
+    if lo == hi:
+        lo, hi = lo - 0.5, hi + 0.5  # numpy's degenerate-range widening
+    if input.split is not None and input.comm.size > 1 and input.size > 0:
+        hist = _hist_distributed(input, builtins.int(bins), lo, hi, None)
+    else:
+        hist, _ = jnp.histogram(
+            input._logical().ravel().astype(jnp.float64),
+            bins=np.linspace(lo, hi, builtins.int(bins) + 1),
+        )
     res = DNDarray.from_logical(hist.astype(input.dtype.jnp_type()), None, input.device, input.comm)
     if out is not None:
         out.larray = res.larray
@@ -228,12 +347,40 @@ def histc(input: DNDarray, bins: int = 100, min: float = 0.0, max: float = 0.0, 
 
 
 def histogram(a: DNDarray, bins: int = 10, range=None, normed=None, weights=None, density=None):
-    """numpy-style histogram (reference statistics.py `histogram`)."""
-    log = a._logical().ravel()
-    hist, edges = jnp.histogram(log, bins=bins, range=range, density=density)
+    """numpy-style histogram (reference statistics.py `histogram`).
+    Distributed algorithm on split inputs — per-shard counts + psum
+    (:func:`_hist_distributed`); ``weights`` follows numpy semantics on
+    every path."""
+    if hasattr(bins, "__len__"):
+        edges_np = np.asarray(bins, dtype=np.float64)
+    else:
+        if range is not None:
+            lo, hi = float(range[0]), float(range[1])
+        else:
+            lo = min(a).item() if a.size else 0.0
+            hi = max(a).item() if a.size else 1.0
+        if lo > hi:
+            raise ValueError("max must be larger than min in range parameter")
+        if lo == hi:
+            lo, hi = lo - 0.5, hi + 0.5  # numpy's degenerate-range widening
+        edges_np = np.linspace(lo, hi, builtins.int(bins) + 1)
+    if a.split is not None and a.comm.size > 1 and a.size > 0:
+        hist = _hist_distributed(a, edges_np, edges_np[0], edges_np[-1], weights)
+        if weights is None:
+            hist = hist.astype(jnp.int64)
+    else:
+        w = weights._logical().ravel() if isinstance(weights, DNDarray) else (
+            jnp.asarray(weights).ravel() if weights is not None else None
+        )
+        hist, _ = jnp.histogram(
+            a._logical().ravel().astype(jnp.float64), bins=edges_np, weights=w
+        )
+    if density:
+        db = jnp.asarray(np.diff(edges_np))
+        hist = hist / db / hist.sum()
     return (
         DNDarray.from_logical(hist, None, a.device, a.comm),
-        DNDarray.from_logical(edges, None, a.device, a.comm),
+        DNDarray.from_logical(jnp.asarray(edges_np), None, a.device, a.comm),
     )
 
 
