@@ -197,6 +197,41 @@ def _valid_weights(x: DNDarray, wbuf):
     return jnp.where(idx < x.shape[x.split], ones, jnp.zeros((), dtype=dt))
 
 
+def _global_minmax(x: DNDarray):
+    """(min, max) of a DNDarray's logical values — one device dispatch pair,
+    ONE host sync. Pads are neutralized per-extreme (dtype max on the
+    min side, dtype min on the max side), so any split/pad layout works."""
+    from .manipulations import _sort_fill
+
+    if x.pad_count:
+        lo_buf = x._masked(_sort_fill(x, descending=False))
+        hi_buf = x._masked(_sort_fill(x, descending=True))
+    else:
+        lo_buf = hi_buf = x.larray
+    # XLA's reduce-min/max compare with `lhs < rhs`, which can silently drop
+    # NaN depending on reduction order — carry an explicit NaN flag in the
+    # same fused transfer (pads are finite fills, so they can't set it)
+    nan_flag = jnp.isnan(lo_buf).any().astype(lo_buf.dtype)
+    mn, mx, has_nan = np.asarray(
+        jnp.stack([jnp.min(lo_buf), jnp.max(hi_buf), nan_flag])
+    )
+    if has_nan:
+        return np.nan, np.nan
+    return mn, mx
+
+
+def _sanitize_range(lo: float, hi: float):
+    """numpy's histogram range rules: finite, ordered, degenerate widened."""
+    lo, hi = float(lo), float(hi)
+    if not (np.isfinite(lo) and np.isfinite(hi)):
+        raise ValueError(f"supplied range of [{lo}, {hi}] is not finite")
+    if lo > hi:
+        raise ValueError("max must be larger than min in range parameter")
+    if lo == hi:
+        return lo - 0.5, hi + 0.5
+    return lo, hi
+
+
 def bincount(x: DNDarray, weights: Optional[DNDarray] = None, minlength: int = 0) -> DNDarray:
     """Occurrence counts of non-negative ints (reference statistics.py:375:
     local bincount + Allreduce). Result is replicated.
@@ -210,18 +245,14 @@ def bincount(x: DNDarray, weights: Optional[DNDarray] = None, minlength: int = 0
         raise ValueError("object too deep for desired array")
     if x.split is not None and x.comm.size > 1 and x.size > 0:
         comm = x.comm
-        # one fused pass + one host sync for both extremes (pads masked to 0
-        # are harmless: they can't fake a negative or beat the true max of a
-        # non-negative domain)
-        mbuf = x._masked(0)
-        mn, mx = (builtins.int(v) for v in np.asarray(jnp.stack([jnp.min(mbuf), jnp.max(mbuf)])))
+        mn, mx = (builtins.int(v) for v in _global_minmax(x))
         if mn < 0:
             raise ValueError("bincount: input must have no negative elements")
         nbins = builtins.max(mx + 1, builtins.int(minlength))
         wbuf = _aligned_weights_buf(x, weights)
         vw = _valid_weights(x, wbuf)
         acc = jnp.float64 if weights is not None else jnp.int64
-        buf = x._masked(0)
+        buf = x._masked(0)  # pads scatter into bin 0 with weight 0
 
         def kernel(vals, w):
             h = jnp.zeros((nbins,), dtype=acc).at[vals].add(w.astype(acc))
@@ -280,21 +311,18 @@ def cov(m: DNDarray, y: Optional[DNDarray] = None, rowvar: bool = True, bias: bo
     return arithmetics.div(c, fact)
 
 
-def _hist_distributed(x: DNDarray, bins, lo, hi, weights):
+def _hist_distributed(x: DNDarray, edges: np.ndarray, weights):
     """Histogram counts of a split array as a DISTRIBUTED algorithm: each
     shard histograms its (raveled) physical buffer locally — tail pads carry
     weight 0, binning is order-independent so ANY split axis works — and one
     psum over ICI combines the per-shard counts (the reference's local hist
-    + Allreduce, statistics.py:375/:509, as one shard_map kernel). Returns
-    the replicated (nbins,) float64 counts."""
+    + Allreduce, statistics.py:375/:509, as one shard_map kernel).
+    ``edges`` are the precomputed float64 bin edges. Returns the replicated
+    (nbins,) float64 counts."""
     comm = x.comm
     wbuf = _aligned_weights_buf(x, weights)
     vw = _valid_weights(x, wbuf)
     buf = x._masked(0)
-    if hasattr(bins, "__len__"):
-        edges = np.asarray(bins, dtype=np.float64)
-    else:
-        edges = np.linspace(float(lo), float(hi), builtins.int(bins) + 1)
 
     def kernel(vals, w):
         # bin in float64 against float64 edges on EVERY path (weighted,
@@ -323,21 +351,15 @@ def histc(input: DNDarray, bins: int = 100, min: float = 0.0, max: float = 0.0, 
     Allreduce). Replicated result; distributed algorithm on split inputs
     (:func:`_hist_distributed`)."""
     lo, hi = float(min), float(max)
-    if lo == 0.0 and hi == 0.0:
-        # the min/max PARAMETERS shadow this module's reductions — reach
-        # them through the module namespace
-        lo = globals()["min"](input).item()
-        hi = globals()["max"](input).item()
-    if lo > hi:
-        raise ValueError("max must be larger than min in range parameter")
-    if lo == hi:
-        lo, hi = lo - 0.5, hi + 0.5  # numpy's degenerate-range widening
+    if lo == 0.0 and hi == 0.0 and input.size > 0:
+        lo, hi = _global_minmax(input)  # fused pass, one host sync
+    lo, hi = _sanitize_range(lo, hi)
+    edges = np.linspace(lo, hi, builtins.int(bins) + 1)
     if input.split is not None and input.comm.size > 1 and input.size > 0:
-        hist = _hist_distributed(input, builtins.int(bins), lo, hi, None)
+        hist = _hist_distributed(input, edges, None)
     else:
         hist, _ = jnp.histogram(
-            input._logical().ravel().astype(jnp.float64),
-            bins=np.linspace(lo, hi, builtins.int(bins) + 1),
+            input._logical().ravel().astype(jnp.float64), bins=edges
         )
     res = DNDarray.from_logical(hist.astype(input.dtype.jnp_type()), None, input.device, input.comm)
     if out is not None:
@@ -356,16 +378,14 @@ def histogram(a: DNDarray, bins: int = 10, range=None, normed=None, weights=None
     else:
         if range is not None:
             lo, hi = float(range[0]), float(range[1])
+        elif a.size:
+            lo, hi = _global_minmax(a)  # fused pass, one host sync
         else:
-            lo = min(a).item() if a.size else 0.0
-            hi = max(a).item() if a.size else 1.0
-        if lo > hi:
-            raise ValueError("max must be larger than min in range parameter")
-        if lo == hi:
-            lo, hi = lo - 0.5, hi + 0.5  # numpy's degenerate-range widening
+            lo, hi = 0.0, 1.0
+        lo, hi = _sanitize_range(lo, hi)
         edges_np = np.linspace(lo, hi, builtins.int(bins) + 1)
     if a.split is not None and a.comm.size > 1 and a.size > 0:
-        hist = _hist_distributed(a, edges_np, edges_np[0], edges_np[-1], weights)
+        hist = _hist_distributed(a, edges_np, weights)
         if weights is None:
             hist = hist.astype(jnp.int64)
     else:

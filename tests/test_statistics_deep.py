@@ -477,3 +477,16 @@ class TestDistributedHistograms(TestCase):
             ht.histc(const, bins=4, min=5.0, max=1.0)
         with pytest.raises(ValueError):
             ht.histogram(const, bins=4, range=(2.0, -2.0))
+
+    def test_nan_range_raises_like_numpy(self):
+        bad = ht.array(np.asarray([1.0, np.nan]), split=0)
+        with pytest.raises(ValueError):
+            ht.histogram(bad, bins=4)  # auto-range sees NaN
+        with pytest.raises(ValueError):
+            ht.histc(bad, bins=4)
+        with pytest.raises(ValueError):
+            ht.histogram(bad, bins=4, range=(np.nan, np.nan))
+        # explicit finite range: NaNs simply aren't counted, like numpy
+        h, _ = ht.histogram(bad, bins=4, range=(0.0, 2.0))
+        hn, _ = np.histogram(np.asarray([1.0, np.nan]), bins=4, range=(0.0, 2.0))
+        np.testing.assert_array_equal(h.numpy(), hn)
