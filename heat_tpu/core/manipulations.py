@@ -290,7 +290,14 @@ def repeat(a: DNDarray, repeats, axis: Optional[int] = None) -> DNDarray:
 
 def reshape(a: DNDarray, *shape, new_split: Optional[int] = None) -> DNDarray:
     """Reshape to a new global shape (reference manipulations.py:1815, which
-    redistributes via Alltoallv :1962; here reshape-the-logical + relayout)."""
+    redistributes via Alltoallv :1962).
+
+    Reshapes that leave the split axis intact run PER-SHARD on the physical
+    buffer with zero communication — trailing reshape (split axis and every
+    dim before it unchanged) and leading reshape (split axis and every dim
+    after it unchanged); tail pads ride along untouched. Only a reshape
+    that actually crosses the split axis pays the logical-view relayout
+    (the genuine all-to-all data movement)."""
     if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
         shape = tuple(shape[0])
     shape = list(shape)
@@ -303,16 +310,56 @@ def reshape(a: DNDarray, *shape, new_split: Optional[int] = None) -> DNDarray:
         for i, s in enumerate(shape):
             if i != neg[0]:
                 known *= s
+        if known == 0:
+            # numpy raises ValueError here; bare // would ZeroDivisionError
+            raise ValueError(
+                f"cannot reshape array of size {a.size} into shape {tuple(shape)}"
+            )
         shape[neg[0]] = a.size // known
     shape = sanitize_shape(tuple(shape))
     if int(np.prod(shape)) != a.size:
         raise ValueError(f"cannot reshape array of size {a.size} into shape {tuple(shape)}")
-    res = jnp.reshape(a._logical(), shape)
     if new_split is None:
-        new_split = a.split if (a.split is not None and a.split < len(shape)) else (
-            0 if a.split is not None else None
-        )
+        if a.split is None:
+            new_split = None
+        elif a.split < len(shape):
+            new_split = a.split
+        else:
+            # rank-reducing reshape: default to the position where the split
+            # dim survives (leading dims collapsed) so the zero-comm leading
+            # fast path applies by default; fall back to 0 otherwise
+            cand = len(shape) - (a.ndim - a.split)
+            if (
+                cand >= 0
+                and tuple(shape[cand:]) == tuple(a.shape[a.split :])
+                and int(np.prod(shape[:cand], initial=1))
+                == int(np.prod(a.shape[: a.split], initial=1))
+            ):
+                new_split = cand
+            else:
+                new_split = 0
     new_split = sanitize_axis(shape, new_split)
+    s = a.split
+    if s is not None and a.comm.size > 1:
+        shape_t = tuple(shape)
+        # trailing reshape: dims [0..s] unchanged, new split stays at s
+        if new_split == s and shape_t[: s + 1] == tuple(a.shape[: s + 1]):
+            phys = a.larray.shape[: s + 1] + shape_t[s + 1 :]
+            buf = jax.device_put(
+                jnp.reshape(a.larray, phys), a.comm.sharding(s, len(shape_t))
+            )
+            return DNDarray(buf, shape_t, a.dtype, s, a.device, a.comm, True)
+        # leading reshape: dims [s..] unchanged and land at new_split
+        if (
+            shape_t[new_split:] == tuple(a.shape[s:])
+            and int(np.prod(shape_t[:new_split], initial=1)) == int(np.prod(a.shape[:s], initial=1))
+        ):
+            phys = shape_t[:new_split] + a.larray.shape[s:]
+            buf = jax.device_put(
+                jnp.reshape(a.larray, phys), a.comm.sharding(new_split, len(shape_t))
+            )
+            return DNDarray(buf, shape_t, a.dtype, new_split, a.device, a.comm, True)
+    res = jnp.reshape(a._logical(), shape)
     return _rewrap(res, new_split, a)
 
 

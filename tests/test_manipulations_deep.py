@@ -535,3 +535,69 @@ class TestDistributedTopk(TestCase):
         want = np.take_along_axis(t, np.argsort(-t, axis=0, kind="stable"), axis=0)[:4]
         np.testing.assert_allclose(v.numpy(), want)
         np.testing.assert_array_equal(np.take_along_axis(t, i.numpy(), axis=0), v.numpy())
+
+
+class TestReshapeFastPaths(TestCase):
+    """Reshapes that leave the split axis intact run per-shard on the
+    physical buffer — zero communication, zero logical-view slices; only a
+    reshape crossing the split axis pays the relayout."""
+
+    def _nlog(self):
+        from heat_tpu.core.dndarray import _PERF_STATS
+
+        return _PERF_STATS["logical_slices"]
+
+    def test_trailing_reshape_no_logical_slice(self):
+        rng = np.random.default_rng(95)
+        n = 2 * self.comm.size + 3  # force tail pads
+        t = rng.standard_normal((n, 4, 6)).astype(np.float32)
+        x = ht.array(t, split=0)
+        c0 = self._nlog()
+        r = ht.reshape(x, (n, 24))
+        r2 = ht.reshape(x, (n, 2, 2, 6))
+        r3 = ht.reshape(x, (n, 24, 1))
+        assert self._nlog() == c0
+        assert r.split == r2.split == r3.split == 0
+        np.testing.assert_array_equal(r.numpy(), t.reshape(n, 24))
+        np.testing.assert_array_equal(r2.numpy(), t.reshape(n, 2, 2, 6))
+        np.testing.assert_array_equal(r3.numpy(), t.reshape(n, 24, 1))
+        shards = [s.data.shape for s in r.larray.addressable_shards]
+        assert all(s == shards[0] for s in shards), "non-canonical layout"
+
+    def test_leading_reshape_no_logical_slice(self):
+        rng = np.random.default_rng(96)
+        n = 3 * self.comm.size + 1
+        t = rng.standard_normal((2, 3, n)).astype(np.float32)
+        x = ht.array(t, split=2)
+        c0 = self._nlog()
+        r = ht.reshape(x, (6, n), new_split=1)
+        assert self._nlog() == c0
+        assert r.split == 1
+        np.testing.assert_array_equal(r.numpy(), t.reshape(6, n))
+
+    def test_crossing_reshape_still_exact(self):
+        rng = np.random.default_rng(97)
+        t = rng.standard_normal((4 * self.comm.size, 5)).astype(np.float32)
+        x = ht.array(t, split=0)
+        for shp in ((5, -1), (t.size,), (2, -1, 5)):
+            np.testing.assert_array_equal(
+                ht.reshape(x, shp).numpy(), t.reshape(shp)
+            )
+
+    def test_rank_reducing_default_split_survives(self):
+        # default new_split lands where the split dim survives -> fast path
+        rng = np.random.default_rng(98)
+        n = 3 * self.comm.size + 1
+        t = rng.standard_normal((2, 3, n)).astype(np.float32)
+        x = ht.array(t, split=2)
+        c0 = self._nlog()
+        r = ht.reshape(x, (6, n))
+        if self.comm.size > 1:
+            assert self._nlog() == c0
+            assert r.split == 1
+        np.testing.assert_array_equal(r.numpy(), t.reshape(6, n))
+
+    def test_zero_size_minus_one_raises_valueerror(self):
+        x = ht.array(np.empty((0, 6), dtype=np.float32), split=0)
+        with pytest.raises(ValueError):
+            ht.reshape(x, (0, -1))
