@@ -11,6 +11,7 @@ from __future__ import annotations
 import builtins
 from typing import Optional, Union
 
+import jax
 import jax.numpy as jnp
 
 from . import types
@@ -106,8 +107,15 @@ def cumsum(a: DNDarray, axis: int, dtype=None, out=None) -> DNDarray:
 
 def diff(a: DNDarray, n: int = 1, axis: int = -1) -> DNDarray:
     """n-th discrete difference along axis (reference arithmetics.py `diff`,
-    which sends boundary slices between ranks; the shifted-slice subtraction
-    here compiles to a halo exchange)."""
+    which sends boundary slices between ranks).
+
+    Off the split axis this is purely shard-local (physical buffer, zero
+    communication). Along the split axis it is a HALO stencil: each shard
+    ppermutes its leading ``n`` rows to the previous shard, extends its
+    block, and diffs locally — the reference's boundary-slice send as one
+    shard_map kernel. The logical gather only remains for the corner cases
+    where the result's chunking changes (tiny arrays, n close to the
+    extent)."""
     if n == 0:
         return a
     if n < 0:
@@ -115,12 +123,34 @@ def diff(a: DNDarray, n: int = 1, axis: int = -1) -> DNDarray:
     from .stride_tricks import sanitize_axis
 
     axis = sanitize_axis(a.shape, axis)
-    log = a._logical()
-    res = log
-    for _ in range(n):
-        res = jnp.diff(res, axis=axis)
-    split = a.split
-    return DNDarray.from_logical(res, split, a.device, a.comm)
+    s = a.split
+    if s is not None and axis != s:
+        # shard-local: the split dim (and its pads) is untouched
+        buf = jnp.diff(a.larray, n=n, axis=axis)
+        gshape = tuple(
+            max(dim - n, 0) if d == axis else dim for d, dim in enumerate(a.shape)
+        )
+        return DNDarray(buf, gshape, a.dtype, s, a.device, a.comm, True)
+    if s is not None and a.comm.size > 1:
+        comm = a.comm
+        chunk = a.larray.shape[s] // comm.size
+        n_out = a.shape[s] - n
+        # fast path needs: halo fits in a chunk, and the result keeps the
+        # same chunking (so shard-local outputs are already canonical; any
+        # pad-contaminated rows land in the result's own pad region)
+        if 0 < n <= chunk and n_out > 0 and -(-n_out // comm.size) == chunk:
+            from ..parallel.halo import halo_stencil
+
+            buf = halo_stencil(
+                a.larray, n, lambda ext: jnp.diff(ext, n=n, axis=s),
+                comm=comm, axis=s, sides="next",
+            )
+            gshape = tuple(
+                n_out if d == s else dim for d, dim in enumerate(a.shape)
+            )
+            return DNDarray(buf, gshape, a.dtype, s, a.device, a.comm, True)
+    res = jnp.diff(a._logical(), n=n, axis=axis)
+    return DNDarray.from_logical(res, a.split, a.device, a.comm)
 
 
 def div(t1, t2, out=None) -> DNDarray:

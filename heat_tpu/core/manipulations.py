@@ -65,6 +65,23 @@ def _rewrap(log, split, proto: DNDarray, dtype=None) -> DNDarray:
     return DNDarray.from_logical(log, split, proto.device, proto.comm, dtype)
 
 
+def _canonical(buf, comm, split):
+    """Ensure ``buf`` carries the canonical NamedSharding for ``split``.
+    A no-op (and uncounted) when XLA's sharding propagation already chose
+    it; otherwise one counted resharding device_put — so the perf counters
+    keep their contract: physical fast paths that move no data stay at 0."""
+    want = comm.sharding(split, buf.ndim)
+    try:
+        if buf.sharding.is_equivalent_to(want, buf.ndim):
+            return buf
+    except Exception:
+        pass
+    from .dndarray import _PERF_STATS
+
+    _PERF_STATS["device_puts"] += 1
+    return jax.device_put(buf, want)
+
+
 def balance(array: DNDarray, copy: bool = False) -> DNDarray:
     """Balanced copy (reference manipulations.py `balance`); the tail-pad
     layout is always balanced, so this is (a copy of) the input."""
@@ -345,9 +362,7 @@ def reshape(a: DNDarray, *shape, new_split: Optional[int] = None) -> DNDarray:
         # trailing reshape: dims [0..s] unchanged, new split stays at s
         if new_split == s and shape_t[: s + 1] == tuple(a.shape[: s + 1]):
             phys = a.larray.shape[: s + 1] + shape_t[s + 1 :]
-            buf = jax.device_put(
-                jnp.reshape(a.larray, phys), a.comm.sharding(s, len(shape_t))
-            )
+            buf = _canonical(jnp.reshape(a.larray, phys), a.comm, s)
             return DNDarray(buf, shape_t, a.dtype, s, a.device, a.comm, True)
         # leading reshape: dims [s..] unchanged and land at new_split
         if (
@@ -355,9 +370,7 @@ def reshape(a: DNDarray, *shape, new_split: Optional[int] = None) -> DNDarray:
             and int(np.prod(shape_t[:new_split], initial=1)) == int(np.prod(a.shape[:s], initial=1))
         ):
             phys = shape_t[:new_split] + a.larray.shape[s:]
-            buf = jax.device_put(
-                jnp.reshape(a.larray, phys), a.comm.sharding(new_split, len(shape_t))
-            )
+            buf = _canonical(jnp.reshape(a.larray, phys), a.comm, new_split)
             return DNDarray(buf, shape_t, a.dtype, new_split, a.device, a.comm, True)
     res = jnp.reshape(a._logical(), shape)
     return _rewrap(res, new_split, a)

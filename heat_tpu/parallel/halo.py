@@ -15,6 +15,32 @@ import jax
 import jax.numpy as jnp
 
 
+def _halo_parts(xb, halo_size: int, axis: int, name: str, p: int, wrap: bool):
+    """In-kernel neighbor slices: (from_prev, from_next) for one shard.
+    The single home of the ring perms and terminal zero-fill — every halo
+    consumer (exchange, stencils) shares it."""
+    fwd = [(i, (i + 1) % p) for i in range(p)]   # send to next
+    bwd = [(i, (i - 1) % p) for i in range(p)]   # send to prev
+    rank = jax.lax.axis_index(name)
+    lead = jax.lax.slice_in_dim(xb, 0, halo_size, axis=axis)
+    n = xb.shape[axis]
+    trail = jax.lax.slice_in_dim(xb, n - halo_size, n, axis=axis)
+    from_prev = jax.lax.ppermute(trail, name, perm=fwd)
+    from_next = jax.lax.ppermute(lead, name, perm=bwd)
+    if not wrap:
+        zero = jnp.zeros_like(from_prev)
+        from_prev = jnp.where(rank == 0, zero, from_prev)
+        from_next = jnp.where(rank == p - 1, zero, from_next)
+    return from_prev, from_next
+
+
+def _check_halo(x, halo_size: int, axis: int, p: int) -> None:
+    if x.shape[axis] // p < halo_size:
+        raise ValueError(
+            f"halo_size {halo_size} exceeds local extent {x.shape[axis] // p}"
+        )
+
+
 def halo_exchange(
     x: jax.Array,
     halo_size: int,
@@ -36,24 +62,10 @@ def halo_exchange(
     """
     p = comm.size
     name = comm.axis_name
-    if x.shape[axis] // p < halo_size:
-        raise ValueError(
-            f"halo_size {halo_size} exceeds local extent {x.shape[axis] // p}"
-        )
-    fwd = [(i, (i + 1) % p) for i in range(p)]   # send to next
-    bwd = [(i, (i - 1) % p) for i in range(p)]   # send to prev
+    _check_halo(x, halo_size, axis, p)
 
     def kernel(xb):
-        rank = jax.lax.axis_index(name)
-        lead = jax.lax.slice_in_dim(xb, 0, halo_size, axis=axis)
-        n = xb.shape[axis]
-        trail = jax.lax.slice_in_dim(xb, n - halo_size, n, axis=axis)
-        from_prev = jax.lax.ppermute(trail, name, perm=fwd)
-        from_next = jax.lax.ppermute(lead, name, perm=bwd)
-        if not wrap:
-            zero = jnp.zeros_like(from_prev)
-            from_prev = jnp.where(rank == 0, zero, from_prev)
-            from_next = jnp.where(rank == p - 1, zero, from_next)
+        from_prev, from_next = _halo_parts(xb, halo_size, axis, name, p, wrap)
         if return_parts:
             return from_prev, from_next
         return jnp.concatenate([from_prev, xb, from_next], axis=axis)
@@ -62,4 +74,45 @@ def halo_exchange(
     out_specs = (spec, spec) if return_parts else spec
     return jax.shard_map(
         kernel, mesh=comm.mesh, in_specs=(spec,), out_specs=out_specs
+    )(x)
+
+
+def halo_stencil(
+    x: jax.Array,
+    halo_size: int,
+    fn,
+    *,
+    comm,
+    axis: int = 0,
+    wrap: bool = False,
+    sides: str = "both",
+) -> jax.Array:
+    """Apply ``fn`` to each shard's halo-extended block inside ONE shard_map.
+
+    ``fn`` receives the local block with ``halo_size`` neighbor rows
+    prepended/appended per ``sides`` ("prev" | "next" | "both") and must
+    return a block sharded the same way (out spec = in spec). This is the
+    boundary-op building block: a stencil that would otherwise need an
+    eager gather runs as local compute + two ppermutes over ICI
+    (reference analog: DNDarray.get_halo Isend/Irecv,
+    reference heat/core/dndarray.py:360-433)."""
+    p = comm.size
+    name = comm.axis_name
+    _check_halo(x, halo_size, axis, p)
+    if sides not in ("prev", "next", "both"):
+        raise ValueError(f"sides must be 'prev', 'next' or 'both', got {sides!r}")
+
+    def kernel(xb):
+        from_prev, from_next = _halo_parts(xb, halo_size, axis, name, p, wrap)
+        parts = []
+        if sides in ("prev", "both"):
+            parts.append(from_prev)
+        parts.append(xb)
+        if sides in ("next", "both"):
+            parts.append(from_next)
+        return fn(jnp.concatenate(parts, axis=axis))
+
+    spec = comm.spec(axis, x.ndim)
+    return jax.shard_map(
+        kernel, mesh=comm.mesh, in_specs=(spec,), out_specs=spec
     )(x)

@@ -332,3 +332,67 @@ class TestLogicalReductionSplits(TestCase):
         np.testing.assert_array_equal(
             ht.isneginf(x).numpy().astype(bool), np.isneginf(a)
         )
+
+
+class TestDiffHaloPath(TestCase):
+    """diff along the split axis is a halo stencil (leading-n ppermute +
+    local diff); off the split axis it is shard-local. Neither gathers."""
+
+    def _nlog(self):
+        from heat_tpu.core.dndarray import _PERF_STATS
+
+        return _PERF_STATS["logical_slices"]
+
+    def test_split_axis_halo_no_gather(self):
+        rng = np.random.default_rng(99)
+        p = self.comm.size
+        # NOT divisible, so a slow-path gather WOULD bump the counter; the
+        # halo fast path applies when the result keeps the chunking, i.e.
+        # order < p - pads (pads = 1 here) — all three orders at p >= 5,
+        # order 1 at p == 3, none at p <= 2 (the gate itself under test)
+        n_rows = 8 * p - 1
+        chunk = -(-n_rows // p)
+        a = rng.standard_normal(n_rows)
+        x = ht.array(a, split=0)
+
+        def fast(order):
+            return (
+                p > 1 and 0 < order <= chunk and n_rows - order > 0
+                and -(-(n_rows - order) // p) == chunk
+            )
+
+        pads = chunk * p - n_rows
+        expected_gathers = sum(
+            1 for o in (1, 2, 3) if not fast(o) and pads > 0
+        )
+        c0 = self._nlog()
+        results = {order: ht.diff(x, n=order) for order in (1, 2, 3)}
+        assert self._nlog() == c0 + expected_gathers
+        if p >= 3:
+            assert any(fast(o) for o in (1, 2, 3)), "fast path never eligible"
+        for order, r in results.items():
+            assert r.split == 0
+            np.testing.assert_allclose(r.numpy(), np.diff(a, n=order), atol=1e-12)
+
+    def test_off_split_axis_local(self):
+        rng = np.random.default_rng(100)
+        t = rng.standard_normal((3 * self.comm.size + 1, 7))
+        for split, axis in ((0, 1), (1, 0)):
+            x = ht.array(t, split=split)
+            c0 = self._nlog()
+            r = ht.diff(x, n=2, axis=axis)
+            assert self._nlog() == c0
+            np.testing.assert_allclose(r.numpy(), np.diff(t, n=2, axis=axis), atol=1e-12)
+
+    def test_uneven_and_corner_sizes(self):
+        rng = np.random.default_rng(101)
+        for n_rows in (self.comm.size + 1, 2 * self.comm.size + 3, 3):
+            a = rng.standard_normal(n_rows)
+            x = ht.array(a, split=0)
+            for order in (1, 2, n_rows - 1, n_rows):
+                if order < 0:
+                    continue
+                np.testing.assert_allclose(
+                    ht.diff(x, n=order).numpy(), np.diff(a, n=order), atol=1e-12,
+                    err_msg=f"{n_rows} {order}",
+                )
