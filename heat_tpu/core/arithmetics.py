@@ -11,7 +11,6 @@ from __future__ import annotations
 import builtins
 from typing import Optional, Union
 
-import jax
 import jax.numpy as jnp
 
 from . import types

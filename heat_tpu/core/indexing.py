@@ -419,12 +419,44 @@ def setitem(x: DNDarray, key, value) -> None:
     ).larray
 
 
-def nonzero(x: DNDarray) -> DNDarray:
-    """Indices of nonzero elements as an (nnz, ndim) array, distributed along
-    axis 0 when the input is split (reference indexing.py `nonzero`, which
-    stacks local torch.nonzero + offset)."""
-    from . import factories
+def _nonzero_compact(comm: MeshCommunication, nnz_pad: int, ndim: int, dest, vals):
+    """Scatter-compaction into the (nnz_pad, ndim) result. The scatter runs
+    SPMD over the sharded dest/vals (XLA may keep its output replicated —
+    forcing out_shardings on a scatter trips a GSPMD override assertion);
+    one device_put lays the O(nnz)-sized result out split=0. Only
+    result-sized traffic, never an input gather."""
+    out = jnp.zeros((nnz_pad, ndim), dtype=jnp.int64).at[dest].set(vals, mode="drop")
+    return jax.device_put(out, comm.sharding(0, 2))
 
+
+def nonzero(x: DNDarray) -> DNDarray:
+    """Indices of nonzero elements as an (nnz, ndim) array, distributed
+    along axis 0 when the input is split (reference indexing.py `nonzero`,
+    which stacks local torch.nonzero + offset).
+
+    For split=0 inputs this is a DISTRIBUTED algorithm: mask the physical
+    buffer (pads masked out), a distributed cumsum assigns every nonzero
+    its global output row, and a sharded scatter compacts the multi-indices
+    into the (nnz, ndim) split=0 result — only the scalar nnz crosses to
+    the host, because output *shape* is host metadata (same design as
+    `unique`). The row-major physical order IS the global order when
+    split=0 (tail-pad invariant), so results match numpy's ordering."""
+    if x.ndim > 0 and x.split == 0 and x.comm.size > 1:
+        comm = x.comm
+        buf = x._masked(0)
+        flat = jnp.reshape(buf, (-1,))
+        mask = flat != 0
+        nnz = builtins.int(mask.sum())
+        nnz_pad = comm.padded_size(nnz)
+        # global output row per element; masked-off elements are routed to
+        # row nnz_pad, which mode='drop' discards
+        dest = jnp.where(mask, jnp.cumsum(mask) - 1, nnz_pad)
+        multi = jnp.unravel_index(jnp.arange(flat.shape[0]), buf.shape)
+        vals = jnp.stack(multi, axis=1).astype(jnp.int64)
+        res = _nonzero_compact(comm, nnz_pad, x.ndim, dest, vals)
+        return DNDarray(
+            res, (nnz, x.ndim), types.int64, 0, x.device, x.comm, True
+        )
     log = x._logical()
     idx = jnp.stack(jnp.nonzero(log), axis=1) if x.ndim > 0 else jnp.nonzero(log)[0][:, None]
     split = 0 if x.split is not None else None

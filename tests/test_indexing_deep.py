@@ -227,3 +227,48 @@ class TestViewSemantics(TestCase):
         y = ht.array(a, split=0)
         x[0] = -5.0
         np.testing.assert_array_equal(y.numpy(), a)
+
+
+class TestDistributedNonzero(TestCase):
+    """nonzero on split=0 inputs is a distributed compaction (mask →
+    distributed cumsum → sharded scatter): only the scalar nnz reaches the
+    host, results stay split=0 in numpy row-major order."""
+
+    def _nlog(self):
+        from heat_tpu.core.dndarray import _PERF_STATS
+
+        return _PERF_STATS["logical_slices"]
+
+    def test_no_gather_and_numpy_order(self):
+        rng = np.random.default_rng(111)
+        for shape in ((5 * self.comm.size + 3,), (3 * self.comm.size + 1, 4)):
+            t = rng.standard_normal(shape)
+            t[t < 0.3] = 0.0
+            x = ht.array(t, split=0)
+            c0 = self._nlog()
+            r = ht.nonzero(x)
+            assert self._nlog() == c0
+            assert r.split == 0
+            np.testing.assert_array_equal(r.numpy(), np.stack(np.nonzero(t), axis=1))
+
+    def test_empty_full_and_fallbacks(self):
+        p = self.comm.size
+        assert ht.nonzero(ht.zeros((3 * p,), split=0)).shape == (0, 1)
+        np.testing.assert_array_equal(
+            ht.nonzero(ht.ones((2 * p + 1,), split=0)).numpy(),
+            np.arange(2 * p + 1)[:, None],
+        )
+        rng = np.random.default_rng(112)
+        t = rng.standard_normal((4, 2 * p))
+        t[t < 0] = 0
+        for split in (None, 1):
+            np.testing.assert_array_equal(
+                ht.nonzero(ht.array(t, split=split)).numpy(),
+                np.stack(np.nonzero(t), axis=1),
+            )
+
+    def test_where_one_arg_routes_through(self):
+        p = self.comm.size
+        a = np.arange(3 * p, dtype=np.float32) - p
+        got = ht.where(ht.array(a, split=0) > 0)
+        np.testing.assert_array_equal(got.numpy(), np.stack(np.nonzero(a > 0), axis=1))
