@@ -210,7 +210,36 @@ def _normalize_basic_key_physical(expanded, x: DNDarray):
     return tuple(out)
 
 
+def _masked_select_distributed(x: DNDarray, mask: DNDarray) -> DNDarray:
+    """``x[mask]`` for a full-shape boolean mask on a split=0 array as a
+    DISTRIBUTED compaction (the nonzero design): pad-False mask →
+    distributed cumsum assigns global output rows → sharded scatter of the
+    VALUES into the (nnz,) split=0 result. Neither the data nor the mask
+    ever gathers; only the scalar nnz reaches the host."""
+    comm = x.comm
+    if mask.split != x.split:
+        # relayout of the MASK only (bool, 1 byte/elem) — x never moves
+        mask = mask.resplit(x.split)
+    flatm = jnp.reshape(mask._masked(False), (-1,))
+    flatv = jnp.reshape(x.larray, (-1,))  # pads never selected: mask pad False
+    nnz = builtins.int(flatm.sum())
+    nnz_pad = comm.padded_size(nnz)
+    dest = jnp.where(flatm, jnp.cumsum(flatm) - 1, nnz_pad)
+    out = _scatter_compact(comm, (nnz_pad,), flatv.dtype, dest, flatv)
+    return DNDarray(out, (nnz,), x.dtype, 0, x.device, x.comm, True)
+
+
 def getitem(x: DNDarray, key) -> DNDarray:
+    # full-shape boolean DNDarray mask on a split=0 array: distributed
+    # compaction BEFORE _normalize_key (which would gather the mask)
+    if (
+        isinstance(key, DNDarray)
+        and key.dtype == types.bool
+        and tuple(key.shape) == tuple(x.shape)
+        and x.split == 0
+        and x.comm.size > 1
+    ):
+        return _masked_select_distributed(x, key)
     key = _normalize_key(key, x)
 
     # --- sharded gather: a single 1-D integer-array key -------------------
@@ -419,14 +448,15 @@ def setitem(x: DNDarray, key, value) -> None:
     ).larray
 
 
-def _nonzero_compact(comm: MeshCommunication, nnz_pad: int, ndim: int, dest, vals):
-    """Scatter-compaction into the (nnz_pad, ndim) result. The scatter runs
-    SPMD over the sharded dest/vals (XLA may keep its output replicated —
-    forcing out_shardings on a scatter trips a GSPMD override assertion);
-    one device_put lays the O(nnz)-sized result out split=0. Only
-    result-sized traffic, never an input gather."""
-    out = jnp.zeros((nnz_pad, ndim), dtype=jnp.int64).at[dest].set(vals, mode="drop")
-    return jax.device_put(out, comm.sharding(0, 2))
+def _scatter_compact(comm: MeshCommunication, out_shape, dtype, dest, vals):
+    """Scatter-compaction into a split=0 result of ``out_shape``. The
+    scatter runs SPMD over the sharded dest/vals (XLA may keep its output
+    replicated — forcing out_shardings on a scatter trips a GSPMD override
+    assertion); one device_put lays the O(result)-sized output out split=0.
+    Only result-sized traffic, never an input gather. Shared by nonzero and
+    the boolean masked select."""
+    out = jnp.zeros(out_shape, dtype=dtype).at[dest].set(vals, mode="drop")
+    return jax.device_put(out, comm.sharding(0, len(out_shape)))
 
 
 def nonzero(x: DNDarray) -> DNDarray:
@@ -453,7 +483,7 @@ def nonzero(x: DNDarray) -> DNDarray:
         dest = jnp.where(mask, jnp.cumsum(mask) - 1, nnz_pad)
         multi = jnp.unravel_index(jnp.arange(flat.shape[0]), buf.shape)
         vals = jnp.stack(multi, axis=1).astype(jnp.int64)
-        res = _nonzero_compact(comm, nnz_pad, x.ndim, dest, vals)
+        res = _scatter_compact(comm, (nnz_pad, x.ndim), jnp.int64, dest, vals)
         return DNDarray(
             res, (nnz, x.ndim), types.int64, 0, x.device, x.comm, True
         )

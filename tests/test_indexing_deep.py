@@ -272,3 +272,34 @@ class TestDistributedNonzero(TestCase):
         a = np.arange(3 * p, dtype=np.float32) - p
         got = ht.where(ht.array(a, split=0) > 0)
         np.testing.assert_array_equal(got.numpy(), np.stack(np.nonzero(a > 0), axis=1))
+
+
+class TestDistributedMaskedSelect(TestCase):
+    """x[mask] with a full-shape boolean DNDarray mask on split=0 data runs
+    the distributed compaction — neither data nor mask gathers; only the
+    scalar nnz reaches the host."""
+
+    def _nlog(self):
+        from heat_tpu.core.dndarray import _PERF_STATS
+
+        return _PERF_STATS["logical_slices"]
+
+    def test_no_gather_order_and_split(self):
+        rng = np.random.default_rng(113)
+        for shape in ((5 * self.comm.size + 3,), (2 * self.comm.size + 1, 4)):
+            t = rng.standard_normal(shape).astype(np.float32)
+            x = ht.array(t, split=0)
+            c0 = self._nlog()
+            r = x[x > 0.2]
+            assert self._nlog() == c0
+            assert r.split == 0
+            np.testing.assert_array_equal(r.numpy(), t[t > 0.2])
+
+    def test_replicated_mask_empty_and_full(self):
+        rng = np.random.default_rng(114)
+        t = rng.standard_normal(4 * self.comm.size + 1).astype(np.float32)
+        x = ht.array(t, split=0)
+        m = ht.array(t > 0, split=None)
+        np.testing.assert_array_equal(x[m].numpy(), t[t > 0])
+        np.testing.assert_array_equal(x[x > 1e9].numpy(), t[t > 1e9])
+        np.testing.assert_array_equal(x[x < 1e9].numpy(), t[t < 1e9])
