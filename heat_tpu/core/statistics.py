@@ -487,8 +487,15 @@ def _percentile_sorted_distributed(x: DNDarray, qa, interpolation: str):
     i0 = np.floor(pos).astype(np.int64)
     i1 = np.ceil(pos).astype(np.int64)
     inear = np.round(pos).astype(np.int64)
-    picked = vals[np.concatenate([i0, i1, inear])]  # sharded gather
-    pl = picked._logical().astype(jnp.float64)
+    # sharded gather with a REPLICATED (3m,) result — the picks are tiny and
+    # every position needs them; routing through a split result + _logical
+    # would gather via the host and is forbidden multi-host
+    from .indexing import _sharded_take_fn
+
+    take = _sharded_take_fn(x.comm, 0, None, 1)
+    pl = take(
+        vals.larray, jnp.asarray(np.concatenate([i0, i1, inear]))
+    ).astype(jnp.float64)
     m = len(q_flat)
     v0, v1, vn = pl[:m], pl[m : 2 * m], pl[2 * m :]
     if interpolation == "linear":

@@ -101,6 +101,36 @@ sorted_x, _ = ht.sort(rev)
 diff = float(ht.max(ht.abs(sorted_x - x)).item())
 assert diff == 0.0, diff
 
+# ======= stage 3: distributed statistics / compaction ops cross-host ======
+# (all of these avoid _logical by design, so they must work multi-host)
+
+# percentile/median: distributed sort + order-statistic gather
+p50 = float(ht.percentile(x, 50.0).item())
+assert abs(p50 - (n - 1) / 2.0) < 1e-9, p50
+assert abs(float(ht.median(x).item()) - (n - 1) / 2.0) < 1e-9
+
+# histogram/bincount: per-shard counts + one psum (replicated results)
+h, e = ht.histogram(x, bins=5, range=(0.0, float(n)))
+assert int(np.asarray(h.larray).sum()) == n
+cnt = ht.bincount(ht.array((local % 3).astype(np.int64), is_split=0))
+assert int(np.asarray(cnt.larray).sum()) == n
+
+# nonzero + masked select: scatter compaction, split=0 results
+nz = ht.nonzero(x)  # the assembled array has one zero (position 0)
+assert nz.shape == (n - 1, 1) and nz.split == 0, nz.shape
+sel = x[x > 4.5]
+assert sel.shape == (n - 5,) and sel.split == 0
+assert abs(float(ht.sum(sel).item()) - float(sum(range(5, n)))) < 1e-4
+
+# topk: two-stage select over both hosts
+tv, ti = ht.topk(x, 3)
+assert [float(v) for v in np.asarray(tv.larray)] == [9.0, 8.0, 7.0]
+
+# diff: halo stencil across the host boundary (telescoping sum = x[-1]-x[0])
+d = ht.diff(x)
+assert d.split == 0 and d.shape == (n - 1,)
+assert abs(float(ht.sum(d).item()) - (n - 1.0)) < 1e-6
+
 print(f"RANK{rank}_OK", flush=True)
 """
 
