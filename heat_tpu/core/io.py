@@ -97,8 +97,13 @@ def load_csv(
     device=None,
     comm=None,
 ) -> DNDarray:
-    """Load a CSV file (reference io.py:710 splits byte ranges per rank; one
-    host read + shard here)."""
+    """Load a CSV file (reference io.py:710 splits byte ranges per rank).
+
+    Single-controller: one host read (native multithreaded tokenizer when
+    available) + shard. Multi-host: each process tokenizes ONLY its
+    canonical row block (`csv_parse_range` — just the newline scan touches
+    the whole file) and the blocks assemble via ``is_split`` — the
+    reference's per-rank byte-range design with canonical chunking."""
     if not isinstance(path, str):
         raise TypeError(f"Expected path to be str, but was {type(path)}")
     if not isinstance(sep, str):
@@ -107,25 +112,61 @@ def load_csv(
         raise TypeError(f"Expected header_lines to be int, but was {type(header_lines)}")
     from .. import native
 
-    data = None
-    if encoding.replace("-", "").lower() in ("utf8", "ascii"):
-        # the native tokenizer reads raw bytes; other encodings go through
-        # numpy's decoding path
-        data = native.parse_csv(path, sep=sep, header_lines=header_lines)
-    if data is None:  # no compiler / exotic separator/encoding: numpy path
+    import jax
+
+    def _genfromtxt_2d():
+        """numpy fallback read, always (rows, cols) — genfromtxt collapses
+        single rows/columns to 1-D and a single value to 0-D; recover the
+        column count from the first data line."""
         data = np.genfromtxt(
             path, delimiter=sep, skip_header=header_lines, encoding=encoding
         )
         if data.ndim < 2:
-            # genfromtxt collapses single rows/columns to 1-D and a single
-            # value to 0-D; recover (rows, cols) — the reference's invariant
-            # shape — from the first data line's field count
             with open(path, "r", encoding=encoding) as f:
                 for _ in range(header_lines):
                     f.readline()
                 line = f.readline().strip()
             ncols = len(line.split(sep)) if line else 1
             data = data.reshape(-1, ncols)
+        return data
+
+    if jax.process_count() > 1:
+        if split != 0:
+            raise NotImplementedError(
+                "multi-host load_csv supports split=0 (row-sharded) only"
+            )
+        c = sanitize_comm(comm)
+        dims = None
+        if encoding.replace("-", "").lower() in ("utf8", "ascii"):
+            dims = native.csv_dims(path, sep, header_lines)
+        full = None
+        if dims is not None:
+            rows, cols = dims
+        else:
+            # no native lib / exotic encoding: every process reads the file
+            # and keeps its canonical block — wasteful IO, correct assembly
+            full = _genfromtxt_2d()
+            rows, cols = full.shape
+        # this process's canonical row block: the chunks of ITS devices in
+        # the communicator's mesh (a sub-mesh comm may own fewer devices
+        # than jax.local_device_count())
+        ldc = sum(1 for d in c.devices if d.process_index == jax.process_index())
+        cs = c.chunk_size(rows)
+        lo = min(c.first_local_position() * cs, rows)
+        hi = min((c.first_local_position() + ldc) * cs, rows)
+        if full is not None:
+            block = full[lo:hi]
+        else:
+            block = native.parse_csv_range(path, sep, header_lines, lo, hi - lo, cols)
+        return _array(block, dtype=dtype, is_split=0, device=device, comm=comm)
+
+    data = None
+    if encoding.replace("-", "").lower() in ("utf8", "ascii"):
+        # the native tokenizer reads raw bytes; other encodings go through
+        # numpy's decoding path
+        data = native.parse_csv(path, sep=sep, header_lines=header_lines)
+    if data is None:  # no compiler / exotic separator/encoding: numpy path
+        data = _genfromtxt_2d()
     return _array(data, dtype=dtype, split=split, device=device, comm=comm)
 
 

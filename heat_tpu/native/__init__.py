@@ -24,7 +24,7 @@ from typing import Optional
 
 import numpy as np
 
-__all__ = ["parse_csv", "native_available"]
+__all__ = ["parse_csv", "parse_csv_range", "csv_dims", "native_available"]
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "fastcsv.cpp")
@@ -93,13 +93,41 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_double), ctypes.c_long, ctypes.c_long,
         ]
         lib.csv_parse.restype = ctypes.c_int
+        lib.csv_parse_range.argtypes = [
+            ctypes.c_char_p, ctypes.c_char, ctypes.c_long,
+            ctypes.c_long, ctypes.c_long,
+            ctypes.POINTER(ctypes.c_double), ctypes.c_long,
+        ]
+        lib.csv_parse_range.restype = ctypes.c_int
         _lib = lib
         return _lib
+
+
+def _sep_byte(sep: str):
+    raw = sep.encode("utf-8")
+    return ctypes.c_char(raw) if len(raw) == 1 else None
 
 
 def native_available() -> bool:
     """Whether the native fastcsv library is (or can be) loaded."""
     return _load() is not None
+
+
+def csv_dims(
+    path: str, sep: str = ",", header_lines: int = 0
+) -> Optional[tuple]:
+    """(rows, cols) of a CSV per the native scanner, or None when the native
+    library or single-byte separator is unavailable."""
+    lib = _load()
+    bsep = _sep_byte(sep)
+    if lib is None or bsep is None:
+        return None
+    rows = ctypes.c_long()
+    cols = ctypes.c_long()
+    rc = lib.csv_dims(os.fsencode(path), bsep, header_lines, ctypes.byref(rows), ctypes.byref(cols))
+    if rc != 0:
+        raise OSError(f"fastcsv: cannot read {path!r} (rc={rc})")
+    return rows.value, cols.value
 
 
 def parse_csv(
@@ -108,26 +136,44 @@ def parse_csv(
     """Parse a numeric CSV into a float64 (rows, cols) array with the native
     tokenizer. Returns None when the native library is unavailable (callers
     fall back to numpy) — raises only for I/O errors on an available lib."""
-    lib = _load()
-    if lib is None:
+    dims = csv_dims(path, sep, header_lines)
+    if dims is None:
         return None
-    bsep_raw = sep.encode("utf-8")
-    if len(bsep_raw) != 1:
-        return None  # multi-char / non-ASCII separators: numpy fallback
-    bpath = os.fsencode(path)
-    bsep = ctypes.c_char(bsep_raw)
-    rows = ctypes.c_long()
-    cols = ctypes.c_long()
-    rc = lib.csv_dims(bpath, bsep, header_lines, ctypes.byref(rows), ctypes.byref(cols))
-    if rc != 0:
-        raise OSError(f"fastcsv: cannot read {path!r} (rc={rc})")
-    out = np.empty((rows.value, cols.value), dtype=np.float64)
+    rows, cols = dims
+    lib = _load()
+    out = np.empty((rows, cols), dtype=np.float64)
     if out.size:
         rc = lib.csv_parse(
-            bpath, bsep, header_lines,
+            os.fsencode(path), _sep_byte(sep), header_lines,
             out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
-            rows.value, cols.value,
+            rows, cols,
         )
         if rc != 0:
             raise OSError(f"fastcsv: parse failed for {path!r} (rc={rc})")
+    return out
+
+
+def parse_csv_range(
+    path: str,
+    sep: str,
+    header_lines: int,
+    row_offset: int,
+    row_count: int,
+    cols: int,
+) -> Optional[np.ndarray]:
+    """Parse only rows [row_offset, row_offset+row_count) into a float64
+    (row_count, cols) array — the per-process block of a multi-host load.
+    Returns None when the native library is unavailable."""
+    lib = _load()
+    bsep = _sep_byte(sep)
+    if lib is None or bsep is None:
+        return None
+    out = np.empty((row_count, cols), dtype=np.float64)
+    if out.size:
+        rc = lib.csv_parse_range(
+            os.fsencode(path), bsep, header_lines, row_offset, row_count,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), cols,
+        )
+        if rc != 0:
+            raise OSError(f"fastcsv: range parse failed for {path!r} (rc={rc})")
     return out

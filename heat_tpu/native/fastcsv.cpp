@@ -11,6 +11,10 @@
 // C ABI (ctypes):
 //   csv_dims(path, sep, skip_header, &rows, &cols) -> 0 on success
 //   csv_parse(path, sep, skip_header, out, rows, cols) -> 0 on success
+//   csv_parse_range(path, sep, skip_header, row_offset, row_count, out, cols)
+//     -> 0 on success; parses only rows [row_offset, row_offset+row_count)
+//     — the per-process block of a multi-host load (each host tokenizes just
+//     its canonical chunk; only the newline scan touches the whole file)
 // Missing trailing fields parse as NaN; extra fields are ignored.
 
 #include <cerrno>
@@ -92,11 +96,12 @@ size_t skip_lines(const char* data, size_t size, long skip) {
     return pos;
 }
 
-// Collect the start offset of every non-empty line in [lo, hi).
+// Collect the start offset of the first (up to) max_n non-empty lines in
+// [lo, hi) — a range parse only needs the prefix, so the scan stops early.
 void line_starts(const char* data, size_t lo, size_t hi,
-                 std::vector<size_t>* out) {
+                 std::vector<size_t>* out, size_t max_n = SIZE_MAX) {
     size_t pos = lo;
-    while (pos < hi) {
+    while (pos < hi && out->size() < max_n) {
         const char* nl = static_cast<const char*>(
             memchr(data + pos, '\n', hi - pos));
         size_t end = nl ? static_cast<size_t>(nl - data) : hi;
@@ -165,6 +170,43 @@ void parse_rows(const char* data, size_t size, char sep,
     }
 }
 
+// Parse rows [first, first+count) of the post-header lines into `out`
+// (count x cols, row-major), multithreaded. Shared by the whole-file and
+// per-process-range entry points; the line scan stops after first+count
+// lines, so a range parse only scans the file prefix it needs.
+int parse_span(const char* path, char sep, long skip_header, long first,
+               long count, long cols, double* out) {
+    if (first < 0 || count < 0) return -2;
+    Mapped m = map_file(path);
+    if (!m.ok()) return -1;
+    size_t lo = skip_lines(m.data, m.size, skip_header);
+    size_t want = static_cast<size_t>(first) + static_cast<size_t>(count);
+    std::vector<size_t> starts;
+    line_starts(m.data, lo, m.size, &starts, want);
+    if (starts.size() < want) {
+        unmap_file(m);
+        return -2;
+    }
+    // slice the range so parse_rows' row->out indexing starts at 0
+    std::vector<size_t> span(starts.begin() + first, starts.end());
+    size_t n = static_cast<size_t>(count);
+    unsigned hw = std::thread::hardware_concurrency();
+    size_t nthreads = hw ? hw : 4;
+    if (nthreads > n / 1024 + 1) nthreads = n / 1024 + 1;  // small spans: fewer threads
+    std::vector<std::thread> threads;
+    size_t chunk = (n + nthreads - 1) / nthreads;
+    for (size_t t = 0; t < nthreads; ++t) {
+        size_t r0 = t * chunk;
+        size_t r1 = r0 + chunk < n ? r0 + chunk : n;
+        if (r0 >= r1) break;
+        threads.emplace_back(parse_rows, m.data, m.size, sep, std::cref(span),
+                             r0, r1, cols, out);
+    }
+    for (auto& th : threads) th.join();
+    unmap_file(m);
+    return 0;
+}
+
 }  // namespace
 
 extern "C" {
@@ -187,29 +229,12 @@ int csv_dims(const char* path, char sep, long skip_header, long* rows,
 
 int csv_parse(const char* path, char sep, long skip_header, double* out,
               long rows, long cols) {
-    Mapped m = map_file(path);
-    if (!m.ok()) return -1;
-    size_t lo = skip_lines(m.data, m.size, skip_header);
-    std::vector<size_t> starts;
-    line_starts(m.data, lo, m.size, &starts);
-    if (static_cast<long>(starts.size()) < rows) { unmap_file(m); return -2; }
+    return parse_span(path, sep, skip_header, 0, rows, cols, out);
+}
 
-    size_t n = static_cast<size_t>(rows);
-    unsigned hw = std::thread::hardware_concurrency();
-    size_t nthreads = hw ? hw : 4;
-    if (nthreads > n / 1024 + 1) nthreads = n / 1024 + 1;  // small files: fewer threads
-    std::vector<std::thread> threads;
-    size_t chunk = (n + nthreads - 1) / nthreads;
-    for (size_t t = 0; t < nthreads; ++t) {
-        size_t r0 = t * chunk;
-        size_t r1 = r0 + chunk < n ? r0 + chunk : n;
-        if (r0 >= r1) break;
-        threads.emplace_back(parse_rows, m.data, m.size, sep, std::cref(starts),
-                             r0, r1, cols, out);
-    }
-    for (auto& th : threads) th.join();
-    unmap_file(m);
-    return 0;
+int csv_parse_range(const char* path, char sep, long skip_header,
+                    long row_offset, long row_count, double* out, long cols) {
+    return parse_span(path, sep, skip_header, row_offset, row_count, cols, out);
 }
 
 }  // extern "C"

@@ -131,6 +131,34 @@ d = ht.diff(x)
 assert d.split == 0 and d.shape == (n - 1,)
 assert abs(float(ht.sum(d).item()) - (n - 1.0)) < 1e-6
 
+# ======= stage 3b: multi-host load_csv — per-process row-range tokenize ===
+import time
+csv_path = sys.argv[3]
+if rank == 0:
+    tmp_csv = csv_path + ".tmp"
+    with open(tmp_csv, "w") as f:
+        f.write("c0,c1\n")
+        for i in range(11):  # 11 rows over 4 devices: uneven, pads in play
+            f.write(f"{i},{10 * i}\n")
+    os.replace(tmp_csv, csv_path)  # atomic publish
+else:
+    for _ in range(200):
+        if os.path.exists(csv_path):
+            break
+        time.sleep(0.05)
+X = ht.load_csv(csv_path, header_lines=1, split=0)
+assert X.shape == (11, 2) and X.split == 0, X.shape
+cols = ht.sum(X, axis=0)
+assert abs(float(cols[0].item()) - 55.0) < 1e-3
+assert abs(float(cols[1].item()) - 550.0) < 1e-2
+# wrong split axis raises the documented guard
+try:
+    ht.load_csv(csv_path, header_lines=1, split=1)
+except NotImplementedError:
+    pass
+else:
+    raise AssertionError("multi-host load_csv split=1 must raise")
+
 print(f"RANK{rank}_OK", flush=True)
 """
 
@@ -151,7 +179,7 @@ class TestMultiHostStage1:
         # the workers force their own XLA_FLAGS before importing jax
         procs = [
             subprocess.Popen(
-                [sys.executable, str(script), str(r), str(port)],
+                [sys.executable, str(script), str(r), str(port), str(tmp_path / "mh_data.csv")],
                 stdout=subprocess.PIPE,
                 stderr=subprocess.STDOUT,
                 env=env,

@@ -166,3 +166,33 @@ class TestLoadCSVWiring:
         want = write_csv(p, 8, 2, seed=5)
         a = ht.load(p)
         np.testing.assert_allclose(a.numpy(), want.astype(np.float32), rtol=1e-6)
+
+
+class TestParseCsvRange:
+    """csv_parse_range — the per-process block tokenizer behind multi-host
+    load_csv: parses only [offset, offset+count) rows; the full parse is the
+    (0, rows) special case."""
+
+    def test_ranges_match_full_parse(self, tmp_path):
+        from heat_tpu import native
+
+        if not native.native_available():
+            pytest.skip("no compiler")
+        rng = np.random.default_rng(121)
+        t = rng.standard_normal((57, 3))
+        p = tmp_path / "r.csv"
+        np.savetxt(p, t, delimiter=",", header="a,b,c", comments="")
+        assert native.csv_dims(str(p), ",", 1) == (57, 3)
+        for lo, n in ((0, 57), (0, 10), (30, 27), (56, 1), (12, 0)):
+            blk = native.parse_csv_range(str(p), ",", 1, lo, n, 3)
+            np.testing.assert_allclose(blk, t[lo : lo + n], rtol=1e-12)
+
+    def test_out_of_range_raises(self, tmp_path):
+        from heat_tpu import native
+
+        if not native.native_available():
+            pytest.skip("no compiler")
+        p = tmp_path / "s.csv"
+        p.write_text("1,2\n3,4\n")
+        with pytest.raises(OSError):
+            native.parse_csv_range(str(p), ",", 0, 1, 5, 2)
