@@ -13,6 +13,7 @@ shapes are jit-hostile — the documented host path, SURVEY §7 hard parts).
 from __future__ import annotations
 
 import builtins
+import operator
 from typing import List, Optional, Sequence, Tuple, Union
 
 import jax
@@ -290,13 +291,29 @@ def redistribute(arr: DNDarray, lshape_map=None, target_map=None) -> DNDarray:
 
 
 def repeat(a: DNDarray, repeats, axis: Optional[int] = None) -> DNDarray:
-    """Repeat elements (reference `repeat`)."""
+    """Repeat elements (reference `repeat`). Scalar repeats off the split
+    axis run shard-locally on the physical buffer — zero communication."""
     from . import factories
 
     if not isinstance(a, DNDarray):
         a = factories.array(a)
     if isinstance(repeats, DNDarray):
         repeats = repeats._logical()
+    elif isinstance(repeats, (list, tuple)):
+        repeats = jnp.asarray(repeats)  # numpy accepts sequences; jnp doesn't
+    if (
+        axis is not None
+        and a.split is not None
+        and sanitize_axis(a.shape, axis) != a.split
+        and np.ndim(repeats) == 0
+    ):
+        ax = sanitize_axis(a.shape, axis)
+        res = _canonical(jnp.repeat(a.larray, repeats, axis=ax), a.comm, a.split)
+        gshape = tuple(
+            s * builtins.int(repeats) if d == ax else s
+            for d, s in enumerate(a.shape)
+        )
+        return DNDarray(res, gshape, a.dtype, a.split, a.device, a.comm, True)
     res = jnp.repeat(a._logical(), repeats, axis=axis)
     if axis is None:
         out_split = 0 if a.split is not None else None
@@ -559,17 +576,32 @@ def _oddeven_sort_physical(a: DNDarray, axis: int, descending: bool):
 
 
 def split(x: DNDarray, indices_or_sections, axis: int = 0) -> List[DNDarray]:
-    """Split into sub-arrays along axis (reference manipulations.py `split`)."""
+    """Split into sub-arrays along axis (reference manipulations.py `split`).
+    Off the split axis the pieces slice the physical buffer shard-locally —
+    the distribution dim (and its pads) carries straight through."""
     axis = sanitize_axis(x.shape, axis)
-    if isinstance(indices_or_sections, builtins.int):
+    if isinstance(indices_or_sections, (builtins.int, np.integer)):
+        indices_or_sections = builtins.int(indices_or_sections)
         if x.shape[axis] % indices_or_sections != 0:
             raise ValueError("array split does not result in an equal division")
-        pieces = jnp.split(x._logical(), indices_or_sections, axis=axis)
+        sections = indices_or_sections
     else:
         if isinstance(indices_or_sections, DNDarray):
             indices_or_sections = indices_or_sections.tolist()
-        pieces = jnp.split(x._logical(), list(indices_or_sections), axis=axis)
+        sections = list(indices_or_sections)
     out_split = x.split
+    if out_split is not None and axis != out_split:
+        pieces = jnp.split(x.larray, sections, axis=axis)
+        out = []
+        for p in pieces:
+            gshape = tuple(
+                p.shape[d] if d != out_split else x.shape[out_split]
+                for d in range(x.ndim)
+            )
+            p = _canonical(p, x.comm, out_split)
+            out.append(DNDarray(p, gshape, x.dtype, out_split, x.device, x.comm, True))
+        return out
+    pieces = jnp.split(x._logical(), sections, axis=axis)
     return [_rewrap(p, out_split, x) for p in pieces]
 
 
@@ -649,10 +681,31 @@ def swapaxes(x: DNDarray, axis1: int, axis2: int) -> DNDarray:
 
 
 def tile(x: DNDarray, reps) -> DNDarray:
-    """Tile the array (reference `tile`)."""
+    """Tile the array (reference `tile`). When the split axis is not
+    repeated (its rep factor is 1) the tile runs shard-locally on the
+    physical buffer — the distribution dim and its pads are untouched."""
     if isinstance(reps, DNDarray):
         reps = reps.tolist()
-    res = jnp.tile(x._logical(), reps)
+    try:
+        # operator.index rejects floats (numpy/jnp raise for 2.5 reps) while
+        # accepting python and numpy integers
+        reps_t = tuple(operator.index(r) for r in reps)
+    except TypeError:
+        reps_t = (operator.index(reps),)
+    if x.split is not None:
+        ndim_out = builtins.max(x.ndim, len(reps_t))
+        new_split = x.split + (ndim_out - x.ndim)
+        reps_full = (1,) * (ndim_out - len(reps_t)) + reps_t
+        if reps_full[new_split] == 1:
+            res = _canonical(jnp.tile(x.larray, reps_t), x.comm, new_split)
+            gshape = tuple(
+                r * s
+                for r, s in zip(
+                    reps_full, (1,) * (ndim_out - x.ndim) + tuple(x.shape)
+                )
+            )
+            return DNDarray(res, gshape, x.dtype, new_split, x.device, x.comm, True)
+    res = jnp.tile(x._logical(), reps_t)
     out_split = x.split
     if out_split is not None and res.ndim != x.ndim:
         out_split += res.ndim - x.ndim

@@ -601,3 +601,64 @@ class TestReshapeFastPaths(TestCase):
         x = ht.array(np.empty((0, 6), dtype=np.float32), split=0)
         with pytest.raises(ValueError):
             ht.reshape(x, (0, -1))
+
+
+class TestSplitRepeatTileFastPaths(TestCase):
+    """split/repeat/tile off the distribution axis run shard-locally on the
+    physical buffer; only variants touching the split axis use the logical
+    route."""
+
+    def _nlog(self):
+        from heat_tpu.core.dndarray import _PERF_STATS
+
+        return _PERF_STATS["logical_slices"]
+
+    def test_fast_paths_no_gather(self):
+        rng = np.random.default_rng(131)
+        t = rng.standard_normal((2 * self.comm.size + 3, 6)).astype(np.float32)
+        x = ht.array(t, split=0)
+        c0 = self._nlog()
+        pieces = ht.split(x, 3, axis=1)
+        rep = ht.repeat(x, 3, axis=1)
+        til = ht.tile(x, (1, 4))
+        til2 = ht.tile(x, (2, 1, 3))
+        assert self._nlog() == c0
+        assert all(p.split == 0 for p in pieces)
+        assert rep.split == 0 and til.split == 0 and til2.split == 1
+        for i, p in enumerate(pieces):
+            np.testing.assert_array_equal(p.numpy(), np.split(t, 3, axis=1)[i])
+        np.testing.assert_array_equal(rep.numpy(), np.repeat(t, 3, axis=1))
+        np.testing.assert_array_equal(til.numpy(), np.tile(t, (1, 4)))
+        np.testing.assert_array_equal(til2.numpy(), np.tile(t, (2, 1, 3)))
+
+    def test_split_axis_variants_still_exact(self):
+        rng = np.random.default_rng(132)
+        t = rng.standard_normal((3 * self.comm.size, 4)).astype(np.float32)
+        x = ht.array(t, split=0)
+        for i, p in enumerate(ht.split(x, 3, axis=0)):
+            np.testing.assert_array_equal(p.numpy(), np.split(t, 3, axis=0)[i])
+        np.testing.assert_array_equal(
+            ht.repeat(x, 2, axis=0).numpy(), np.repeat(t, 2, axis=0)
+        )
+        np.testing.assert_array_equal(
+            ht.tile(x, (2, 1)).numpy(), np.tile(t, (2, 1))
+        )
+
+    def test_sequence_repeats(self):
+        # numpy accepts python sequences for repeats; jnp needs an array
+        t = np.arange(8.0).reshape(4, 2)
+        x = ht.array(t, split=0)
+        np.testing.assert_array_equal(
+            ht.repeat(x, [1, 2, 1, 3], axis=0).numpy(),
+            np.repeat(t, [1, 2, 1, 3], axis=0),
+        )
+
+    def test_numpy_scalar_sections_and_float_reps(self):
+        t = np.arange(12.0).reshape(6, 2)
+        x = ht.array(t, split=0)
+        for i, p in enumerate(ht.split(x, np.int64(3), axis=0)):
+            np.testing.assert_array_equal(p.numpy(), np.split(t, 3, axis=0)[i])
+        with pytest.raises(TypeError):
+            ht.tile(x, 2.5)
+        with pytest.raises(TypeError):
+            ht.tile(x, (2, 1.5))
