@@ -44,12 +44,19 @@ def dot(a: DNDarray, b: DNDarray, out: Optional[DNDarray] = None) -> Union[DNDar
     """Dot product with numpy dispatch rules (reference basics.py:42:
     1-D × 1-D is a local dot + Allreduce :85-87)."""
     if isinstance(a, DNDarray) and isinstance(b, DNDarray) and a.ndim == 1 and b.ndim == 1:
+        if a.shape != b.shape:
+            raise ValueError("shapes are not aligned")
+        # physical-shape mismatch means exactly one side is replicated
+        # (equal 1-D gshapes with equal splits pad identically); resplit the
+        # replicated side — it moves no distributed bytes — so the product
+        # runs on padded buffers and XLA inserts the psum
+        if a.larray.shape != b.larray.shape:
+            if a.split is None:
+                a = a.resplit(b.split)
+            else:
+                b = b.resplit(a.split)
         am = a._masked(0) if a.pad_count else a.larray
         bm = b._masked(0) if b.pad_count else b.larray
-        if am.shape != bm.shape:
-            if a.shape != b.shape:
-                raise ValueError("shapes are not aligned")
-            am, bm = a._logical(), b._logical()
         res = jnp.dot(am, bm)
         ret = DNDarray(res, (), types.canonical_heat_type(res.dtype), None, a.device, a.comm, True)
         if out is not None:
@@ -234,17 +241,53 @@ def norm(x: DNDarray, axis=None, keepdims: bool = False, ord=None) -> DNDarray:
 
 def outer(a: DNDarray, b: DNDarray, out: Optional[DNDarray] = None, split: Optional[int] = None) -> DNDarray:
     """Outer product of two vectors (reference basics.py:1056 ring-exchanges
-    chunks; one broadcasted multiply here)."""
-    from .. import factories
-
+    chunks). With a split=0 result the row operand stays on its padded
+    physical buffer (pad rows become pad rows) and only the column operand
+    replicates — which the reference's ring also streams through every
+    rank; the big operand never gathers."""
     if not isinstance(a, DNDarray) or not isinstance(b, DNDarray):
         raise TypeError("both operands must be DNDarrays")
+    if a.ndim != 1 or b.ndim != 1:
+        raise TypeError("outer expects 1-D operands")
+    if split is None:
+        # default the result split to the operand that is already
+        # distributed, so no distributed bytes move
+        split = 0 if a.split is not None else (1 if b.split is not None else None)
+    if split in (0, 1) and a.comm.size > 1:
+        if split == 0:
+            if a.split is None:
+                a = a.resplit(0)  # replicated → split moves no bytes
+            res = a.larray[:, None] * _replicate_vec(b)[None, :]
+        else:
+            if b.split is None:
+                b = b.resplit(0)
+            res = _replicate_vec(a)[:, None] * b.larray[None, :]
+        return _wrap_out(
+            DNDarray(
+                res, (a.shape[0], b.shape[0]),
+                types.canonical_heat_type(res.dtype), split, a.device, a.comm, True,
+            ),
+            out,
+        )
     a_flat = a._logical().ravel()
     b_flat = b._logical().ravel()
-    if split is None:
-        split = 0 if (a.split is not None or b.split is not None) else None
     res = jnp.outer(a_flat, b_flat)
-    ret = DNDarray.from_logical(res, split, a.device, a.comm)
+    return _wrap_out(DNDarray.from_logical(res, split, a.device, a.comm), out)
+
+
+def _replicate_vec(v: DNDarray):
+    """Logical 1-D values replicated on every device — a device-side
+    all_gather of the padded buffer + local pad slice; never the host
+    logical view (multi-host safe)."""
+    if v.split is None:
+        return v.larray
+    import jax
+
+    buf = jax.device_put(v.larray, v.comm.sharding(None, 1))
+    return buf[: v.shape[0]]
+
+
+def _wrap_out(ret: DNDarray, out: Optional[DNDarray]) -> DNDarray:
     if out is not None:
         out.larray = ret.larray
         return out
@@ -262,7 +305,43 @@ def projection(a: DNDarray, b: DNDarray) -> DNDarray:
 
 
 def trace(a: DNDarray, offset: int = 0, axis1: int = 0, axis2: int = 1, dtype=None, out=None):
-    """Sum along diagonals (reference basics.py:1313)."""
+    """Sum along diagonals (reference basics.py:1313). 2-D split matrices
+    sum their shard's diagonal slice locally — a per-row (or per-column)
+    take on the physical buffer with out-of-band positions masked to 0 —
+    and XLA reduces across shards; no gather."""
+    if a.ndim >= 2:
+        axis1 = sanitize_axis(a.shape, axis1)
+        axis2 = sanitize_axis(a.shape, axis2)
+    if (
+        a.ndim == 2
+        and a.split is not None
+        and a.comm.size > 1
+        and (axis1, axis2) in ((0, 1), (1, 0))
+    ):
+        off = -offset if (axis1, axis2) == (1, 0) else offset
+        buf = a.larray
+        n, m = a.shape
+        if a.split == 0:
+            # row r holds diag element (r, r+off)
+            pos = jnp.arange(buf.shape[0])
+            cols = pos + off
+            valid = (pos < n) & (cols >= 0) & (cols < m)
+            picked = jnp.take_along_axis(
+                buf, jnp.clip(cols, 0, m - 1)[:, None], axis=1
+            )[:, 0]
+        else:
+            # column c holds diag element (c-off, c)
+            pos = jnp.arange(buf.shape[1])
+            rows = pos - off
+            valid = (pos < m) & (rows >= 0) & (rows < n)
+            picked = jnp.take_along_axis(
+                buf, jnp.clip(rows, 0, n - 1)[None, :], axis=0
+            )[0, :]
+        res = jnp.where(valid, picked, jnp.zeros((), dtype=buf.dtype)).sum()
+        if dtype is not None:
+            res = res.astype(types.canonical_heat_type(dtype).jnp_type())
+        ret = DNDarray(res, (), types.canonical_heat_type(res.dtype), None, a.device, a.comm, True)
+        return _wrap_out(ret, out)
     log = a._logical()
     res = jnp.trace(log, offset=offset, axis1=axis1, axis2=axis2)
     if dtype is not None:
@@ -271,10 +350,7 @@ def trace(a: DNDarray, offset: int = 0, axis1: int = 0, axis2: int = 1, dtype=No
         ret = DNDarray(res, (), types.canonical_heat_type(res.dtype), None, a.device, a.comm, True)
     else:
         ret = DNDarray.from_logical(res, None, a.device, a.comm)
-    if out is not None:
-        out.larray = ret.larray if ret.ndim else ret.larray
-        return out
-    return ret
+    return _wrap_out(ret, out)
 
 
 def transpose(a: DNDarray, axes: Optional[Sequence[int]] = None) -> DNDarray:

@@ -258,3 +258,83 @@ class TestSolverDeep(TestCase):
         # V orthonormal, V^T A V == T
         np.testing.assert_allclose(Vn.T @ Vn, np.eye(n), atol=1e-6)
         np.testing.assert_allclose(Vn.T @ A @ Vn, Tn, atol=1e-5)
+
+
+class TestLinalgNoGatherPaths(TestCase):
+    """dot aligns mixed replicated/split operands by resplitting the
+    replicated side; outer keeps the split row operand on its physical
+    buffer; trace sums the shard-local diagonal slice — none gather the
+    distributed operand."""
+
+    def _nlog(self):
+        from heat_tpu.core.dndarray import _PERF_STATS
+
+        return _PERF_STATS["logical_slices"]
+
+    def test_dot_mixed_layouts(self):
+        rng = np.random.default_rng(141)
+        a = rng.standard_normal(5 * self.comm.size + 2).astype(np.float32)
+        b = rng.standard_normal(len(a)).astype(np.float32)
+        for sa, sb in ((0, 0), (0, None), (None, 0), (None, None)):
+            got = float(ht.dot(ht.array(a, split=sa), ht.array(b, split=sb)))
+            np.testing.assert_allclose(got, a @ b, rtol=1e-4)
+
+    def test_outer_split_row_operand_no_gather(self):
+        rng = np.random.default_rng(142)
+        a = rng.standard_normal(4 * self.comm.size + 3).astype(np.float32)
+        b = rng.standard_normal(6).astype(np.float32)
+        x = ht.array(a, split=0)
+        c0 = self._nlog()
+        r = ht.outer(x, ht.array(b))  # replicated column operand
+        assert self._nlog() == c0, "outer gathered the split operand"
+        assert r.split == 0 and r.shape == (len(a), 6)
+        np.testing.assert_allclose(r.numpy(), np.outer(a, b), rtol=1e-6)
+        np.testing.assert_allclose(
+            ht.outer(ht.array(a), ht.array(b, split=0)).numpy(), np.outer(a, b), rtol=1e-6
+        )
+
+    def test_trace_grid_no_gather(self):
+        rng = np.random.default_rng(143)
+        n = 3 * self.comm.size + 1
+        for shape in ((n, n), (n, 5), (5, n)):
+            t = rng.standard_normal(shape)
+            for split in (None, 0, 1):
+                x = ht.array(t, split=split)
+                for off in (0, 1, -2, shape[1] + 1, -shape[0] - 1):
+                    np.testing.assert_allclose(
+                        float(ht.linalg.trace(x, offset=off)),
+                        np.trace(t, offset=off),
+                        rtol=1e-10,
+                        err_msg=f"{shape} {split} {off}",
+                    )
+                np.testing.assert_allclose(
+                    float(ht.linalg.trace(x, offset=1, axis1=1, axis2=0)),
+                    np.trace(t, offset=1, axis1=1, axis2=0),
+                    rtol=1e-10,
+                )
+        x = ht.array(rng.standard_normal((n, 4)), split=0)
+        c0 = self._nlog()
+        ht.linalg.trace(x)
+        assert self._nlog() == c0
+
+    def test_outer_b_split_defaults_to_split1(self):
+        rng = np.random.default_rng(144)
+        a = rng.standard_normal(5).astype(np.float32)
+        b = rng.standard_normal(4 * self.comm.size + 1).astype(np.float32)
+        y = ht.array(b, split=0)
+        c0 = self._nlog()
+        r = ht.outer(ht.array(a), y)  # only b distributed -> split=1 result
+        assert self._nlog() == c0, "outer gathered the split column operand"
+        if self.comm.size > 1:
+            assert r.split == 1
+        np.testing.assert_allclose(r.numpy(), np.outer(a, b), rtol=1e-6)
+
+    def test_trace_negative_axes_no_gather(self):
+        rng = np.random.default_rng(145)
+        n = 3 * self.comm.size + 1
+        t = rng.standard_normal((n, 4))
+        x = ht.array(t, split=0)
+        c0 = self._nlog()
+        got = float(ht.linalg.trace(x, axis1=-2, axis2=-1))
+        assert self._nlog() == c0
+        np.testing.assert_allclose(got, np.trace(t), rtol=1e-10)
