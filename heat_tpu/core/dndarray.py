@@ -429,14 +429,21 @@ class DNDarray:
         return self.lshape_map
 
     def fill_diagonal(self, value) -> "DNDarray":
-        """Fill the main diagonal in place (reference dndarray.py: 2-D only)."""
+        """Fill the main diagonal in place (reference dndarray.py: 2-D only).
+        Runs on the physical buffer: global position (i, i) is a physical
+        position too (tail pads only extend the split dim), so a masked
+        where against a positional iota pair touches no pad and gathers
+        nothing."""
         if self.ndim != 2:
             raise ValueError("DNDarray must be 2D")
         k = min(self.__gshape)
-        idx = jnp.arange(k)
-        log = self._logical().at[idx, idx].set(jnp.asarray(value, self.__array.dtype))
-        new = DNDarray.from_logical(log, self.__split, self.__device, self.__comm, self.__dtype)
-        self.__array = new.larray
+        buf = self.__array
+        rows = jax.lax.broadcasted_iota(jnp.int32, buf.shape, 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, buf.shape, 1)
+        on_diag = (rows == cols) & (rows < k) & (cols < k)
+        self.__array = jnp.where(
+            on_diag, jnp.asarray(value, buf.dtype), buf
+        )
         self._invalidate_halo()
         return self
 

@@ -206,3 +206,25 @@ class TestFromLogical(TestCase):
         log = jnp.ones((2, 3), dtype=jnp.float32)
         x = DNDarray.from_logical(log, None, ht.get_device(), self.comm)
         assert x.split is None and x.pad_count == 0
+
+
+class TestFillDiagonalPhysical(TestCase):
+    """fill_diagonal writes the shard-local diagonal positions via a masked
+    where on the physical buffer — no gather, any split, any rectangle."""
+
+    def test_grid_no_gather(self):
+        from heat_tpu.core.dndarray import _PERF_STATS
+
+        rng = np.random.default_rng(151)
+        n = 3 * self.comm.size + 1
+        for shape in ((n, n), (n, 4), (4, n)):
+            for split in (None, 0, 1):
+                t = rng.standard_normal(shape).astype(np.float32)
+                x = ht.array(t, split=split)
+                c0 = _PERF_STATS["logical_slices"]
+                r = x.fill_diagonal(-2.5)
+                assert r is x
+                assert _PERF_STATS["logical_slices"] == c0
+                w = t.copy()
+                np.fill_diagonal(w, -2.5)
+                np.testing.assert_array_equal(x.numpy(), w)
