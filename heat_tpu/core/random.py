@@ -138,6 +138,13 @@ def permutation(x: Union[int, DNDarray]) -> DNDarray:
     if not isinstance(x, DNDarray):
         raise TypeError(f"x must be int or DNDarray, got {type(x)}")
     perm = jax.random.permutation(_next_key(), x.shape[0])
+    if x.split is not None and x.comm.size > 1:
+        # sharded gather keeps the shuffle distributed — no replicated
+        # intermediate (the advanced-indexing engine carries ANY split
+        # through a row gather: axis-0 take leaves other-axis pads alone)
+        from .indexing import _advanced_take
+
+        return _advanced_take(x, 0, perm)
     data = jnp.take(x._logical(), perm, axis=0)
     return DNDarray.from_logical(data, x.split, x.device, x.comm, x.dtype)
 

@@ -105,3 +105,42 @@ class TestRandomEdges:
         x = ht.random.normal(2.0, 0.5, (20000,), split=0)
         assert abs(float(x.mean().numpy()) - 2.0) < 0.02
         assert abs(float(x.std().numpy()) - 0.5) < 0.02
+
+
+class TestPermutationDistributed(TestCase):
+    """permutation of a split=0 array runs the sharded gather — the shuffle
+    stays distributed, layout-deterministic under one seed."""
+
+    def test_no_gather_and_determinism(self):
+        from heat_tpu.core.dndarray import _PERF_STATS
+
+        a = np.arange((3 * self.comm.size + 1) * 2.0).reshape(-1, 2)
+        ht.random.seed(17)
+        x = ht.array(a, split=0)
+        c0 = _PERF_STATS["logical_slices"]
+        p = ht.random.permutation(x)
+        assert _PERF_STATS["logical_slices"] == c0
+        assert p.split == 0
+        pn = p.numpy()
+        assert sorted(map(tuple, pn.tolist())) == sorted(map(tuple, a.tolist()))
+        ht.random.seed(17)
+        np.testing.assert_array_equal(
+            ht.random.permutation(ht.array(a, split=None)).numpy(), pn
+        )
+
+    def test_split1_stays_distributed(self):
+        from heat_tpu.core.dndarray import _PERF_STATS
+
+        a = np.arange(5.0 * (2 * self.comm.size + 1)).reshape(5, -1)
+        ht.random.seed(19)
+        x = ht.array(a, split=1)
+        c0 = _PERF_STATS["logical_slices"]
+        p = ht.random.permutation(x)
+        assert _PERF_STATS["logical_slices"] == c0
+        assert p.split == 1
+        pn = p.numpy()
+        assert sorted(map(tuple, pn.tolist())) == sorted(map(tuple, a.tolist()))
+        ht.random.seed(19)
+        np.testing.assert_array_equal(
+            ht.random.permutation(ht.array(a, split=None)).numpy(), pn
+        )
