@@ -825,15 +825,24 @@ def unique(a: DNDarray, sorted: bool = False, return_inverse: bool = False, axis
     data — only the scalar count U crosses to the host, because output
     *shape* is host-level metadata in this framework.
 
-    ``axis=...`` (row-unique) and 0-d/multi-dim flows keep the eager host
-    path — their dynamic output shapes have no XLA form (SURVEY §7 hard
-    parts); that path's tested ceiling is documented in PARITY.md.
+    n-D inputs with ``axis=None`` relayout once to a flat split=0 vector
+    and run the same distributed algorithm (inverses come back
+    input-shaped, numpy semantics). Only ``axis=...`` (row-unique) and
+    replicated/0-d flows keep the eager host path — their dynamic output
+    shapes have no XLA form (SURVEY §7 hard parts); that path's tested
+    ceiling is documented in PARITY.md.
     """
     if (
-        axis is None and a.split is not None and a.ndim == 1
-        and a.comm.size > 1 and a.shape[0] > 0
+        axis is None and a.split is not None
+        and a.comm.size > 1 and a.size > 0
     ):
-        return _distributed_unique(a, return_inverse)
+        flat = a if a.ndim == 1 else reshape(a, (a.size,))
+        if return_inverse:
+            vals, inv = _distributed_unique(flat, True)
+            if a.ndim > 1:
+                inv = reshape(inv, tuple(a.shape))
+            return vals, inv
+        return _distributed_unique(flat, False)
     log = a._logical()
     if axis is not None:
         axis = sanitize_axis(a.shape, axis)

@@ -6,6 +6,7 @@ import numpy as np
 import pytest
 
 import heat_tpu as ht
+from .basic_test import TestCase as BTTestCase
 
 
 def _check_sort(xn, split, axis=-1, descending=False):
@@ -203,3 +204,22 @@ class TestDistributedUnique:
         xn = np.array([3.0, np.nan, 1.0, np.nan, 2.0, 1.0, np.nan], dtype=np.float64)
         u = ht.unique(ht.array(xn, split=0))
         np.testing.assert_array_equal(u.numpy(), np.unique(xn))
+
+
+class TestUniqueNDim(BTTestCase):
+    """n-D unique with axis=None relayouts once to a flat split=0 vector
+    and runs the distributed algorithm; inverses come back input-shaped
+    (numpy semantics)."""
+
+    def test_matrix_and_3d(self):
+        rng = np.random.default_rng(161)
+        for shape in ((2 * self.comm.size + 1, 4), (3, self.comm.size + 2, 2)):
+            t = rng.integers(0, 7, shape)
+            for split in (0, 1):
+                x = ht.array(t, split=split)
+                u, inv = ht.unique(x, return_inverse=True)
+                np.testing.assert_array_equal(
+                    np.sort(u.numpy()), np.unique(t), err_msg=f"{shape} {split}"
+                )
+                assert inv.shape == t.shape
+                np.testing.assert_array_equal(u.numpy()[inv.numpy()], t)
