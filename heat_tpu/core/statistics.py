@@ -468,60 +468,59 @@ def minimum(x1, x2, out=None) -> DNDarray:
 _PERCENTILE_METHODS = ("linear", "lower", "higher", "midpoint", "nearest")
 
 
-def _percentile_sorted_distributed(x: DNDarray, qa, interpolation: str):
-    """Distributed percentile of a 1-D split array — beats the reference's
-    gather (reference statistics.py:1406-1441 collects per-rank partials on
-    rank 0): the data never replicates. Distributed sort along the split
-    axis (odd-even merge network over ICI), then a sharded gather of the
-    2-3 order statistics each q needs; only O(q) scalars leave the mesh.
-    Returns a replicated jnp vector of shape (len(q),), float64."""
+def _percentile_sorted_axis(x: DNDarray, qa, interpolation: str, ax: builtins.int):
+    """Distributed percentile along the SPLIT axis (any rank; ndim==1 is
+    the ax=0 special case) — beats the reference's rank-0 gather
+    (statistics.py:1406-1441): distributed sort along the axis (odd-even
+    merge network over ICI, each lane independent), then a replicated
+    sharded gather of ONLY the order-statistic slices the interpolation
+    method reads. Returns a float64 jnp array shaped (len(q), *rest) with
+    the reduced axis moved out, numpy-style."""
     from . import logical as lg
     from . import manipulations
-
-    n = x.shape[0]
-    q_flat = np.atleast_1d(np.asarray(qa, dtype=np.float64))
-    vals, _ = manipulations.sort(x)
-    # bracketing order statistics; indices are host-computable (q, n static).
-    # np.round is exact half-to-even — numpy's 'nearest' rule
-    pos = q_flat / 100.0 * (n - 1)
-    i0 = np.floor(pos).astype(np.int64)
-    i1 = np.ceil(pos).astype(np.int64)
-    inear = np.round(pos).astype(np.int64)
-    # sharded gather with a REPLICATED (3m,) result — the picks are tiny and
-    # every position needs them; routing through a split result + _logical
-    # would gather via the host and is forbidden multi-host
     from .indexing import _sharded_take_fn
 
-    take = _sharded_take_fn(x.comm, 0, None, 1)
-    pl = take(
-        vals.larray, jnp.asarray(np.concatenate([i0, i1, inear]))
-    ).astype(jnp.float64)
+    n = x.shape[ax]
+    q_flat = np.atleast_1d(np.asarray(qa, dtype=np.float64))
+    vals, _ = manipulations.sort(x, axis=ax)
+    # bracketing order statistics; indices are host-computable (q, n
+    # static). np.round is exact half-to-even — numpy's 'nearest' rule
+    pos = q_flat / 100.0 * (n - 1)
     m = len(q_flat)
-    v0, v1, vn = pl[:m], pl[m : 2 * m], pl[2 * m :]
-    if interpolation == "linear":
-        res = v0 + (v1 - v0) * jnp.asarray(pos - i0)
-    elif interpolation == "lower":
-        res = v0
+    if interpolation == "lower":
+        idx = np.floor(pos).astype(np.int64)
     elif interpolation == "higher":
-        res = v1
+        idx = np.ceil(pos).astype(np.int64)
+    elif interpolation == "nearest":
+        idx = np.round(pos).astype(np.int64)
+    else:  # linear / midpoint need both brackets
+        i0 = np.floor(pos).astype(np.int64)
+        idx = np.concatenate([i0, np.ceil(pos).astype(np.int64)])
+    take = _sharded_take_fn(x.comm, ax, None, x.ndim)
+    pl = take(vals.larray, jnp.asarray(idx))
+    pl = jnp.moveaxis(pl, ax, 0).astype(jnp.float64)  # (m or 2m, *rest)
+    if interpolation == "linear":
+        frac = jnp.asarray(pos - i0).reshape((m,) + (1,) * (x.ndim - 1))
+        res = pl[:m] + (pl[m:] - pl[:m]) * frac
     elif interpolation == "midpoint":
-        res = (v0 + v1) / 2.0
-    else:  # nearest — gate guarantees membership in _PERCENTILE_METHODS
-        res = vn
+        res = (pl[:m] + pl[m:]) / 2.0
+    else:  # lower / higher / nearest gathered exactly their picks
+        res = pl
     if jnp.issubdtype(x.dtype.jnp_type(), jnp.floating):
-        # numpy: any NaN anywhere makes every percentile NaN (the sort
-        # pushed NaNs to the global tail, so the picks alone can't tell)
-        nan_any = lg.any(lg.isnan(x)).larray
-        res = jnp.where(nan_any, jnp.nan, res)
+        # numpy: a NaN anywhere in a lane makes that lane's percentiles NaN
+        # (the sort pushed NaNs to the lane tail, so picks alone can't tell)
+        nan_lane = lg.any(lg.isnan(x), axis=ax).larray  # replicated (*rest)
+        res = jnp.where(nan_lane[None] if x.ndim > 1 else nan_lane, jnp.nan, res)
     return res
 
 
 def percentile(x: DNDarray, q, axis=None, out=None, interpolation: str = "linear", keepdims: bool = False) -> DNDarray:
-    """q-th percentile. On a 1-D split array reduced over its only axis this
-    is a DISTRIBUTED algorithm (sort + order-statistic gather, see
-    :func:`_percentile_sorted_distributed`); otherwise one jnp.percentile
-    over the logical view (reference statistics.py:1406-1441 gathers
-    per-rank partials). Result replicated either way."""
+    """q-th percentile. Reductions over the split axis (1-D global, or n-D
+    along the split axis) are a DISTRIBUTED algorithm —
+    :func:`_percentile_sorted_axis`: distributed sort + order-statistic
+    slice gather; otherwise one jnp.percentile over the logical view
+    (reference statistics.py:1406-1441 gathers per-rank partials). Result
+    replicated either way."""
     qa = jnp.asarray(q, dtype=jnp.float64)
     qv = np.asarray(qa)
     if np.any(~((qv >= 0.0) & (qv <= 100.0))):
@@ -536,18 +535,21 @@ def percentile(x: DNDarray, q, axis=None, out=None, interpolation: str = "linear
     ax = sanitize_axis(x.shape, axis) if axis is not None else None
     if (
         x.split is not None
-        and x.ndim == 1
         and x.comm.size > 1
-        and x.shape[0] > 0
+        and x.shape[x.split] > 0
         and qa.size > 0
-        and (ax is None or ax == 0 or ax == (0,))
         and interpolation in _PERCENTILE_METHODS
+        and (
+            (x.ndim == 1 and (ax is None or ax == 0 or ax == (0,)))
+            or (x.ndim > 1 and (ax == x.split or ax == (x.split,)))
+        )
     ):
-        res = _percentile_sorted_distributed(x, qa, interpolation)
+        res = _percentile_sorted_axis(x, qa, interpolation, x.split)
         if not qa.ndim:
-            res = res[0]
+            res = res[0]  # scalar q: rest dims only
         if keepdims:
-            res = res[..., None]  # the single reduced dim
+            off = 1 if qa.ndim else 0
+            res = jnp.expand_dims(res, x.split + off)
         # falls through to the shared reshape/astype/wrap/out epilogue
     elif interpolation == "nearest":
         log = x._logical()

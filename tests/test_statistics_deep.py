@@ -282,14 +282,14 @@ class TestDistributedPercentile(TestCase):
         from heat_tpu.core import statistics as st
 
         calls = []
-        orig = st._percentile_sorted_distributed
+        orig = st._percentile_sorted_axis
 
         def spy(*a, **k):
             calls.append(1)
             return orig(*a, **k)
 
-        st._percentile_sorted_distributed = spy
-        return calls, lambda: setattr(st, "_percentile_sorted_distributed", orig)
+        st._percentile_sorted_axis = spy
+        return calls, lambda: setattr(st, "_percentile_sorted_axis", orig)
 
     def test_fast_path_taken_and_numpy_exact(self):
         rng = np.random.default_rng(71)
@@ -490,3 +490,58 @@ class TestDistributedHistograms(TestCase):
         h, _ = ht.histogram(bad, bins=4, range=(0.0, 2.0))
         hn, _ = np.histogram(np.asarray([1.0, np.nan]), bins=4, range=(0.0, 2.0))
         np.testing.assert_array_equal(h.numpy(), hn)
+
+
+class TestAxisPercentileDistributed(TestCase):
+    """percentile along the SPLIT axis of n-D arrays: distributed sort per
+    lane + replicated order-statistic slice gather — no logical gather."""
+
+    def test_grid_vs_numpy(self):
+        from heat_tpu.core import statistics as st
+
+        rng = np.random.default_rng(171)
+        calls = []
+        orig = st._percentile_sorted_axis
+
+        def spy(*a, **k):
+            calls.append(1)
+            return orig(*a, **k)
+
+        st._percentile_sorted_axis = spy
+        try:
+            for shape, split in (
+                ((3 * self.comm.size + 1, 4), 0),
+                ((3, 2 * self.comm.size + 3), 1),
+            ):
+                t = rng.standard_normal(shape)
+                x = ht.array(t, split=split)
+                for method in ("linear", "nearest", "midpoint", "lower", "higher"):
+                    for q in (35.0, [10, 50, 99], [[5, 25], [75, 95]]):
+                        for kd in (False, True):
+                            got = ht.percentile(
+                                x, q, axis=split, interpolation=method, keepdims=kd
+                            ).numpy()
+                            want = np.percentile(
+                                t, q, axis=split, method=method, keepdims=kd
+                            )
+                            np.testing.assert_allclose(got, want, rtol=1e-12)
+        finally:
+            st._percentile_sorted_axis = orig
+        if self.comm.size > 1:
+            assert calls, "axis fast path not taken"
+
+    def test_nan_lane_and_median(self):
+        rng = np.random.default_rng(172)
+        t = rng.standard_normal((4 * self.comm.size, 3))
+        t[1, 1] = np.nan
+        import warnings
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            got = ht.percentile(ht.array(t, split=0), 50, axis=0).numpy()
+            want = np.percentile(t, 50, axis=0)
+        np.testing.assert_allclose(got, want, equal_nan=True)
+        t2 = rng.standard_normal((2 * self.comm.size + 1, 5))
+        np.testing.assert_allclose(
+            ht.median(ht.array(t2, split=0), axis=0).numpy(), np.median(t2, axis=0)
+        )
