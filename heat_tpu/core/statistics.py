@@ -136,9 +136,20 @@ def average(x: DNDarray, axis=None, weights: Optional[DNDarray] = None, returned
             raise ValueError("Length of weights not compatible with specified axis")
         shape = [1] * x.ndim
         shape[axis] = weights.shape[0]
-        w = DNDarray.from_logical(
-            jnp.reshape(weights._logical(), shape), None, x.device, x.comm
-        )
+        if axis == x.split and x.comm.size > 1:
+            # the weights run along the SPLIT axis — align them to x's
+            # chunking (same extent → same tail pads) instead of
+            # replicating an axis-length vector; the broadcast multiply
+            # then stays shard-local
+            wv = weights if weights.split == 0 else weights.resplit(0)
+            w = DNDarray(
+                jnp.reshape(wv.larray, [1] * axis + [wv.larray.shape[0]] + [1] * (x.ndim - axis - 1)),
+                tuple(shape), wv.dtype, axis, x.device, x.comm, True,
+            )
+        else:
+            w = DNDarray.from_logical(
+                jnp.reshape(weights._logical(), shape), None, x.device, x.comm
+            )
     elif weights.shape == x.shape:
         w = weights
     else:

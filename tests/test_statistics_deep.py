@@ -271,25 +271,30 @@ class TestMaximumMinimumGrid(TestCase):
         assert np.isnan(got[1])
 
 
+def _spy_percentile_fast_path():
+    """Patch statistics._percentile_sorted_axis with a call counter;
+    returns (counter, undo)."""
+    from heat_tpu.core import statistics as st
+
+    calls = []
+    orig = st._percentile_sorted_axis
+
+    def spy(*a, **k):
+        calls.append(1)
+        return orig(*a, **k)
+
+    st._percentile_sorted_axis = spy
+    return calls, lambda: setattr(st, "_percentile_sorted_axis", orig)
+
+
 class TestDistributedPercentile(TestCase):
-    """The 1-D split fast path: distributed sort + order-statistic gather
-    (statistics._percentile_sorted_distributed) — the data never
-    replicates, unlike the reference's rank-0 gather
+    """The split-axis fast path (statistics._percentile_sorted_axis, here
+    via 1-D inputs): distributed sort + order-statistic gather — the data
+    never replicates, unlike the reference's rank-0 gather
     (reference statistics.py:1406-1441)."""
 
     def _spy(self):
-        """Patch the fast path with a call counter; returns (counter, undo)."""
-        from heat_tpu.core import statistics as st
-
-        calls = []
-        orig = st._percentile_sorted_axis
-
-        def spy(*a, **k):
-            calls.append(1)
-            return orig(*a, **k)
-
-        st._percentile_sorted_axis = spy
-        return calls, lambda: setattr(st, "_percentile_sorted_axis", orig)
+        return _spy_percentile_fast_path()
 
     def test_fast_path_taken_and_numpy_exact(self):
         rng = np.random.default_rng(71)
@@ -497,17 +502,8 @@ class TestAxisPercentileDistributed(TestCase):
     lane + replicated order-statistic slice gather — no logical gather."""
 
     def test_grid_vs_numpy(self):
-        from heat_tpu.core import statistics as st
-
         rng = np.random.default_rng(171)
-        calls = []
-        orig = st._percentile_sorted_axis
-
-        def spy(*a, **k):
-            calls.append(1)
-            return orig(*a, **k)
-
-        st._percentile_sorted_axis = spy
+        calls, undo = _spy_percentile_fast_path()
         try:
             for shape, split in (
                 ((3 * self.comm.size + 1, 4), 0),
@@ -526,7 +522,7 @@ class TestAxisPercentileDistributed(TestCase):
                             )
                             np.testing.assert_allclose(got, want, rtol=1e-12)
         finally:
-            st._percentile_sorted_axis = orig
+            undo()
         if self.comm.size > 1:
             assert calls, "axis fast path not taken"
 
@@ -545,3 +541,28 @@ class TestAxisPercentileDistributed(TestCase):
         np.testing.assert_allclose(
             ht.median(ht.array(t2, split=0), axis=0).numpy(), np.median(t2, axis=0)
         )
+
+
+class TestAverageSplitAxisWeights(TestCase):
+    """1-D weights along the split axis align to x's chunking instead of
+    replicating an axis-length vector — the weighted reduce stays
+    shard-local until the final psum."""
+
+    def test_no_gather_and_numpy_exact(self):
+        from heat_tpu.core.dndarray import _PERF_STATS
+
+        rng = np.random.default_rng(181)
+        n = 4 * self.comm.size + 3
+        t = rng.standard_normal((n, 3))
+        w = rng.uniform(0.5, 2.0, n)
+        for wsplit in (0, None):
+            x = ht.array(t, split=0)
+            c0 = _PERF_STATS["logical_slices"]
+            avg, den = ht.average(
+                x, axis=0, weights=ht.array(w, split=wsplit), returned=True
+            )
+            assert _PERF_STATS["logical_slices"] == c0
+            np.testing.assert_allclose(
+                avg.numpy(), np.average(t, axis=0, weights=w), rtol=1e-10
+            )
+            np.testing.assert_allclose(den.numpy(), np.full(3, w.sum()), rtol=1e-10)
