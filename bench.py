@@ -399,81 +399,90 @@ def bench_heat_tpu(errors, profile_dir=None, small=False, only=None):
     return results
 
 
-def bench_torch_cpu(errors):
+def bench_torch_cpu(errors, only=None):
     """The reference harness's torch-cpu baseline (benchmarks/*/torch-cpu.py),
-    size-reduced; GFLOP/s is the size-normalized comparison."""
+    size-reduced; GFLOP/s is the size-normalized comparison. ``only``
+    restricts it to the same workload subset as ours."""
     results = {}
     try:
-        _torch_cpu_workloads(results)
+        _torch_cpu_workloads(results, only)
     except Exception as e:  # noqa: BLE001 — baseline failure must not eat ours
         errors["torch"] = repr(e)
     return results
 
 
-def _torch_cpu_workloads(results):
+def _torch_cpu_workloads(results, only=None):
     import torch
+
+    def want(name):
+        return only is None or name in only
 
     torch.manual_seed(0)
 
-    n = 2048
-    a = torch.randn(n, n)
-    b = torch.randn(n, n)
-    torch.mm(a, b)
-    t = _best_time(lambda: torch.mm(a, b), repeats=2)
-    results["matmul"] = (2.0 * n * n * n) / t / 1e9
+    if want("matmul"):
+        n = 2048
+        a = torch.randn(n, n)
+        b = torch.randn(n, n)
+        torch.mm(a, b)
+        t = _best_time(lambda: torch.mm(a, b), repeats=2)
+        results["matmul"] = (2.0 * n * n * n) / t / 1e9
 
-    m, k = 8192, 128
-    x = torch.randn(m, k)
-    torch.cdist(x, x)
-    t = _best_time(lambda: torch.cdist(x, x), repeats=2)
-    results["cdist"] = (2.0 * m * m * k) / t / 1e9
+    if want("cdist"):
+        m, k = 8192, 128
+        x = torch.randn(m, k)
+        torch.cdist(x, x)
+        t = _best_time(lambda: torch.cdist(x, x), repeats=2)
+        results["cdist"] = (2.0 * m * m * k) / t / 1e9
 
-    ns, d, kc, iters = 100_000, 64, 16, 5
-    xs = torch.randn(ns, d)
-    centers = xs[:kc].clone()
+    if want("kmeans"):
+        ns, d, kc, iters = 100_000, 64, 16, 5
+        xs = torch.randn(ns, d)
+        centers = xs[:kc].clone()
 
-    def lloyd():
-        c = centers.clone()
-        for _ in range(iters):
-            d2 = torch.cdist(xs, c) ** 2
-            lab = d2.argmin(dim=1)
-            oh = torch.nn.functional.one_hot(lab, kc).to(xs.dtype)
-            cnt = oh.sum(0).clamp(min=1.0)
-            c = (oh.T @ xs) / cnt[:, None]
+        def lloyd():
+            c = centers.clone()
+            for _ in range(iters):
+                d2 = torch.cdist(xs, c) ** 2
+                lab = d2.argmin(dim=1)
+                oh = torch.nn.functional.one_hot(lab, kc).to(xs.dtype)
+                cnt = oh.sum(0).clamp(min=1.0)
+                c = (oh.T @ xs) / cnt[:, None]
 
-    lloyd()
-    t = _best_time(lloyd, repeats=2)
-    results["kmeans"] = (iters * 4.0 * ns * kc * d) / t / 1e9
+        lloyd()
+        t = _best_time(lloyd, repeats=2)
+        results["kmeans"] = (iters * 4.0 * ns * kc * d) / t / 1e9
 
-    nm, dm = 1_000_000, 64
-    xm = torch.randn(nm, dm)
+    if want("moments"):
+        nm, dm = 1_000_000, 64
+        xm = torch.randn(nm, dm)
 
-    def moments():
-        xm.mean(dim=0)
-        xm.var(dim=0)
+        def moments():
+            xm.mean(dim=0)
+            xm.var(dim=0)
 
-    moments()
-    t = _best_time(moments, repeats=2)
-    results["moments"] = (4.0 * nm * dm) / t / 1e9
+        moments()
+        t = _best_time(moments, repeats=2)
+        results["moments"] = (4.0 * nm * dm) / t / 1e9
 
-    nl, dl, sweeps = 100_000, 64, 2
-    xl = torch.randn(nl, dl)
-    yl = xl @ torch.randn(dl, 1)
+    if want("lasso"):
+        nl, dl, sweeps = 100_000, 64, 2
+        xl = torch.randn(nl, dl)
+        yl = xl @ torch.randn(dl, 1)
 
-    def lasso():
-        w = torch.zeros(dl, 1)
-        y_est = xl @ w
-        for _ in range(sweeps):
-            for j in range(dl):
-                xj = xl[:, j : j + 1]
-                rho = (xj * (yl - y_est + w[j] * xj)).mean()
-                wj = torch.sign(rho) * torch.clamp(rho.abs() - 0.01, min=0.0)
-                y_est = y_est + (wj - w[j]) * xj
-                w[j] = wj
+        def lasso():
+            w = torch.zeros(dl, 1)
+            y_est = xl @ w
+            for _ in range(sweeps):
+                for j in range(dl):
+                    xj = xl[:, j : j + 1]
+                    rho = (xj * (yl - y_est + w[j] * xj)).mean()
+                    wj = torch.sign(rho) * torch.clamp(rho.abs() - 0.01, min=0.0)
+                    y_est = y_est + (wj - w[j]) * xj
+                    w[j] = wj
 
-    lasso()
-    t = _best_time(lasso, repeats=2)
-    results["lasso"] = (sweeps * dl * 4.0 * nl) / t / 1e9
+        lasso()
+        t = _best_time(lasso, repeats=2)
+        results["lasso"] = (sweeps * dl * 4.0 * nl) / t / 1e9
 
 
 def main():
@@ -485,11 +494,15 @@ def main():
     ap.add_argument("--only", metavar="NAMES", default=None,
                     help="comma-separated workload subset to run "
                          "(re-measure one row without the full sweep)")
+    ap.add_argument("--small", action="store_true",
+                    help="force the reduced (CPU-scale) workload sizes — "
+                         "what the probe selects on a CPU-only host; lets "
+                         "tests exercise every maker quickly")
     args = ap.parse_args()
 
     errors = {}
     fallback = False  # True => default backend broken, forced onto CPU
-    small = False  # True => CPU sizes (fallback OR genuinely CPU-only host)
+    small = args.small  # True => CPU sizes (fallback OR CPU-only OR forced)
     if not args.no_probe:
         platform, diags = _probe_platform()
         for d in diags:
@@ -500,6 +513,17 @@ def main():
             errors["backend"] = "default platform init failed; fell back to cpu"
         elif platform == "cpu":
             small = True  # healthy CPU-only host: shrink, but not an error
+
+    only = None
+    if args.only:
+        only = {s.strip() for s in args.only.split(",") if s.strip()}
+        known = {
+            "matmul", "matmul_f32", "matmul_bf16", "cdist", "kmeans",
+            "moments", "lasso", "attention", "matmul_int8", "lm_step",
+        }
+        unknown = only - known
+        if unknown:
+            errors["only"] = f"unknown workload(s): {sorted(unknown)}"
 
     ours, device_kind, n_devices = {}, None, 0
     try:
@@ -512,23 +536,13 @@ def main():
                 pass
         devs = jax.devices()
         device_kind, n_devices = devs[0].device_kind, len(devs)
-        only = None
-        if args.only:
-            only = {s.strip() for s in args.only.split(",") if s.strip()}
-            known = {
-                "matmul", "matmul_f32", "matmul_bf16", "cdist", "kmeans",
-                "moments", "lasso", "attention", "matmul_int8", "lm_step",
-            }
-            unknown = only - known
-            if unknown:
-                errors["only"] = f"unknown workload(s): {sorted(unknown)}"
         ours = bench_heat_tpu(
             errors, profile_dir=args.profile, small=small, only=only,
         )
     except Exception as e:  # noqa: BLE001 — the JSON line must still print
         errors["fatal"] = repr(e)
 
-    base = bench_torch_cpu(errors)
+    base = bench_torch_cpu(errors, only=only)
 
     # headline geomean keeps the r02 workload set for comparability
     # (matmul_f32/matmul_bf16/attention/matmul_int8 are labeled detail rows)
@@ -590,7 +604,14 @@ def main():
         json.dumps(
             {
                 "metric": "geomean GFLOP/s (matmul, cdist, kmeans, moments, lasso)"
-                + (" [CPU FALLBACK]" if fallback else " [CPU HOST]" if small else "")
+                + (
+                    " [CPU FALLBACK]" if fallback
+                    # forced small sizes on a healthy device are NOT a
+                    # CPU-host run — label them distinctly
+                    else " [SMALL]" if args.small
+                    else " [CPU HOST]" if small
+                    else ""
+                )
                 + (f" [partial: {sorted(errors)} failed]" if errors else ""),
                 "value": round(geo_ours, 2),
                 "unit": "GFLOP/s",

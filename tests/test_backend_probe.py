@@ -111,3 +111,4 @@ class TestRetrySchedule:
             plat, n, diags = probe_default_platform(retries=1, timeout=60)
         assert plat == "cpu" and n >= 1
         assert any("ok (" in d for d in diags)
+
