@@ -20,10 +20,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import types
-from .communication import sanitize_comm
+from .communication import CommunicationError, sanitize_comm
 from .devices import sanitize_device
 from .dndarray import DNDarray
 from .factories import array as _array
+from .stride_tricks import sanitize_axis
 
 __all__ = [
     "load",
@@ -150,10 +151,7 @@ def load_csv(
         # this process's canonical row block: the chunks of ITS devices in
         # the communicator's mesh (a sub-mesh comm may own fewer devices
         # than jax.local_device_count())
-        ldc = sum(1 for d in c.devices if d.process_index == jax.process_index())
-        cs = c.chunk_size(rows)
-        lo = min(c.first_local_position() * cs, rows)
-        hi = min((c.first_local_position() + ldc) * cs, rows)
+        lo, hi = _process_slab(c, rows)
         if full is not None:
             block = full[lo:hi]
         else:
@@ -171,7 +169,45 @@ def load_csv(
 
 
 def save_csv(data: DNDarray, path: str, header_lines: Optional[str] = None, sep: str = ","):
-    """Save to CSV (reference io.py `save_csv`)."""
+    """Save to CSV (reference io.py `save_csv`).
+
+    Multi-host with a row-split array: process 0 truncates the file and
+    writes the header + its rows, later processes append theirs in process
+    order (serialized slab writes — no host gathers the global array).
+    Replicated arrays are written by process 0 only; column-split arrays
+    would need a cross-host relayout and raise."""
+    import jax
+
+    def header_text():
+        if not header_lines:
+            return ""
+        return "".join("# " + ln + "\n" for ln in str(header_lines).splitlines())
+
+    if jax.process_count() > 1:
+        if data.split == 0:
+            block, lo, hi = _local_block(data)
+
+            def write(p):
+                with open(path, "w" if p == 0 else "a") as f:
+                    if p == 0:
+                        f.write(header_text())
+                    if hi > lo:
+                        np.savetxt(f, block, delimiter=sep)
+
+            _serialized_slab_write(write, "csv")
+            return
+        if data.split is None:
+
+            def write0(p):
+                if p == 0:
+                    np.savetxt(path, data.numpy(), delimiter=sep, header=header_lines or "")
+
+            _serialized_slab_write(write0, "csv0")
+            return
+        raise NotImplementedError(
+            "multi-host save_csv supports split=0 (row-sharded) or replicated "
+            "arrays only; resplit_(0) first"
+        )
     np.savetxt(path, data.numpy(), delimiter=sep, header=header_lines or "")
 
 
@@ -179,6 +215,87 @@ def load_npy(path: str, dtype=None, split=None, device=None, comm=None) -> DNDar
     """Load a numpy .npy file (extension; memory-maps then shards)."""
     data = np.load(path, mmap_mode="r")
     return _array(np.asarray(data), dtype=dtype, split=split, device=device, comm=comm)
+
+
+def _process_slab(comm, n: int):
+    """This process's canonical logical range ``[lo, hi)`` along a split
+    dimension of length ``n``: the union of the ceil-rule chunks of its
+    (contiguous) devices in the communicator mesh. The same arithmetic as the
+    multi-host ``load_csv`` path."""
+    import jax
+
+    c = comm.chunk_size(n)
+    ldc = sum(1 for d in comm.devices if d.process_index == jax.process_index())
+    first = comm.first_local_position()
+    lo = min(first * c, n)
+    hi = min((first + ldc) * c, n)
+    return lo, hi
+
+
+def _local_block(x: DNDarray):
+    """Process-local *logical* data of a split DNDarray as one numpy block,
+    plus its global bounds ``(block, lo, hi)`` along the split axis.
+
+    Concatenates this process's addressable shards in mesh order and trims
+    the physical tail pad — no cross-host traffic, so (unlike ``.numpy()``)
+    this is multi-host safe."""
+    split = x.split
+    comm = x.comm
+    n = x.shape[split]
+    lo, hi = _process_slab(comm, n)
+    shards = sorted(
+        x.larray.addressable_shards,
+        key=lambda s: s.index[split].start or 0,
+    )
+    seen = set()
+    parts = []
+    for s in shards:
+        key = s.index[split].start or 0
+        if key in seen:  # replicated non-split dims can duplicate shards
+            continue
+        seen.add(key)
+        parts.append(np.asarray(s.data))
+    block = parts[0] if len(parts) == 1 else np.concatenate(parts, axis=split)
+    sl = [slice(None)] * x.ndim
+    sl[split] = slice(0, hi - lo)  # physical block may carry tail pad
+    return block[tuple(sl)], lo, hi
+
+
+def _serialized_slab_write(writer, n_header: str):
+    """Run ``writer(process_id)`` on each process in process order, with a
+    global device barrier between turns.
+
+    TPU pods have no MPI-IO; concurrent writes to one HDF5/NetCDF file are
+    unsafe without it. Serializing the per-process slab writes keeps the
+    memory-scalability of parallel I/O (no host ever gathers the global
+    array — better than the reference's serial fallback, which resplits to
+    rank 0 first, reference io.py:44-47) at the cost of write-time overlap.
+    Assumes the path is on a filesystem all processes see.
+
+    A writer failure on one process must not strand the others at the
+    barrier: the exception is held until the ring completes, then an ok-flag
+    allgather raises on EVERY process (the file may be partially written)."""
+    import jax
+    from jax.experimental import multihost_utils
+
+    err = None
+    for p in range(jax.process_count()):
+        if p == jax.process_index() and err is None:
+            try:
+                writer(p)
+            except Exception as e:  # noqa: BLE001 — re-raised after the ring
+                err = e
+        multihost_utils.sync_global_devices(f"ht.io.slab:{n_header}:{p}")
+    oks = np.asarray(
+        multihost_utils.process_allgather(np.asarray([err is None], dtype=np.int32))
+    ).ravel()
+    if err is not None:
+        raise err
+    if not oks.all():
+        raise CommunicationError(
+            f"slab write failed on process(es) {np.nonzero(oks == 0)[0].tolist()} "
+            "— the file is incomplete"
+        )
 
 
 def load_hdf5(
@@ -189,24 +306,76 @@ def load_hdf5(
     device=None,
     comm=None,
 ) -> DNDarray:
-    """Load an HDF5 dataset (reference io.py:55; per-rank slice reads there,
-    host read + shard here)."""
+    """Load an HDF5 dataset (reference io.py:55 reads per-rank slices
+    ``f[dataset][slices]``).
+
+    Single-controller: one host read + shard. Multi-host with ``split``:
+    every process reads ONLY its canonical slab of the dataset (an h5py
+    range read — the file is never materialized whole on any host) and the
+    slabs assemble via ``is_split``."""
     if not __HDF5:
         raise RuntimeError("hdf5 is required for this operation (h5py not available)")
     if not isinstance(path, str):
         raise TypeError(f"path must be str, not {type(path)}")
     if not isinstance(dataset, str):
         raise TypeError(f"dataset must be str, not {type(dataset)}")
+    import jax
+
+    if jax.process_count() > 1 and split is not None:
+        c = sanitize_comm(comm)
+        with h5py.File(path, "r") as handle:
+            ds = handle[dataset]
+            gshape = tuple(ds.shape)
+            split_s = sanitize_axis(gshape, split)
+            lo, hi = _process_slab(c, gshape[split_s])
+            sl = [slice(None)] * len(gshape)
+            sl[split_s] = slice(lo, hi)
+            block = np.asarray(ds[tuple(sl)])
+        return _array(block, dtype=dtype, is_split=split_s, device=device, comm=comm)
+
     with h5py.File(path, "r") as handle:
         data = np.asarray(handle[dataset])
     return _array(data, dtype=dtype, split=split, device=device, comm=comm)
 
 
 def save_hdf5(data: DNDarray, path: str, dataset: str, mode: str = "w", **kwargs):
-    """Save to an HDF5 dataset (reference io.py:147; parallel writes when MPI
-    h5py — one host write here)."""
+    """Save to an HDF5 dataset (reference io.py:147 writes per-rank slices,
+    MPI-parallel when h5py has MPI).
+
+    Multi-host with a split array: process 0 creates the dataset at the
+    global shape, then every process writes ONLY its slab (serialized via a
+    barrier ring — see ``_serialized_slab_write``). No host gathers the
+    global array."""
     if not __HDF5:
         raise RuntimeError("hdf5 is required for this operation (h5py not available)")
+    import jax
+
+    if jax.process_count() > 1 and data.split is not None:
+        block, lo, hi = _local_block(data)
+        gshape = tuple(data.shape)
+        sl = [slice(None)] * data.ndim
+        sl[data.split] = slice(lo, hi)
+
+        def write(p):
+            with h5py.File(path, mode if p == 0 else "r+") as handle:
+                if p == 0:
+                    handle.create_dataset(
+                        dataset, shape=gshape, dtype=block.dtype, **kwargs
+                    )
+                if hi > lo:
+                    handle[dataset][tuple(sl)] = block
+
+        _serialized_slab_write(write, f"h5:{dataset}")
+        return
+    if jax.process_count() > 1:
+        # replicated array on multi-host: exactly one writer, all wait
+        def write0(p):
+            if p == 0:
+                with h5py.File(path, mode) as handle:
+                    handle.create_dataset(dataset, data=data.numpy(), **kwargs)
+
+        _serialized_slab_write(write0, f"h5r:{dataset}")
+        return
     with h5py.File(path, mode) as handle:
         handle.create_dataset(dataset, data=data.numpy(), **kwargs)
 
@@ -219,18 +388,74 @@ def load_netcdf(
     device=None,
     comm=None,
 ) -> DNDarray:
-    """Load a NetCDF variable (reference io.py:265)."""
+    """Load a NetCDF variable (reference io.py:265 reads per-rank slices).
+
+    Multi-host with ``split``: per-process slab reads + ``is_split``
+    assembly, same design as :func:`load_hdf5`."""
     if not __NETCDF:
         raise RuntimeError("netcdf is required for this operation (netCDF4 not available)")
+    import jax
+
+    if jax.process_count() > 1 and split is not None:
+        c = sanitize_comm(comm)
+        with netCDF4.Dataset(path, "r") as handle:
+            var = handle[variable]
+            gshape = tuple(var.shape)
+            split_s = sanitize_axis(gshape, split)
+            lo, hi = _process_slab(c, gshape[split_s])
+            sl = [slice(None)] * len(gshape)
+            sl[split_s] = slice(lo, hi)
+            block = np.asarray(var[tuple(sl)])
+        return _array(block, dtype=dtype, is_split=split_s, device=device, comm=comm)
+
     with netCDF4.Dataset(path, "r") as handle:
         data = np.asarray(handle[variable][:])
     return _array(data, dtype=dtype, split=split, device=device, comm=comm)
 
 
 def save_netcdf(data: DNDarray, path: str, variable: str, mode: str = "w", **kwargs):
-    """Save to a NetCDF variable (reference io.py:348)."""
+    """Save to a NetCDF variable (reference io.py:348).
+
+    Multi-host with a split array: process 0 creates dimensions + variable
+    at the global shape, then per-process slab writes (serialized, no
+    gather), as in :func:`save_hdf5`."""
     if not __NETCDF:
         raise RuntimeError("netcdf is required for this operation (netCDF4 not available)")
+    import jax
+
+    if jax.process_count() > 1 and data.split is not None:
+        block, lo, hi = _local_block(data)
+        gshape = tuple(data.shape)
+        sl = [slice(None)] * data.ndim
+        sl[data.split] = slice(lo, hi)
+
+        def write(p):
+            with netCDF4.Dataset(path, mode if p == 0 else "r+") as handle:
+                if p == 0:
+                    dims = []
+                    for i, s in enumerate(gshape):
+                        name = f"{variable}_dim{i}"
+                        handle.createDimension(name, s)
+                        dims.append(name)
+                    handle.createVariable(variable, block.dtype, tuple(dims))
+                if hi > lo:
+                    handle[variable][tuple(sl)] = block
+
+        _serialized_slab_write(write, f"nc:{variable}")
+        return
+    if jax.process_count() > 1:
+
+        def write0(p):
+            if p == 0:
+                save_netcdf_local(data, path, variable, mode, **kwargs)
+
+        _serialized_slab_write(write0, f"ncr:{variable}")
+        return
+    save_netcdf_local(data, path, variable, mode, **kwargs)
+
+
+def save_netcdf_local(data: DNDarray, path: str, variable: str, mode: str = "w", **kwargs):
+    """Single-writer NetCDF save (the local body of :func:`save_netcdf`)."""
     with netCDF4.Dataset(path, mode) as handle:
         np_data = data.numpy()
         dims = []

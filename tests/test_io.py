@@ -151,3 +151,26 @@ class TestUnevenShapes:
                        else restored["x"]),
             np.arange(11, dtype=np.float32),
         )
+
+
+class TestSlabHelpers:
+    """Unit tests of the multi-host slab arithmetic on a single controller
+    (one process owns all devices — lo=0, hi=n; the real 2-process exercise
+    lives in test_multihost.py stage 4)."""
+
+    def test_process_slab_whole_range(self, comm):
+        from heat_tpu.core.io import _process_slab
+
+        lo, hi = _process_slab(comm, 11)
+        assert (lo, hi) == (0, 11)
+
+    @pytest.mark.parametrize("split,n", [(0, 11), (1, 5), (0, 8)])
+    def test_local_block_matches_logical(self, comm, split, n):
+        from heat_tpu.core.io import _local_block
+
+        shape = (n, 5) if split == 0 else (7, n)
+        want = np.arange(np.prod(shape), dtype=np.float32).reshape(shape)
+        x = ht.array(want, split=split, comm=comm)
+        block, lo, hi = _local_block(x)
+        assert (lo, hi) == (0, shape[split])
+        np.testing.assert_array_equal(block, want)
