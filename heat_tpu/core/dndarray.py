@@ -269,6 +269,32 @@ class DNDarray:
         sl = tuple(slice(0, n) for n in self.__gshape)
         return self.__array[sl]
 
+    def _relayout(self, new_split: Optional[int]) -> jax.Array:
+        """Physical buffer re-laid-out to the canonical layout of
+        ``new_split``: logical slice, tail re-pad, `device_put` with the
+        target sharding. Every step is a compiled op on the global array
+        (XLA emits the all-to-all/all-gather), so — unlike :meth:`_logical`,
+        which hands the host a non-canonically-shardable view — this is the
+        ONE sanctioned relayout primitive and is multi-host safe."""
+        buf = self.__array
+        if self.pad_count != 0:
+            sl = tuple(slice(0, g) for g in self.__gshape)
+            buf = buf[sl]
+        pshape = self.__comm.padded_shape(self.__gshape, new_split)
+        if tuple(buf.shape) != pshape:
+            _PERF_STATS["repads"] += 1
+            pad = [(0, p - g) for p, g in zip(pshape, buf.shape)]
+            buf = jnp.pad(buf, pad)
+        if self.__comm.size > 1:
+            _PERF_STATS["device_puts"] += 1
+            tgt = (
+                self.__comm.sharding(new_split, len(self.__gshape))
+                if new_split is not None
+                else self.__comm.replicated()
+            )
+            buf = jax.device_put(buf, tgt)
+        return buf
+
     @classmethod
     def from_logical(
         cls,
@@ -395,10 +421,7 @@ class DNDarray:
         axis = sanitize_axis(self.__gshape, axis)
         if axis == self.__split:
             return self
-        new = DNDarray.from_logical(
-            self._logical(), axis, self.__device, self.__comm, self.__dtype
-        )
-        self.__array = new.larray
+        self.__array = self._relayout(axis)
         self._invalidate_halo()
         self.__split = axis
         self.__lshape_map = None

@@ -42,9 +42,9 @@ def svd(a: DNDarray, full_matrices: bool = False, compute_uv: bool = True):
 
     if compute_uv and a.split == 0 and a.comm.size > 1 and m >= n and not full_matrices:
         q, r = _qr(a)
-        u_r, s_log, vt_log = (
-            jnp.linalg.svd(r._logical(), full_matrices=False)
-        )
+        # R from TSQR is replicated (split=None, no pad) — its physical
+        # buffer IS the logical array
+        u_r, s_log, vt_log = jnp.linalg.svd(r.larray, full_matrices=False)
         u = matmul(q, DNDarray.from_logical(u_r.astype(dt.jnp_type()), None, a.device, a.comm, dt))
         s_ht = DNDarray.from_logical(s_log.astype(dt.jnp_type()), None, a.device, a.comm, dt)
         v_ht = DNDarray.from_logical(vt_log.T.astype(dt.jnp_type()), None, a.device, a.comm, dt)
@@ -70,7 +70,7 @@ def svd(a: DNDarray, full_matrices: bool = False, compute_uv: bool = True):
             a = transpose(a)
         _, r = _qr(a, calc_q=False)
         s_log = jnp.linalg.svd(
-            r._logical().astype(dt.jnp_type()), compute_uv=False
+            r.larray.astype(dt.jnp_type()), compute_uv=False
         )
         return DNDarray.from_logical(s_log, None, a.device, a.comm, dt)
 

@@ -199,17 +199,32 @@ def expand_dims(a: DNDarray, axis: int) -> DNDarray:
 
 
 def flatten(a: DNDarray) -> DNDarray:
-    """1-D copy of the array (reference manipulations.py `flatten`)."""
-    res = a._logical().ravel()
-    return _rewrap(res, 0 if a.split is not None else None, a)
+    """1-D copy of the array (reference manipulations.py `flatten`).
+    Delegates to :func:`reshape`, whose zero-comm fast paths apply when the
+    layout allows."""
+    return reshape(a, (-1,), new_split=0 if a.split is not None else None)
+
+
+def _permute_split_axis(a: DNDarray, idx_of: "jnp.ndarray") -> "jax.Array":
+    """Physical buffer with the padded split axis permuted by a logical
+    index map: output position ``j < n`` reads input position ``idx_of[j]``;
+    pad positions read themselves. One compiled sharded gather (XLA emits
+    the collective permutes) — no host relayout, multi-host safe."""
+    s = a.split
+    n = a.shape[s]
+    iota = jnp.arange(a.larray.shape[s])
+    idx = jnp.where(iota < n, idx_of, iota)
+    buf = jnp.take(a.larray, idx, axis=s)
+    if a.comm.size > 1:
+        buf = jax.lax.with_sharding_constraint(buf, a.comm.sharding(s, a.ndim))
+    return buf
 
 
 def flip(a: DNDarray, axis=None) -> DNDarray:
     """Reverse element order along axis (reference manipulations.py:876 swaps
-    mirrored ranks p2p). When the flip leaves the (padded) split dim alone —
-    or there is no pad — it runs on the physical buffer with no relayout;
-    flipping a padded split dim must move the tail pad and goes through the
-    logical view."""
+    mirrored ranks p2p). Non-split axes flip shard-locally; a padded split
+    dim flips via one index-map gather on the physical buffer (the pad stays
+    at the tail) — no logical-view relayout either way."""
     if axis is None:
         axes = tuple(range(a.ndim))
     else:
@@ -218,8 +233,14 @@ def flip(a: DNDarray, axis=None) -> DNDarray:
     if a.pad_count == 0 or a.split not in axes:
         res = jnp.flip(a.larray, axis=axes)
         return DNDarray(res, a.shape, a.dtype, a.split, a.device, a.comm, True)
-    res = jnp.flip(a._logical(), axis=axes)
-    return _rewrap(res, a.split, a)
+    s = a.split
+    n = a.shape[s]
+    iota = jnp.arange(a.larray.shape[s])
+    res = _permute_split_axis(a, n - 1 - iota)
+    other = tuple(ax for ax in axes if ax != s)
+    if other:
+        res = jnp.flip(res, axis=other)
+    return DNDarray(res, a.shape, a.dtype, a.split, a.device, a.comm, True)
 
 
 def fliplr(a: DNDarray) -> DNDarray:
@@ -395,38 +416,66 @@ def reshape(a: DNDarray, *shape, new_split: Optional[int] = None) -> DNDarray:
 
 def resplit(arr: DNDarray, axis: Optional[int] = None) -> DNDarray:
     """Out-of-place redistribution to a new split axis (reference
-    manipulations.py:3351)."""
+    manipulations.py:3351). One compiled relayout — multi-host safe."""
     axis = sanitize_axis(arr.shape, axis)
-    return DNDarray.from_logical(arr._logical(), axis, arr.device, arr.comm, arr.dtype)
+    buf = arr._relayout(axis)
+    return DNDarray(buf, arr.shape, arr.dtype, axis, arr.device, arr.comm, True)
 
 
 def roll(x: DNDarray, shift, axis=None) -> DNDarray:
     """Circular shift (reference manipulations.py:1980, Isend/Irecv ring
-    :2061-2069; XLA collective-permute here). Rolls that avoid the padded
-    split dim run on the physical buffer; a roll across the padded split dim
-    (or the flattened axis=None form) wraps through the tail pad and uses the
-    logical view."""
+    :2061-2069; XLA collective-permute here). Rolls off the padded split dim
+    run shard-locally; a roll along the padded split dim is one index-map
+    gather on the physical buffer (wrapping around the logical extent, pads
+    untouched). Only the flattened ``axis=None`` form of a padded
+    multi-dim array needs a relayout, via :func:`flatten`."""
     if axis is not None:
         ax = sanitize_axis(x.shape, axis)
         axes = (ax,) if isinstance(ax, builtins.int) else tuple(ax)
+        shifts = (
+            tuple(shift) if isinstance(shift, (tuple, list)) else (shift,) * len(axes)
+        )
+        if len(shifts) != len(axes):
+            raise ValueError(
+                f"shift and axis must match in length, got {len(shifts)} and {len(axes)}"
+            )
         if x.pad_count == 0 or x.split not in axes:
-            res = jnp.roll(x.larray, shift, axis=axes)
+            res = jnp.roll(x.larray, shifts, axis=axes)
             return DNDarray(res, x.shape, x.dtype, x.split, x.device, x.comm, True)
-    elif x.pad_count == 0 and x.ndim == 1:
+        s = x.split
+        n = x.shape[s]
+        s_shift = builtins.sum(sh for sh, ax_ in zip(shifts, axes) if ax_ == s)
+        iota = jnp.arange(x.larray.shape[s])
+        res = _permute_split_axis(x, (iota - s_shift) % n)
+        rest = [(sh, ax_) for sh, ax_ in zip(shifts, axes) if ax_ != s]
+        if rest:
+            res = jnp.roll(res, tuple(r[0] for r in rest), axis=tuple(r[1] for r in rest))
+        return DNDarray(res, x.shape, x.dtype, x.split, x.device, x.comm, True)
+    if x.pad_count == 0 and x.ndim == 1:
         res = jnp.roll(x.larray, shift)
         return DNDarray(res, x.shape, x.dtype, x.split, x.device, x.comm, True)
-    res = jnp.roll(x._logical(), shift, axis=axis)
-    return _rewrap(res, x.split, x)
+    if x.ndim == 1:  # padded 1-D: the split-axis gather form
+        return roll(x, shift, axis=0)
+    # numpy semantics: roll the flattened array, restore the shape
+    flat = roll(flatten(x), shift, axis=0)
+    return reshape(flat, x.shape, new_split=x.split)
 
 
 def rot90(m: DNDarray, k: int = 1, axes=(0, 1)) -> DNDarray:
-    """Rotate by 90° in the axes plane (reference `rot90`)."""
-    res = jnp.rot90(m._logical(), k=k, axes=tuple(axes))
-    out_split = m.split
-    if out_split in tuple(sanitize_axis(m.shape, a) for a in axes) and k % 2 != 0:
-        a0, a1 = (sanitize_axis(m.shape, a) for a in axes)
-        out_split = a1 if out_split == a0 else a0
-    return _rewrap(res, out_split, m)
+    """Rotate by 90° in the axes plane (reference `rot90`) — composed from
+    :func:`flip` and :func:`swapaxes` (numpy's construction), so it inherits
+    their physical no-relayout paths."""
+    a0, a1 = (sanitize_axis(m.shape, a) for a in axes)
+    if a0 == a1:
+        raise ValueError("rot90 axes must be different")
+    k = k % 4
+    if k == 0:
+        return DNDarray(m.larray, m.shape, m.dtype, m.split, m.device, m.comm, True)
+    if k == 2:
+        return flip(flip(m, a0), a1)
+    if k == 1:
+        return swapaxes(flip(m, a1), a0, a1)
+    return flip(swapaxes(m, a0, a1), a1)
 
 
 def row_stack(arrays: Sequence[DNDarray]) -> DNDarray:
@@ -626,8 +675,14 @@ def squeeze(x: DNDarray, axis=None) -> DNDarray:
         res = jnp.squeeze(x.larray, axis=axes)
         gshape = tuple(s for d, s in enumerate(x.shape) if d not in axes)
         return DNDarray(res, gshape, x.dtype, out_split, x.device, x.comm, True)
-    res = jnp.squeeze(x._logical(), axis=axes if axes else None)
-    return _rewrap(res, out_split, x)
+    # the (size-1) split dim itself is squeezed away: one compiled take of
+    # logical position 0 along the padded axis + replication — no host path
+    buf = jnp.take(x.larray, jnp.array([0]), axis=x.split)
+    res = jnp.squeeze(buf, axis=axes)
+    if x.comm.size > 1:
+        res = jax.device_put(res, x.comm.replicated())
+    gshape = tuple(s for d, s in enumerate(x.shape) if d not in axes)
+    return DNDarray(res, gshape, x.dtype, out_split, x.device, x.comm, True)
 
 
 def stack(arrays: Sequence[DNDarray], axis: int = 0, out=None) -> DNDarray:
