@@ -16,32 +16,33 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = r"""
 import os, sys
 rank, port = int(sys.argv[1]), sys.argv[2]
+NPROCS, LDC = int(sys.argv[4]), int(sys.argv[5])  # topology: procs x local devices
 os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+os.environ["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={LDC}"
 import jax
 jax.config.update("jax_platforms", "cpu")
 import numpy as np
 import heat_tpu as ht
 
 comm = ht.init_distributed(
-    coordinator_address=f"localhost:{port}", num_processes=2, process_id=rank
+    coordinator_address=f"localhost:{port}", num_processes=NPROCS, process_id=rank
 )
-assert jax.process_count() == 2
-assert comm.size == 4, comm.size  # 2 processes x 2 local devices
+assert jax.process_count() == NPROCS
+assert comm.size == NPROCS * LDC, comm.size
 assert comm.rank == rank
 
 # --- is_split assembly: each process passes its canonical block ----------
-n = 10  # c = ceil(10/4) = 3; proc 0 -> rows [0,6), proc 1 -> rows [6,10)
+n = 10  # non-divisible over every swept mesh; 4x2 leaves proc 3 EMPTY
 c = comm.chunk_size(n)
-lo = min(rank * 2 * c, n)
-hi = min((rank + 1) * 2 * c, n)
+lo = min(rank * LDC * c, n)
+hi = min((rank + 1) * LDC * c, n)
 local = np.arange(lo, hi, dtype=np.float32)
 x = ht.array(local, is_split=0)
 assert x.shape == (n,), x.shape
 assert x.split == 0
 
 # --- lshape reports the first LOCAL device's chunk, not process index ----
-assert comm.first_local_position() == rank * 2, comm.first_local_position()
+assert comm.first_local_position() == rank * LDC, comm.first_local_position()
 _, exp_lshape, _ = comm.chunk((n,), 0, comm.first_local_position())
 assert x.lshape == exp_lshape, (x.lshape, exp_lshape)
 
@@ -246,7 +247,14 @@ def _free_port() -> int:
 
 
 class TestMultiHostStage1:
-    def test_two_process_init_distributed_and_is_split(self, tmp_path):
+    """The worker list runs under two topologies of the same 8-position
+    mesh — 2 procs × 4 devices and 4 procs × 2 devices (SURVEY §4's
+    world-size sweep; VERDICT r3 item 9). The 10-row gshape is
+    non-divisible under both, and 4×2 leaves the last process with an
+    EMPTY canonical block."""
+
+    @pytest.mark.parametrize("nprocs,ldc", [(2, 4), (4, 2)])
+    def test_process_topologies(self, tmp_path, nprocs, ldc):
         script = tmp_path / "mh_worker.py"
         script.write_text(WORKER)
         port = _free_port()
@@ -255,18 +263,21 @@ class TestMultiHostStage1:
         # the workers force their own XLA_FLAGS before importing jax
         procs = [
             subprocess.Popen(
-                [sys.executable, str(script), str(r), str(port), str(tmp_path / "mh_data.csv")],
+                [
+                    sys.executable, str(script), str(r), str(port),
+                    str(tmp_path / "mh_data.csv"), str(nprocs), str(ldc),
+                ],
                 stdout=subprocess.PIPE,
                 stderr=subprocess.STDOUT,
                 env=env,
                 cwd=REPO,
             )
-            for r in (0, 1)
+            for r in range(nprocs)
         ]
         outs = []
         try:
             for p in procs:
-                out, _ = p.communicate(timeout=240)
+                out, _ = p.communicate(timeout=360)
                 outs.append(out.decode(errors="replace"))
         finally:
             for p in procs:
