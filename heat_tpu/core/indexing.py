@@ -47,8 +47,13 @@ __all__ = ["nonzero", "where"]
 
 
 def _normalize_key(key, x: DNDarray):
-    """Convert DNDarray keys to jnp arrays, leave the rest untouched."""
+    """Convert DNDarray keys to jnp arrays, leave the rest untouched.
+    Split keys replicate via the compiled relayout (index vectors and masks
+    are small next to the data) — multi-host safe, unlike the host-logical
+    view."""
     if isinstance(key, DNDarray):
+        if key.split is not None and (key.pad_count or key.comm.size > 1):
+            return key._relayout(None)
         return key._logical()
     if isinstance(key, tuple):
         return tuple(_normalize_key(k, x) for k in key)
@@ -175,6 +180,45 @@ def _advanced_take(x: DNDarray, axis: int, idx: jax.Array) -> DNDarray:
     )
 
 
+def _paired_take(x: DNDarray, pos0: int, rows: jax.Array, cols: jax.Array) -> DNDarray:
+    """``x[..., rows, cols, ...]`` — two adjacent 1-D integer arrays at dims
+    ``(pos0, pos0+1)``, every other dim a full slice. The two dims are
+    merged shard-side and the pair becomes ONE linearized sharded gather
+    (``row * stride + col`` into the merged axis), so the result comes out
+    with its canonical sharding and no replicated intermediate — the second
+    mixed-key pattern the reference handles shard-side
+    (reference dndarray.py:661-1549)."""
+    comm = x.comm
+    n0, n1 = x.shape[pos0], x.shape[pos0 + 1]
+    _check_bounds(rows, n0, pos0)
+    _check_bounds(cols, n1, pos0 + 1)
+    rows = jnp.where(rows < 0, rows + n0, rows)
+    cols = jnp.where(cols < 0, cols + n1, cols)
+    rows, cols = jnp.broadcast_arrays(rows, cols)
+    k = builtins.int(rows.shape[0])
+    buf = x.larray
+    stride = buf.shape[pos0 + 1]  # physical minor extent
+    merged = jnp.reshape(
+        buf, buf.shape[:pos0] + (buf.shape[pos0] * stride,) + buf.shape[pos0 + 2 :]
+    )
+    idx = rows * stride + cols  # logical rows/cols never address the pad
+    out_gshape = x.shape[:pos0] + (k,) + x.shape[pos0 + 2 :]
+    if x.split is None:
+        out_split = None
+    elif x.split < pos0:
+        out_split = x.split
+    elif x.split in (pos0, pos0 + 1):
+        out_split = pos0  # the advanced dim stays distributed
+    else:
+        out_split = x.split - 1
+    P = comm.padded_size(k) if out_split == pos0 else k
+    if P != k:
+        idx = jnp.pad(idx, (0, P - k))
+    fn = _sharded_take_fn(comm, pos0, out_split, len(out_gshape))
+    res = fn(merged, idx)
+    return DNDarray(res, out_gshape, x.dtype, out_split, x.device, x.comm, True)
+
+
 def _normalize_basic_key_physical(expanded, x: DNDarray):
     """Normalize an expanded basic key against the *logical* global shape so
     it can be applied to the padded physical buffer (the pad sits at the
@@ -258,6 +302,70 @@ def getitem(x: DNDarray, key) -> DNDarray:
         ):
             return _advanced_take(x, arr_pos, jnp.asarray(key[arr_pos]))
 
+    # --- mixed advanced keys that stay shard-side -------------------------
+    if (
+        isinstance(key, tuple)
+        and len(key) <= x.ndim
+        and not builtins.any(k is Ellipsis or k is None for k in key)
+    ):
+        arr_pos_list = [i for i, k in enumerate(key) if _is_int_array(k)]
+        others_basic = builtins.all(
+            _is_int_array(k) or isinstance(k, (slice, builtins.int, np.integer))
+            for k in key
+        )
+        # (slice/int…, 1-D int-array): apply the basic part first (shard-
+        # friendly), then the sharded gather on the surviving axis. Scalar
+        # ints count as advanced when an array key is present — the
+        # decomposition keeps numpy's in-place result dim only when the
+        # advanced entries are CONSECUTIVE (separated advanced dims move to
+        # the front in numpy; that shape juggling stays on the fallback)
+        adv_pos = [
+            i
+            for i, k in enumerate(key)
+            if _is_int_array(k) or isinstance(k, (builtins.int, np.integer))
+        ]
+        adv_consecutive = (
+            len(adv_pos) <= 1 or adv_pos[-1] - adv_pos[0] + 1 == len(adv_pos)
+        )
+        if (
+            others_basic
+            and adv_consecutive
+            and len(arr_pos_list) == 1
+            and getattr(key[arr_pos_list[0]], "ndim", 0) == 1
+        ):
+            i = arr_pos_list[0]
+            base = tuple(slice(None) if j == i else k for j, k in enumerate(key))
+            nontrivial = builtins.any(
+                not (isinstance(k, slice) and k == slice(None)) for k in base
+            )
+            y = getitem(x, base) if nontrivial else x
+            new_axis = i - builtins.sum(
+                1
+                for j, k in enumerate(key)
+                if j < i and isinstance(k, (builtins.int, np.integer))
+            )
+            return _advanced_take(y, new_axis, jnp.asarray(key[i]))
+        # (1-D int-array, 1-D int-array) on adjacent dims, rest full slices:
+        # one linearized sharded gather
+        if (
+            others_basic
+            and len(arr_pos_list) == 2
+            and arr_pos_list[1] == arr_pos_list[0] + 1
+            and builtins.all(
+                isinstance(k, slice) and k == slice(None)
+                for j, k in enumerate(key)
+                if j not in arr_pos_list
+            )
+            and getattr(key[arr_pos_list[0]], "ndim", 0) == 1
+            and getattr(key[arr_pos_list[1]], "ndim", 0) == 1
+        ):
+            return _paired_take(
+                x,
+                arr_pos_list[0],
+                jnp.asarray(key[arr_pos_list[0]]),
+                jnp.asarray(key[arr_pos_list[1]]),
+            )
+
     # --- basic keys -------------------------------------------------------
     is_basic = not isinstance(key, tuple) and (
         isinstance(key, (builtins.int, np.integer, slice)) or key is Ellipsis or key is None
@@ -303,8 +411,10 @@ def getitem(x: DNDarray, key) -> DNDarray:
             if out_split is not None and out_split >= result.ndim:
                 out_split = None
             return DNDarray(result, gshape, x.dtype, out_split, x.device, x.comm, True)
-        # logical route (pad_count==0 means this is the physical buffer too)
-        result = (x.larray if x.pad_count == 0 else x._logical())[norm_key]
+        # keys are normalized against the LOGICAL extents, so they can never
+        # reach the tail pad — index the physical buffer directly (compiled,
+        # multi-host safe); the result is unpadded and re-laid-out below
+        result = x.larray[norm_key]
         if result.ndim == 0:
             return DNDarray(
                 result, (), types.canonical_heat_type(result.dtype), None, x.device, x.comm, True

@@ -303,3 +303,88 @@ class TestDistributedMaskedSelect(TestCase):
         np.testing.assert_array_equal(x[m].numpy(), t[t > 0])
         np.testing.assert_array_equal(x[x > 1e9].numpy(), t[t > 1e9])
         np.testing.assert_array_equal(x[x < 1e9].numpy(), t[t < 1e9])
+
+
+class TestMixedAdvancedShardSide(TestCase):
+    """Round-4 (VERDICT r3 item 6): (slice, int-array) and
+    (int-array, int-array) key patterns stay shard-side — sharded gather,
+    no replicated intermediate, no host-logical view."""
+
+    def _np_oracle(self, shape, key, split):
+        xn = np.arange(np.prod(shape), dtype=np.float32).reshape(shape)
+        x = ht.array(xn, split=split)
+        from heat_tpu.core.dndarray import _PERF_STATS
+
+        before = _PERF_STATS["logical_slices"]
+        got = x[key]
+        assert _PERF_STATS["logical_slices"] == before, "hit the logical view"
+        np.testing.assert_array_equal(got.numpy(), xn[key])
+        return got
+
+    def test_slice_then_array(self):
+        idx = np.array([5, 0, 3, 3, 6])
+        for split in (0, 1):
+            got = self._np_oracle((11, 7), (slice(2, 6), idx), split)
+            assert got.shape == (4, 5)
+
+    def test_array_then_slice(self):
+        idx = np.array([5, 0, 3])
+        for split in (0, 1):
+            self._np_oracle((11, 7), (idx, slice(1, 4)), split)
+
+    def test_int_and_array_consecutive(self):
+        idx = np.array([2, 4, 0])
+        for split in (0, 1):
+            got = self._np_oracle((11, 7), (3, idx), split)
+            assert got.shape == (3,)
+        got = self._np_oracle((11, 7, 5), (slice(None), 2, idx), 0)
+        assert got.shape == (11, 3)
+
+    def test_paired_arrays(self):
+        rows = np.array([1, 5, 9, 0])
+        cols = np.array([0, 3, 6, 2])
+        for split in (0, 1):
+            got = self._np_oracle((11, 7), (rows, cols), split)
+            assert got.shape == (4,)
+            # the result is laid out with its canonical sharding
+            import jax
+
+            if got.split is not None:
+                assert got.larray.sharding.is_equivalent_to(
+                    got.comm.sharding(got.split, got.ndim), got.ndim
+                )
+
+    def test_paired_arrays_3d_rest_slice(self):
+        rows = np.array([1, 5, 9])
+        cols = np.array([0, 3, 6])
+        got = self._np_oracle((11, 7, 4), (rows, cols), 0)
+        assert got.shape == (3, 4)
+        got = self._np_oracle((4, 11, 7), (slice(None), rows, cols), 1)
+        assert got.shape == (4, 3)
+
+    def test_paired_negative_indices(self):
+        rows = np.array([-1, 0, -11])
+        cols = np.array([-7, 3, 0])
+        self._np_oracle((11, 7), (rows, cols), 0)
+
+    def test_paired_broadcast_scalar(self):
+        rows = np.array([3])
+        cols = np.array([0, 1, 2, 6])
+        for split in (0, 1):
+            self._np_oracle((11, 7), (rows, cols), split)
+
+    def test_separated_advanced_falls_back_correct(self):
+        # x[1, :, idx] — separated advanced dims move to the FRONT in
+        # numpy; the shard-side decomposition must NOT claim this pattern
+        xn = np.arange(11 * 5 * 7, dtype=np.float32).reshape(11, 5, 7)
+        x = ht.array(xn, split=0)
+        idx = np.array([2, 0, 5])
+        got = x[1, :, idx]
+        np.testing.assert_array_equal(got.numpy(), xn[1, :, idx])
+
+    def test_out_of_bounds_raises(self):
+        x = ht.array(np.zeros((6, 4), np.float32), split=0)
+        with self.assertRaises(IndexError):
+            x[np.array([0, 6]), np.array([0, 1])]
+        with self.assertRaises(IndexError):
+            x[slice(0, 3), np.array([4])]
