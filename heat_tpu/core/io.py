@@ -185,7 +185,31 @@ def save_csv(data: DNDarray, path: str, header_lines: Optional[str] = None, sep:
 
     if jax.process_count() > 1:
         if data.split == 0:
+            from jax.experimental import multihost_utils
+
             block, lo, hi = _local_block(data)
+            # the append-in-process-order design assumes the per-process
+            # slabs tile [0, n) contiguously in process-index order; a comm
+            # built over an interleaved device list would scramble rows —
+            # validate full coverage, not just monotonicity
+            spans = np.asarray(
+                multihost_utils.process_allgather(
+                    np.asarray([lo, hi], dtype=np.int64)
+                )
+            ).reshape(-1, 2)
+            n_rows = data.shape[0]
+            contiguous = (
+                spans[0, 0] == 0
+                and spans[-1, 1] == n_rows
+                and (spans[1:, 0] == spans[:-1, 1]).all()
+            )
+            if not contiguous:
+                raise NotImplementedError(
+                    "multi-host save_csv requires the per-process slabs to "
+                    "tile the rows contiguously in process order (got spans "
+                    f"{spans.tolist()} for {n_rows} rows); use save_hdf5, "
+                    "which writes explicit slices"
+                )
 
             def write(p):
                 with open(path, "w" if p == 0 else "a") as f:
@@ -255,6 +279,12 @@ def _local_block(x: DNDarray):
             continue
         seen.add(key)
         parts.append(np.asarray(s.data))
+    if not parts:
+        # a process owning none of the comm's devices still participates in
+        # the collective write — with an empty slab (hi == lo)
+        eshape = list(x.padded_shape)
+        eshape[split] = 0
+        return np.empty(eshape, dtype=x.larray.dtype), lo, lo
     block = parts[0] if len(parts) == 1 else np.concatenate(parts, axis=split)
     sl = [slice(None)] * x.ndim
     sl[split] = slice(0, hi - lo)  # physical block may carry tail pad

@@ -13,6 +13,7 @@ shapes are jit-hostile — the documented host path, SURVEY §7 hard parts).
 from __future__ import annotations
 
 import builtins
+import functools
 import operator
 from typing import List, Optional, Sequence, Tuple, Union
 
@@ -91,6 +92,26 @@ def balance(array: DNDarray, copy: bool = False) -> DNDarray:
     return _copy(array) if copy else array
 
 
+@functools.lru_cache(maxsize=128)
+def _concat_split_fn(comm, axis, out_split, in_shapes, gshape, out_dtype):
+    """Cached jitted slice→concat→re-pad program for concatenation along
+    the split axis (keyed on shapes/dtype so repeated calls reuse the
+    compile, the `_sharded_take_fn` pattern)."""
+    pshape = comm.padded_shape(gshape, out_split)
+    jdt = out_dtype.jnp_type()
+
+    def cat(*bufs):
+        logs = [
+            b[tuple(slice(0, g) for g in shp)].astype(jdt)
+            for b, shp in zip(bufs, in_shapes)
+        ]
+        res = jnp.concatenate(logs, axis=axis)
+        pad = [(0, p - g) for p, g in zip(pshape, gshape)]
+        return jnp.pad(res, pad)
+
+    return jax.jit(cat, out_shardings=comm.sharding(out_split, len(gshape)))
+
+
 def concatenate(arrays: Sequence[DNDarray], axis: int = 0) -> DNDarray:
     """Join arrays along an existing axis (reference manipulations.py:188,
     with the split-combination case table :377-443).
@@ -140,6 +161,26 @@ def concatenate(arrays: Sequence[DNDarray], axis: int = 0) -> DNDarray:
         gshape[axis] = builtins.sum(a.shape[axis] for a in arrays)
         return DNDarray(
             res, tuple(gshape), out_dtype, out_split, arrays[0].device, comm, True
+        )
+
+    if out_split is not None:
+        # concatenation ALONG the split axis: one compiled
+        # slice→concat→re-pad program laid out to the result's canonical
+        # sharding — XLA emits the relayout collectives, multi-host safe
+        gshape = list(arrays[0].shape)
+        gshape[axis] = builtins.sum(a.shape[axis] for a in arrays)
+        gshape = tuple(gshape)
+        fn = _concat_split_fn(
+            comm,
+            axis,
+            out_split,
+            tuple(tuple(a.shape) for a in arrays),
+            gshape,
+            out_dtype,
+        )
+        res = fn(*[a.larray for a in arrays])
+        return DNDarray(
+            res, gshape, out_dtype, out_split, arrays[0].device, comm, True
         )
 
     logs = [a._logical().astype(out_dtype.jnp_type()) for a in arrays]

@@ -170,7 +170,11 @@ def _dist(x: DNDarray, y: Optional[DNDarray], block_fn: Callable, ring_ok: bool,
         out = out[:, :n]
         return DNDarray(out, (m, n), promoted, out_split, x.device, x.comm, True)
 
-    out = _local_dist(block_fn, x.larray, y._logical(), promoted.jnp_type())
+    # y's logical rows become output COLUMNS, whole on every row-shard (the
+    # replicated-centers pattern): replicate via the compiled relayout when
+    # y is split — multi-host safe, unlike the host-logical view
+    yb = y._relayout(None) if y.split is not None else y.larray
+    out = _local_dist(block_fn, x.larray, yb, promoted.jnp_type())
     return DNDarray(out, (m, n), promoted, out_split, x.device, x.comm, True)
 
 
