@@ -28,6 +28,9 @@ Workloads (BASELINE.json configs):
   * lm_step     — flagship TransformerLM training step (fwd+bwd+AdamW in one
                   jit, bf16, Pallas flash core); detail row with model-flops
                   MFU
+  * attention_bwd — fwd+bwd through the Pallas flash kernels (causal)
+  * matmul_1b   — BASELINE.md north-star row: 32768² bf16 split DNDarrays
+                  (1.074B elements each) through framework matmul
 
 Headline metric: geometric-mean achieved GFLOP/s across completed f32
 workloads. `--profile DIR` additionally captures a jax.profiler trace of the
@@ -88,7 +91,8 @@ def _sync(arr):
     return float(arr[(0,) * arr.ndim])
 
 
-def bench_heat_tpu(errors, profile_dir=None, small=False, only=None):
+def bench_heat_tpu(errors, profile_dir=None, small=False, only=None,
+                   sweep_attn=False):
     """``small=True`` (CPU fallback / CPU-only host) shrinks sizes so the run
     stays minutes, not hours — the numbers are then diagnostic, not the
     headline claim.
@@ -246,10 +250,11 @@ def bench_heat_tpu(errors, profile_dir=None, small=False, only=None):
         # per sweep per coordinate: rho = x_j . residual (2n) + y_est (2n)
         return run, sweeps * dl * 4.0 * nl
 
-    def make_attention():
+    def make_attention(block_q=512, block_k=1024):
         # Pallas flash-attention chain (heat_tpu.parallel.flash_attention),
         # bf16, non-causal; detail row like matmul_bf16 (not in the geomean).
-        # (512, 1024) blocks won the v5e sweep at 2.7× the XLA path
+        # (512, 1024) blocks won the v5e sweep at 2.7× the XLA path; see
+        # --sweep-attn for re-running the sweep
         from heat_tpu.parallel import flash_attention
 
         (b, t, h, d, reps) = (1, 512, 2, 64, 2) if small else (4, 4096, 8, 128, 20)
@@ -263,7 +268,9 @@ def bench_heat_tpu(errors, profile_dir=None, small=False, only=None):
         def chain(q, k, v):
             def body(_, q_):
                 # keep the chain data-dependent so XLA can't dedupe reps
-                return flash_attention(q_, k, v) + q_ * jnp.bfloat16(1e-3)
+                return flash_attention(
+                    q_, k, v, block_q=block_q, block_k=block_k
+                ) + q_ * jnp.bfloat16(1e-3)
 
             return jax.lax.fori_loop(0, reps, body, q)
 
@@ -271,6 +278,58 @@ def bench_heat_tpu(errors, profile_dir=None, small=False, only=None):
             return _sync(chain(q, k, v).astype(jnp.float32))
 
         return run, reps * 4.0 * b * h * t * t * d
+
+    def make_attention_bwd():
+        # fwd+bwd through the Pallas kernels (causal): the r4 backward is
+        # two hand-tiled Pallas passes from the saved O/log-sum-exp instead
+        # of the r3 XLA recompute — this row tracks it. Counted flops:
+        # causal fwd 2·bhT²d + bwd 3.5× fwd ⇒ 9·bhT²d per rep.
+        from heat_tpu.parallel import flash_attention
+
+        (b, t, h, d, reps) = (1, 512, 2, 64, 2) if small else (4, 4096, 8, 128, 10)
+        key = jax.random.PRNGKey(1)
+        kq, kk, kv = jax.random.split(key, 3)
+        q = jax.random.normal(kq, (b, t, h, d), dtype=jnp.bfloat16)
+        k = jax.random.normal(kk, (b, t, h, d), dtype=jnp.bfloat16)
+        v = jax.random.normal(kv, (b, t, h, d), dtype=jnp.bfloat16)
+
+        def loss(q_, k_, v_):
+            return flash_attention(q_, k_, v_, causal=True).astype(jnp.float32).sum()
+
+        @jax.jit
+        def chain(q, k, v):
+            def body(_, carry):
+                q_, k_, v_ = carry
+                dq, dk, dv = jax.grad(loss, argnums=(0, 1, 2))(q_, k_, v_)
+                # fold grads back in so reps stay data-dependent
+                return (
+                    q_ + dq * jnp.bfloat16(1e-3),
+                    k_ + dk * jnp.bfloat16(1e-3),
+                    v_ + dv * jnp.bfloat16(1e-3),
+                )
+
+            return jax.lax.fori_loop(0, reps, body, (q, k, v))[0]
+
+        def run():
+            return _sync(chain(q, k, v).astype(jnp.float32))
+
+        return run, reps * 9.0 * b * h * t * t * d
+
+    def make_matmul_1b():
+        # BASELINE.md north star: a >=1B-element split DNDarray driven
+        # through framework matmul on the chip. 32768^2 bf16 operands are
+        # 1.074B elements (2.15 GB) each; a/y0/y1 fit v5e's 16 GB HBM with
+        # room for XLA workspace. Detail row (not in the geomean); the
+        # [SMALL] variant keeps the maker testable on CPU hosts.
+        n, reps = (1024, 2) if small else (32768, 5)
+        ab = (ht.random.rand(n, n, dtype=ht.float32, split=0) / float(n)).astype(ht.bfloat16)
+        yb = ht.random.rand(n, n, dtype=ht.float32, split=0).astype(ht.bfloat16)
+        jchain = _jit_matmul_chain(ab, yb, reps)
+
+        def run():
+            return _sync(jchain(ab.larray, yb.larray).astype(jnp.float32))
+
+        return run, reps * 2.0 * n * n * n
 
     def make_matmul_int8():
         # W8A8 Pallas GEMM chain (heat_tpu.core.linalg.int8_matmul) — the
@@ -376,8 +435,10 @@ def bench_heat_tpu(errors, profile_dir=None, small=False, only=None):
         ("moments", make_moments),
         ("lasso", make_lasso),
         ("attention", make_attention),
+        ("attention_bwd", make_attention_bwd),
         ("matmul_int8", make_matmul_int8),
         ("lm_step", make_lm_step),
+        ("matmul_1b", make_matmul_1b),
     ]
 
     results = {}
@@ -396,6 +457,37 @@ def bench_heat_tpu(errors, profile_dir=None, small=False, only=None):
                   file=sys.stderr, flush=True)
         except Exception as e:  # noqa: BLE001 — record and continue
             errors[name] = repr(e)
+
+    if sweep_attn or os.environ.get("HEAT_TPU_SWEEP_ATTN"):
+        # block-size sweep of the flash kernel (VERDICT r3 item 5): per-combo
+        # GFLOP/s on stderr; the winner should be baked into make_attention.
+        # Blocks clamp to the sequence length, so combos that resolve to the
+        # same effective kernel are deduplicated and labeled by the EFFECTIVE
+        # blocks actually run.
+        t_seq = 512 if small else 4096
+        clamp = lambda blk: min(blk, -(-t_seq // 128) * 128)
+        seen = set()
+        for bq in (256, 512, 1024):
+            for bk in (256, 512, 1024, 2048):
+                ebq, ebk = clamp(bq), clamp(bk)
+                if (ebq, ebk) in seen:
+                    continue
+                seen.add((ebq, ebk))
+                label = f"bq{ebq}_bk{ebk}"
+                try:
+                    run, flops = make_attention(block_q=ebq, block_k=ebk)
+                    run()
+                    t = _best_time(run, repeats=2)
+                    print(
+                        json.dumps({
+                            "sweep_attn": label,
+                            "gflops": round(flops / t / 1e9, 2),
+                        }),
+                        file=sys.stderr, flush=True,
+                    )
+                except Exception as e:  # noqa: BLE001
+                    print(json.dumps({"sweep_attn": label, "error": repr(e)}),
+                          file=sys.stderr, flush=True)
     return results
 
 
@@ -494,6 +586,10 @@ def main():
     ap.add_argument("--only", metavar="NAMES", default=None,
                     help="comma-separated workload subset to run "
                          "(re-measure one row without the full sweep)")
+    ap.add_argument("--sweep-attn", action="store_true",
+                    help="also sweep flash-attention (block_q, block_k) "
+                         "combos and print per-combo GFLOP/s to stderr "
+                         "(labels use the effective, clamped blocks)")
     ap.add_argument("--small", action="store_true",
                     help="force the reduced (CPU-scale) workload sizes — "
                          "what the probe selects on a CPU-only host; lets "
@@ -551,7 +647,8 @@ def main():
         only = {s.strip() for s in args.only.split(",") if s.strip()}
         known = {
             "matmul", "matmul_f32", "matmul_bf16", "cdist", "kmeans",
-            "moments", "lasso", "attention", "matmul_int8", "lm_step",
+            "moments", "lasso", "attention", "attention_bwd", "matmul_int8",
+            "lm_step", "matmul_1b",
         }
         unknown = only - known
         if unknown:
@@ -579,6 +676,7 @@ def main():
             sys.exit(3)
         ours = bench_heat_tpu(
             errors, profile_dir=args.profile, small=small, only=only,
+            sweep_attn=args.sweep_attn,
         )
     except Exception as e:  # noqa: BLE001 — the JSON line must still print
         errors["fatal"] = repr(e)
@@ -590,7 +688,8 @@ def main():
     f32 = {
         k: v
         for k, v in ours.items()
-        if k not in ("matmul_bf16", "matmul_f32", "attention", "matmul_int8", "lm_step")
+        if k not in ("matmul_bf16", "matmul_f32", "attention", "attention_bwd",
+                     "matmul_int8", "lm_step", "matmul_1b")
     }
     geo_ours = float(np.exp(np.mean([np.log(v) for v in f32.values()]))) if f32 else 0.0
     # vs_baseline compares geomeans over the SAME workload subset, so a
@@ -633,6 +732,14 @@ def main():
         detail["matmul_int8_vs_bf16_peak"] = round(
             ours["matmul_int8"] / peak_single, 3
         )
+        # the honest int8 MFU: against the int8 roofline (2x bf16 peak)
+        detail["matmul_int8_mfu"] = round(
+            ours["matmul_int8"] / (2.0 * peak_single), 3
+        )
+    if peak_single and "attention_bwd" in ours:
+        detail["attention_bwd_mfu"] = round(ours["attention_bwd"] / peak_single, 3)
+    if peak and "matmul_1b" in ours:
+        detail["matmul_1b_mfu"] = round(ours["matmul_1b"] / peak, 3)
     if peak_single and "lm_step" in ours:
         # model-flops utilization of the full training step (6·N·T counted
         # flops over matmul-participating params; attention excluded)

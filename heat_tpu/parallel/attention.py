@@ -40,7 +40,12 @@ def _block_attn(q, k, v, m, l, o, q_start, k_start, scale, causal, kv_len_valid)
     ``q_start``/``k_start`` are the blocks' global sequence offsets (traced
     scalars) used for causal masking; ``kv_len_valid`` masks K tail padding.
     """
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    # MXU dots run in the INPUT dtype with f32 accumulation — an up-front
+    # astype(f32) would force true-f32 MXU passes at ~1/4 throughput (the
+    # r3 lm_step/backward bottleneck); softmax stays f32 throughout
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
     tk = k.shape[1]
     k_pos = k_start + jnp.arange(tk)
     mask = k_pos[None, :] < kv_len_valid  # (1, Tk) — valid K positions
@@ -57,7 +62,12 @@ def _block_attn(q, k, v, m, l, o, q_start, k_start, scale, causal, kv_len_valid)
     alpha = jnp.exp(jnp.where(m <= NEG_INF / 2, NEG_INF, m) - m_safe)
     alpha = jnp.where(m <= NEG_INF / 2, 0.0, alpha)
     l_new = alpha * l + p.sum(axis=-1)
-    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    # PV in v's dtype (standard flash practice): f32 probabilities round to
+    # bf16 on the way into the MXU for bf16 v, accumulating in f32
+    p_mx = p if v.dtype == jnp.float32 else p.astype(v.dtype)
+    pv = jnp.einsum(
+        "bhqk,bkhd->bqhd", p_mx, v, preferred_element_type=jnp.float32
+    )
     o_new = o * alpha.transpose(0, 2, 1)[..., None] + pv
     return m_new, l_new, o_new
 
@@ -108,10 +118,10 @@ def local_attention(
         k_start = i * block_size
         kb = jax.lax.dynamic_slice_in_dim(k, k_start, block_size, axis=1)
         vb = jax.lax.dynamic_slice_in_dim(v, k_start, block_size, axis=1)
+        # inputs keep their dtype: the MXU dots inside _block_attn accumulate
+        # in f32 via preferred_element_type (bf16 inputs run full-rate)
         return _block_attn(
-            q.astype(jnp.float32), kb.astype(jnp.float32),
-            vb.astype(jnp.float32), m, l, o, 0, k_start, scale, causal,
-            kv_valid,
+            q, kb, vb, m, l, o, 0, k_start, scale, causal, kv_valid,
         )
 
     m, l, o = jax.lax.fori_loop(0, nblk, body, (m, l, o))
@@ -153,13 +163,12 @@ def ring_attention(
         # freshly-built accumulators are replicated; the scan carry must be
         # device-varying because it mixes with the sharded q/k/v blocks
         m, l, o = (jax.lax.pcast(a, (axis,), to="varying") for a in (m, l, o))
-        qf = qb.astype(jnp.float32)
 
         def body(t, carry):
             kc, vc, m, l, o = carry
             origin = (rank - t) % p
             m, l, o = _block_attn(
-                qf, kc.astype(jnp.float32), vc.astype(jnp.float32),
+                qb, kc, vc,
                 m, l, o, rank * tc, origin * tc, scale, causal, seq_len,
             )
             kc = jax.lax.ppermute(kc, axis, perm=perm)

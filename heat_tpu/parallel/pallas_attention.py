@@ -15,8 +15,10 @@ paths agree to tight tolerance (asserted on CPU via the Pallas
 interpreter); for bf16 inputs the MXU dots run in bf16 with f32
 accumulation (and p rounds to bf16 before the PV product — standard flash
 practice), so agreement is to bf16 tolerance, also asserted. The backward
-pass recomputes through the jnp path under ``jax.custom_vjp`` — flash
-recomputation, O(T) memory, no stored (T, T) matrix.
+pass is two hand-tiled Pallas kernels (dq; dk/dv) that rebuild the
+probabilities from the saved O and log-sum-exp residuals — O(T) memory
+(no stored (T, T) matrix), every MXU dot in the input dtype. The
+inference-only forward skips the log-sum-exp output entirely.
 """
 
 from __future__ import annotations
@@ -39,7 +41,7 @@ _I0 = np.int32(0)
 
 
 def _flash_kernel(
-    q_ref, k_ref, v_ref, o_ref, m_s, l_s, acc_s,
+    q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s,
     *, scale, causal, kv_valid, block_q, block_k,
 ):
     """Grid = (B, H, num_q_blocks, num_k_blocks); last axis is sequential.
@@ -120,28 +122,40 @@ def _flash_kernel(
         l_fin = l_s[:, 0:1]
         denom = jnp.where(l_fin == jnp.float32(0.0), jnp.float32(1.0), l_fin)
         o_ref[0, 0] = (acc_s[:] / denom).astype(o_ref.dtype)
+        if lse_ref is not None:
+            # log-sum-exp for the backward pass, lane-broadcast layout
+            # (block_q, 128) like the scratch; fully-masked rows get +BIG so
+            # the backward's exp(s - lse) is exactly 0 there
+            big = jnp.float32(1e30)
+            m_fin = m_s[:]
+            l_full = l_s[:]
+            m_fin_safe = jnp.where(m_fin <= half_neg, jnp.float32(0.0), m_fin)
+            lse = jnp.where(
+                l_full == jnp.float32(0.0),
+                big,
+                m_fin_safe + jnp.log(jnp.maximum(l_full, jnp.float32(1e-38))),
+            )
+            lse_ref[0, 0] = lse
 
 
-def _out_struct(shape, like):
-    """ShapeDtypeStruct matching ``like``'s dtype — inside a shard_map the
-    output must also declare how it varies over mesh axes (vma), inherited
-    from the input block."""
+def _out_struct(shape, like, dtype=None):
+    """ShapeDtypeStruct matching ``like``'s dtype (or an explicit one) —
+    inside a shard_map the output must also declare how it varies over mesh
+    axes (vma), inherited from the input block."""
+    dtype = like.dtype if dtype is None else dtype
     try:
         vma = jax.typeof(like).vma
     except (AttributeError, TypeError):
         vma = None
     if vma:
-        return jax.ShapeDtypeStruct(shape, like.dtype, vma=vma)
-    return jax.ShapeDtypeStruct(shape, like.dtype)
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
 
 
-def _flash_forward(q, k, v, scale, causal, kv_valid, block_q, block_k, interpret):
-    b, h, t_q, d = q.shape
-    t_k = k.shape[2]
-
-    # clamp blocks for short sequences so padding stays one lane-tile, then
-    # pad seq lengths to block multiples and head dim to the lane width;
-    # zero-pad K/V tails are masked out via kv_valid, Q tail rows sliced off
+def _pad_blocks(q, k, v, t_q, t_k, d, block_q, block_k):
+    """Clamp blocks for short sequences, pad seq lengths to block multiples
+    and the head dim to the lane width. Returns the padded operands and the
+    resolved geometry."""
     block_q = min(block_q, -(-t_q // _LANES) * _LANES)
     block_k = min(block_k, -(-t_k // _LANES) * _LANES)
     pq = -t_q % block_q
@@ -156,7 +170,19 @@ def _flash_forward(q, k, v, scale, causal, kv_valid, block_q, block_k, interpret
         q = jnp.pad(q, ((0, 0), (0, 0), (0, 0), (0, pd)))
         k = jnp.pad(k, ((0, 0), (0, 0), (0, 0), (0, pd)))
         v = jnp.pad(v, ((0, 0), (0, 0), (0, 0), (0, pd)))
-    dp = d + pd
+    return q, k, v, block_q, block_k, pq, pk, d + pd
+
+
+def _flash_forward(
+    q, k, v, scale, causal, kv_valid, block_q, block_k, interpret,
+    return_lse=False,
+):
+    b, h, t_q, d = q.shape
+    t_k = k.shape[2]
+    # zero-pad K/V tails are masked out via kv_valid, Q tail rows sliced off
+    q, k, v, block_q, block_k, pq, pk, dp = _pad_blocks(
+        q, k, v, t_q, t_k, d, block_q, block_k
+    )
 
     grid = (b, h, (t_q + pq) // block_q, (t_k + pk) // block_k)
     kernel = functools.partial(
@@ -164,8 +190,36 @@ def _flash_forward(q, k, v, scale, causal, kv_valid, block_q, block_k, interpret
         scale=scale, causal=causal, kv_valid=kv_valid,
         block_q=block_q, block_k=block_k,
     )
-    out = pl.pallas_call(
-        kernel,
+    o_spec = pl.BlockSpec(
+        (1, 1, block_q, dp), lambda bi, hi, qi, ki: (bi, hi, qi, _I0),
+        memory_space=pltpu.VMEM,
+    )
+    if return_lse:
+        out_specs = [
+            o_spec,
+            pl.BlockSpec(
+                (1, 1, block_q, _LANES),
+                lambda bi, hi, qi, ki: (bi, hi, qi, _I0),
+                memory_space=pltpu.VMEM,
+            ),
+        ]
+        out_shape = [
+            _out_struct((b, h, t_q + pq, dp), q),
+            _out_struct((b, h, t_q + pq, _LANES), q, dtype=jnp.float32),
+        ]
+        kfn = kernel
+    else:
+        # inference-only path: no lse buffer is declared or written — a
+        # custom call's unused output would not be DCE'd and at bench shapes
+        # the f32 lse would cost 2x the bytes of the bf16 output itself
+        out_specs = o_spec
+        out_shape = _out_struct((b, h, t_q + pq, dp), q)
+
+        def kfn(q_ref, k_ref, v_ref, o_ref, m_s, l_s, acc_s):
+            return kernel(q_ref, k_ref, v_ref, o_ref, None, m_s, l_s, acc_s)
+
+    res = pl.pallas_call(
+        kfn,
         grid=grid,
         in_specs=[
             pl.BlockSpec(
@@ -181,11 +235,8 @@ def _flash_forward(q, k, v, scale, causal, kv_valid, block_q, block_k, interpret
                 memory_space=pltpu.VMEM,
             ),
         ],
-        out_specs=pl.BlockSpec(
-            (1, 1, block_q, dp), lambda bi, hi, qi, ki: (bi, hi, qi, _I0),
-            memory_space=pltpu.VMEM,
-        ),
-        out_shape=_out_struct((b, h, t_q + pq, dp), q),
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[
             pltpu.VMEM((block_q, _LANES), jnp.float32),  # m
             pltpu.VMEM((block_q, _LANES), jnp.float32),  # l
@@ -196,7 +247,146 @@ def _flash_forward(q, k, v, scale, causal, kv_valid, block_q, block_k, interpret
         ),
         interpret=interpret,
     )(q, k, v)
-    return out[:, :, :t_q, :d]
+    if return_lse:
+        out, lse = res
+        # lse stays in padded lane-broadcast layout
+        return out[:, :, :t_q, :d], lse
+    return res[:, :, :t_q, :d]
+
+
+def _rebuild_probs(q, k, lse, iq, ik, *, scale, causal, kv_valid, block_q, block_k):
+    """Shared backward-pass probability reconstruction: the (bq, bk) score
+    block, kv_valid + causal masking, and ``p = exp(s − lse)`` — one
+    definition so the dq and dk/dv kernels can never desynchronize."""
+    neg_inf = jnp.float32(NEG_INF)
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * jnp.float32(scale)
+    k_pos = ik * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    )
+    mask = k_pos < kv_valid
+    if causal:
+        q_pos = iq * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        mask = mask & (k_pos <= q_pos)
+    s = jnp.where(mask, s, neg_inf)
+    p = jnp.where(mask, jnp.exp(s - lse), jnp.float32(0.0))
+    return p
+
+
+def _bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref, dq_ref, dq_acc,
+    *, scale, causal, kv_valid, block_q, block_k,
+):
+    """dQ pass. Grid = (B, H, num_q_blocks, num_k_blocks), last sequential.
+
+    p is rebuilt from the saved log-sum-exp (``p = exp(s − lse)``), then
+    ``dS = P ∘ (dP − D)`` and ``dQ += scale · dS Kᵀ`` accumulate in VMEM
+    scratch across the K axis — the standard flash backward, all four MXU
+    dots in the input dtype with f32 accumulation.
+    """
+    iq = pl.program_id(2)
+    ik = pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    if causal:
+        live = ik * block_k <= iq * block_q + (block_q - 1)
+    else:
+        live = ik >= 0
+
+    @pl.when(live)
+    def _accumulate():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0][:, 0:1]  # (bq, 1)
+        dd = dd_ref[0, 0][:, 0:1]
+
+        p = _rebuild_probs(
+            q, k, lse, iq, ik, scale=scale, causal=causal, kv_valid=kv_valid,
+            block_q=block_q, block_k=block_k,
+        )  # (bq, bk)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # (bq, bk)
+        ds = p * (dp - dd) * jnp.float32(scale)
+        ds_mx = ds if k.dtype == jnp.float32 else ds.astype(k.dtype)
+        dq_acc[:] = dq_acc[:] + jax.lax.dot_general(
+            ds_mx, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref, dk_ref, dv_ref,
+    dk_acc, dv_acc,
+    *, scale, causal, kv_valid, block_q, block_k,
+):
+    """dK/dV pass. Grid = (B, H, num_k_blocks, num_q_blocks), last
+    sequential: the transposed-probability form — ``dV += Pᵀ dO`` and
+    ``dK += scale · dSᵀ Q`` accumulate per K block across the Q axis."""
+    ik = pl.program_id(2)
+    iq = pl.program_id(3)
+    nq = pl.num_programs(3)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    if causal:
+        live = iq * block_q + (block_q - 1) >= ik * block_k
+    else:
+        live = iq >= 0
+
+    @pl.when(live)
+    def _accumulate():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0][:, 0:1]  # (bq, 1)
+        dd = dd_ref[0, 0][:, 0:1]
+
+        # same (bq, bk) score orientation as the dq pass — the q-dim
+        # contractions below transpose implicitly via dot_general dimension
+        # numbers (no Mosaic-side transposes)
+        p = _rebuild_probs(
+            q, k, lse, iq, ik, scale=scale, causal=causal, kv_valid=kv_valid,
+            block_q=block_q, block_k=block_k,
+        )  # (bq, bk)
+        p_mx = p if do.dtype == jnp.float32 else p.astype(do.dtype)
+        # dV += Pᵀ dO: contract the q dim of both operands
+        dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
+            p_mx, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # (bq, bk)
+        ds = p * (dp - dd) * jnp.float32(scale)
+        ds_mx = ds if q.dtype == jnp.float32 else ds.astype(q.dtype)
+        # dK += dSᵀ Q: contract the q dim
+        dk_acc[:] = dk_acc[:] + jax.lax.dot_general(
+            ds_mx, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(iq == nq - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
 
 
 @functools.partial(
@@ -209,28 +399,108 @@ def _flash(q, k, v, scale, causal, kv_valid, block_q, block_k, interpret):
 
 
 def _flash_fwd(q, k, v, scale, causal, kv_valid, block_q, block_k, interpret):
-    out = _flash(q, k, v, scale, causal, kv_valid, block_q, block_k, interpret)
-    return out, (q, k, v)
+    out, lse = _flash_forward(
+        q, k, v, scale, causal, kv_valid, block_q, block_k, interpret,
+        return_lse=True,
+    )
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(scale, causal, kv_valid, block_q, block_k, interpret, res, g):
-    # flash recomputation: rebuild the forward through the XLA online-softmax
-    # path (same numerics) and let autodiff produce the gradients — O(T)
-    # memory, nothing saved but q/k/v
-    from .attention import local_attention
+    """Flash backward as two Pallas kernels (dq; dk/dv) using the saved O
+    and log-sum-exp — O(T) memory, every MXU dot in the input dtype (the
+    r3 XLA-recompute backward ran true-f32 passes; this is the lm_step MFU
+    lever)."""
+    q, k, v, out, lse = res
+    b, h, t_q, d = q.shape
+    t_k = k.shape[2]
 
-    q, k, v = res
+    # D = rowsum(dO ∘ O) per query row, f32, lane-broadcast padded layout
+    dd = (g.astype(jnp.float32) * out.astype(jnp.float32)).sum(axis=-1)
+    qp, kp, vp, block_q, block_k, pq, pk, dp = _pad_blocks(
+        q, k, v, t_q, t_k, d, block_q, block_k
+    )
+    pd_extra = dp - d
+    if pq or pd_extra:
+        do_p = jnp.pad(g, ((0, 0), (0, 0), (0, pq), (0, pd_extra)))
+    else:
+        do_p = g
+    dd_p = jnp.pad(dd, ((0, 0), (0, 0), (0, pq)))[..., None] * jnp.ones(
+        (_LANES,), jnp.float32
+    )
 
-    def ref_fwd(q_, k_, v_):
-        o = local_attention(
-            q_.transpose(0, 2, 1, 3), k_.transpose(0, 2, 1, 3),
-            v_.transpose(0, 2, 1, 3),
-            causal=causal, scale=scale, kv_valid=kv_valid,
-        )
-        return o.transpose(0, 2, 1, 3)
+    grid_q = (b, h, (t_q + pq) // block_q, (t_k + pk) // block_k)
+    qo_spec = pl.BlockSpec(
+        (1, 1, block_q, dp), lambda bi, hi, qi, ki: (bi, hi, qi, _I0),
+        memory_space=pltpu.VMEM,
+    )
+    kv_spec_q = pl.BlockSpec(
+        (1, 1, block_k, dp), lambda bi, hi, qi, ki: (bi, hi, ki, _I0),
+        memory_space=pltpu.VMEM,
+    )
+    lm_spec_q = pl.BlockSpec(
+        (1, 1, block_q, _LANES), lambda bi, hi, qi, ki: (bi, hi, qi, _I0),
+        memory_space=pltpu.VMEM,
+    )
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel, scale=scale, causal=causal, kv_valid=kv_valid,
+            block_q=block_q, block_k=block_k,
+        ),
+        grid=grid_q,
+        in_specs=[qo_spec, kv_spec_q, kv_spec_q, qo_spec, lm_spec_q, lm_spec_q],
+        out_specs=qo_spec,
+        out_shape=_out_struct((b, h, t_q + pq, dp), q),
+        scratch_shapes=[pltpu.VMEM((block_q, dp), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(qp, kp, vp, do_p, lse, dd_p)
 
-    _, vjp = jax.vjp(ref_fwd, q, k, v)
-    return vjp(g)
+    # dk/dv pass: K blocks on the parallel axis, Q sequential
+    grid_k = (b, h, (t_k + pk) // block_k, (t_q + pq) // block_q)
+    qo_spec_k = pl.BlockSpec(
+        (1, 1, block_q, dp), lambda bi, hi, ki, qi: (bi, hi, qi, _I0),
+        memory_space=pltpu.VMEM,
+    )
+    kv_spec_k = pl.BlockSpec(
+        (1, 1, block_k, dp), lambda bi, hi, ki, qi: (bi, hi, ki, _I0),
+        memory_space=pltpu.VMEM,
+    )
+    lm_spec_k = pl.BlockSpec(
+        (1, 1, block_q, _LANES), lambda bi, hi, ki, qi: (bi, hi, qi, _I0),
+        memory_space=pltpu.VMEM,
+    )
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel, scale=scale, causal=causal, kv_valid=kv_valid,
+            block_q=block_q, block_k=block_k,
+        ),
+        grid=grid_k,
+        in_specs=[
+            qo_spec_k, kv_spec_k, kv_spec_k, qo_spec_k, lm_spec_k, lm_spec_k,
+        ],
+        out_specs=[kv_spec_k, kv_spec_k],
+        out_shape=[
+            _out_struct((b, h, t_k + pk, dp), k),
+            _out_struct((b, h, t_k + pk, dp), v),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, dp), jnp.float32),
+            pltpu.VMEM((block_k, dp), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(qp, kp, vp, do_p, lse, dd_p)
+
+    return (
+        dq[:, :, :t_q, :d].astype(q.dtype),
+        dk[:, :, :t_k, :d].astype(k.dtype),
+        dv[:, :, :t_k, :d].astype(v.dtype),
+    )
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)

