@@ -124,6 +124,15 @@ class TestUniqueCeiling:
         u = ht.unique(x)
         np.testing.assert_array_equal(np.sort(u.numpy()), np.unique(xn))
 
+    def test_unique_above_ceiling_host_bound_not_failure(self):
+        # PARITY.md promises "host-memory-bound, not failure" ABOVE the
+        # ceiling — pin that for the eager axis-unique path (r3 weak #7)
+        n = (1 << 20) + 4097  # past the ceiling, deliberately not a power of two
+        rng = np.random.default_rng(12)
+        xn = rng.integers(0, 50, size=2 * n).astype(np.int32).reshape(n, 2)
+        u = ht.unique(ht.array(xn, split=0), axis=0)
+        np.testing.assert_array_equal(u.numpy(), np.unique(xn, axis=0))
+
     def test_unique_inverse_roundtrip(self):
         xn = np.array([3, 1, 2, 3, 1, 2, 9], dtype=np.int32)
         x = ht.array(xn, split=0)
