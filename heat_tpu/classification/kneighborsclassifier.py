@@ -39,7 +39,7 @@ class KNeighborsClassifier(BaseEstimator, ClassificationMixin):
             raise TypeError("x and y need to be DNDarrays")
         self.x = x
         self.y = y
-        self._classes = np.unique(np.asarray(y._logical()))
+        self._classes = np.unique(np.asarray(y._replicated()))
         return self
 
     def predict(self, x: DNDarray) -> DNDarray:
@@ -50,8 +50,8 @@ class KNeighborsClassifier(BaseEstimator, ClassificationMixin):
         from ..cluster._kcluster import _d2
 
         xq = x._masked(0).astype(jnp.float32)  # zeroed tail-pad rows
-        xt = self.x._logical().astype(jnp.float32)  # (n, d)
-        yt = self.y._logical().ravel()
+        xt = self.x._replicated().astype(jnp.float32)  # (n, d)
+        yt = self.y._replicated().ravel()
 
         d2 = _d2(xq, xt)  # (m, n), HIGHEST-precision GEMM form
         k = min(self.n_neighbors, xt.shape[0])

@@ -101,28 +101,35 @@ class _KCluster(BaseEstimator, ClusteringMixin):
         k = self.n_clusters
         seed = self.random_state if self.random_state is not None else 0
         key = jax.random.PRNGKey(seed)
-        log = x._logical()
         n = x.shape[0]
+        buf = x._masked(0)  # padded physical buffer, pad rows zeroed
 
         if isinstance(self.init, DNDarray):
             if self.init.shape != (k, x.shape[1]):
                 raise ValueError(
                     f"passed centroids need to be of shape ({k}, {x.shape[1]}), but are {self.init.shape}"
                 )
-            return self.init._logical()
+            return self.init._replicated()
         if self.init == "random":
+            # sampled indices are < n, so the sharded gather never reads the
+            # pad — the owning-rank-Bcast of the reference (:100-130) becomes
+            # one compiled cross-shard take
             idx = jax.random.choice(key, n, shape=(k,), replace=False)
-            return jnp.take(log, idx, axis=0)
+            return jnp.take(buf, idx, axis=0)
         if self.init in ("probability_based", "kmeans++", "k-means++"):
-            # k-means++ seeding (reference 'probability_based' :100-130)
-            centers = [jnp.take(log, jax.random.randint(key, (), 0, n), axis=0)]
+            # k-means++ seeding (reference 'probability_based' :100-130);
+            # pad rows get probability 0 so they are never selected
+            row_ok = jnp.arange(buf.shape[0]) < n
+            centers = [jnp.take(buf, jax.random.randint(key, (), 0, n), axis=0)]
             for i in range(1, k):
                 key, sub = jax.random.split(key)
                 c = jnp.stack(centers)
-                d2 = jnp.min(_d2(log.astype(jnp.float32), c.astype(jnp.float32)), axis=1)
+                d2 = jnp.min(_d2(buf.astype(jnp.float32), c.astype(jnp.float32)), axis=1)
+                d2 = jnp.where(row_ok, d2, 0.0)
                 probs = d2 / jnp.maximum(jnp.sum(d2), 1e-30)
-                nxt = jax.random.choice(sub, n, p=probs)
-                centers.append(jnp.take(log, nxt, axis=0))
+                # compiled slice to the logical length (stays on device)
+                nxt = jax.random.choice(sub, n, p=probs[:n])
+                centers.append(jnp.take(buf, nxt, axis=0))
             return jnp.stack(centers)
         raise ValueError(
             f"initialization needs to be 'random', 'probability_based' or a DNDarray, but was {self.init}"
@@ -133,7 +140,7 @@ class _KCluster(BaseEstimator, ClusteringMixin):
     def _assign_to_cluster(self, x: DNDarray) -> DNDarray:
         """Hard assignment of each sample under the estimator's metric
         (reference _kcluster.py:196,206: ``self._metric(x, centers).argmin``)."""
-        centers = self._cluster_centers._logical()
+        centers = self._cluster_centers._replicated()
         dist_fn = _d1 if self._metric_name == "manhattan" else _d2
         d = dist_fn(x._masked(0).astype(centers.dtype), centers)
         labels = jnp.argmin(d, axis=1).astype(jnp.int64)

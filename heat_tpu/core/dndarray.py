@@ -295,6 +295,16 @@ class DNDarray:
             buf = jax.device_put(buf, tgt)
         return buf
 
+    def _replicated(self) -> jax.Array:
+        """Logical global array replicated on every device — the raw buffer
+        when already replicated, one compiled :meth:`_relayout` otherwise.
+        The multi-host-safe way to read a SMALL array whole (index vectors,
+        centroids, class statistics); unlike :meth:`_logical` it never hands
+        the host a non-canonically-shardable view."""
+        if self.__split is None:
+            return self.__array
+        return self._relayout(None)
+
     @classmethod
     def from_logical(
         cls,

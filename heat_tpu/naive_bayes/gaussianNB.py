@@ -52,12 +52,12 @@ class GaussianNB(BaseEstimator, ClassificationMixin):
             raise TypeError("x and y need to be DNDarrays")
         if x.ndim != 2:
             raise ValueError(f"expected x to be a 2-D tensor, is {x.ndim}-D")
-        yl = y._logical().ravel()
+        yl = y._replicated().ravel()
         xl = x._masked(0).astype(jnp.float64)
         w = (jnp.arange(xl.shape[0]) < x.shape[0]).astype(xl.dtype)
         if sample_weight is not None:
             sw = (
-                sample_weight._logical()
+                sample_weight._replicated()
                 if isinstance(sample_weight, DNDarray)
                 else jnp.asarray(sample_weight)
             ).astype(xl.dtype).ravel()
@@ -92,7 +92,7 @@ class GaussianNB(BaseEstimator, ClassificationMixin):
         if self.priors is None:
             prior = counts / jnp.sum(counts)
         else:
-            prior = self.priors._logical()
+            prior = self.priors._replicated()
             if prior.shape[0] != k:
                 raise ValueError("Number of priors must match number of classes.")
             if not np.isclose(float(jnp.sum(prior)), 1.0):
@@ -109,9 +109,9 @@ class GaussianNB(BaseEstimator, ClassificationMixin):
                 raise ValueError("classes must be passed on the first call to partial_fit")
             return self.fit(x, y, _classes=np.asarray(classes.numpy() if isinstance(classes, DNDarray) else classes))
         # merge batch moments with stored moments
-        old_n = self.class_count_._logical()
-        old_mu = self.theta_._logical()
-        old_var = self.var_._logical() - self.epsilon_
+        old_n = self.class_count_._replicated()
+        old_mu = self.theta_._replicated()
+        old_var = self.var_._replicated() - self.epsilon_
 
         tmp = GaussianNB(var_smoothing=self.var_smoothing)
         tmp.fit(x, y)
@@ -120,9 +120,9 @@ class GaussianNB(BaseEstimator, ClassificationMixin):
         if not np.array_equal(np.intersect1d(new_classes, ref_classes), new_classes):
             raise ValueError("partial_fit batch contains unseen classes")
         idx = jnp.asarray(np.searchsorted(ref_classes, new_classes))
-        b_n = jnp.zeros_like(old_n).at[idx].set(tmp.class_count_._logical())
-        b_mu = jnp.zeros_like(old_mu).at[idx].set(tmp.theta_._logical())
-        b_var = jnp.zeros_like(old_var).at[idx].set(tmp.var_._logical() - tmp.epsilon_)
+        b_n = jnp.zeros_like(old_n).at[idx].set(tmp.class_count_._replicated())
+        b_mu = jnp.zeros_like(old_mu).at[idx].set(tmp.theta_._replicated())
+        b_var = jnp.zeros_like(old_var).at[idx].set(tmp.var_._replicated() - tmp.epsilon_)
 
         n_tot = old_n + b_n
         safe = jnp.maximum(n_tot, 1.0)
@@ -145,9 +145,9 @@ class GaussianNB(BaseEstimator, ClassificationMixin):
     def __joint_log_likelihood(self, x: DNDarray) -> jnp.ndarray:
         """log P(c) + Σ log N(x_i; μ_c, σ_c²) (reference gaussianNB.py:391)."""
         xl = x.larray.astype(jnp.float64)
-        mu = self.theta_._logical()
-        var = self.var_._logical()
-        prior = self.class_prior_._logical()
+        mu = self.theta_._replicated()
+        var = self.var_._replicated()
+        prior = self.class_prior_._replicated()
         log_prior = jnp.log(prior)[None, :]
         n_ij = -0.5 * jnp.sum(jnp.log(2.0 * jnp.pi * var), axis=1)[None, :]
         diff = xl[:, None, :] - mu[None, :, :]  # (m, k, d)
@@ -159,7 +159,7 @@ class GaussianNB(BaseEstimator, ClassificationMixin):
         if self.theta_ is None:
             raise RuntimeError("fit needs to be called before predict")
         jll = self.__joint_log_likelihood(x)
-        classes = self.classes_._logical()
+        classes = self.classes_._replicated()
         pred = jnp.take(classes, jnp.argmax(jll, axis=1))
         return DNDarray(pred, (x.shape[0],), types.canonical_heat_type(pred.dtype), x.split, x.device, x.comm, True)
 

@@ -68,6 +68,36 @@ def _sort(ht, np, c):
     _close(ht.max(ht.abs(s - c["x"])).item(), 0.0)
 
 
+def _kmeans_fit(ht, np, c):
+    km = ht.cluster.KMeans(n_clusters=2, init="random", max_iter=2, tol=0.0,
+                           random_state=0)
+    km.fit(c["X"])
+    assert km.cluster_centers_.shape == (2, 3)
+    lab = km.predict(c["X"])
+    assert lab.shape == (N,)
+
+
+def _lasso_fit(ht, np, c):
+    est = ht.regression.Lasso(lam=0.1, max_iter=3, tol=0.0)
+    y = c["X"][:, :1]
+    est.fit(c["X"], y)
+    assert est.coef_.shape[0] == 3
+
+
+def _gnb_fit(ht, np, c):
+    gnb = ht.naive_bayes.GaussianNB()
+    gnb.fit(c["X"], c["ints"])
+    pred = gnb.predict(c["X"])
+    assert pred.shape == (N,)
+
+
+def _knn_predict(ht, np, c):
+    knn = ht.classification.KNeighborsClassifier(n_neighbors=3)
+    knn.fit(c["X"], c["ints"])
+    pred = knn.predict(c["X"])
+    assert pred.shape == (N,)
+
+
 OPS = [
     # --- elementwise / reductions (physical pad-aware paths) --------------
     ("add_mul_chain", lambda ht, np, c: _close(ht.sum((c["x"] * 2 + 1) / 2).item(), SUM_N + 0.5 * N), "ok"),
@@ -112,6 +142,11 @@ OPS = [
     ("dot_1d", lambda ht, np, c: _close(ht.dot(c["x"], c["x"]).item(), float((np.arange(N) ** 2).sum())), "ok"),
     # --- ML ---------------------------------------------------------------
     ("cdist", lambda ht, np, c: None if ht.spatial.cdist(c["X"], c["X"]).shape == (N, N) else None, "ok"),
+    ("cdist_ring", lambda ht, np, c: None if ht.spatial.cdist(c["X"], c["X"], ring=True).shape == (N, N) else None, "ok"),
+    ("kmeans_fit", _kmeans_fit, "ok"),
+    ("lasso_fit", _lasso_fit, "ok"),
+    ("gaussiannb_fit", _gnb_fit, "ok"),
+    ("knn_predict", _knn_predict, "ok"),
     # --- documented multi-host boundaries (must raise) --------------------
     ("numpy_gather", lambda ht, np, c: c["x"].numpy(), "raises"),
     ("reshape_cross_split", lambda ht, np, c: ht.reshape(c["X"], (3, N)), "raises"),
