@@ -93,6 +93,25 @@ def balance(array: DNDarray, copy: bool = False) -> DNDarray:
 
 
 @functools.lru_cache(maxsize=128)
+def _reshape_split_fn(comm, in_shape, out_shape, out_split):
+    """Cached jitted slice→reshape→re-pad program for a reshape that crosses
+    the split axis — the genuine all-to-all data movement (the reference's
+    Alltoallv relayout, manipulations.py:1962) as ONE compiled XLA program
+    laid out to the result's canonical sharding; multi-host safe."""
+    pshape = comm.padded_shape(out_shape, out_split)
+
+    def f(buf):
+        log = buf[tuple(slice(0, g) for g in in_shape)]
+        res = jnp.reshape(log, out_shape)
+        pad = [(0, p - g) for p, g in zip(pshape, out_shape)]
+        return jnp.pad(res, pad)
+
+    if out_split is None:
+        return jax.jit(f, out_shardings=comm.replicated())
+    return jax.jit(f, out_shardings=comm.sharding(out_split, len(out_shape)))
+
+
+@functools.lru_cache(maxsize=128)
 def _concat_split_fn(comm, axis, out_split, in_shapes, gshape, out_dtype):
     """Cached jitted slice→concat→re-pad program for concatenation along
     the split axis (keyed on shapes/dtype so repeated calls reuse the
@@ -451,6 +470,13 @@ def reshape(a: DNDarray, *shape, new_split: Optional[int] = None) -> DNDarray:
             phys = shape_t[:new_split] + a.larray.shape[s:]
             buf = _canonical(jnp.reshape(a.larray, phys), a.comm, new_split)
             return DNDarray(buf, shape_t, a.dtype, new_split, a.device, a.comm, True)
+    if a.split is not None and a.comm.size > 1:
+        # reshape CROSSING the split axis: one compiled relayout program
+        fn = _reshape_split_fn(a.comm, tuple(a.shape), tuple(shape), new_split)
+        res = fn(a.larray)
+        return DNDarray(
+            res, tuple(shape), a.dtype, new_split, a.device, a.comm, True
+        )
     res = jnp.reshape(a._logical(), shape)
     return _rewrap(res, new_split, a)
 

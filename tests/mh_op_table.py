@@ -57,6 +57,18 @@ def _advanced_take(ht, np, c):
     _close(ht.sum(c["x"][c["ints"]]).item(), want)
 
 
+def _reshape_cross(ht, np, c):
+    # (10, 3) split=0 -> (3, 10) split=0: the one compiled relayout program
+    r = ht.reshape(c["X"], (3, N))
+    assert r.shape == (3, N) and r.split == 0
+    _close(ht.sum(r).item(), SUM_X)
+    # row sums of the reshaped layout match numpy
+    rs = ht.sum(r, axis=1)
+    want = np.arange(3 * N, dtype=np.float64).reshape(3, N).sum(axis=1)
+    for i in range(3):
+        _close(rs[i].item(), want[i], tol=0.5)
+
+
 def _qr_split1_tall(ht, np, c):
     # (10, 3) split=1 tall: the CholeskyQR2 ring/scatter path
     q, r = ht.linalg.qr(c["X"].resplit(1))
@@ -147,7 +159,8 @@ OPS = [
     ("lasso_fit", _lasso_fit, "ok"),
     ("gaussiannb_fit", _gnb_fit, "ok"),
     ("knn_predict", _knn_predict, "ok"),
+    ("reshape_cross_split", _reshape_cross, "ok"),
+    ("flatten", lambda ht, np, c: _close(ht.sum(ht.flatten(c["X"])).item(), SUM_X), "ok"),
     # --- documented multi-host boundaries (must raise) --------------------
     ("numpy_gather", lambda ht, np, c: c["x"].numpy(), "raises"),
-    ("reshape_cross_split", lambda ht, np, c: ht.reshape(c["X"], (3, N)), "raises"),
 ]
