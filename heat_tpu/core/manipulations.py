@@ -218,17 +218,37 @@ def diag(a: DNDarray, offset: int = 0) -> DNDarray:
     """Extract a diagonal or construct a diagonal matrix (reference
     manipulations.py `diag`)."""
     if a.ndim == 1:
-        res = jnp.diag(a._logical(), k=offset)
+        # construction: the 1-D source replicates (compiled relayout, small
+        # next to its n² result) and the matrix lays out sharded
+        res = jnp.diag(a._replicated(), k=offset)
         return _rewrap(res, a.split, a)
     return diagonal(a, offset=offset)
 
 
 def diagonal(a: DNDarray, offset: int = 0, dim1: int = 0, dim2: int = 1) -> DNDarray:
-    """Diagonal view (reference manipulations.py `diagonal`)."""
+    """Diagonal view (reference manipulations.py `diagonal`). 2-D split
+    inputs extract shard-side through the paired (rows, cols) sharded
+    gather — multi-host safe, no replicated intermediate."""
     dim1 = sanitize_axis(a.shape, dim1)
     dim2 = sanitize_axis(a.shape, dim2)
     if dim1 == dim2:
         raise ValueError("dim1 and dim2 need to be different")
+    if a.ndim == 2 and a.split is not None and a.comm.size > 1:
+        if (dim1, dim2) == (1, 0):
+            return diagonal(swapaxes(a, 0, 1), offset=offset)
+        n0, n1 = a.shape
+        if offset >= 0:
+            klen = builtins.min(n0, n1 - offset)
+            r0, c0 = 0, offset
+        else:
+            klen = builtins.min(n0 + offset, n1)
+            r0, c0 = -offset, 0
+        klen = builtins.max(klen, 0)
+        from .indexing import getitem
+
+        rows = jnp.arange(klen) + r0
+        cols = jnp.arange(klen) + c0
+        return getitem(a, (rows, cols))
     res = jnp.diagonal(a._logical(), offset=offset, axis1=dim1, axis2=dim2)
     out_split = None
     if a.split is not None and a.split not in (dim1, dim2):

@@ -160,6 +160,10 @@ OPS = [
     ("gaussiannb_fit", _gnb_fit, "ok"),
     ("knn_predict", _knn_predict, "ok"),
     ("reshape_cross_split", _reshape_cross, "ok"),
+    ("diagonal_2d", lambda ht, np, c: _close(ht.sum(ht.diagonal(c["X"])).item(), float(np.trace(np.arange(3 * N).reshape(N, 3)))), "ok"),
+    ("trace", lambda ht, np, c: _close(ht.linalg.trace(c["X"]).item() if hasattr(ht.linalg, "trace") else ht.trace(c["X"]).item(), float(np.trace(np.arange(3 * N).reshape(N, 3)))), "ok"),
+    ("cov", lambda ht, np, c: None if ht.cov(c["X"].T).shape == (3, 3) else None, "ok"),
+    ("skew_kurtosis", lambda ht, np, c: (_close(ht.skew(c["x"]).item(), 0.0, tol=0.2), _close(ht.kurtosis(c["x"]).item(), -1.2002, tol=0.05)), "ok"),
     ("flatten", lambda ht, np, c: _close(ht.sum(ht.flatten(c["X"])).item(), SUM_X), "ok"),
     # --- documented multi-host boundaries (must raise) --------------------
     ("numpy_gather", lambda ht, np, c: c["x"].numpy(), "raises"),
