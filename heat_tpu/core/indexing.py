@@ -466,7 +466,13 @@ def _host_fallback_warning(reason: str):
 def setitem(x: DNDarray, key, value) -> None:
     key = _normalize_key(key, x)
     if isinstance(value, DNDarray):
-        value = value._logical()
+        if value.split is not None and jax.process_count() > 1:
+            # compiled relayout — multi-host safe (values are at most the
+            # size of the selected region); single-controller keeps the
+            # cheaper logical slice
+            value = value._replicated()
+        else:
+            value = value._logical()
     buf = x.larray
 
     if _is_bool_mask(key, x):

@@ -30,6 +30,7 @@ __all__ = [
     "load",
     "load_csv",
     "load_npy",
+    "save_npy",
     "save",
     "save_csv",
     "supports_checkpoint",
@@ -236,9 +237,52 @@ def save_csv(data: DNDarray, path: str, header_lines: Optional[str] = None, sep:
 
 
 def load_npy(path: str, dtype=None, split=None, device=None, comm=None) -> DNDarray:
-    """Load a numpy .npy file (extension; memory-maps then shards)."""
+    """Load a numpy .npy file (extension; memory-maps then shards).
+
+    Multi-host with ``split``: the memory map means each process touches
+    ONLY its canonical slab's pages — per-process slab reads for free."""
+    import jax
+
     data = np.load(path, mmap_mode="r")
+    if jax.process_count() > 1 and split is not None:
+        c = sanitize_comm(comm)
+        split_s = sanitize_axis(data.shape, split)
+        lo, hi = _process_slab(c, data.shape[split_s])
+        sl = [slice(None)] * data.ndim
+        sl[split_s] = slice(lo, hi)
+        return _array(
+            np.asarray(data[tuple(sl)]), dtype=dtype, is_split=split_s,
+            device=device, comm=comm,
+        )
     return _array(np.asarray(data), dtype=dtype, split=split, device=device, comm=comm)
+
+
+def save_npy(data: DNDarray, path: str) -> None:
+    """Save to .npy. Multi-host with a split array: process 0 creates the
+    file at the global shape via a memory map, then every process writes
+    only its slab (serialized barrier ring — no gather)."""
+    import jax
+
+    if jax.process_count() > 1 and data.split is not None:
+        block, lo, hi = _local_block(data)
+        gshape = tuple(data.shape)
+        sl = [slice(None)] * data.ndim
+        sl[data.split] = slice(lo, hi)
+
+        def write(p):
+            mm = np.lib.format.open_memmap(
+                path,
+                mode="w+" if p == 0 else "r+",
+                dtype=block.dtype if p == 0 else None,
+                shape=gshape if p == 0 else None,
+            )
+            if hi > lo:
+                mm[tuple(sl)] = block
+            mm.flush()
+
+        _serialized_slab_write(write, "npy")
+        return
+    np.save(path, data.numpy())
 
 
 def _process_slab(comm, n: int):
@@ -599,6 +643,5 @@ def save(data: DNDarray, path: str, *args, **kwargs):
     if ext == ".csv":
         return save_csv(data, path, *args, **kwargs)
     if ext == ".npy":
-        np.save(path, data.numpy())
-        return
+        return save_npy(data, path)
     raise ValueError(f"Unsupported file extension {ext}")

@@ -236,6 +236,29 @@ if ht.supports_hdf5():
     else:
         raise AssertionError("multi-host save_csv split=1 must raise")
 
+# ======= stage 5: npy slab I/O — memmap reads, slab writes ================
+npy_path = csv_path + ".npy"
+ref_npy = np.arange(11 * 3, dtype=np.float32).reshape(11, 3)
+if rank == 0:
+    tmp_npy = npy_path + ".tmp.npy"
+    np.save(tmp_npy, ref_npy)
+    os.replace(tmp_npy, npy_path)
+else:
+    for _ in range(200):
+        if os.path.exists(npy_path):
+            break
+        time.sleep(0.05)
+An = ht.load_npy(npy_path, split=0)
+assert An.shape == (11, 3) and An.split == 0
+assert abs(float(ht.sum(An).item()) - float(ref_npy.sum())) < 1e-2
+out_npy = npy_path + ".out.npy"
+ht.save_npy(An, out_npy)
+got_npy = np.load(out_npy)
+assert np.array_equal(got_npy, ref_npy)
+# split=1 load (uneven column chunks)
+Acn = ht.load_npy(npy_path, split=1)
+assert Acn.split == 1 and Acn.shape == (11, 3)
+
 print(f"RANK{rank}_OK", flush=True)
 """
 
