@@ -91,7 +91,7 @@ class Spectral(BaseEstimator, ClusteringMixin):
         V, T = lanczos(L, m)
         t_host = np.asarray(T.numpy(), dtype=np.float64)
         eigval, eigvec = np.linalg.eigh(t_host)  # ascending
-        v_log = V._logical().astype(jnp.float64)
+        v_log = V._replicated().astype(jnp.float64)
         full_vec = v_log @ jnp.asarray(eigvec)  # Ritz vectors
         return (
             DNDarray.from_logical(jnp.asarray(eigval), None, x.device, x.comm),
@@ -104,7 +104,7 @@ class Spectral(BaseEstimator, ClusteringMixin):
         in the same embedding."""
         components = eigvec[:, : self.n_clusters]
         return DNDarray.from_logical(
-            components._logical().astype(jnp.float32), x.split, x.device, x.comm
+            components._replicated().astype(jnp.float32), x.split, x.device, x.comm
         )
 
     def fit(self, x: DNDarray) -> "Spectral":

@@ -109,7 +109,12 @@ def array(
                     obj.larray, obj.shape, obj.dtype, obj.split, device, comm, True
                 )
             return obj
-        data = obj._logical()
+        import jax as _jax
+
+        if obj.split is not None and _jax.process_count() > 1:
+            data = obj._replicated()  # compiled relayout; _wrap re-shards
+        else:
+            data = obj._logical()
         if dtype is not None:
             data = data.astype(types.canonical_heat_type(dtype).jnp_type())
         tgt_split = split if split is not None else (obj.split if is_split is None else is_split)

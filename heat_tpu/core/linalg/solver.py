@@ -138,7 +138,7 @@ def lanczos(
 
     n = A.shape[0]
     dt = types.promote_types(A.dtype, types.float32)
-    a_log = A._logical().astype(dt.jnp_type())
+    a_log = A._replicated().astype(dt.jnp_type())
 
     if v0 is None:
         import numpy as _np
@@ -146,7 +146,7 @@ def lanczos(
         rng = _np.random.default_rng(0)
         v = jnp.asarray(rng.standard_normal(n), dtype=dt.jnp_type())
     else:
-        v = v0._logical().astype(dt.jnp_type())
+        v = v0._replicated().astype(dt.jnp_type())
 
     V_mat, alphas, betas = _lanczos_jit(a_log, v, m)
 

@@ -75,7 +75,7 @@ class Laplacian:
     def construct(self, X: DNDarray) -> DNDarray:
         """Similarity → adjacency → Laplacian (reference laplacian.py:110)."""
         S = self.similarity_metric(X)
-        A = S._logical()
+        A = S._replicated()
         if self.mode == "eNeighbour":
             key, val = self.epsilon
             if key == "upper":

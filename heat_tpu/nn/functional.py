@@ -71,7 +71,7 @@ def scaled_dot_product_attention(
                 f"attention over split={q.split} not supported; resplit to 1"
             )
         out = local_attention(
-            q._logical(), k._logical(), v._logical(), causal=causal, scale=scale
+            q._replicated(), k._replicated(), v._replicated(), causal=causal, scale=scale
         )
         return DNDarray.from_logical(out, q.split, q.device, q.comm)
 
