@@ -50,27 +50,38 @@ def svd(a: DNDarray, full_matrices: bool = False, compute_uv: bool = True):
         v_ht = DNDarray.from_logical(vt_log.T.astype(dt.jnp_type()), None, a.device, a.comm, dt)
         return SVD(u, s_ht, v_ht)
 
-    if compute_uv and a.split == 1 and a.comm.size > 1 and n > m and not full_matrices:
-        # wide column-split: A^T is tall row-split — run the TSQR path there
-        # and swap the factors (A = U S V^T  <=>  A^T = V S U^T)
+    if compute_uv and a.split == 1 and a.comm.size > 1 and m >= n and not full_matrices:
+        # tall column-split: CholeskyQR2 (no gather, qr.py) + small-R SVD;
+        # U = Q·u_r is the psum_scatter panel pattern, emitted by matmul
+        q, r = _qr(a)
+        u_r, s_log, vt_log = jnp.linalg.svd(r._replicated(), full_matrices=False)
+        u = matmul(q, DNDarray.from_logical(u_r.astype(dt.jnp_type()), None, a.device, a.comm, dt))
+        s_ht = DNDarray.from_logical(s_log.astype(dt.jnp_type()), None, a.device, a.comm, dt)
+        v_ht = DNDarray.from_logical(vt_log.T.astype(dt.jnp_type()), None, a.device, a.comm, dt)
+        return SVD(u, s_ht, v_ht)
+
+    if compute_uv and a.comm.size > 1 and not full_matrices and (
+        (a.split == 1 and n > m) or (a.split == 0 and n > m)
+    ):
+        # wide: A^T is tall with the complementary split — run the tall path
+        # there and swap the factors (A = U S V^T  <=>  A^T = V S U^T)
         from .basics import transpose
 
         res = svd(transpose(a), full_matrices=False, compute_uv=True)
         return SVD(res.V, res.S, res.U)
 
-    if not compute_uv and a.comm.size > 1 and (
-        (a.split == 0 and m >= n) or (a.split == 1 and n > m)
-    ):
-        # singular values only: they equal R's from the TSQR — no Q needed.
-        # Wide column-split transposes into the tall row-split form first
-        # (singular values are transpose-invariant).
-        if a.split == 1:
+    if not compute_uv and a.comm.size > 1 and a.split is not None:
+        # singular values only: they equal R's — no Q needed. Wide inputs
+        # transpose into the tall form of the complementary split
+        # (singular values are transpose-invariant); both tall forms have a
+        # no-gather QR (TSQR / CholeskyQR2).
+        if n > m:
             from .basics import transpose
 
             a = transpose(a)
         _, r = _qr(a, calc_q=False)
         s_log = jnp.linalg.svd(
-            r.larray.astype(dt.jnp_type()), compute_uv=False
+            r._replicated().astype(dt.jnp_type()), compute_uv=False
         )
         return DNDarray.from_logical(s_log, None, a.device, a.comm, dt)
 

@@ -359,3 +359,36 @@ class TestQRSplit1Distributed(TestCase):
         before = _PERF_STATS["logical_slices"]
         ht.linalg.qr(a)
         assert _PERF_STATS["logical_slices"] == before
+
+
+class TestSVDAllSplits(TestCase):
+    """Round-4: SVD covers all four (split, shape) combos through the
+    no-gather QR paths (split=0 TSQR, split=1 CholeskyQR2)."""
+
+    def _check(self, m, n, split):
+        rng = np.random.default_rng(m * 17 + n)
+        an = rng.standard_normal((m, n)).astype(np.float32)
+        a = ht.array(an, split=split)
+        u, s, v = ht.linalg.svd(a)
+        un, sn, vn = u.numpy(), s.numpy(), v.numpy()
+        np.testing.assert_allclose(un @ np.diag(sn) @ vn.T, an, atol=2e-3)
+        k = min(m, n)
+        np.testing.assert_allclose(un.T @ un, np.eye(k), atol=2e-3)
+        np.testing.assert_allclose(
+            sn, np.linalg.svd(an, compute_uv=False), rtol=2e-3, atol=1e-4
+        )
+        # values-only agrees on the same path family
+        s2 = ht.linalg.svd(ht.array(an, split=split), compute_uv=False)
+        np.testing.assert_allclose(s2.numpy(), sn, rtol=2e-3, atol=1e-4)
+
+    def test_tall_split1(self):
+        self._check(40, 6, 1)
+
+    def test_wide_split0(self):
+        self._check(6, 40, 0)
+
+    def test_tall_split0(self):
+        self._check(40, 6, 0)
+
+    def test_wide_split1(self):
+        self._check(6, 40, 1)
