@@ -20,6 +20,7 @@ from typing import Callable, Iterator, List, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ...core.dndarray import DNDarray
 
@@ -74,12 +75,22 @@ class Dataset:
 
     @property
     def data(self) -> jax.Array:
-        """The (logical) sample buffer."""
-        return self.htdata._logical()
+        """The sample buffer: the logical global array on a single
+        controller; under multi-host, THIS PROCESS's canonical slab — the
+        reference's local-shard Dataset semantics (datatools.py:143)."""
+        return self._host_view(self.htdata)
 
     @property
     def targets(self):
-        return None if self.httargets is None else self.httargets._logical()
+        return None if self.httargets is None else self._host_view(self.httargets)
+
+    @staticmethod
+    def _host_view(arr: DNDarray) -> jax.Array:
+        if jax.process_count() > 1 and arr.split is not None:
+            from ...core.io import _local_block
+
+            return jnp.asarray(_local_block(arr)[0])
+        return arr._logical()
 
     def __len__(self) -> int:
         return self.htdata.shape[0]
@@ -119,13 +130,16 @@ def _shuffle_arrays(dataset, blocking: bool) -> None:
 
     shuffled = []
     for arr in dataset._arrays():
-        logical = arr._logical()
-        out = jnp.take(logical, perm, axis=0)
-        shuffled.append(out)
+        if arr.split is not None and arr.comm.size > 1:
+            # distributed: the sharded-gather permutation (the exact global
+            # cross-shard shuffle) — canonical physical output, multi-host
+            from ...core.indexing import _advanced_take
+
+            shuffled.append(_advanced_take(arr, 0, jnp.asarray(perm)).larray)
+        else:
+            shuffled.append(jnp.take(arr._logical(), perm, axis=0))
     if blocking:
-        for arr, out in zip(dataset._arrays(), shuffled):
-            new = DNDarray.from_logical(out, arr.split, arr.device, arr.comm)
-            arr.larray = new.larray
+        _apply_shuffled(dataset, shuffled)
         jax.block_until_ready([a.larray for a in dataset._arrays()])
         dataset._pending = None
     else:
@@ -133,14 +147,22 @@ def _shuffle_arrays(dataset, blocking: bool) -> None:
         dataset._pending = shuffled
 
 
+def _apply_shuffled(dataset, shuffled) -> None:
+    for arr, out in zip(dataset._arrays(), shuffled):
+        if arr.split is not None and arr.comm.size > 1:
+            arr.larray = out  # already the canonical physical layout
+        else:
+            arr.larray = DNDarray.from_logical(
+                out, arr.split, arr.device, arr.comm
+            ).larray
+
+
 def _harvest_pending(dataset) -> None:
     """Apply a previously dispatched Ishuffle (reference dataset_irecv,
     datatools.py:343-375)."""
     if dataset._pending is None:
         return
-    for arr, out in zip(dataset._arrays(), dataset._pending):
-        new = DNDarray.from_logical(out, arr.split, arr.device, arr.comm)
-        arr.larray = new.larray
+    _apply_shuffled(dataset, dataset._pending)
     dataset._pending = None
 
 
@@ -211,7 +233,33 @@ class DataLoader:
         self._first_iter = True
         self.last_epoch = False
 
+    def _mh_geometry(self):
+        """Multi-host batch geometry: rows-per-batch for THIS process and
+        the common batch count (every process's slab sliced to the common
+        minimum — the reference's per-rank slice-off, datatools.py:147-155).
+        Pure chunk arithmetic, identical on every process — no comm."""
+        comm = self.dataset.comm
+        n = len(self.dataset)
+        per_dev = self.batch_size // comm.size
+        counts, _ = comm.counts_displs(n)
+        proc_rows: dict = {}
+        proc_ldc: dict = {}
+        for dev, cnt in zip(comm.devices, counts):
+            proc_rows[dev.process_index] = proc_rows.get(dev.process_index, 0) + cnt
+            proc_ldc[dev.process_index] = proc_ldc.get(dev.process_index, 0) + 1
+        nb = min(
+            proc_rows[pi] // (per_dev * proc_ldc[pi]) if proc_ldc[pi] else 0
+            for pi in proc_ldc
+        )
+        my_rows = per_dev * proc_ldc.get(jax.process_index(), 0)
+        return my_rows, nb
+
     def __len__(self) -> int:
+        if (
+            jax.process_count() > 1
+            and self.dataset.htdata.split is not None
+        ):
+            return self._mh_geometry()[1]
         n = len(self.dataset)
         p = self.dataset.comm.size
         full, rem = divmod(n, self.batch_size)
@@ -249,6 +297,34 @@ class DataLoader:
         comm = self.dataset.comm
         data = self.dataset.data
         targets = self.dataset.targets
+        if jax.process_count() > 1 and self.dataset.htdata.split is not None:
+            # multi-host: each process batches ITS slab; per-batch blocks
+            # assemble into globally-sharded arrays (the reference's
+            # iterate-your-shard design). `data` is already the local slab.
+            my_rows, nb = self._mh_geometry()
+            bs = self.batch_size
+
+            def assemble(local, ndim_shape):
+                return jax.make_array_from_process_local_data(
+                    comm.sharding(0, len(ndim_shape)), local, ndim_shape
+                )
+
+            for i in range(nb):
+                lo = i * my_rows
+                xb = assemble(
+                    np.asarray(data[lo : lo + my_rows]),
+                    (bs,) + tuple(data.shape[1:]),
+                )
+                if targets is None:
+                    batch = (xb,)
+                else:
+                    yb = assemble(
+                        np.asarray(targets[lo : lo + my_rows]),
+                        (bs,) + tuple(targets.shape[1:]),
+                    )
+                    batch = (xb, yb)
+                yield self.collate_fn(*batch) if self.collate_fn else batch
+            return
         n = data.shape[0]
         bs = self.batch_size
         nb = len(self)

@@ -259,6 +259,41 @@ assert np.array_equal(got_npy, ref_npy)
 Acn = ht.load_npy(npy_path, split=1)
 assert Acn.split == 1 and Acn.shape == (11, 3)
 
+# ======= stage 6: DataLoader — per-process slab batching ==================
+ND = 32
+dl_c = comm.chunk_size(ND)
+dl_lo = min(rank * LDC * dl_c, ND)
+dl_hi = min((rank + 1) * LDC * dl_c, ND)
+dl_local = np.stack(
+    [np.arange(dl_lo, dl_hi, dtype=np.float32)] * 2, axis=1
+)  # (rows, 2)
+Xd = ht.array(dl_local, is_split=0)
+Yd = ht.array(np.arange(dl_lo, dl_hi, dtype=np.float32), is_split=0)
+import jax.numpy as jnp
+from heat_tpu.utils.data import DataLoader, Dataset
+
+ds = Dataset(Xd, targets=Yd)
+loader = DataLoader(ds, batch_size=8, shuffle=False)
+nb = len(loader)
+assert nb >= 2, nb
+tot = 0.0
+rows = 0
+for xb, yb in loader:
+    assert xb.shape[0] == 8 and xb.shape[1] == 2, xb.shape
+    tot += float(jnp.sum(xb[:, 0]))
+    rows += xb.shape[0]
+assert rows == nb * 8
+assert abs(tot - float(sum(range(ND)))) < 1e-3, tot
+
+# shuffled epochs preserve the total
+import jax.numpy as jnp2
+loader2 = DataLoader(Dataset(Xd, targets=Yd), batch_size=8, shuffle=True)
+for _ in range(2):
+    tot2 = 0.0
+    for xb, yb in loader2:
+        tot2 += float(jnp2.sum(xb[:, 0]))
+    assert abs(tot2 - float(sum(range(ND)))) < 1e-3, tot2
+
 print(f"RANK{rank}_OK", flush=True)
 """
 
