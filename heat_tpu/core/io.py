@@ -212,12 +212,17 @@ def save_csv(data: DNDarray, path: str, header_lines: Optional[str] = None, sep:
                     "which writes explicit slices"
                 )
 
+            from .. import native
+
             def write(p):
-                with open(path, "w" if p == 0 else "a") as f:
-                    if p == 0:
+                if p == 0:
+                    with open(path, "w") as f:
                         f.write(header_text())
-                    if hi > lo:
-                        np.savetxt(f, block, delimiter=sep)
+                if hi > lo:
+                    blk2 = block if block.ndim == 2 else block[:, None]
+                    if not native.write_csv(path, blk2, sep=sep, append=True):
+                        with open(path, "a") as f:
+                            np.savetxt(f, block, delimiter=sep)
 
             _serialized_slab_write(write, "csv")
             return
@@ -233,7 +238,16 @@ def save_csv(data: DNDarray, path: str, header_lines: Optional[str] = None, sep:
             "multi-host save_csv supports split=0 (row-sharded) or replicated "
             "arrays only; resplit_(0) first"
         )
-    np.savetxt(path, data.numpy(), delimiter=sep, header=header_lines or "")
+    from .. import native
+
+    host = data.numpy()
+    if host.ndim in (1, 2) and np.issubdtype(host.dtype, np.floating):
+        h2 = host if host.ndim == 2 else host[:, None]
+        with open(path, "w") as f:
+            f.write(header_text())
+        if native.write_csv(path, h2, sep=sep, append=True):
+            return
+    np.savetxt(path, host, delimiter=sep, header=header_lines or "")
 
 
 def load_npy(path: str, dtype=None, split=None, device=None, comm=None) -> DNDarray:

@@ -27,6 +27,7 @@
 #include <locale.h>
 #include <sys/mman.h>
 #include <sys/stat.h>
+#include <string>
 #include <thread>
 #include <unistd.h>
 #include <vector>
@@ -113,8 +114,13 @@ void line_starts(const char* data, size_t lo, size_t hi,
 
 // strtod honors LC_NUMERIC; a host app running under a comma-decimal locale
 // (de_DE etc.) would silently truncate "1.5" to 1.0. Pin the C locale.
-double strtod_c(const char* s, char** end) {
+locale_t c_locale() {
     static locale_t c_loc = newlocale(LC_NUMERIC_MASK, "C", nullptr);
+    return c_loc;
+}
+
+double strtod_c(const char* s, char** end) {
+    locale_t c_loc = c_locale();
     if (!c_loc) return strtod(s, end);  // newlocale failed: plain strtod
     return strtod_l(s, end, c_loc);
 }
@@ -235,6 +241,63 @@ int csv_parse(const char* path, char sep, long skip_header, double* out,
 int csv_parse_range(const char* path, char sep, long skip_header,
                     long row_offset, long row_count, double* out, long cols) {
     return parse_span(path, sep, skip_header, row_offset, row_count, cols, out);
+}
+
+// Format (rows x cols, row-major f64) as CSV into `path`. %.17g keeps every
+// double bit-exact on round-trip (and is several times faster than
+// numpy.savetxt's Python-level formatting). Rows are formatted into
+// per-thread buffers in parallel, then written sequentially in order.
+// append != 0 opens in append mode (the multi-host slab-ring writer).
+int csv_write(const char* path, const double* data, long rows, long cols,
+              char sep, int append) {
+    if (rows < 0 || cols < 0) return -2;
+    size_t n = static_cast<size_t>(rows);
+    unsigned hw = std::thread::hardware_concurrency();
+    size_t nthreads = hw ? hw : 4;
+    if (nthreads > n / 2048 + 1) nthreads = n / 2048 + 1;
+    size_t chunk = n ? (n + nthreads - 1) / nthreads : 0;
+    std::vector<std::string> bufs(nthreads);
+
+    auto format_rows = [&](size_t t, size_t r0, size_t r1) {
+        // snprintf %g honors LC_NUMERIC like strtod — pin the C locale in
+        // each formatting thread so a comma-decimal host locale can't
+        // corrupt the output
+        locale_t c_loc = c_locale();
+        locale_t prev = c_loc ? uselocale(c_loc) : static_cast<locale_t>(0);
+        std::string& b = bufs[t];
+        b.reserve((r1 - r0) * static_cast<size_t>(cols) * 26);
+        char tmp[40];
+        for (size_t r = r0; r < r1; ++r) {
+            const double* row = data + static_cast<size_t>(cols) * r;
+            for (long c = 0; c < cols; ++c) {
+                int len = snprintf(tmp, sizeof(tmp), "%.17g", row[c]);
+                b.append(tmp, static_cast<size_t>(len));
+                b.push_back(c + 1 < cols ? sep : '\n');
+            }
+            if (cols == 0) b.push_back('\n');
+        }
+        if (prev) uselocale(prev);
+    };
+
+    std::vector<std::thread> threads;
+    for (size_t t = 0; t < nthreads; ++t) {
+        size_t r0 = t * chunk;
+        size_t r1 = r0 + chunk < n ? r0 + chunk : n;
+        if (r0 >= r1) break;
+        threads.emplace_back(format_rows, t, r0, r1);
+    }
+    for (auto& th : threads) th.join();
+
+    FILE* f = fopen(path, append ? "ab" : "wb");
+    if (!f) return -1;
+    for (const auto& b : bufs) {
+        if (!b.empty() && fwrite(b.data(), 1, b.size(), f) != b.size()) {
+            fclose(f);
+            return -1;
+        }
+    }
+    if (fclose(f) != 0) return -1;
+    return 0;
 }
 
 }  // extern "C"

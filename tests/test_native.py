@@ -196,3 +196,38 @@ class TestParseCsvRange:
         p.write_text("1,2\n3,4\n")
         with pytest.raises(OSError):
             native.parse_csv_range(str(p), ",", 0, 1, 5, 2)
+
+
+class TestNativeWriter:
+    """write_csv: multithreaded %.17g formatter, bit-exact round-trip."""
+
+    def test_roundtrip_bit_exact(self, tmp_path):
+        from heat_tpu import native
+
+        if not native.native_available():
+            pytest.skip("no native toolchain")
+        a = np.random.default_rng(3).standard_normal((513, 5))
+        p = str(tmp_path / "w.csv")
+        assert native.write_csv(p, a)
+        b = np.loadtxt(p, delimiter=",")
+        np.testing.assert_array_equal(a, b)
+
+    def test_append_mode(self, tmp_path):
+        from heat_tpu import native
+
+        if not native.native_available():
+            pytest.skip("no native toolchain")
+        a = np.arange(12, dtype=np.float64).reshape(4, 3)
+        p = str(tmp_path / "a.csv")
+        assert native.write_csv(p, a[:2])
+        assert native.write_csv(p, a[2:], append=True)
+        np.testing.assert_array_equal(np.loadtxt(p, delimiter=","), a)
+
+    def test_save_csv_uses_native(self, tmp_path):
+        import heat_tpu as ht
+
+        want = np.random.default_rng(4).standard_normal((37, 3)).astype(np.float32)
+        p = str(tmp_path / "s.csv")
+        ht.save_csv(ht.array(want, split=0), p)
+        back = ht.load_csv(p, split=0)
+        np.testing.assert_allclose(back.numpy(), want, rtol=0, atol=0)
