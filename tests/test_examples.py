@@ -81,3 +81,33 @@ class TestExamples:
         r = _run("examples/nn/scaleout_tour.py", timeout=420)
         assert r.returncode == 0, r.stderr[-1500:]
         assert "all three schedules match" in r.stdout
+
+    def test_multihost_demo(self):
+        # the one example that spawns ITS OWN 2-process jax.distributed run
+        import socket
+
+        with socket.socket() as s:
+            s.bind(("localhost", 0))
+            port = s.getsockname()[1]
+        keep = ("PATH", "HOME", "LANG", "LC_ALL", "TMPDIR", "TEMP", "TMP")
+        env = {k: os.environ[k] for k in keep if k in os.environ}
+        env["PYTHONPATH"] = REPO
+        script = os.path.join(REPO, "examples/multihost/demo_multihost.py")
+        if os.path.exists("/tmp/demo_multihost.npy"):
+            os.remove("/tmp/demo_multihost.npy")
+        procs = [
+            subprocess.Popen(
+                [sys.executable, script, str(r), "2", f"localhost:{port}"],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, env=env, cwd=REPO,
+            )
+            for r in (0, 1)
+        ]
+        outs = [p.communicate(timeout=420)[0] for p in procs]
+        for r, (p, out) in enumerate(zip(procs, outs)):
+            assert p.returncode == 0, f"rank {r}:\n{out[-1500:]}"
+            assert f"[{r}] done" in out, out[-1500:]
+        # both ranks computed identical global statistics
+        line0 = [l for l in outs[0].splitlines() if "kmeans inertia" in l][0]
+        line1 = [l for l in outs[1].splitlines() if "kmeans inertia" in l][0]
+        assert line0.split("]")[1] == line1.split("]")[1]
