@@ -55,27 +55,32 @@ def cg(A: DNDarray, b: DNDarray, x0: DNDarray, out: Optional[DNDarray] = None) -
     return x
 
 
-def _lanczos_kernel(a: "jnp.ndarray", v0: "jnp.ndarray", m: int):
-    """The whole Lanczos iteration as ONE compiled program (jit over a
-    static ``m``): `lax.fori_loop` over Krylov steps with masked full
+def _lanczos_kernel(a: "jnp.ndarray", v0: "jnp.ndarray", m: int, n: int):
+    """The whole Lanczos iteration as ONE compiled program (jit over
+    static ``m``/``n``): `lax.fori_loop` over Krylov steps with masked full
     reorthogonalization against a fixed (m, n) basis buffer, breakdown
     restarts selected by `jnp.where` instead of host branches. One dispatch,
     no per-iteration eager collectives — a Python loop of eager sharded
     matvecs can interleave two in-flight collective programs on the
     in-process CPU backend and deadlock (observed; and on TPU it would pay
-    a dispatch round-trip per step)."""
-    import jax
+    a dispatch round-trip per step).
 
-    n = a.shape[0]
+    ``a`` may be the PADDED split-0 physical buffer (n_pad, n) with zeroed
+    pad rows — the matvec stays sharded (XLA partitions it) and only the
+    (n,)-vector slice relays per step. Krylov vectors are length ``n``."""
+    import jax
 
     def norm(x):
         return jnp.sqrt(jnp.sum(x * x))
+
+    def matvec(x):
+        return (a @ x)[:n]  # pad rows produce zeros; slice to logical
 
     v = v0 / norm(v0)
     Vb = jnp.zeros((m, n), dtype=a.dtype).at[0].set(v)
     alphas = jnp.zeros((m,), dtype=a.dtype)
     betas = jnp.zeros((m,), dtype=a.dtype)
-    w = a @ v
+    w = matvec(v)
     alpha = jnp.dot(w, v)
     w = w - alpha * v
     alphas = alphas.at[0].set(alpha)
@@ -98,7 +103,7 @@ def _lanczos_kernel(a: "jnp.ndarray", v0: "jnp.ndarray", m: int):
         beta_rec = jnp.where(ok, beta, 0.0)
         Vb = Vb.at[i].set(v_next)
         betas = betas.at[i].set(beta_rec)
-        w = a @ v_next
+        w = matvec(v_next)
         alpha = jnp.dot(w, v_next)
         w = w - alpha * v_next - beta_rec * Vb[i - 1]
         alphas = alphas.at[i].set(alpha)
@@ -110,10 +115,25 @@ def _lanczos_kernel(a: "jnp.ndarray", v0: "jnp.ndarray", m: int):
     return Vb.T, alphas, betas
 
 
+import functools as _functools
+
 import jax as _jax
 
 # module-level jit: compiles once per (shape, dtype, m), not per call
-_lanczos_jit = _jax.jit(_lanczos_kernel, static_argnums=2)
+_lanczos_jit = _jax.jit(_lanczos_kernel, static_argnums=(2, 3))
+
+
+@_functools.lru_cache(maxsize=32)
+def _lanczos_jit_for(comm):
+    """jit variant with explicit replicated out_shardings for sharded
+    operands — an XLA-chosen output sharding can otherwise hit jax's
+    device-order reshard assertion in the downstream device_put under
+    multi-host."""
+    return _jax.jit(
+        _lanczos_kernel,
+        static_argnums=(2, 3),
+        out_shardings=(comm.replicated(), comm.replicated(), comm.replicated()),
+    )
 
 
 def lanczos(
@@ -138,7 +158,14 @@ def lanczos(
 
     n = A.shape[0]
     dt = types.promote_types(A.dtype, types.float32)
-    a_log = A._replicated().astype(dt.jnp_type())
+    if A.split == 0 and A.comm.size > 1:
+        # keep A sharded: the matvec partitions over the mesh (pad rows are
+        # zeroed and sliced off inside the kernel) — A never replicates
+        a_log = A._masked(0).astype(dt.jnp_type())
+        kernel_jit = _lanczos_jit_for(A.comm)
+    else:
+        a_log = A._replicated().astype(dt.jnp_type())
+        kernel_jit = _lanczos_jit
 
     if v0 is None:
         import numpy as _np
@@ -148,7 +175,7 @@ def lanczos(
     else:
         v = v0._replicated().astype(dt.jnp_type())
 
-    V_mat, alphas, betas = _lanczos_jit(a_log, v, m)
+    V_mat, alphas, betas = kernel_jit(a_log, v, m, n)
 
     T_mat = (
         jnp.diag(alphas)

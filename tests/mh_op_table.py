@@ -57,6 +57,32 @@ def _advanced_take(ht, np, c):
     _close(ht.sum(c["x"][c["ints"]]).item(), want)
 
 
+def _spd(ht, np, c):
+    # (N, N) split=0 s.p.d. system from the shared data
+    A = ht.matmul(c["X"], c["X"].T)
+    return A + 50.0 * ht.eye(N, split=0)
+
+
+def _cg_solve(ht, np, c):
+    A = _spd(ht, np, c)
+    x = ht.linalg.cg(A, c["x"], ht.zeros((N,), split=0))
+    # residual must be tiny relative to b
+    r = c["x"] - ht.matmul(A, x)
+    assert float(ht.max(ht.abs(r)).item()) < 1e-2
+
+
+def _lanczos(ht, np, c):
+    A = _spd(ht, np, c)
+    V, T = ht.linalg.lanczos(A, 4)
+    assert V.shape == (N, 4) and T.shape == (4, 4)
+
+
+def _spectral_fit(ht, np, c):
+    sp = ht.cluster.Spectral(n_clusters=2, n_lanczos=4)
+    labels = sp.fit_predict(c["X"])
+    assert labels.shape == (N,)
+
+
 def _reshape_cross(ht, np, c):
     # (10, 3) split=0 -> (3, 10) split=0: the one compiled relayout program
     r = ht.reshape(c["X"], (3, N))
@@ -159,6 +185,9 @@ OPS = [
     ("lasso_fit", _lasso_fit, "ok"),
     ("gaussiannb_fit", _gnb_fit, "ok"),
     ("knn_predict", _knn_predict, "ok"),
+    ("cg_solve", _cg_solve, "ok"),
+    ("lanczos", _lanczos, "ok"),
+    ("spectral_fit", _spectral_fit, "ok"),
     ("reshape_cross_split", _reshape_cross, "ok"),
     ("diagonal_2d", lambda ht, np, c: _close(ht.sum(ht.diagonal(c["X"])).item(), float(np.trace(np.arange(3 * N).reshape(N, 3)))), "ok"),
     ("trace", lambda ht, np, c: _close(ht.linalg.trace(c["X"]).item() if hasattr(ht.linalg, "trace") else ht.trace(c["X"]).item(), float(np.trace(np.arange(3 * N).reshape(N, 3)))), "ok"),
