@@ -273,6 +273,30 @@ def _masked_select_distributed(x: DNDarray, mask: DNDarray) -> DNDarray:
     return DNDarray(out, (nnz,), x.dtype, 0, x.device, x.comm, True)
 
 
+def _row_mask_select_distributed(x: DNDarray, mask: DNDarray) -> DNDarray:
+    """``x[mask]`` for a 1-D boolean mask over the leading (split=0) axis of
+    an n-D array: distributed row compaction — pad-False mask → distributed
+    cumsum assigns output rows → sharded scatter of whole ROWS into the
+    (nnz, ...) split=0 result. Only the scalar nnz reaches the host."""
+    comm = x.comm
+    if mask.split != 0:
+        mask = mask.resplit(0)
+    m = mask._masked(False)  # (n_pad,)
+    nnz = builtins.int(m.sum())
+    nnz_pad = comm.padded_size(nnz)
+    dest = jnp.where(m, jnp.cumsum(m) - 1, nnz_pad)
+    out_shape = (nnz_pad,) + x.shape[1:]
+    out = (
+        jnp.zeros(out_shape, dtype=x.larray.dtype)
+        .at[dest]
+        .set(x.larray, mode="drop")
+    )
+    out = jax.device_put(out, comm.sharding(0, len(out_shape)))
+    return DNDarray(
+        out, (nnz,) + x.shape[1:], x.dtype, 0, x.device, x.comm, True
+    )
+
+
 def getitem(x: DNDarray, key) -> DNDarray:
     # full-shape boolean DNDarray mask on a split=0 array: distributed
     # compaction BEFORE _normalize_key (which would gather the mask)
@@ -284,6 +308,18 @@ def getitem(x: DNDarray, key) -> DNDarray:
         and x.comm.size > 1
     ):
         return _masked_select_distributed(x, key)
+    # 1-D boolean row mask on an n-D split=0 array: distributed ROW
+    # compaction (reference dndarray.py:661-1549 handles this shard-side)
+    if (
+        isinstance(key, DNDarray)
+        and key.dtype == types.bool
+        and key.ndim == 1
+        and x.ndim > 1
+        and tuple(key.shape) == (x.shape[0],)
+        and x.split == 0
+        and x.comm.size > 1
+    ):
+        return _row_mask_select_distributed(x, key)
     key = _normalize_key(key, x)
 
     # --- sharded gather: a single 1-D integer-array key -------------------

@@ -83,6 +83,13 @@ def _spectral_fit(ht, np, c):
     assert labels.shape == (N,)
 
 
+def _row_mask(ht, np, c):
+    sel = c["X"][c["x"] > 4.5]  # rows 5..9 of arange(30).reshape(10, 3)
+    assert sel.shape == (N - 5, 3) and sel.split == 0
+    want = float(np.arange(3 * N).reshape(N, 3)[5:].sum())
+    _close(ht.sum(sel).item(), want)
+
+
 def _reshape_cross(ht, np, c):
     # (10, 3) split=0 -> (3, 10) split=0: the one compiled relayout program
     r = ht.reshape(c["X"], (3, N))
@@ -163,6 +170,7 @@ OPS = [
     ("unique_1d", lambda ht, np, c: _close(float(ht.max(ht.unique(c["ints"])).item()), 2.0), "ok"),
     ("nonzero", _nonzero, "ok"),
     ("masked_select", lambda ht, np, c: _close(ht.sum(c["x"][c["x"] > 4.5]).item(), float(sum(range(5, N)))), "ok"),
+    ("row_mask_select", _row_mask, "ok"),
     ("diff", lambda ht, np, c: _close(ht.sum(ht.diff(c["x"])).item(), N - 1.0), "ok"),
     ("flip_split_axis", lambda ht, np, c: _close(ht.flip(c["x"], 0)[0].item(), N - 1.0), "ok"),
     ("roll_split_axis", lambda ht, np, c: _close(ht.roll(c["x"], 3, 0)[0].item(), N - 3.0), "ok"),
