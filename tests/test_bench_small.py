@@ -27,7 +27,7 @@ class TestBenchSmallMode:
             "import jax; jax.config.update('jax_platforms', 'cpu');"
             "import sys, runpy;"
             "sys.argv = ['bench.py', '--small', '--no-probe',"
-            " '--only', 'moments,lasso,attention,lm_step'];"
+            " '--only', 'moments,lasso,attention,attention_bwd,matmul_1b,lm_step'];"
             f"runpy.run_path({bench!r}, run_name='__main__')"
         )
         r = subprocess.run(
@@ -40,6 +40,7 @@ class TestBenchSmallMode:
         detail = json.loads(
             [l for l in r.stderr.splitlines() if l.startswith("{") and "gflops" in l][-1]
         )
-        for row in ("moments_gflops", "lasso_gflops", "attention_gflops", "lm_step_gflops"):
+        for row in ("moments_gflops", "lasso_gflops", "attention_gflops",
+                    "attention_bwd_gflops", "matmul_1b_gflops", "lm_step_gflops"):
             assert detail[row] > 0, (row, detail)
         assert "errors" not in detail, detail.get("errors")
