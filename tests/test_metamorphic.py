@@ -171,3 +171,83 @@ class TestRoundTrips(TestCase):
         padded = ht.pad(x, ((2, 1), (0, 0)))
         back = padded[2 : 2 + p + 1]
         self.assert_array_equal(back, m)
+
+
+class TestRound4Involutions(TestCase):
+    """Involution / roundtrip identities of the round-4 physical paths —
+    padded split axes throughout (11 rows over the 8-device mesh)."""
+
+    def test_flip_involution_padded_split(self):
+        rng = np.random.default_rng(90)
+        m = rng.standard_normal((11, 3)).astype(np.float32)
+        for split in (0, 1, None):
+            x = ht.array(m, split=split)
+            _close(ht.flip(ht.flip(x, 0), 0).numpy(), m)
+            _close(ht.flip(x, (0, 1)).numpy(), m[::-1, ::-1])
+
+    def test_roll_inverse_padded_split(self):
+        rng = np.random.default_rng(91)
+        m = rng.standard_normal((13,)).astype(np.float32)
+        x = ht.array(m, split=0)
+        for k in (1, 5, 13, 17, -3):
+            _close(ht.roll(ht.roll(x, k, 0), -k, 0).numpy(), m)
+
+    def test_rot90_four_times_identity(self):
+        rng = np.random.default_rng(92)
+        m = rng.standard_normal((10, 7)).astype(np.float32)
+        for split in (0, 1):
+            x = ht.array(m, split=split)
+            y = x
+            for _ in range(4):
+                y = ht.rot90(y)
+            _close(y.numpy(), m)
+            _close(ht.rot90(x, 2).numpy(), np.rot90(m, 2))
+
+    def test_resplit_roundtrip(self):
+        rng = np.random.default_rng(93)
+        m = rng.standard_normal((11, 5)).astype(np.float32)
+        x = ht.array(m, split=0)
+        y = x.resplit(1).resplit(None).resplit(0)
+        assert y.split == 0
+        _close(y.numpy(), m)
+
+    def test_reshape_cross_split_roundtrip(self):
+        rng = np.random.default_rng(94)
+        m = rng.standard_normal((12, 5)).astype(np.float32)
+        x = ht.array(m, split=0)
+        y = ht.reshape(ht.reshape(x, (5, 12)), (12, 5))
+        _close(y.numpy(), m)
+
+    def test_qr_split0_vs_split1_same_R(self):
+        rng = np.random.default_rng(95)
+        m = rng.standard_normal((24, 6)).astype(np.float32)
+        r0 = ht.linalg.qr(ht.array(m, split=0), calc_q=False).R.numpy()
+        r1 = ht.linalg.qr(ht.array(m, split=1), calc_q=False).R.numpy()
+        _close(np.abs(r0), np.abs(r1), rtol=1e-3, atol=1e-3)
+
+    def test_svd_layout_invariance(self):
+        rng = np.random.default_rng(96)
+        m = rng.standard_normal((18, 5)).astype(np.float32)
+        ss = [
+            ht.linalg.svd(ht.array(m, split=s), compute_uv=False).numpy()
+            for s in (None, 0, 1)
+        ]
+        for s in ss[1:]:
+            _close(s, ss[0], rtol=1e-3, atol=1e-4)
+
+    def test_diagonal_matches_paired_indexing(self):
+        rng = np.random.default_rng(97)
+        m = rng.standard_normal((9, 12)).astype(np.float32)
+        for split in (0, 1):
+            x = ht.array(m, split=split)
+            for off in (-2, 0, 3):
+                _close(ht.diagonal(x, offset=off).numpy(), np.diagonal(m, off))
+
+    def test_dataset_shuffle_is_permutation(self):
+        from heat_tpu.utils.data import Dataset
+
+        m = np.arange(22, dtype=np.float32)
+        ds = Dataset(ht.array(m, split=0))
+        ds.Shuffle()
+        out = np.sort(ds.htdata.numpy())
+        _close(out, m)
