@@ -266,6 +266,21 @@ def qr(
             return _cholqr_split1(a, dt, calc_q)
         return _wide_split1(a, dt, calc_q)
 
+    # wide row-split: factor the m×m leading block (the small-dim² piece,
+    # replicated via the compiled relayout), then R = QᵀA — a contraction
+    # over the split rows that matmul renders as one psum. Multi-host safe.
+    if a.split == 0 and comm.size > 1 and m < n:
+        from .basics import matmul
+
+        lead = a[:, :m]  # split=0 (m, m)
+        q_log, _ = jnp.linalg.qr(lead._replicated().astype(dt.jnp_type()))
+        qt_ht = DNDarray.from_logical(q_log.T, None, a.device, comm, dt)
+        r_ht = matmul(qt_ht, a)
+        if not calc_q:
+            return QR(None, r_ht)
+        q_ht = DNDarray.from_logical(q_log, 0, a.device, comm, dt)
+        return QR(q_ht, r_ht)
+
     # general path: one XLA QR over the logical view (wide/replicated
     # inputs and single-position meshes; XLA gathers as needed)
     log = a._logical().astype(dt.jnp_type())

@@ -185,6 +185,7 @@ OPS = [
     ("matmul_split0", lambda ht, np, c: _close(ht.sum(ht.matmul(c["X"].T, c["X"])).item(), float((np.arange(30).reshape(10, 3).T @ np.arange(30).reshape(10, 3)).sum()), tol=1.0), "ok"),
     ("qr_split0", lambda ht, np, c: None if ht.linalg.qr(c["X"]).R.shape == (3, 3) else None, "ok"),
     ("qr_split1_tall", _qr_split1_tall, "ok"),
+    ("qr_split0_wide", lambda ht, np, c: None if ht.linalg.qr(c["Xc"].resplit(0)).R.shape == (6, 10) else None, "ok"),
     ("dot_1d", lambda ht, np, c: _close(ht.dot(c["x"], c["x"]).item(), float((np.arange(N) ** 2).sum())), "ok"),
     # --- ML ---------------------------------------------------------------
     ("cdist", lambda ht, np, c: None if ht.spatial.cdist(c["X"], c["X"]).shape == (N, N) else None, "ok"),
