@@ -155,6 +155,75 @@ def array(
     return _wrap(data, split, device, comm, dtype)
 
 
+def _assemble_ragged(
+    local,
+    split: int,
+    gshape,
+    all_shapes,
+    first: int,
+    count: int,
+    device,
+    comm,
+    dtype,
+) -> "DNDarray":
+    """Assemble arbitrary ragged per-process blocks into the canonical
+    layout. Stage 1: every process pads its block into a uniform slot of
+    ``c_stage = max_p ceil(len_p / ldc_p)`` rows per device, so the staged
+    array is canonically sharded by construction. Stage 2: one compiled
+    gather maps canonical positions to staged positions (the index map is
+    host-computable from the allgathered lengths) and lands with the
+    result's sharding."""
+    import jax
+
+    lens = all_shapes[:, split].astype(np.int64)
+    n = int(lens.sum())
+    nprocs = jax.process_count()
+    # per-process device counts, in process order
+    ldc = np.zeros((nprocs,), dtype=np.int64)
+    for dev in comm.devices:
+        ldc[dev.process_index] += 1
+    if (ldc == 0).any():
+        raise NotImplementedError(
+            "ragged is_split needs every process to own mesh devices"
+        )
+    c_stage = int(max(-(-int(l) // int(d)) for l, d in zip(lens, ldc)))
+    c_stage = max(c_stage, 1)
+    slot = ldc * c_stage  # rows per process in the staging layout
+    n_stage = int(slot.sum())  # == c_stage * comm.size
+
+    ht_dtype = (
+        types.canonical_heat_type(dtype)
+        if dtype is not None
+        else types.canonical_heat_type(local.dtype)
+    )
+    block = np.asarray(local).astype(ht_dtype.jnp_type())
+    pidx = jax.process_index()
+    padw = [(0, 0)] * block.ndim
+    padw[split] = (0, int(slot[pidx]) - block.shape[split])
+    block = np.pad(block, padw)
+    stage_shape = gshape[:split] + (n_stage,) + gshape[split + 1 :]
+    staged = jax.make_array_from_process_local_data(
+        comm.sharding(split, len(gshape)), block, stage_shape
+    )
+
+    # canonical position j < n reads staged position slot_start[q] + (j -
+    # prefix[q]) where q owns global row j; pads read row 0
+    prefix = np.concatenate([[0], np.cumsum(lens)])
+    slot_start = np.concatenate([[0], np.cumsum(slot)])
+    n_pad = comm.padded_size(n)
+    j = np.arange(n_pad, dtype=np.int64)
+    q = np.searchsorted(prefix, np.minimum(j, n - 1), side="right") - 1
+    src = np.where(j < n, slot_start[q] + (j - prefix[q]), 0)
+    idx = jnp.asarray(src)
+
+    gather = jax.jit(
+        lambda b: jnp.take(b, idx, axis=split),
+        out_shardings=comm.sharding(split, len(gshape)),
+    )
+    buf = gather(staged)
+    return DNDarray(buf, gshape, ht_dtype, split, device, comm, True)
+
+
 def _assemble_is_split(
     data,
     split: int,
@@ -217,12 +286,12 @@ def _assemble_is_split(
     have_lo = int(all_shapes[:pidx, split].sum())
     have_hi = have_lo + int(local.shape[split])
     if (have_lo, have_hi) != (want_lo, want_hi):
-        raise NotImplementedError(
-            f"is_split stage 1: process {pidx}'s block spans global rows "
-            f"[{have_lo},{have_hi}) but its devices' canonical ceil-rule "
-            f"chunks span [{want_lo},{want_hi}); re-chunk the local blocks "
-            f"to ceil({n}/{comm.size})={c} rows per device, or pass split= "
-            "with the global array"
+        # RAGGED blocks (the reference accepts any per-rank extents,
+        # factories.py:386-429): stage the blocks in a uniform-slot layout,
+        # then one compiled index-map gather re-chunks to canonical — the
+        # DCN all-to-all the relayout requires, emitted by XLA
+        return _assemble_ragged(
+            local, split, gshape, all_shapes, first, count, device, comm, dtype
         )
     phys_rows = count * c
     if local.shape[split] < phys_rows:

@@ -52,14 +52,21 @@ assert total == float(sum(range(n))), total
 mx = float(ht.max(x).item())
 assert mx == n - 1.0, mx
 
-# --- misaligned blocks raise the stage-1 NotImplementedError -------------
-bad = np.arange(3 + rank, dtype=np.float32)  # proc0: 3 rows, proc1: 4 rows
-try:
-    ht.array(bad, is_split=0)
-except NotImplementedError:
-    pass
-else:
-    raise AssertionError("misaligned is_split blocks must raise")
+# --- RAGGED blocks assemble via the staging gather (reference parity:
+# arbitrary per-rank extents, factories.py:386-429) ----------------------
+rag_lens = [3 + r for r in range(NPROCS)]
+rag_prefix = sum(rag_lens[:rank])
+ragged = np.arange(
+    rag_prefix, rag_prefix + rag_lens[rank], dtype=np.float32
+)
+xr = ht.array(ragged, is_split=0)
+n_rag = sum(rag_lens)
+assert xr.shape == (n_rag,), xr.shape
+assert abs(float(ht.sum(xr).item()) - float(sum(range(n_rag)))) < 1e-3
+assert float(ht.max(xr).item()) == n_rag - 1.0
+# order preserved: sorted equals itself
+srt, _ = ht.sort(xr)
+assert float(ht.max(ht.abs(srt - xr)).item()) == 0.0
 
 # ======= stage 2: real compute across the two hosts =======================
 # Verification discipline: results of cross-host ops are checked through
