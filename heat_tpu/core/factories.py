@@ -160,8 +160,6 @@ def _assemble_ragged(
     split: int,
     gshape,
     all_shapes,
-    first: int,
-    count: int,
     device,
     comm,
     dtype,
@@ -238,11 +236,11 @@ def _assemble_is_split(
     ordered by process index. The global extent is inferred by all-gathering
     the local shapes (the handshake analog); non-split dims must agree.
 
-    Stage-1 restriction: each process's block must coincide with its devices'
-    canonical ceil-rule chunks ``[first_dev*c, min(last_dev_end*c, n))`` —
-    the layout produced by per-host sharded data loading. Arbitrary ragged
-    blocks would need a cross-host re-chunk (DCN all-to-all) at construction
-    time; pass ``split=`` with a global array instead.
+    Blocks matching the canonical ceil-rule chunks (the layout produced by
+    per-host sharded data loading) assemble directly; arbitrary RAGGED
+    extents go through :func:`_assemble_ragged` — a staging layout plus one
+    compiled re-chunk gather (the branch is decided collectively from the
+    allgathered shapes).
     """
     from jax.experimental import multihost_utils
 
@@ -281,17 +279,33 @@ def _assemble_is_split(
             "communicator mesh"
         )
     first, count = mesh_positions[0], len(mesh_positions)
-    want_lo = min(first * c, n)
-    want_hi = min((first + count) * c, n)
-    have_lo = int(all_shapes[:pidx, split].sum())
-    have_hi = have_lo + int(local.shape[split])
-    if (have_lo, have_hi) != (want_lo, want_hi):
+    # canonical-vs-ragged is decided COLLECTIVELY from the allgathered
+    # shapes — every process computes every process's (have, want) spans and
+    # agrees on the branch, because the two branches issue different
+    # collective programs (a per-process decision could deadlock the job)
+    lens_all = all_shapes[:, split].astype(np.int64)
+    prefixes = np.concatenate([[0], np.cumsum(lens_all)])
+    nprocs = jax.process_count()
+    first_all = np.full((nprocs,), -1, dtype=np.int64)
+    ldc_all = np.zeros((nprocs,), dtype=np.int64)
+    for i, dev in enumerate(comm.devices):
+        if first_all[dev.process_index] < 0:
+            first_all[dev.process_index] = i
+        ldc_all[dev.process_index] += 1
+    canonical = True
+    for p_i in range(nprocs):
+        w_lo = min(int(first_all[p_i]) * c, n)
+        w_hi = min((int(first_all[p_i]) + int(ldc_all[p_i])) * c, n)
+        if (int(prefixes[p_i]), int(prefixes[p_i + 1])) != (w_lo, w_hi):
+            canonical = False
+            break
+    if not canonical:
         # RAGGED blocks (the reference accepts any per-rank extents,
         # factories.py:386-429): stage the blocks in a uniform-slot layout,
         # then one compiled index-map gather re-chunks to canonical — the
         # DCN all-to-all the relayout requires, emitted by XLA
         return _assemble_ragged(
-            local, split, gshape, all_shapes, first, count, device, comm, dtype
+            local, split, gshape, all_shapes, device, comm, dtype
         )
     phys_rows = count * c
     if local.shape[split] < phys_rows:
