@@ -29,17 +29,23 @@ if [ -n "$REPORT" ]; then
     fi
 fi
 
+FAILED_SIZES=""
 for n in $SIZES; do
     echo "=== suite @ ${n} virtual devices ==="
     args=(-q -p no:cacheprovider)
     if [ -n "$REPORT" ]; then
         args+=("--junitxml=${REPORT}/junit_${n}.xml")
     fi
+    rc=0
     if [ "$have_coverage" = 1 ]; then
         HEAT_TPU_TEST_DEVICES=$n COVERAGE_FILE="${REPORT}/.coverage.${n}" \
-            python -m coverage run --source=heat_tpu -m pytest tests/ "${args[@]}"
+            python -m coverage run --source=heat_tpu -m pytest tests/ "${args[@]}" || rc=$?
     else
-        HEAT_TPU_TEST_DEVICES=$n python -m pytest tests/ "${args[@]}"
+        HEAT_TPU_TEST_DEVICES=$n python -m pytest tests/ "${args[@]}" || rc=$?
+    fi
+    if [ "$rc" != 0 ]; then
+        echo "=== suite @ ${n} devices FAILED (rc=$rc) — continuing sweep ==="
+        FAILED_SIZES="$FAILED_SIZES $n"
     fi
 done
 
@@ -49,5 +55,9 @@ if [ "$have_coverage" = 1 ]; then
     (cd "$REPORT" && python -m coverage combine .coverage.* \
         && python -m coverage report --include='*/heat_tpu/*' > coverage.txt \
         && tail -1 coverage.txt)
+fi
+if [ -n "$FAILED_SIZES" ]; then
+    echo "=== FAILED at device counts:$FAILED_SIZES ==="
+    exit 1
 fi
 echo "=== all device counts green ==="

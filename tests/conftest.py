@@ -22,3 +22,16 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+
+
+import pytest
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _bound_jax_memory():
+    """Long single-process sweeps accumulate XLA executables; clearing the
+    caches per module bounds RSS on small CI hosts (a 3-device full-suite
+    pass died in a compile-time C++ abort from memory exhaustion without
+    this). Costs some re-compiles across modules — correctness unaffected."""
+    yield
+    jax.clear_caches()
