@@ -12,6 +12,10 @@
 #       # `coverage` module is available, per-size coverage data merged
 #       # into one report (out/coverage.txt) — the Jenkinsfile analog
 #   HEAT_TPU_CI_SIZES="2 8" scripts/run_ci.sh   # custom size list
+#   HEAT_TPU_CI_CHUNKS=4 scripts/run_ci.sh
+#       # run each size's suite in N fresh-process chunks of test files —
+#       # bounds accumulated XLA state (a 3-device full pass aborts flakily
+#       # inside XLA after ~300 tests in one process on this host)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -29,20 +33,35 @@ if [ -n "$REPORT" ]; then
     fi
 fi
 
+CHUNKS=${HEAT_TPU_CI_CHUNKS:-1}
 FAILED_SIZES=""
 for n in $SIZES; do
-    echo "=== suite @ ${n} virtual devices ==="
-    args=(-q -p no:cacheprovider)
-    if [ -n "$REPORT" ]; then
-        args+=("--junitxml=${REPORT}/junit_${n}.xml")
-    fi
+    echo "=== suite @ ${n} virtual devices (${CHUNKS} chunk(s)) ==="
     rc=0
-    if [ "$have_coverage" = 1 ]; then
-        HEAT_TPU_TEST_DEVICES=$n COVERAGE_FILE="${REPORT}/.coverage.${n}" \
-            python -m coverage run --source=heat_tpu -m pytest tests/ "${args[@]}" || rc=$?
-    else
-        HEAT_TPU_TEST_DEVICES=$n python -m pytest tests/ "${args[@]}" || rc=$?
-    fi
+    for ((k = 0; k < CHUNKS; k++)); do
+        # round-robin test files into chunks; each chunk is a fresh process
+        mapfile -t files < <(ls tests/test_*.py | awk -v k=$k -v c=$CHUNKS 'NR % c == k')
+        [ ${#files[@]} -eq 0 ] && continue
+        args=(-q -p no:cacheprovider)
+        if [ -n "$REPORT" ]; then
+            if [ "$CHUNKS" = 1 ]; then
+                args+=("--junitxml=${REPORT}/junit_${n}.xml")
+            else
+                args+=("--junitxml=${REPORT}/junit_${n}_${k}.xml")
+            fi
+        fi
+        crc=0
+        if [ "$have_coverage" = 1 ]; then
+            HEAT_TPU_TEST_DEVICES=$n COVERAGE_FILE="${REPORT}/.coverage.${n}.${k}" \
+                python -m coverage run --source=heat_tpu -m pytest "${files[@]}" "${args[@]}" || crc=$?
+        else
+            HEAT_TPU_TEST_DEVICES=$n python -m pytest "${files[@]}" "${args[@]}" || crc=$?
+        fi
+        # pytest rc 5 = no tests collected in this chunk — not a failure
+        if [ "$crc" != 0 ] && [ "$crc" != 5 ]; then
+            rc=$crc
+        fi
+    done
     if [ "$rc" != 0 ]; then
         echo "=== suite @ ${n} devices FAILED (rc=$rc) — continuing sweep ==="
         FAILED_SIZES="$FAILED_SIZES $n"
