@@ -38,6 +38,7 @@ FAILED_SIZES=""
 for n in $SIZES; do
     echo "=== suite @ ${n} virtual devices (${CHUNKS} chunk(s)) ==="
     rc=0
+    ran_chunks=0
     for ((k = 0; k < CHUNKS; k++)); do
         # round-robin test files into chunks; each chunk is a fresh process
         mapfile -t files < <(ls tests/test_*.py | awk -v k=$k -v c=$CHUNKS 'NR % c == k')
@@ -58,10 +59,17 @@ for n in $SIZES; do
             HEAT_TPU_TEST_DEVICES=$n python -m pytest "${files[@]}" "${args[@]}" || crc=$?
         fi
         # pytest rc 5 = no tests collected in this chunk — not a failure
-        if [ "$crc" != 0 ] && [ "$crc" != 5 ]; then
+        # on its own, but at least one chunk must actually run tests
+        if [ "$crc" = 0 ]; then
+            ran_chunks=$((ran_chunks + 1))
+        elif [ "$crc" != 5 ]; then
             rc=$crc
         fi
     done
+    if [ "$ran_chunks" = 0 ] && [ "$rc" = 0 ]; then
+        echo "=== suite @ ${n} devices ran NO tests — failing the size ==="
+        rc=2
+    fi
     if [ "$rc" != 0 ]; then
         echo "=== suite @ ${n} devices FAILED (rc=$rc) — continuing sweep ==="
         FAILED_SIZES="$FAILED_SIZES $n"
