@@ -494,6 +494,10 @@ def bench_heat_tpu(errors, profile_dir=None, small=False, only=None,
         seen = set()
         for bq in (256, 512, 1024):
             for bk in (256, 512, 1024, 2048):
+                if deadline is not None and time.monotonic() > deadline:
+                    print(json.dumps({"sweep_attn": "stopped: budget exhausted"}),
+                          file=sys.stderr, flush=True)
+                    return results
                 ebq, ebk = clamp(bq), clamp(bk)
                 if (ebq, ebk) in seen:
                     continue

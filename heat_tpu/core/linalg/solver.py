@@ -14,41 +14,89 @@ from ..dndarray import DNDarray
 __all__ = ["cg", "lanczos"]
 
 
+def _cg_kernel(a: "jnp.ndarray", b: "jnp.ndarray", x0: "jnp.ndarray", n: int):
+    """Whole CG iteration as ONE compiled program: `lax.while_loop` with the
+    convergence test on-device (reference solver.py:13 drives the loop from
+    the host with four `.item()` syncs per iteration; here zero scalars cross
+    to the host until the solve finishes). ``a`` may be the PADDED split-0
+    physical buffer (n_pad, n) with zeroed pad rows — the matvec stays
+    sharded and only (n,) vectors carry between steps."""
+    import jax.lax as lax
+
+    def matvec(x):
+        return (a @ x)[:n]  # pad rows contribute zeros; slice to logical
+
+    tol2 = jnp.asarray(1e-20, dtype=a.dtype)  # (1e-10)^2, tested on r.r
+
+    r0 = b - matvec(x0)
+    rs0 = jnp.dot(r0, r0)
+
+    def cond(carry):
+        _x, _r, _p, rsold, it = carry
+        return (it < n) & (rsold >= tol2)
+
+    def body(carry):
+        x, r, p, rsold, it = carry
+        Ap = matvec(p)
+        alpha = rsold / jnp.dot(p, Ap)
+        x = x + alpha * p
+        r = r - alpha * Ap
+        rsnew = jnp.dot(r, r)
+        p = r + (rsnew / rsold) * p
+        return x, r, p, rsnew, it + 1
+
+    x, _r, _p, _rs, _it = lax.while_loop(
+        cond, body, (x0, r0, r0, rs0, jnp.asarray(0, dtype=jnp.int32))
+    )
+    return x
+
+
 def cg(A: DNDarray, b: DNDarray, x0: DNDarray, out: Optional[DNDarray] = None) -> DNDarray:
-    """Conjugate gradients for s.p.d. ``A x = b`` (reference solver.py:13 —
-    textbook CG in ht ops; matmul/elementwise carry the distribution)."""
-    from .. import arithmetics
-    from .basics import matmul, dot
+    """Conjugate gradients for s.p.d. ``A x = b`` (reference solver.py:13).
 
-    if not isinstance(A, DNDarray) or not isinstance(b, DNDarray) or not isinstance(x0, DNDarray):
-        raise TypeError("A, b and x0 need to be of type ht.DNDarray")
+    The entire solve — matvecs, vector updates, and the residual-norm
+    convergence check — runs as one jitted `lax.while_loop` dispatch, the
+    same treatment `lanczos` gets below; A stays sharded (split=0 matvecs
+    partition over the mesh) and no scalar reaches the host mid-solve."""
+    if (
+        not isinstance(A, DNDarray)
+        or not isinstance(b, DNDarray)
+        or not isinstance(x0, DNDarray)
+    ):
+        raise TypeError("cg expects DNDarray operands for A, b and x0")
     if A.ndim != 2:
-        raise RuntimeError("A needs to be a 2D matrix")
+        raise RuntimeError(f"cg expects a 2-D matrix A, got {A.ndim}-D")
     if b.ndim != 1:
-        raise RuntimeError("b needs to be a 1D vector")
+        raise RuntimeError(f"cg expects a 1-D right-hand side b, got {b.ndim}-D")
     if x0.ndim != 1:
-        raise RuntimeError("c needs to be a 1D vector")
+        raise RuntimeError(f"cg expects a 1-D initial guess x0, got {x0.ndim}-D")
 
-    r = arithmetics.sub(b, matmul(A, x0))
-    p = r
-    rsold = dot(r, r)
-    x = x0
+    n = A.shape[0]
+    dt = types.promote_types(
+        types.promote_types(A.dtype, b.dtype), types.promote_types(x0.dtype, types.float32)
+    )
+    if A.split == 0 and A.comm.size > 1:
+        # keep A sharded: the matvec partitions over the mesh (pad rows are
+        # zeroed and sliced off inside the kernel) — A never replicates
+        a_log = A._masked(0).astype(dt.jnp_type())
+        kernel_jit = _cg_jit_for(A.comm)
+    else:
+        a_log = A._replicated().astype(dt.jnp_type())
+        kernel_jit = _cg_jit
+    b_log = b._replicated().astype(dt.jnp_type())
+    x0_log = x0._replicated().astype(dt.jnp_type())
 
-    for _ in range(len(b)):
-        Ap = matmul(A, p)
-        alpha = rsold.item() / dot(p, Ap).item()
-        x = arithmetics.add(x, arithmetics.mul(alpha, p))
-        r = arithmetics.sub(r, arithmetics.mul(alpha, Ap))
-        rsnew = dot(r, r)
-        if float(rsnew.item()) ** 0.5 < 1e-10:
-            if out is not None:
-                out.larray = x.larray
-                return out
-            return x
-        beta = rsnew.item() / rsold.item()
-        p = arithmetics.add(r, arithmetics.mul(beta, p))
-        rsold = rsnew
+    x_log = kernel_jit(a_log, b_log, x0_log, n)
+    if not bool(jnp.all(jnp.isfinite(x_log))):
+        # breakdown (p^T A p = 0 ⇒ alpha = inf inside the kernel) exits the
+        # while_loop via the NaN residual; surface it loudly — the solve is
+        # only defined for s.p.d. A. One host sync, after the loop finishes.
+        raise RuntimeError(
+            "cg broke down (non-finite iterate) — A must be symmetric "
+            "positive definite"
+        )
 
+    x = DNDarray.from_logical(x_log, x0.split, x0.device, x0.comm, dt)
     if out is not None:
         out.larray = x.larray
         return out
@@ -121,6 +169,19 @@ import jax as _jax
 
 # module-level jit: compiles once per (shape, dtype, m), not per call
 _lanczos_jit = _jax.jit(_lanczos_kernel, static_argnums=(2, 3))
+
+
+# module-level jit: compiles once per (shape, dtype), not per call
+_cg_jit = _jax.jit(_cg_kernel, static_argnums=(3,))
+
+
+@_functools.lru_cache(maxsize=32)
+def _cg_jit_for(comm):
+    """cg jit variant with replicated out_shardings for sharded operands
+    (same multi-host reshard-assertion guard as `_lanczos_jit_for`)."""
+    return _jax.jit(
+        _cg_kernel, static_argnums=(3,), out_shardings=comm.replicated()
+    )
 
 
 @_functools.lru_cache(maxsize=32)
