@@ -56,10 +56,18 @@ class Spectral(BaseEstimator, ClusteringMixin):
         self.assign_labels = assign_labels
 
         sigma = float(np.sqrt(1.0 / (2.0 * gamma)))
-        if metric == "rbf":
+        if callable(metric):
+            # extension over the reference (spectral.py:84 raises for
+            # anything beyond rbf/euclidean): any DNDarray -> DNDarray
+            # similarity callable plugs into the Laplacian
+            sim = metric
+        elif metric == "rbf":
             sim = lambda x: spatial.rbf(x, sigma=sigma, quadratic_expansion=True)
         elif metric == "euclidean":
             sim = lambda x: spatial.cdist(x, quadratic_expansion=True)
+        elif metric == "manhattan":
+            # extension: L1 affinity via the same ring/GEMM machinery
+            sim = lambda x: spatial.manhattan(x)
         else:
             raise NotImplementedError(f"Metric {metric} is currently not implemented")
         self._laplacian = Laplacian(
@@ -107,10 +115,23 @@ class Spectral(BaseEstimator, ClusteringMixin):
             components._replicated().astype(jnp.float32), x.split, x.device, x.comm
         )
 
+    @staticmethod
+    def _as_rows(x: DNDarray) -> DNDarray:
+        """Canonicalize to row-split (or replicated) samples. The reference
+        raises NotImplementedError for split != 0 (spectral.py:154,:198);
+        here any split is accepted — a feature-split input pays one relayout
+        up front and the pipeline runs on rows as usual."""
+        if x.split is not None and x.split != 0:
+            from ..core import manipulations
+
+            return manipulations.resplit(x, 0)
+        return x
+
     def fit(self, x: DNDarray) -> "Spectral":
         """Embed and cluster (reference spectral.py:134)."""
         if not isinstance(x, DNDarray):
             raise TypeError(f"input needs to be a DNDarray, but was {type(x)}")
+        x = self._as_rows(x)
         eigval, eigvec = self._spectral_embedding(x)
         if self.n_clusters is None:
             # largest eigen-gap heuristic (reference spectral.py:150)
@@ -135,7 +156,6 @@ class Spectral(BaseEstimator, ClusteringMixin):
             raise RuntimeError("fit needs to be called before predict")
         if not isinstance(x, DNDarray):
             raise TypeError(f"input needs to be a DNDarray, but was {type(x)}")
-        if x.split is not None and x.split != 0:
-            raise NotImplementedError("Not implemented for other splitting-axes")
+        x = self._as_rows(x)
         _, eigvec = self._spectral_embedding(x)
         return self._cluster.predict(self._embed(x, eigvec))
