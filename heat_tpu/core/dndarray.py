@@ -336,10 +336,22 @@ class DNDarray:
 
     # ---------------------------------------------------------- conversions
 
+    def _host_view(self) -> jax.Array:
+        """Logical global array safe to hand to the host from ANY process
+        topology. Single-controller: the cheap :meth:`_logical` slice.
+        Multi-host with a padded split axis: one compiled
+        :meth:`_replicated` relayout (the reference gathers via Allgatherv,
+        dndarray.py:1256; here XLA's all-gather does it and the result is
+        fully replicated, hence addressable on every process)."""
+        if self.pad_count and jax.process_count() > 1:
+            return self._replicated()
+        return self._logical()
+
     def numpy(self) -> np.ndarray:
         """Gather the logical global array to host numpy (reference
-        dndarray.py: `numpy`)."""
-        return np.asarray(self._logical())
+        dndarray.py: `numpy`). Multi-host safe: padded split arrays relayout
+        through one compiled all-gather instead of refusing."""
+        return np.asarray(self._host_view())
 
     def __array__(self, dtype=None) -> np.ndarray:
         a = self.numpy()
@@ -353,7 +365,7 @@ class DNDarray:
         dndarray.py:952)."""
         if self.size != 1:
             raise ValueError("only one-element DNDarrays can be converted to python scalars")
-        return self._logical().reshape(()).item()
+        return self._host_view().reshape(()).item()
 
     def __bool__(self) -> bool:
         return bool(self.__cast(builtins.bool))

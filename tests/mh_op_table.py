@@ -203,6 +203,14 @@ OPS = [
     ("cov", lambda ht, np, c: None if ht.cov(c["X"].T).shape == (3, 3) else None, "ok"),
     ("skew_kurtosis", lambda ht, np, c: (_close(ht.skew(c["x"]).item(), 0.0, tol=0.2), _close(ht.kurtosis(c["x"]).item(), -1.2002, tol=0.05)), "ok"),
     ("flatten", lambda ht, np, c: _close(ht.sum(ht.flatten(c["X"])).item(), SUM_X), "ok"),
-    # --- documented multi-host boundaries (must raise) --------------------
-    ("numpy_gather", lambda ht, np, c: c["x"].numpy(), "raises"),
+    # numpy()/item() on a padded split array relayout through one compiled
+    # all-gather (_host_view) instead of refusing (VERDICT r4 item 6)
+    ("numpy_gather", lambda ht, np, c: _numpy_gather(ht, np, c), "ok"),
 ]
+
+
+def _numpy_gather(ht, np, c):
+    a = c["x"].numpy()
+    assert a.shape == (N,), a.shape
+    assert float(a.sum()) == SUM_N, a
+    assert float(c["x"][N - 1].item()) == N - 1
