@@ -18,8 +18,10 @@ cheapest physical route:
   dndarray.py:661-1549);
 * **setitem** updates the physical buffer in place via ``.at[key].set`` with
   the key normalized against the logical extents (pads can never be hit);
-  only truly jnp-incompatible keys (e.g. ragged boolean-mask assignment)
-  fall back to a host numpy round-trip, and that path emits a loud
+  ragged boolean-mask assignment stays shard-side too (rank-among-True
+  cumsum + static gather + where — the value length is static metadata);
+  only truly jnp-incompatible keys (e.g. bool arrays mixed inside tuple
+  keys) fall back to a host numpy round-trip, and that path emits a loud
   ``UserWarning``;
 * everything else (mixed advanced keys, partial boolean masks) goes through
   the logical view; split metadata of results follows Heat's rules:
@@ -523,14 +525,27 @@ def setitem(x: DNDarray, key, value) -> None:
             valp = jnp.pad(val, padw) if x.pad_count else val
             new = jnp.where(mask, valp, buf)
         else:
-            # ragged mask assignment — dynamic true-count, jit-hostile
-            _host_fallback_warning("ragged boolean-mask assignment (value shape "
-                                   f"{tuple(val.shape)} vs mask)")
-            host = np.array(x._logical())
-            host[np.asarray(key)] = np.asarray(val)
-            new = DNDarray.from_logical(
-                jnp.asarray(host), x.split, x.device, x.comm, x.dtype
-            ).larray
+            # ragged mask assignment, shard-side: the value's length is
+            # STATIC, so each True position's value index is its rank among
+            # True positions — one cumsum + static-shape gather + where, no
+            # dynamic shapes and no host gather. Physical row-major order
+            # skips pads (mask False there), so ranks follow logical
+            # row-major order for any split (reference handles this
+            # shard-side too, dndarray.py:1334-1549). One scalar sync
+            # validates the count (numpy parity).
+            val1 = val.reshape(-1)
+            nnz = builtins.int(jnp.sum(mask))
+            if builtins.int(val1.shape[0]) != nnz:
+                raise ValueError(
+                    f"cannot assign {builtins.int(val1.shape[0])} input "
+                    f"values to the {nnz} output values where the mask is true"
+                )
+            if nnz == 0:
+                return
+            flatm = jnp.reshape(mask, (-1,))
+            ranks = jnp.clip(jnp.cumsum(flatm) - 1, 0, val1.shape[0] - 1)
+            taken = jnp.reshape(jnp.take(val1, ranks), buf.shape)
+            new = jnp.where(mask, taken, buf)
         x.larray = new
         return
 
