@@ -624,6 +624,20 @@ def sort(a: DNDarray, axis: int = -1, descending: bool = False, out=None):
     return values, indices
 
 
+def _oddeven_partner_perms(p: int):
+    """The two static ppermute partner permutations (even / odd rounds) of
+    the odd-even transposition network; unpaired shards self-send."""
+
+    def _perm(b):
+        perm, paired = [], set()
+        for lo in range(b, p - 1, 2):
+            perm += [(lo, lo + 1), (lo + 1, lo)]
+            paired |= {lo, lo + 1}
+        return perm + [(k, k) for k in range(p) if k not in paired]
+
+    return (_perm(0), _perm(1))
+
+
 def _oddeven_sort_physical(a: DNDarray, axis: int, descending: bool):
     """Distributed sort of the physical buffer along the split axis.
 
@@ -653,15 +667,7 @@ def _oddeven_sort_physical(a: DNDarray, axis: int, descending: bool):
         idx0 = iota  # pads already carry the largest global indices
 
     c = pshape[axis] // p  # local chunk length along the sort axis
-
-    def _perm(b):
-        perm, paired = [], set()
-        for lo in range(b, p - 1, 2):
-            perm += [(lo, lo + 1), (lo + 1, lo)]
-            paired |= {lo, lo + 1}
-        return perm + [(k, k) for k in range(p) if k not in paired]
-
-    perms = (_perm(0), _perm(1))
+    perms = _oddeven_partner_perms(p)
 
     def kernel(v, i):
         # the p rounds run as a fori_loop with lax.cond selecting between the
@@ -969,10 +975,14 @@ def unique(a: DNDarray, sorted: bool = False, return_inverse: bool = False, axis
 
     n-D inputs with ``axis=None`` relayout once to a flat split=0 vector
     and run the same distributed algorithm (inverses come back
-    input-shaped, numpy semantics). Only ``axis=...`` (row-unique) and
-    replicated/0-d flows keep the eager host path — their dynamic output
-    shapes have no XLA form (SURVEY §7 hard parts); that path's tested
-    ceiling is documented in PARITY.md.
+    input-shaped, numpy semantics). ``axis=k`` (row-unique) on split
+    arrays is ALSO distributed (:func:`_distributed_unique_rows_nd`):
+    lexicographic odd-even row sort → neighbor row-equality mask →
+    row compaction — no host gather, no size ceiling. Only replicated/0-d
+    flows, complex dtypes, and rows wider than ``_ROW_UNIQUE_MAX_WIDTH``
+    keep the eager host path (single-controller; bounded by host memory —
+    and, like every eager `_logical` flow, it raises on multi-host padded
+    arrays rather than mis-computing).
     """
     if (
         axis is None and a.split is not None
@@ -985,6 +995,26 @@ def unique(a: DNDarray, sorted: bool = False, return_inverse: bool = False, axis
                 inv = reshape(inv, tuple(a.shape))
             return vals, inv
         return _distributed_unique(flat, False)
+    if (
+        axis is not None and a.split is not None
+        and a.comm.size > 1 and a.size > 0
+    ):
+        ax = sanitize_axis(a.shape, axis)
+        if a.ndim == 1:
+            # 1-D axis=0 runs the ROWS path on (n, 1) so it gets numpy's
+            # axis semantics (NaN entries stay distinct — the flat path's
+            # equal_nan collapse would diverge from the axis oracle)
+            b2 = reshape(a, (a.shape[0], 1))
+            out = _distributed_unique_rows_nd(b2, 0, return_inverse)
+            if return_inverse:
+                res, inv = out
+                return reshape(res, (res.shape[0],)), inv
+            return reshape(out, (out.shape[0],))
+        if (
+            a.size // a.shape[ax] <= _ROW_UNIQUE_MAX_WIDTH
+            and not issubclass(a.dtype, types.complexfloating)
+        ):
+            return _distributed_unique_rows_nd(a, ax, return_inverse)
     log = a._logical()
     if axis is not None:
         axis = sanitize_axis(a.shape, axis)
@@ -1085,6 +1115,173 @@ def _distributed_unique(a: DNDarray, return_inverse: bool):
     inv_buf = jax.shard_map(
         inverse_kernel, mesh=comm.mesh, in_specs=(spec, spec), out_specs=spec
     )(ibuf, gid_buf)
+    inv_ht = DNDarray(inv_buf, (n,), types.int64, 0, a.device, a.comm, True)
+    return res_ht, inv_ht
+
+
+# Widest row (in elements) the distributed row-unique network takes on:
+# the lexicographic merge sorts R+1 separate key operands per round, so
+# compile time grows with R. Wider rows keep the eager path (which is
+# bounded by host memory, not by a correctness cap).
+_ROW_UNIQUE_MAX_WIDTH = 256
+
+
+def _distributed_unique_rows_nd(a: DNDarray, axis: int, return_inverse: bool):
+    """Distributed ``unique(a, axis=k)`` — unique subarrays along ``axis``
+    (reference manipulations.py:3077 resolves this with Alltoallv; here it
+    is three device programs + one scalar sync, the same shape as the 1-D
+    distributed unique):
+
+    1. canonicalize: resplit to ``split == axis`` if needed, move the axis
+       to the front (shard-local transpose), flatten trailing dims — a
+       zero-comm trailing reshape — giving (n, R) rows split=0;
+    2. :func:`_distributed_unique_rows` (lexicographic odd-even row sort →
+       neighbor row-equality mask → scatter+psum row compaction);
+    3. reshape/moveaxis the (U, R) result back around the original axis.
+    """
+    b = a if a.split == axis else resplit(a, axis)
+    if axis != 0:
+        b = moveaxis(b, axis, 0)
+    n = b.shape[0]
+    rest = b.shape[1:]
+    b2 = b if b.ndim == 2 else reshape(b, (n, builtins.int(np.prod(rest))))
+    vals2, inv = _distributed_unique_rows(b2, return_inverse)
+    u = vals2.shape[0]
+    res = vals2 if len(rest) == 1 else reshape(vals2, (u,) + rest)
+    if axis != 0:
+        res = moveaxis(res, 0, axis)
+    if return_inverse:
+        return res, inv
+    return res
+
+
+def _distributed_unique_rows(a: DNDarray, return_inverse: bool):
+    """Distributed unique of the rows of an (n, R) split=0 array.
+
+    The 1-D design (:func:`_distributed_unique`) generalized to rows: the
+    odd-even merge network sorts LEXICOGRAPHICALLY by the R columns plus the
+    global row index (``lax.sort`` takes them as R+1 key operands, so every
+    shape stays static), the boundary mask compares full neighbor rows with
+    plain ``!=`` (numpy's axis semantics keep NaN rows DISTINCT — unlike
+    the flat path's equal_nan collapse), and the compaction scatters whole
+    rows.
+    Only the scalar U reaches the host. Cost: p merge rounds x chunk rows,
+    then one O(U_pad * R) psum.
+    """
+    comm = a.comm
+    p = comm.size
+    n, R = a.shape
+    axis_name = comm.axis_name
+    spec2 = comm.spec(0, 2)
+    spec1 = comm.spec(0, 1)
+
+    fill = _sort_fill(a, False)
+    buf = a._masked(fill) if a.pad_count else a.larray
+    n_pad = buf.shape[0]
+    c = n_pad // p
+    idx0 = jax.lax.broadcasted_iota(jnp.int32, (n_pad,), 0)
+    perms = _oddeven_partner_perms(p)
+
+    def lexsort_block(vv, ii):
+        ops = tuple(vv[:, j] for j in range(R)) + (ii,)
+        out = jax.lax.sort(ops, dimension=0, num_keys=R + 1)
+        return jnp.stack(out[:R], axis=1), out[R]
+
+    def sort_kernel(v, i):
+        me = comm.axis_index()
+        v, i = lexsort_block(v, i)
+
+        def exchange(perm, vv, ii):
+            ov = comm.ppermute(vv, perm)
+            oi = comm.ppermute(ii, perm)
+            return lexsort_block(
+                jnp.concatenate([vv, ov], axis=0),
+                jnp.concatenate([ii, oi], axis=0),
+            )
+
+        def round_body(r, carry):
+            v, i = carry
+            b = r % 2
+            mv, mi = jax.lax.cond(
+                b == 0,
+                lambda t: exchange(perms[0], *t),
+                lambda t: exchange(perms[1], *t),
+                (v, i),
+            )
+            is_low = (me % 2 == b) & (me + 1 < p)
+            is_high = (me >= 1) & ((me - 1) % 2 == b)
+            sel_v = jnp.where(is_low, mv[:c], mv[c : 2 * c])
+            sel_i = jnp.where(is_low, mi[:c], mi[c : 2 * c])
+            return (
+                jnp.where(is_low | is_high, sel_v, v),
+                jnp.where(is_low | is_high, sel_i, i),
+            )
+
+        return jax.lax.fori_loop(0, p, round_body, (v, i))
+
+    vbuf, ibuf = jax.shard_map(
+        sort_kernel, mesh=comm.mesh, in_specs=(spec2, spec1),
+        out_specs=(spec2, spec1),
+    )(buf, idx0)
+
+    def mask_kernel(v, oi):
+        rank = comm.axis_index()
+        prev_last = jax.lax.ppermute(
+            v[-1:], axis_name, [(i, (i + 1) % p) for i in range(p)]
+        )
+        left = jnp.concatenate([prev_last, v[:-1]], axis=0)
+        # numpy's axis-unique keeps NaN rows DISTINCT (unlike the flat
+        # path's equal_nan collapse) — plain != matches that: NaN != NaN
+        # makes every NaN-bearing row a fresh group
+        neq = v != left
+        isf = jnp.any(neq, axis=1)
+        isf = isf.at[0].set(jnp.where(rank == 0, True, isf[0]))
+        isf = isf & (oi < n)  # sorted pad rows carry tail iota >= n
+        local_cum = jnp.cumsum(isf.astype(jnp.int64))
+        totals = jax.lax.all_gather(local_cum[-1], axis_name)
+        before = jnp.where(
+            jnp.arange(p, dtype=jnp.int64) < rank, totals, 0
+        ).sum()
+        gid = before + local_cum - 1
+        return isf, gid
+
+    isf_buf, gid_buf = jax.shard_map(
+        mask_kernel, mesh=comm.mesh, in_specs=(spec2, spec1),
+        out_specs=(spec1, spec1),
+    )(vbuf, ibuf)
+
+    u = builtins.int(jnp.sum(isf_buf))  # the one host sync: the output SIZE
+    cu = comm.chunk_size(u)
+    u_pad = cu * p
+    scatter_dt = jnp.int32 if vbuf.dtype == jnp.bool_ else vbuf.dtype
+
+    def compact_kernel(v, isf, gid):
+        rank = comm.axis_index()
+        tgt = jnp.where(isf, gid, u_pad)
+        contrib = jnp.zeros((u_pad, R), scatter_dt).at[tgt].set(
+            v.astype(scatter_dt), mode="drop"
+        )
+        full = jax.lax.psum(contrib, axis_name)
+        return jax.lax.dynamic_slice_in_dim(full, rank * cu, cu, axis=0).astype(v.dtype)
+
+    out_buf = jax.shard_map(
+        compact_kernel, mesh=comm.mesh, in_specs=(spec2, spec1, spec1),
+        out_specs=spec2,
+    )(vbuf, isf_buf, gid_buf)
+    res_ht = DNDarray(out_buf, (u, R), a.dtype, 0, a.device, a.comm, True)
+    if not return_inverse:
+        return res_ht, None
+
+    def inverse_kernel(orig_idx, gid):
+        rank = comm.axis_index()
+        tgt = jnp.where(orig_idx < n, orig_idx, n_pad)
+        contrib = jnp.zeros((n_pad,), jnp.int64).at[tgt].set(gid, mode="drop")
+        full = jax.lax.psum(contrib, axis_name)
+        return jax.lax.dynamic_slice_in_dim(full, rank * c, c)
+
+    inv_buf = jax.shard_map(
+        inverse_kernel, mesh=comm.mesh, in_specs=(spec1, spec1), out_specs=spec1
+    )(ibuf.astype(jnp.int64), gid_buf)
     inv_ht = DNDarray(inv_buf, (n,), types.int64, 0, a.device, a.comm, True)
     return res_ht, inv_ht
 
