@@ -456,9 +456,24 @@ class DNDarray:
 
     def redistribute_(self, lshape_map=None, target_map=None) -> None:
         """API-parity shim (reference dndarray.py:1007 reshuffles to an
-        arbitrary ragged target map). The tail-pad layout admits exactly one
-        physical layout per (gshape, split); any canonical target is already
-        satisfied, non-canonical targets are not representable on XLA."""
+        arbitrary ragged target map via MPI p2p).
+
+        FORMALLY CLOSED for ragged targets — the design decision is
+        documented in PARITY.md ("redistribute_ and ragged target maps"):
+        the XLA layout model admits exactly one physical layout per
+        (gshape, split, mesh) — equal ceil-rule shards with a tail pad —
+        so "rank 0 holds 7 rows, rank 1 holds 2" has no representation to
+        redistribute *to*; any compiled op would relayout it back first.
+        Every layout this framework can produce IS the canonical map, so:
+
+        * a canonical ``target_map`` (or None) is already satisfied —
+          accepted as a no-op, matching the reference's fast path;
+        * a non-canonical map raises NotImplementedError naming the
+          supported relayouts (``resplit_`` to change the axis,
+          ``balance_`` to canonicalize ragged ``is_split`` inputs) —
+          deliberate imbalance on TPU meshes is expressed by reshaping
+          the mesh or masking work, not by ragged shards.
+        """
         if target_map is None:
             return None
         want = np.asarray(target_map)
@@ -466,8 +481,13 @@ class DNDarray:
         if want.shape == have.shape and (want == have).all():
             return None
         raise NotImplementedError(
-            "arbitrary ragged layouts are not representable in the XLA tail-pad "
-            "layout; resplit_/balance_ cover the canonical cases"
+            "redistribute_ to a non-canonical (ragged) lshape_map is "
+            "formally closed on the XLA tail-pad layout — every sharded "
+            "dim has exactly one physical layout per (gshape, split, "
+            "mesh); see PARITY.md 'redistribute_ and ragged target maps'. "
+            "Use resplit_() to change the distribution axis or balance_() "
+            "to canonicalize; deliberate imbalance is expressed via mesh "
+            "shape or masking, not ragged shards"
         )
 
     def create_lshape_map(self, force_check: bool = False) -> np.ndarray:

@@ -50,7 +50,99 @@ try:  # pragma: no cover
 
     __NETCDF = True
 except ImportError:
+    netCDF4 = None
     __NETCDF = False
+
+try:  # pragma: no cover — NetCDF-3 fallback backend when netCDF4 is absent
+    from scipy.io import netcdf_file as _scipy_netcdf
+
+    __NETCDF_SCIPY = True
+except ImportError:
+    _scipy_netcdf = None
+    __NETCDF_SCIPY = False
+
+# unmangled aliases for use inside the adapter class bodies (a leading-__
+# module global would name-mangle to _NcRead__NETCDF there)
+_HAS_NC4 = __NETCDF
+_HAS_NC_SCIPY = __NETCDF_SCIPY
+_HAS_H5 = __HDF5
+
+
+class _NcRead:
+    """Read adapter over the available NetCDF backend: netCDF4 when
+    installed, else scipy.io (classic NetCDF-3), else h5py (NetCDF-4 files
+    ARE HDF5 files, so simple variables read fine). Variables expose
+    ``.shape`` and numpy-yielding ``__getitem__`` in every branch."""
+
+    def __init__(self, path: str):
+        if _HAS_NC4:
+            self._h = netCDF4.Dataset(path, "r")
+            self._get = lambda name: self._h[name]
+        elif _HAS_NC_SCIPY:
+            try:
+                self._h = _scipy_netcdf(path, "r", mmap=False)
+                self._get = lambda name: self._h.variables[name]
+            except Exception:
+                # not classic format — likely a NetCDF-4 (HDF5) file
+                if not _HAS_H5:
+                    raise
+                self._h = h5py.File(path, "r")
+                self._get = lambda name: self._h[name]
+        else:  # pragma: no cover — supports_netcdf() gates callers
+            raise RuntimeError(
+                "netcdf is required for this operation "
+                "(neither netCDF4 nor scipy is available)"
+            )
+
+    def var(self, name: str):
+        return self._get(name)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self._h.close()
+        return False
+
+
+class _NcWrite:
+    """Write adapter: netCDF4 when installed, else scipy.io NetCDF-3
+    (classic dtypes only — i8/i16/i32/f32/f64; int64 raises the backend's
+    own clear error). ``mode`` follows the netCDF4 convention ('w' create,
+    'r+' modify)."""
+
+    def __init__(self, path: str, mode: str):
+        if _HAS_NC4:
+            self._h = netCDF4.Dataset(path, mode)
+        elif _HAS_NC_SCIPY:
+            self._h = _scipy_netcdf(
+                path, "w" if mode == "w" else "a", mmap=False
+            )
+        else:  # pragma: no cover — supports_netcdf() gates callers
+            raise RuntimeError(
+                "netcdf is required for this operation "
+                "(neither netCDF4 nor scipy is available)"
+            )
+
+    def create(self, variable: str, dtype, shape):
+        dims = []
+        for i, s in enumerate(shape):
+            name = f"{variable}_dim{i}"
+            self._h.createDimension(name, int(s))
+            dims.append(name)
+        return self._h.createVariable(variable, dtype, tuple(dims))
+
+    def var(self, name: str):
+        if _HAS_NC4:
+            return self._h[name]
+        return self._h.variables[name]
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self._h.close()
+        return False
 
 
 def supports_hdf5() -> bool:
@@ -69,8 +161,9 @@ def supports_checkpoint() -> bool:
 
 
 def supports_netcdf() -> bool:
-    """Whether netCDF4 is available (reference io.py `supports_netcdf`)."""
-    return __NETCDF
+    """Whether a NetCDF backend is available (reference io.py
+    `supports_netcdf`): netCDF4, or the scipy.io NetCDF-3 fallback."""
+    return __NETCDF or __NETCDF_SCIPY
 
 
 def load(path: str, *args, **kwargs) -> DNDarray:
@@ -480,14 +573,17 @@ def load_netcdf(
 
     Multi-host with ``split``: per-process slab reads + ``is_split``
     assembly, same design as :func:`load_hdf5`."""
-    if not __NETCDF:
-        raise RuntimeError("netcdf is required for this operation (netCDF4 not available)")
+    if not supports_netcdf():
+        raise RuntimeError(
+            "netcdf is required for this operation "
+            "(neither netCDF4 nor scipy is available)"
+        )
     import jax
 
     if jax.process_count() > 1 and split is not None:
         c = sanitize_comm(comm)
-        with netCDF4.Dataset(path, "r") as handle:
-            var = handle[variable]
+        with _NcRead(path) as handle:
+            var = handle.var(variable)
             gshape = tuple(var.shape)
             split_s = sanitize_axis(gshape, split)
             lo, hi = _process_slab(c, gshape[split_s])
@@ -496,8 +592,8 @@ def load_netcdf(
             block = np.asarray(var[tuple(sl)])
         return _array(block, dtype=dtype, is_split=split_s, device=device, comm=comm)
 
-    with netCDF4.Dataset(path, "r") as handle:
-        data = np.asarray(handle[variable][:])
+    with _NcRead(path) as handle:
+        data = np.asarray(handle.var(variable)[:])
     return _array(data, dtype=dtype, split=split, device=device, comm=comm)
 
 
@@ -507,8 +603,11 @@ def save_netcdf(data: DNDarray, path: str, variable: str, mode: str = "w", **kwa
     Multi-host with a split array: process 0 creates dimensions + variable
     at the global shape, then per-process slab writes (serialized, no
     gather), as in :func:`save_hdf5`."""
-    if not __NETCDF:
-        raise RuntimeError("netcdf is required for this operation (netCDF4 not available)")
+    if not supports_netcdf():
+        raise RuntimeError(
+            "netcdf is required for this operation "
+            "(neither netCDF4 nor scipy is available)"
+        )
     import jax
 
     if jax.process_count() > 1 and data.split is not None:
@@ -518,16 +617,11 @@ def save_netcdf(data: DNDarray, path: str, variable: str, mode: str = "w", **kwa
         sl[data.split] = slice(lo, hi)
 
         def write(p):
-            with netCDF4.Dataset(path, mode if p == 0 else "r+") as handle:
+            with _NcWrite(path, mode if p == 0 else "r+") as handle:
                 if p == 0:
-                    dims = []
-                    for i, s in enumerate(gshape):
-                        name = f"{variable}_dim{i}"
-                        handle.createDimension(name, s)
-                        dims.append(name)
-                    handle.createVariable(variable, block.dtype, tuple(dims))
+                    handle.create(variable, block.dtype, gshape)
                 if hi > lo:
-                    handle[variable][tuple(sl)] = block
+                    handle.var(variable)[tuple(sl)] = block
 
         _serialized_slab_write(write, f"nc:{variable}")
         return
@@ -544,20 +638,15 @@ def save_netcdf(data: DNDarray, path: str, variable: str, mode: str = "w", **kwa
 
 def save_netcdf_local(data: DNDarray, path: str, variable: str, mode: str = "w", **kwargs):
     """Single-writer NetCDF save (the local body of :func:`save_netcdf`)."""
-    with netCDF4.Dataset(path, mode) as handle:
+    with _NcWrite(path, mode) as handle:
         np_data = data.numpy()
-        dims = []
-        for i, s in enumerate(np_data.shape):
-            name = f"{variable}_dim{i}"
-            handle.createDimension(name, s)
-            dims.append(name)
-        var = handle.createVariable(variable, np_data.dtype, tuple(dims))
+        var = handle.create(variable, np_data.dtype, np_data.shape)
         var[:] = np_data
 
 
 if __HDF5:
     __all__ += ["load_hdf5", "save_hdf5"]
-if __NETCDF:
+if supports_netcdf():
     __all__ += ["load_netcdf", "save_netcdf"]
 
 

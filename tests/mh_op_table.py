@@ -214,8 +214,9 @@ OPS = [
 
 
 def _unique_rows(ht, np, c):
-    # X = arange(30).reshape(10, 3): all rows distinct; duplicate by % 4
-    rows = ht.floor(c["X"] / 12.0)  # 10 rows, values 0/1/2 -> 3 unique rows...
+    # X = arange(30).reshape(10, 3); floor(X/12) collapses the 10 rows to
+    # exactly 3 distinct constant rows ([0,0,0], [1,1,1], [2,2,2])
+    rows = ht.floor(c["X"] / 12.0)
     u = ht.unique(rows, axis=0)
     assert u.shape[1] == 3 and u.split == 0, (u.shape, u.split)
     got = np.unique(np.floor(np.arange(30).reshape(10, 3) / 12.0), axis=0)

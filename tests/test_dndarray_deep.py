@@ -107,6 +107,21 @@ class TestRedistribute(TestCase):
         x.redistribute_(target_map=target)  # identity target: values intact
         self.assert_array_equal(x, a)
 
+    def test_ragged_target_map_formally_closed(self):
+        # PARITY.md "redistribute_ and ragged target maps": non-canonical
+        # targets raise, naming the supported relayouts
+        p = self.comm.size
+        if p < 2:
+            pytest.skip("needs >= 2 devices for a ragged map")
+        a = np.arange(4 * p, dtype=np.float32)
+        x = ht.array(a, split=0)
+        ragged = x.lshape_map.copy()
+        ragged[0, 0] += 1
+        ragged[1, 0] -= 1
+        with pytest.raises(NotImplementedError, match="resplit_"):
+            x.redistribute_(target_map=ragged)
+        self.assert_array_equal(x, a)  # untouched after the refusal
+
     def test_balance_on_balanced_noop(self):
         a = np.arange(3 * self.comm.size + 1, dtype=np.float32)
         x = ht.array(a, split=0)

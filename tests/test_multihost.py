@@ -243,6 +243,27 @@ if ht.supports_hdf5():
     else:
         raise AssertionError("multi-host save_csv split=1 must raise")
 
+# ======= stage 4b: sharded NetCDF I/O — slab reads + serialized writes ====
+if ht.supports_netcdf():
+    R, C = 11, 3
+    ref_nc = np.arange(R * C, dtype=np.float32).reshape(R, C)
+    nc_out = csv_path + ".out.nc"
+    # save from a split=0 array: process-ordered slab writes, no gather
+    Anc = ht.array(ref_nc, split=0)
+    ht.save_netcdf(Anc, nc_out, "data")
+    # load split=0/1: per-process slab range reads + is_split assembly
+    L0 = ht.load_netcdf(nc_out, "data", split=0)
+    assert L0.shape == (R, C) and L0.split == 0, (L0.shape, L0.split)
+    assert abs(float(ht.sum(L0).item()) - float(ref_nc.sum())) < 1e-2
+    L1 = ht.load_netcdf(nc_out, "data", split=1)
+    assert L1.shape == (R, C) and L1.split == 1
+    assert abs(float(ht.sum(L1).item()) - float(ref_nc.sum())) < 1e-2
+    # replicated multi-host save: exactly one writer
+    nc_rep = csv_path + ".rep.nc"
+    ht.save_netcdf(ht.array(ref_nc[:4]), nc_rep, "data")
+    Lr = ht.load_netcdf(nc_rep, "data")
+    assert abs(float(ht.sum(Lr).item()) - float(ref_nc[:4].sum())) < 1e-2
+
 # ======= stage 5: npy slab I/O — memmap reads, slab writes ================
 npy_path = csv_path + ".npy"
 ref_npy = np.arange(11 * 3, dtype=np.float32).reshape(11, 3)
