@@ -102,9 +102,32 @@ class KMeans(_KCluster):
 
         dt, xb, w, centers = self._fit_buffers(x)
 
-        centers, labels, inertia, n_iter = _lloyd_fit(
-            xb, w, centers, self.max_iter, jnp.asarray(self.tol, xb.dtype)
-        )
+        from .pallas_lloyd import lloyd_fit_pallas, pallas_lloyd_applicable
+
+        done = False
+        if pallas_lloyd_applicable(
+            x.comm.size, x.shape[1], self.n_clusters, xb.dtype
+        ):
+            # fused single-pass-over-X Lloyd update (see pallas_lloyd);
+            # Mosaic failure degrades to the XLA fit rather than erroring
+            try:
+                p_out = lloyd_fit_pallas(
+                    xb, centers, x.shape[0], self.max_iter,
+                    jnp.asarray(self.tol, xb.dtype),
+                )
+                # materialize INSIDE the try — async TPU runtime faults
+                # surface lazily and must trigger the fallback here
+                jax.block_until_ready(p_out)
+                centers, labels, inertia, n_iter = p_out
+                done = True
+            except Exception as e:  # pragma: no cover — TPU-runtime only
+                import warnings
+
+                warnings.warn(f"pallas kmeans fell back to XLA: {e!r}")
+        if not done:
+            centers, labels, inertia, n_iter = _lloyd_fit(
+                xb, w, centers, self.max_iter, jnp.asarray(self.tol, xb.dtype)
+            )
         n_iter = int(n_iter)
 
         self._cluster_centers = DNDarray.from_logical(centers, None, x.device, x.comm, dt)

@@ -1,0 +1,180 @@
+"""Fused Pallas TPU kernel for the Lloyd (K-Means) iteration.
+
+The XLA Lloyd step (:func:`heat_tpu.cluster.kmeans._lloyd_step`) is already
+one compiled program, but it materializes two (n, k) f32 intermediates per
+iteration (the distance matrix and the one-hot matrix) — at bench shapes
+(n=2M, d=k=64) that is ~5 HBM round trips over X's own footprint, and the
+r4 bench measured 4.5 TF/s counted against a ~50 TF/s bandwidth roofline.
+
+This kernel runs the whole accumulation in one pass over X: for each row
+block the assignment scores, argmin, and the (k, d)/(k,) sums+counts
+updates all happen on the tile while it is in VMEM — X is read exactly
+ONCE per Lloyd iteration and nothing (n, k)-sized ever touches HBM.
+
+Two MXU dots per block (scores: (bm,d)x(d,k); update: (k,bm)x(bm,d)), both
+with f32 accumulation. The argmin drops the ||x||^2 term (constant per
+row — it cannot change the winner), so scores are just c2 - 2 x.c at
+``Precision.HIGH`` (the bf16x3 guard from ``_kcluster._d2``).
+
+Scope: single-device TPU fits (the bench configuration; multi-device fits
+keep the XLA path, whose per-iteration psum XLA already places well). The
+final labels/inertia pass stays on the XLA `_d2` form — one extra pass
+at the end of the fit is noise across max_iter iterations.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["lloyd_fit_pallas", "pallas_lloyd_applicable"]
+
+_I0 = np.int32(0)  # i32 index-map literal (jax_enable_x64 guard)
+_MAX_D = 512
+_MAX_K = 1024
+
+
+def _round_up(v: int, m: int) -> int:
+    return -(-v // m) * m
+
+
+def _lloyd_kernel(
+    x_ref, c_ref, sums_ref, counts_ref, sums_s, counts_s, *, n, bm, k
+):
+    """Grid = (num_row_blocks,), sequential. Scratch (sums, counts)
+    accumulates across blocks; written out at the last block."""
+    i = pl.program_id(0)
+    nb = pl.num_programs(0)
+
+    @pl.when(i == 0)
+    def _init():
+        sums_s[:] = jnp.zeros_like(sums_s)
+        counts_s[:] = jnp.zeros_like(counts_s)
+
+    xb = x_ref[:]  # (bm, dp) f32
+    c = c_ref[:]  # (kp, dp) f32
+    dot = jax.lax.dot_general(
+        xb, c, (((1,), (1,)), ((), ())),
+        precision=jax.lax.Precision.HIGH,
+        preferred_element_type=jnp.float32,
+    )  # (bm, kp)
+    c2 = jnp.sum(c * c, axis=1)[None, :]  # (1, kp)
+    score = c2 - jnp.float32(2.0) * dot  # argmin-equivalent to d2
+    jidx = jax.lax.broadcasted_iota(jnp.int32, score.shape, 1)
+    score = jnp.where(jidx < k, score, jnp.float32(3.4e38))  # mask center pads
+    labels = jnp.argmin(score, axis=1)[:, None]  # (bm, 1)
+    row = i * bm + jax.lax.broadcasted_iota(jnp.int32, (bm, 1), 0)
+    valid = row < n  # global tail pads drop out of sums and counts
+    onehot = jnp.where(
+        (labels == jidx) & valid, jnp.float32(1.0), jnp.float32(0.0)
+    )  # (bm, kp)
+    sums_s[:] += jax.lax.dot_general(
+        onehot, xb, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # (kp, dp)
+    counts_s[:] += jnp.broadcast_to(
+        jnp.sum(onehot, axis=0, keepdims=True), counts_s.shape
+    )
+
+    @pl.when(i == nb - 1)
+    def _flush():
+        sums_ref[:] = sums_s[:]
+        counts_ref[:] = counts_s[:]
+
+
+def _lloyd_update(x, centers_pad, n, k, bm, interpret):
+    """One fused accumulation pass: (sums (kp, dp), counts (8, kp)).
+    ``x`` must already be padded to (mp, dp) with mp % bm == 0;
+    ``centers_pad`` to (kp, dp)."""
+    mp, dp = x.shape
+    kp = centers_pad.shape[0]
+    return pl.pallas_call(
+        functools.partial(_lloyd_kernel, n=n, bm=bm, k=k),
+        grid=(mp // bm,),
+        in_specs=[
+            pl.BlockSpec((bm, dp), lambda i: (i, _I0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((kp, dp), lambda i: (_I0, _I0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((kp, dp), lambda i: (_I0, _I0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((8, kp), lambda i: (_I0, _I0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((kp, dp), jnp.float32),
+            jax.ShapeDtypeStruct((8, kp), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((kp, dp), jnp.float32),
+            pltpu.VMEM((8, kp), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+        ),
+        interpret=interpret,
+    )(x, centers_pad)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("n", "max_iter", "block_m", "interpret")
+)
+def lloyd_fit_pallas(
+    xb: jax.Array,
+    centers0: jax.Array,
+    n: int,
+    max_iter: int,
+    tol,
+    block_m: int = 512,
+    interpret: bool = False,
+):
+    """The whole K-Means fit with the fused update kernel inside a
+    `lax.while_loop`; returns (centers (k, d), labels (m,), inertia,
+    n_iter) with the same semantics as `kmeans._lloyd_fit` (labels/inertia
+    from one final XLA `_d2` pass over the converged centers)."""
+    from ._kcluster import _d2
+
+    m, d = xb.shape
+    k = centers0.shape[0]
+    dp, kp = _round_up(d, 128), _round_up(k, 128)
+    bm = min(block_m, _round_up(m, 8))
+    mp = _round_up(m, bm)
+    xp = jnp.pad(xb.astype(jnp.float32), ((0, mp - m), (0, dp - d)))
+    c0 = jnp.pad(centers0.astype(jnp.float32), ((0, kp - k), (0, dp - d)))
+
+    def cond(carry):
+        _, it, shift = carry
+        return jnp.logical_and(it < max_iter, shift > tol)
+
+    def body(carry):
+        c, it, _ = carry
+        sums, counts = _lloyd_update(xp, c, n, k, bm, interpret)
+        cnt = counts[0:1, :].T  # (kp, 1); center pads stay 0
+        new_c = jnp.where(cnt > 0, sums / jnp.maximum(cnt, 1.0), c)
+        shift = jnp.sum((new_c - c) ** 2)
+        return new_c, it + 1, shift
+
+    cpad, n_iter, _ = jax.lax.while_loop(
+        cond, body, (c0, jnp.int32(0), jnp.asarray(jnp.inf, jnp.float32))
+    )
+    centers = cpad[:k, :d].astype(xb.dtype)
+    # final assignment on the XLA form (one pass; exact d2 for inertia)
+    w = (jnp.arange(m) < n).astype(xb.dtype)
+    d2 = _d2(xb, centers)
+    labels = jnp.argmin(d2, axis=1)
+    inertia = jnp.sum(jnp.min(d2, axis=1) * w)
+    return centers, labels, inertia, n_iter
+
+
+def pallas_lloyd_applicable(comm_size: int, d: int, k: int, jnp_dtype) -> bool:
+    """Single-device TPU f32 fits with blocks that fit VMEM."""
+    return (
+        jax.default_backend() == "tpu"
+        and comm_size == 1
+        and d <= _MAX_D
+        and k <= _MAX_K
+        and jnp_dtype == jnp.float32
+    )

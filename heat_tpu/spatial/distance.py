@@ -18,6 +18,7 @@ On TPU two paths replace it:
 
 from __future__ import annotations
 
+import warnings
 from functools import partial
 from typing import Callable, Optional
 
@@ -128,9 +129,37 @@ def _ring_dist(x: DNDarray, y: DNDarray, block_fn: Callable) -> jax.Array:
     )(xm, ym)
 
 
-def _dist(x: DNDarray, y: Optional[DNDarray], block_fn: Callable, ring_ok: bool, ring: bool) -> DNDarray:
+def _pallas_local(comm, xbuf: jax.Array, yb: jax.Array, epilogue: str, gamma: float) -> jax.Array:
+    """Fused Pallas euclidean kernel over the local path's layout: x rows
+    (possibly sharded split=0), y replicated. Single mesh: one call;
+    multi-device: shard_map over the row shards (each computes its
+    (local_rows, n) slab — the same decomposition as `_local_dist`, with
+    the whole epilogue fused into the GEMM output tile)."""
+    from .pallas_cdist import euclid_pallas
+
+    if comm.size == 1:
+        return euclid_pallas(xbuf, yb, gamma, epilogue=epilogue)
+    spec = comm.spec(0, 2)
+    return jax.shard_map(
+        lambda xb, yy: euclid_pallas(xb, yy, gamma, epilogue=epilogue),
+        mesh=comm.mesh,
+        in_specs=(spec, comm.spec(None, 2)),
+        out_specs=spec,
+    )(xbuf, yb)
+
+
+def _dist(
+    x: DNDarray,
+    y: Optional[DNDarray],
+    block_fn: Callable,
+    ring_ok: bool,
+    ring: bool,
+    rbf_gamma: Optional[float] = None,
+) -> DNDarray:
     """Distance engine (reference distance.py:209): result is
-    (n_x, n_y) distributed along the rows of x."""
+    (n_x, n_y) distributed along the rows of x. ``rbf_gamma`` composes the
+    Gaussian-kernel epilogue — fused into the Pallas tile when that path
+    runs, one extra compiled exp pass otherwise."""
     if not isinstance(x, DNDarray):
         raise TypeError(f"x must be a DNDarray, but was {type(x)}")
     if x.ndim != 2:
@@ -159,6 +188,11 @@ def _dist(x: DNDarray, y: Optional[DNDarray], block_fn: Callable, ring_ok: bool,
         and y.split == 0
         and x.comm.size > 1
     )
+    def _finish(out):
+        if rbf_gamma is not None:
+            out = _rbf_from_dist(out, jnp.asarray(rbf_gamma, out.dtype))
+        return DNDarray(out, (m, n), promoted, out_split, x.device, x.comm, True)
+
     if use_ring:
         # ring kernel works on the padded buffers; x pad rows land in output
         # pad rows, y pad columns are sliced off below
@@ -168,14 +202,44 @@ def _dist(x: DNDarray, y: Optional[DNDarray], block_fn: Callable, ring_ok: bool,
         yw = DNDarray(ym, y.shape, promoted, 0, y.device, y.comm, True)
         out = _ring_dist(xw, yw, block_fn)
         out = out[:, :n]
-        return DNDarray(out, (m, n), promoted, out_split, x.device, x.comm, True)
+        return _finish(out)
 
     # y's logical rows become output COLUMNS, whole on every row-shard (the
     # replicated-centers pattern): replicate via the compiled relayout when
     # y is split — multi-host safe, unlike the host-logical view
     yb = y._relayout(None) if y.split is not None else y.larray
+
+    if block_fn is _quadratic_euclidean:
+        from .pallas_cdist import pallas_cdist_applicable
+
+        # multi-device needs x row-SHARDED (the shard_map decomposition);
+        # a replicated x on a >1-device mesh keeps the XLA path
+        layout_ok = x.comm.size == 1 or x.split == 0
+        if layout_ok and pallas_cdist_applicable(x.shape[1], promoted.jnp_type()):
+            epi = "rbf" if rbf_gamma is not None else "dist"
+            try:
+                out = _pallas_local(
+                    x.comm,
+                    x.larray.astype(promoted.jnp_type()),
+                    yb.astype(promoted.jnp_type()),
+                    epi,
+                    0.0 if rbf_gamma is None else float(rbf_gamma),
+                )
+                # force materialization INSIDE the try: Mosaic/TPU runtime
+                # faults surface lazily and must trigger the fallback here,
+                # not at the caller's first read
+                jax.block_until_ready(out)
+            except Exception as e:  # pragma: no cover — TPU-runtime only
+                # Mosaic lowering/runtime failure must degrade to the XLA
+                # form, not kill the workload
+                warnings.warn(f"pallas cdist fell back to XLA: {e!r}")
+            else:
+                return DNDarray(
+                    out, (m, n), promoted, out_split, x.device, x.comm, True
+                )
+
     out = _local_dist(block_fn, x.larray, yb, promoted.jnp_type())
-    return DNDarray(out, (m, n), promoted, out_split, x.device, x.comm, True)
+    return _finish(out)
 
 
 def cdist(X: DNDarray, Y: Optional[DNDarray] = None, quadratic_expansion: bool = False, ring: bool = False) -> DNDarray:
@@ -200,8 +264,11 @@ def rbf(
     quadratic_expansion: bool = False,
     ring: bool = False,
 ) -> DNDarray:
-    """Gaussian kernel matrix exp(−‖x−y‖²/2σ²) (reference distance.py:159)."""
+    """Gaussian kernel matrix exp(−‖x−y‖²/2σ²) (reference distance.py:159).
+
+    On TPU with the GEMM form, the exp epilogue fuses into the Pallas
+    distance tile (no separate m×n exp pass); elsewhere it is one extra
+    compiled pass over the distance matrix."""
     gamma = 1.0 / (2.0 * sigma * sigma)
-    d = cdist(X, Y, quadratic_expansion=quadratic_expansion, ring=ring)
-    out = _rbf_from_dist(d.larray, jnp.asarray(gamma, d.larray.dtype))
-    return DNDarray(out, d.shape, d.dtype, d.split, d.device, d.comm, True)
+    fn = _quadratic_euclidean if quadratic_expansion else _blocked_euclidean
+    return _dist(X, Y, fn, ring_ok=True, ring=ring, rbf_gamma=gamma)

@@ -1,0 +1,69 @@
+"""Correctness of the fused Pallas Lloyd kernel via the Pallas interpreter:
+the full pallas fit must agree with the XLA `_lloyd_fit` (same centers,
+labels, inertia) from the same start — they implement the same math."""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from heat_tpu.cluster.kmeans import _lloyd_fit
+from heat_tpu.cluster.pallas_lloyd import lloyd_fit_pallas
+
+
+def _blobs(n, d, k, seed):
+    rng = np.random.default_rng(seed)
+    protos = rng.standard_normal((k, d)).astype(np.float32) * 6.0
+    lab = rng.integers(0, k, n)
+    return (protos[lab] + rng.standard_normal((n, d)).astype(np.float32)), protos
+
+
+class TestPallasLloydInterpret:
+    def _agree(self, n, d, k, pad_rows, seed, block_m=64):
+        x, protos = _blobs(n, d, k, seed)
+        # emulate the tail-pad invariant: pad rows are zeros, weights drop them
+        xp = np.vstack([x, np.zeros((pad_rows, d), np.float32)])
+        w = (np.arange(n + pad_rows) < n).astype(np.float32)
+        c0 = x[:k].copy()
+
+        want_c, want_l, want_i, want_it = _lloyd_fit(
+            jnp.asarray(xp), jnp.asarray(w), jnp.asarray(c0), 20, jnp.float32(0.0)
+        )
+        got_c, got_l, got_i, got_it = lloyd_fit_pallas(
+            jnp.asarray(xp), jnp.asarray(c0), n, 20, jnp.float32(0.0),
+            block_m=block_m, interpret=True,
+        )
+        np.testing.assert_allclose(np.asarray(got_c), np.asarray(want_c),
+                                   rtol=1e-4, atol=1e-4)
+        np.testing.assert_array_equal(
+            np.asarray(got_l)[:n], np.asarray(want_l)[:n]
+        )
+        np.testing.assert_allclose(float(got_i), float(want_i), rtol=1e-3)
+
+    def test_small_blocked(self):
+        # several row blocks, ragged tail pad, k and d far from tile sizes
+        self._agree(n=300, d=5, k=7, pad_rows=20, seed=0)
+
+    def test_k_above_lanes(self):
+        self._agree(n=257, d=3, k=9, pad_rows=7, seed=1)
+
+    def test_no_padding_needed(self):
+        self._agree(n=256, d=8, k=4, pad_rows=0, seed=2, block_m=128)
+
+    def test_empty_cluster_keeps_center(self):
+        # a far-away initial center captures nothing; both paths must keep it
+        x = np.vstack([
+            np.zeros((50, 2), np.float32),
+            np.ones((50, 2), np.float32) * 2.0,
+        ])
+        c0 = np.array([[0.0, 0.0], [2.0, 2.0], [100.0, 100.0]], np.float32)
+        got_c, got_l, _, _ = lloyd_fit_pallas(
+            jnp.asarray(x), jnp.asarray(c0), 100, 5, jnp.float32(0.0),
+            block_m=32, interpret=True,
+        )
+        want_c, want_l, _, _ = _lloyd_fit(
+            jnp.asarray(x), jnp.ones((100,), jnp.float32), jnp.asarray(c0),
+            5, jnp.float32(0.0),
+        )
+        np.testing.assert_allclose(np.asarray(got_c), np.asarray(want_c),
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_array_equal(np.asarray(got_l), np.asarray(want_l))
