@@ -1,0 +1,127 @@
+"""Pallas TPU kernel: single-HBM-read column moments (mean + M2).
+
+``ht.var`` is the numerically-safe two-pass form (mean, then centered
+square sum) — under one jit that is two full HBM reads of X, capping the
+statistical-moments benchmark at ~50% of the bandwidth roofline. This
+kernel computes both moments in ONE pass using the chunk-parallel Welford
+combine (the same merge rule the reference applies across MPI ranks,
+statistics.py:803-828, applied here across row blocks): each block's
+(count, mean, M2) is computed stably in VMEM and merged into running
+accumulators — X is read exactly once and the result matches the two-pass
+form to f32 accuracy (no E[x^2]-E[x]^2 cancellation).
+
+Wired into :func:`heat_tpu.core.statistics.var` (and through it ``std``)
+for the single-device TPU f32 axis-0 reduction on 2-D arrays — the
+benchmark shape and the common "feature statistics" case. Everything else
+keeps the two-pass form.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["column_moments", "pallas_moments_applicable"]
+
+_I0 = np.int32(0)
+_MAX_D = 4096  # (bm, dp) f32 block + 4 (8, dp) accumulators must fit VMEM
+
+
+def _round_up(v: int, m: int) -> int:
+    return -(-v // m) * m
+
+
+def _moments_kernel(x_ref, mean_ref, m2_ref, mean_s, m2_s, cnt_s, *, n, bm):
+    """Grid = (num_row_blocks,), sequential; Welford-combine across blocks."""
+    i = pl.program_id(0)
+    nb = pl.num_programs(0)
+
+    @pl.when(i == 0)
+    def _init():
+        mean_s[:] = jnp.zeros_like(mean_s)
+        m2_s[:] = jnp.zeros_like(m2_s)
+        cnt_s[0] = jnp.float32(0.0)
+
+    xb = x_ref[:]  # (bm, dp) f32
+    row = i * bm + jax.lax.broadcasted_iota(jnp.int32, (bm, 1), 0)
+    valid = (row < n).astype(jnp.float32)  # (bm, 1); zero rows drop out
+    nv = jnp.sum(valid)  # block count (scalar f32)
+
+    @pl.when(nv > 0)
+    def _combine():
+        xv = xb * valid
+        bsum = jnp.sum(xv, axis=0, keepdims=True)  # (1, dp)
+        bmean = bsum / nv
+        d = (xb - bmean) * valid
+        bm2 = jnp.sum(d * d, axis=0, keepdims=True)  # (1, dp)
+        cnt = cnt_s[0]
+        tot = cnt + nv
+        delta = bmean - mean_s[0:1, :]
+        mean_new = mean_s[0:1, :] + delta * (nv / tot)
+        m2_new = m2_s[0:1, :] + bm2 + delta * delta * (cnt * nv / tot)
+        mean_s[:] = jnp.broadcast_to(mean_new, mean_s.shape)
+        m2_s[:] = jnp.broadcast_to(m2_new, m2_s.shape)
+        cnt_s[0] = tot
+
+    @pl.when(i == nb - 1)
+    def _flush():
+        mean_ref[:] = mean_s[:]
+        m2_ref[:] = m2_s[:]
+
+
+@functools.partial(jax.jit, static_argnames=("n", "block_m", "interpret"))
+def column_moments(
+    x: jax.Array, n: int, block_m: int = 1024, interpret: bool = False
+):
+    """(mean (d,), M2 (d,)) over the first axis of an (m, d) f32 array,
+    counting only the first ``n`` rows (tail-pad aware). One HBM read."""
+    m, d = x.shape
+    dp = _round_up(d, 128)
+    bm = min(block_m, _round_up(m, 8))
+    mp = _round_up(m, bm)
+    if (mp, dp) != (m, d):
+        x = jnp.pad(x.astype(jnp.float32), ((0, mp - m), (0, dp - d)))
+    else:
+        x = x.astype(jnp.float32)
+    mean_o, m2_o = pl.pallas_call(
+        functools.partial(_moments_kernel, n=n, bm=bm),
+        grid=(mp // bm,),
+        in_specs=[
+            pl.BlockSpec((bm, dp), lambda i: (i, _I0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((8, dp), lambda i: (_I0, _I0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((8, dp), lambda i: (_I0, _I0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((8, dp), jnp.float32),
+            jax.ShapeDtypeStruct((8, dp), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((8, dp), jnp.float32),
+            pltpu.VMEM((8, dp), jnp.float32),
+            pltpu.SMEM((1,), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+        ),
+        interpret=interpret,
+    )(x)
+    return mean_o[0, :d], m2_o[0, :d]
+
+
+def pallas_moments_applicable(comm_size: int, ndim: int, axis, d: int, jnp_dtype) -> bool:
+    """Single-device TPU f32 axis-0 reductions on 2-D arrays."""
+    return (
+        jax.default_backend() == "tpu"
+        and comm_size == 1
+        and ndim == 2
+        and axis == 0
+        and d <= _MAX_D
+        and jnp_dtype == jnp.float32
+    )

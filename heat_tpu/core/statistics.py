@@ -645,6 +645,35 @@ def var(x: DNDarray, axis=None, ddof: int = 0, keepdims: bool = False) -> DNDarr
         raise ValueError(f"ddof must be integer, is {type(ddof)}")
     if ddof not in (0, 1):
         raise ValueError("Heat currently supports ddof of 0 or 1 only")
+
+    # single-device TPU f32 axis-0 on 2-D: one-HBM-read Welford kernel
+    # (pallas_moments) instead of the two-read two-pass form
+    if (
+        axis == 0
+        and not keepdims
+        and x.split in (None, 0)
+        and isinstance(x, DNDarray)
+    ):
+        from .pallas_moments import column_moments, pallas_moments_applicable
+
+        if pallas_moments_applicable(
+            x.comm.size, x.ndim, 0, x.shape[1], x.larray.dtype
+        ):
+            try:
+                _mu, m2 = column_moments(x.larray, x.shape[0])
+                import jax
+
+                jax.block_until_ready(m2)  # surface Mosaic faults HERE
+                out = m2 / (x.shape[0] - ddof)
+                return DNDarray.from_logical(
+                    out, None, x.device, x.comm,
+                    types.canonical_heat_type(out.dtype),
+                )
+            except Exception as e:  # pragma: no cover — TPU-runtime only
+                import warnings
+
+                warnings.warn(f"pallas var fell back to two-pass: {e!r}")
+
     mu = mean(x, axis, keepdims_internal=True)
     d = arithmetics.sub(x, mu)
     sq = arithmetics.mul(d, d)

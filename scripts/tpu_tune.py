@@ -1,0 +1,215 @@
+"""On-chip tuning sweep (round 5): one JSON line per experiment.
+
+Run on the real TPU to (a) verify the new Pallas cdist/Lloyd kernels beat
+the XLA forms, (b) find the matmul steady-state MFU config, (c) measure the
+moments pass against the HBM roofline. Each experiment is isolated — a
+failure prints an error line and the sweep continues. Usage:
+
+    python scripts/tpu_tune.py [--only cdist,kmeans,matmul,moments,rbf]
+
+Keep sizes bench-equal so winners can be baked straight into bench.py.
+"""
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def _sync(arr):
+    return float(arr[(0,) * arr.ndim])
+
+
+def _time(fn, repeats=2):
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def emit(**kw):
+    print(json.dumps(kw), flush=True)
+
+
+def run_guarded(name, fn):
+    try:
+        fn()
+    except Exception as e:  # noqa: BLE001
+        emit(exp=name, error=repr(e))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--only", default=None)
+    args = ap.parse_args()
+    only = set(args.only.split(",")) if args.only else None
+
+    def want(name):
+        return only is None or name in only
+
+    import jax
+    import jax.numpy as jnp
+
+    import heat_tpu as ht
+
+    emit(device=jax.devices()[0].device_kind, n=len(jax.devices()))
+
+    # ---------------- cdist: pallas kernel vs XLA form -------------------
+    m, k, reps = 16384, 128, 10
+    if want("cdist"):
+        x = ht.random.rand(m, k, dtype=ht.float32, split=0)
+
+        def bench_cdist(tag, fn):
+            fn()  # compile
+            t = _time(fn)
+            emit(exp=f"cdist_{tag}", gflops=round(reps * 2.0 * m * m * k / t / 1e9, 1),
+                 seconds=round(t, 3))
+
+        def run_pallas():
+            from heat_tpu.spatial.pallas_cdist import euclid_pallas
+
+            out = None
+            for _ in range(reps):
+                out = euclid_pallas(x.larray, x.larray)
+            return _sync(out)
+
+        def run_xla():
+            from heat_tpu.spatial.distance import _local_dist, _quadratic_euclidean
+
+            out = None
+            for _ in range(reps):
+                out = _local_dist(_quadratic_euclidean, x.larray, x.larray, jnp.float32)
+            return _sync(out)
+
+        run_guarded("cdist_pallas", lambda: bench_cdist("pallas", run_pallas))
+        run_guarded("cdist_xla", lambda: bench_cdist("xla", run_xla))
+        # block-size sweep for the pallas kernel
+        from heat_tpu.spatial.pallas_cdist import euclid_pallas
+
+        for bm, bn in ((256, 1024), (512, 512), (512, 1024), (512, 2048), (1024, 1024)):
+            def run_blk(bm=bm, bn=bn):
+                out = None
+                for _ in range(reps):
+                    out = euclid_pallas(x.larray, x.larray, block_m=bm, block_n=bn)
+                _sync(out)
+
+            def do(bm=bm, bn=bn, run_blk=run_blk):
+                run_blk()
+                t = _time(run_blk)
+                emit(exp=f"cdist_pallas_bm{bm}_bn{bn}",
+                     gflops=round(reps * 2.0 * m * m * k / t / 1e9, 1))
+
+            run_guarded(f"cdist_blk_{bm}_{bn}", do)
+
+    # ---------------- rbf fused epilogue ---------------------------------
+    if want("rbf"):
+        x = ht.random.rand(8192, 128, dtype=ht.float32, split=0)
+
+        def run_rbf():
+            out = None
+            for _ in range(reps):
+                out = ht.spatial.rbf(x, sigma=1.0, quadratic_expansion=True)
+            return _sync(out.larray)
+
+        def do_rbf():
+            run_rbf()
+            t = _time(run_rbf)
+            emit(exp="rbf_fused", gflops=round(reps * 2.0 * 8192 * 8192 * 128 / t / 1e9, 1))
+
+        run_guarded("rbf", do_rbf)
+
+    # ---------------- kmeans: pallas lloyd vs XLA ------------------------
+    if want("kmeans"):
+        ns, d, kc, iters = 2_000_000, 64, 64, 50
+        xs = ht.random.randn(ns, d, dtype=ht.float32, split=0)
+
+        def fit(tag, force_xla):
+            km = ht.cluster.KMeans(n_clusters=kc, init="random", max_iter=iters,
+                                   tol=0.0, random_state=1)
+            if force_xla:
+                import heat_tpu.cluster.pallas_lloyd as pli
+
+                orig = pli.pallas_lloyd_applicable
+                pli.pallas_lloyd_applicable = lambda *a: False
+                try:
+                    km.fit(xs)
+                finally:
+                    pli.pallas_lloyd_applicable = orig
+            else:
+                km.fit(xs)
+            return _sync(km.cluster_centers_.larray)
+
+        for tag, force in (("pallas", False), ("xla", True)):
+            def do(tag=tag, force=force):
+                fit(tag, force)  # compile
+                t = _time(lambda: fit(tag, force))
+                emit(exp=f"kmeans_{tag}",
+                     gflops=round(iters * 4.0 * ns * kc * d / t / 1e9, 1),
+                     seconds=round(t, 3))
+
+            run_guarded(f"kmeans_{tag}", do)
+
+    # ---------------- matmul steady-state sweep --------------------------
+    if want("matmul"):
+        from heat_tpu.core.dndarray import DNDarray
+
+        def chain_fn(a, y0, reps_):
+            def chain(abuf, ybuf):
+                A = DNDarray(abuf, a.shape, a.dtype, a.split, a.device, a.comm, True)
+                Y = DNDarray(ybuf, y0.shape, y0.dtype, y0.split, y0.device, y0.comm, True)
+                for _ in range(reps_):
+                    Y = ht.matmul(A, Y)
+                return Y.larray
+
+            return jax.jit(chain)
+
+        for n_, reps_ in ((8192, 30), (8192, 60), (16384, 10), (4096, 100)):
+            def do(n_=n_, reps_=reps_):
+                ab = (ht.random.rand(n_, n_, dtype=ht.float32, split=0) / float(n_)).astype(ht.bfloat16)
+                yb = ht.random.rand(n_, n_, dtype=ht.float32, split=0).astype(ht.bfloat16)
+                jc = chain_fn(ab, yb, reps_)
+                run = lambda: _sync(jc(ab.larray, yb.larray).astype(jnp.float32))
+                run()
+                t = _time(run)
+                gf = reps_ * 2.0 * n_ ** 3 / t / 1e9
+                emit(exp=f"matmul_bf16_n{n_}_r{reps_}", gflops=round(gf, 1),
+                     mfu_v5e=round(gf / 197e3, 3), seconds=round(t, 3))
+
+            run_guarded(f"matmul_{n_}_{reps_}", do)
+
+    # ---------------- moments vs HBM roofline ----------------------------
+    if want("moments"):
+        nm, dm, mreps = 8_000_000, 64, 10
+        xm = ht.random.randn(nm, dm, dtype=ht.float32, split=0)
+
+        @jax.jit
+        def one_pass(buf):
+            from heat_tpu.core.dndarray import DNDarray
+
+            X = DNDarray(buf, xm.shape, xm.dtype, xm.split, xm.device, xm.comm, True)
+            return (ht.mean(X, axis=0) + ht.var(X, axis=0)).larray
+
+        def run_m():
+            out = None
+            for _ in range(mreps):
+                out = one_pass(xm.larray)
+            return _sync(out)
+
+        def do_m():
+            run_m()
+            t = _time(run_m)
+            gf = mreps * 4.0 * nm * dm / t / 1e9
+            bytes_read = mreps * nm * dm * 4
+            emit(exp="moments", gflops=round(gf, 1),
+                 effective_gbps=round(bytes_read / t / 1e9, 1),
+                 note="gbps assumes ONE read of X per pass")
+
+        run_guarded("moments", do_m)
+
+
+if __name__ == "__main__":
+    main()

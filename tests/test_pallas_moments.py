@@ -1,0 +1,56 @@
+"""Correctness of the single-read Pallas column-moments kernel via the
+interpreter. Oracle: numpy mean/var (the kernel's chunked Welford combine
+must match the two-pass form to f32 accuracy, including on data with a
+large common offset where the naive E[x^2]-E[x]^2 form loses digits)."""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from heat_tpu.core.pallas_moments import column_moments
+
+
+class TestColumnMomentsInterpret:
+    def _check(self, x, n, block_m=64, rtol=1e-5, atol=1e-5):
+        mean, m2 = column_moments(
+            jnp.asarray(x), n, block_m=block_m, interpret=True
+        )
+        want_mean = x[:n].mean(axis=0)
+        want_var = x[:n].var(axis=0)
+        np.testing.assert_allclose(np.asarray(mean), want_mean, rtol=rtol, atol=atol)
+        np.testing.assert_allclose(
+            np.asarray(m2) / n, want_var, rtol=rtol, atol=atol
+        )
+
+    def test_basic(self):
+        rng = np.random.default_rng(0)
+        self._check(rng.standard_normal((300, 5)).astype(np.float32), 300)
+
+    def test_tail_pad_rows_ignored(self):
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((300, 7)).astype(np.float32)
+        xp = np.vstack([x, np.full((33, 7), 1e9, np.float32)])  # poison pads
+        self._check(xp, 300)
+
+    def test_large_offset_stability(self):
+        # mean ~1e4, std ~1: E[x^2]-E[x]^2 would lose ~8 digits; the
+        # Welford combine must stay accurate
+        rng = np.random.default_rng(2)
+        x = (1e4 + rng.standard_normal((1000, 3))).astype(np.float32)
+        mean, m2 = column_moments(jnp.asarray(x), 1000, block_m=128, interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(m2) / 1000, x.var(axis=0, dtype=np.float64),
+            rtol=5e-3,
+        )
+
+    def test_single_block(self):
+        rng = np.random.default_rng(3)
+        self._check(rng.standard_normal((50, 4)).astype(np.float32), 50,
+                    block_m=64)
+
+    def test_all_pad_final_block(self):
+        # mp rounds up so the last block can be entirely pad rows
+        rng = np.random.default_rng(4)
+        x = rng.standard_normal((64, 3)).astype(np.float32)
+        xp = np.vstack([x, np.zeros((64, 3), np.float32)])
+        self._check(xp, 64, block_m=64)
