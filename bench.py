@@ -35,6 +35,8 @@ Workloads (BASELINE.json configs):
                   jit, bf16, Pallas flash core); detail row with model-flops
                   MFU
   * attention_bwd — fwd+bwd through the Pallas flash kernels (causal)
+  * spectral    — Spectral clustering fit (lanczos-bound; the perf guard
+                  for the estimator family beyond the bench five)
   * matmul_1b   — BASELINE.md north-star row: 32768² bf16 split DNDarrays
                   (1.074B elements each) through framework matmul
 
@@ -324,6 +326,28 @@ def bench_heat_tpu(errors, profile_dir=None, small=False, only=None,
 
         return run, reps * 9.0 * b * h * t * t * d
 
+    def make_spectral():
+        # Spectral clustering fit (lanczos-bound) — the perf guard for the
+        # estimator family beyond the bench five (VERDICT r4 weak 6): rbf
+        # affinity (fused Pallas epilogue on TPU) + Laplacian + lanczos
+        # matvecs + small-T eig + KMeans in the embedding. Counted flops:
+        # rbf GEMM 2·n²·d + lanczos matvecs 2·m·n² + full reorth ~2·m²·n
+        # (detail row, not in the geomean).
+        ns, d, kc, mlan = (512, 16, 4, 16) if small else (8192, 32, 8, 64)
+        base_pts = ht.random.randn(ns, d, dtype=ht.float32, split=0)
+        # pull the blobs apart so the embedding is non-degenerate
+        shift = ht.random.randint(0, kc, (ns, 1)).astype(ht.float32) * 8.0
+        xs = base_pts + shift
+
+        def run():
+            sp = ht.cluster.Spectral(
+                n_clusters=kc, gamma=0.05, n_lanczos=mlan
+            )
+            sp.fit(xs)
+            return _sync(sp.labels_.larray)
+
+        return run, 2.0 * ns * ns * (d + mlan) + 2.0 * mlan * mlan * ns
+
     def make_matmul_1b():
         # BASELINE.md north star: a >=1B-element split DNDarray driven
         # through framework matmul on the chip. 32768^2 bf16 operands are
@@ -452,6 +476,7 @@ def bench_heat_tpu(errors, profile_dir=None, small=False, only=None,
         ("attention", make_attention),
         ("matmul_f32", make_matmul_f32),
         ("matmul_int8", make_matmul_int8),
+        ("spectral", make_spectral),
         ("lm_step", make_lm_step),
     ]
 
@@ -685,7 +710,7 @@ def main():
         known = {
             "matmul", "matmul_f32", "matmul_bf16", "cdist", "kmeans",
             "moments", "lasso", "attention", "attention_bwd", "matmul_int8",
-            "lm_step", "matmul_1b",
+            "lm_step", "matmul_1b", "spectral",
         }
         unknown = only - known
         if unknown:
@@ -712,7 +737,8 @@ def main():
             k: v
             for k, v in ours_now.items()
             if k not in ("matmul_bf16", "matmul_f32", "attention",
-                         "attention_bwd", "matmul_int8", "lm_step", "matmul_1b")
+                         "attention_bwd", "matmul_int8", "lm_step",
+                         "matmul_1b", "spectral")
         }
         geo_ours = (
             float(np.exp(np.mean([np.log(v) for v in f32.values()]))) if f32 else 0.0
