@@ -56,6 +56,24 @@ class TestEuclidPallasInterpret:
         want = np.exp(-gamma * _np_cdist(x, y) ** 2)
         np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
 
+    def test_sharded_wiring_on_mesh(self):
+        # the shard_map decomposition used on multi-device TPU, exercised
+        # on the CPU mesh via the interpreter: split=0 x, replicated y
+        import heat_tpu as ht
+        from heat_tpu.spatial.distance import _pallas_local
+
+        comm = ht.get_comm()
+        rng = np.random.default_rng(11)
+        n_rows = 16 * comm.size + comm.size // 2  # ragged over the mesh
+        xn = rng.standard_normal((n_rows, 9)).astype(np.float32)
+        yn = rng.standard_normal((13, 9)).astype(np.float32)
+        x = ht.array(xn, split=0)
+        out = _pallas_local(
+            comm, x._masked(0), jnp.asarray(yn), "dist", 0.0, interpret=True
+        )
+        got = np.asarray(out)[:n_rows]  # physical pad rows sliced off
+        np.testing.assert_allclose(got, _np_cdist(xn, yn), rtol=2e-4, atol=2e-4)
+
     def test_applicability_gate(self, monkeypatch):
         import jax
 
