@@ -102,19 +102,29 @@ class KMeans(_KCluster):
 
         dt, xb, w, centers = self._fit_buffers(x)
 
-        from .pallas_lloyd import lloyd_fit_pallas, pallas_lloyd_applicable
+        from .pallas_lloyd import (
+            lloyd_fit_pallas,
+            lloyd_fit_pallas_sharded,
+            pallas_lloyd_applicable,
+        )
 
         done = False
         if pallas_lloyd_applicable(
-            x.comm.size, x.shape[1], self.n_clusters, xb.dtype
+            x.comm.size, x.split, x.shape[1], self.n_clusters, xb.dtype
         ):
             # fused single-pass-over-X Lloyd update (see pallas_lloyd);
             # Mosaic failure degrades to the XLA fit rather than erroring
             try:
-                p_out = lloyd_fit_pallas(
-                    xb, centers, x.shape[0], self.max_iter,
-                    jnp.asarray(self.tol, xb.dtype),
-                )
+                if x.comm.size > 1:
+                    p_out = lloyd_fit_pallas_sharded(
+                        x.comm, xb, centers, x.shape[0], self.max_iter,
+                        jnp.asarray(self.tol, xb.dtype),
+                    )
+                else:
+                    p_out = lloyd_fit_pallas(
+                        xb, centers, x.shape[0], self.max_iter,
+                        jnp.asarray(self.tol, xb.dtype),
+                    )
                 # materialize INSIDE the try — async TPU runtime faults
                 # surface lazily and must trigger the fallback here
                 jax.block_until_ready(p_out)

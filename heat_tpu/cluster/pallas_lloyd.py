@@ -16,10 +16,11 @@ with f32 accumulation. The argmin drops the ||x||^2 term (constant per
 row — it cannot change the winner), so scores are just c2 - 2 x.c at
 ``Precision.HIGH`` (the bf16x3 guard from ``_kcluster._d2``).
 
-Scope: single-device TPU fits (the bench configuration; multi-device fits
-keep the XLA path, whose per-iteration psum XLA already places well). The
-final labels/inertia pass stays on the XLA `_d2` form — one extra pass
-at the end of the fit is noise across max_iter iterations.
+Scope: TPU f32 fits — single-device directly, multi-device via
+`lloyd_fit_pallas_sharded` (shard_map over row shards + one psum of the
+sums/counts per iteration, the same single-collective shape as the XLA
+fit). The final labels/inertia pass stays on the XLA `_d2` form — one
+extra pass at the end of the fit is noise across max_iter iterations.
 """
 
 from __future__ import annotations
@@ -32,7 +33,11 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["lloyd_fit_pallas", "pallas_lloyd_applicable"]
+__all__ = [
+    "lloyd_fit_pallas",
+    "lloyd_fit_pallas_sharded",
+    "pallas_lloyd_applicable",
+]
 
 _I0 = np.int32(0)  # i32 index-map literal (jax_enable_x64 guard)
 _MAX_D = 512
@@ -44,10 +49,13 @@ def _round_up(v: int, m: int) -> int:
 
 
 def _lloyd_kernel(
-    x_ref, c_ref, sums_ref, counts_ref, sums_s, counts_s, *, n, bm, k
+    lim_ref, x_ref, c_ref, sums_ref, counts_ref, sums_s, counts_s, *, bm, k
 ):
     """Grid = (num_row_blocks,), sequential. Scratch (sums, counts)
-    accumulates across blocks; written out at the last block."""
+    accumulates across blocks; written out at the last block. ``lim_ref``
+    holds this buffer's LOCAL valid-row count — rows at or past it (the
+    global tail pad on the last shards, plus any local block-size
+    round-up pad) drop out of sums and counts."""
     i = pl.program_id(0)
     nb = pl.num_programs(0)
 
@@ -69,7 +77,7 @@ def _lloyd_kernel(
     score = jnp.where(jidx < k, score, jnp.float32(3.4e38))  # mask center pads
     labels = jnp.argmin(score, axis=1)[:, None]  # (bm, 1)
     row = i * bm + jax.lax.broadcasted_iota(jnp.int32, (bm, 1), 0)
-    valid = row < n  # global tail pads drop out of sums and counts
+    valid = row < lim_ref[0]
     onehot = jnp.where(
         (labels == jidx) & valid, jnp.float32(1.0), jnp.float32(0.0)
     )  # (bm, kp)
@@ -87,16 +95,20 @@ def _lloyd_kernel(
         counts_ref[:] = counts_s[:]
 
 
-def _lloyd_update(x, centers_pad, n, k, bm, interpret):
+def _lloyd_update(x, centers_pad, n, k, bm, interpret, lim=None):
     """One fused accumulation pass: (sums (kp, dp), counts (8, kp)).
     ``x`` must already be padded to (mp, dp) with mp % bm == 0;
-    ``centers_pad`` to (kp, dp)."""
+    ``centers_pad`` to (kp, dp); ``lim`` is the LOCAL valid-row count
+    (defaults to the global n — correct outside shard_map)."""
     mp, dp = x.shape
     kp = centers_pad.shape[0]
+    if lim is None:
+        lim = jnp.full((1,), n, jnp.int32)
     return pl.pallas_call(
-        functools.partial(_lloyd_kernel, n=n, bm=bm, k=k),
+        functools.partial(_lloyd_kernel, bm=bm, k=k),
         grid=(mp // bm,),
         in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec((bm, dp), lambda i: (i, _I0), memory_space=pltpu.VMEM),
             pl.BlockSpec((kp, dp), lambda i: (_I0, _I0), memory_space=pltpu.VMEM),
         ],
@@ -116,7 +128,7 @@ def _lloyd_update(x, centers_pad, n, k, bm, interpret):
             dimension_semantics=("arbitrary",),
         ),
         interpret=interpret,
-    )(x, centers_pad)
+    )(lim.astype(jnp.int32), x, centers_pad)
 
 
 @functools.partial(
@@ -169,11 +181,82 @@ def lloyd_fit_pallas(
     return centers, labels, inertia, n_iter
 
 
-def pallas_lloyd_applicable(comm_size: int, d: int, k: int, jnp_dtype) -> bool:
-    """Single-device TPU f32 fits with blocks that fit VMEM."""
+@functools.partial(
+    jax.jit, static_argnames=("comm", "n", "max_iter", "block_m", "interpret")
+)
+def lloyd_fit_pallas_sharded(
+    comm,
+    xb: jax.Array,
+    centers0: jax.Array,
+    n: int,
+    max_iter: int,
+    tol,
+    block_m: int = 512,
+    interpret: bool = False,
+):
+    """Multi-device variant: the fused update runs per row-shard inside
+    `shard_map` and one psum per iteration merges the (k, d)+(k,)
+    sums/counts — the same single-collective-per-Lloyd-iteration shape as
+    the XLA fit (and the reference's Allreduce, kmeans.py:73). Centers
+    carry replicated through the while_loop; labels/inertia come from one
+    final XLA `_d2` pass on the sharded buffer outside the shard_map."""
+    from ._kcluster import _d2
+
+    p = comm.size
+    m, d = xb.shape
+    k = centers0.shape[0]
+    dp, kp = _round_up(d, 128), _round_up(k, 128)
+    c_rows = m // p  # physical buffer rows divide the mesh by invariant
+    bm = min(block_m, _round_up(c_rows, 8))
+    c0 = jnp.pad(centers0.astype(jnp.float32), ((0, kp - k), (0, dp - d)))
+
+    def shard_fn(xs, c0_):
+        rank = comm.axis_index()
+        # local valid rows: global logical rows falling inside this shard
+        lim = jnp.clip(n - rank * c_rows, 0, c_rows).astype(jnp.int32).reshape((1,))
+        mp_l = _round_up(c_rows, bm)
+        xp = jnp.pad(xs.astype(jnp.float32), ((0, mp_l - c_rows), (0, dp - d)))
+
+        def cond(carry):
+            _, it, shift = carry
+            return jnp.logical_and(it < max_iter, shift > tol)
+
+        def body(carry):
+            c, it, _ = carry
+            sums, counts = _lloyd_update(xp, c, n, k, bm, interpret, lim)
+            sums = jax.lax.psum(sums, comm.axis_name)
+            counts = jax.lax.psum(counts, comm.axis_name)
+            cnt = counts[0:1, :].T
+            new_c = jnp.where(cnt > 0, sums / jnp.maximum(cnt, 1.0), c)
+            shift = jnp.sum((new_c - c) ** 2)
+            return new_c, it + 1, shift
+
+        cpad, n_iter, _ = jax.lax.while_loop(
+            cond, body, (c0_, jnp.int32(0), jnp.asarray(jnp.inf, jnp.float32))
+        )
+        return cpad, n_iter
+
+    cpad, n_iter = jax.shard_map(
+        shard_fn,
+        mesh=comm.mesh,
+        in_specs=(comm.spec(0, 2), comm.spec(None, 2)),
+        out_specs=(comm.spec(None, 2), comm.spec(None, 0)),
+        check_vma=False,
+    )(xb, c0)
+    centers = cpad[:k, :d].astype(xb.dtype)
+    w = (jnp.arange(m) < n).astype(xb.dtype)
+    d2 = _d2(xb, centers)
+    labels = jnp.argmin(d2, axis=1)
+    inertia = jnp.sum(jnp.min(d2, axis=1) * w)
+    return centers, labels, inertia, n_iter
+
+
+def pallas_lloyd_applicable(comm_size: int, split, d: int, k: int, jnp_dtype) -> bool:
+    """TPU f32 fits with blocks that fit VMEM; multi-device needs the
+    sample buffer row-sharded (split=0)."""
     return (
         jax.default_backend() == "tpu"
-        and comm_size == 1
+        and (comm_size == 1 or split == 0)
         and d <= _MAX_D
         and k <= _MAX_K
         and jnp_dtype == jnp.float32

@@ -49,6 +49,52 @@ class TestPallasLloydInterpret:
     def test_no_padding_needed(self):
         self._agree(n=256, d=8, k=4, pad_rows=0, seed=2, block_m=128)
 
+    def test_sharded_fit_on_mesh(self):
+        # the multi-device shard_map + per-iteration psum wiring, on the
+        # CPU mesh via the interpreter — must agree with the XLA fit
+        import heat_tpu as ht
+        from heat_tpu.cluster.pallas_lloyd import lloyd_fit_pallas_sharded
+
+        comm = ht.get_comm()
+        n, d, k = 40 * comm.size + 3, 4, 5
+        # STRONGLY separated blobs: the kernel scores with c2 - 2xc (no
+        # x2 term) which can flip last-ulp near-ties vs the XLA d2 form —
+        # with centroids 60 apart and noise 1 no assignment is ambiguous
+        rng = np.random.default_rng(7)
+        protos = (rng.permutation(k)[:, None] * 60.0 + rng.standard_normal((k, d))).astype(np.float32)
+        lab = rng.integers(0, k, n)
+        x = (protos[lab] + rng.standard_normal((n, d))).astype(np.float32)
+        xd = ht.array(x, split=0)
+        xb = xd._masked(0)  # padded sharded buffer, pads zeroed
+        m = xb.shape[0]
+        w = (np.arange(m) < n).astype(np.float32)
+        c0 = (protos + 0.1).astype(np.float32)  # unambiguous from step one
+
+        # one iteration from identical centers: the psum-merged sums/counts
+        # must reproduce the XLA update (reduction-order tolerance only)
+        want_c, _, _, _ = _lloyd_fit(
+            jnp.asarray(np.pad(x, ((0, m - n), (0, 0)))), jnp.asarray(w),
+            jnp.asarray(c0), 1, jnp.float32(0.0),
+        )
+        got_c, _, _, _ = lloyd_fit_pallas_sharded(
+            comm, xb, jnp.asarray(c0), n, 1, jnp.float32(0.0),
+            block_m=16, interpret=True,
+        )
+        np.testing.assert_allclose(np.asarray(got_c), np.asarray(want_c),
+                                   rtol=1e-4, atol=1e-4)
+        # to convergence: trajectories may flip boundary points (different
+        # reduction order), but the fit quality must match
+        want_c, _, want_i, _ = _lloyd_fit(
+            jnp.asarray(np.pad(x, ((0, m - n), (0, 0)))), jnp.asarray(w),
+            jnp.asarray(c0), 15, jnp.float32(0.0),
+        )
+        got_c, got_l, got_i, _ = lloyd_fit_pallas_sharded(
+            comm, xb, jnp.asarray(c0), n, 15, jnp.float32(0.0),
+            block_m=16, interpret=True,
+        )
+        assert abs(float(got_i) - float(want_i)) <= 0.02 * float(want_i) + 1e-3
+        assert np.asarray(got_l)[:n].shape == (n,)
+
     def test_empty_cluster_keeps_center(self):
         # a far-away initial center captures nothing; both paths must keep it
         x = np.vstack([
