@@ -26,7 +26,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["column_moments", "pallas_moments_applicable"]
+__all__ = ["column_moments", "sharded_column_moments", "pallas_moments_applicable"]
 
 _I0 = np.int32(0)
 _MAX_D = 4096  # (bm, dp) f32 block + 4 (8, dp) accumulators must fit VMEM
@@ -36,7 +36,7 @@ def _round_up(v: int, m: int) -> int:
     return -(-v // m) * m
 
 
-def _moments_kernel(x_ref, mean_ref, m2_ref, mean_s, m2_s, cnt_s, *, n, bm):
+def _moments_kernel(lim_ref, x_ref, mean_ref, m2_ref, mean_s, m2_s, cnt_s, *, bm):
     """Grid = (num_row_blocks,), sequential; Welford-combine across blocks."""
     i = pl.program_id(0)
     nb = pl.num_programs(0)
@@ -49,7 +49,9 @@ def _moments_kernel(x_ref, mean_ref, m2_ref, mean_s, m2_s, cnt_s, *, n, bm):
 
     xb = x_ref[:]  # (bm, dp) f32
     row = i * bm + jax.lax.broadcasted_iota(jnp.int32, (bm, 1), 0)
-    valid = (row < n).astype(jnp.float32)  # (bm, 1); zero rows drop out
+    # LOCAL valid-row count (inside shard_map each shard passes its own
+    # limit; block round-up pads past it drop out)
+    valid = (row < lim_ref[0]).astype(jnp.float32)  # (bm, 1)
     nv = jnp.sum(valid)  # block count (scalar f32)
 
     @pl.when(nv > 0)
@@ -76,7 +78,8 @@ def _moments_kernel(x_ref, mean_ref, m2_ref, mean_s, m2_s, cnt_s, *, n, bm):
 
 @functools.partial(jax.jit, static_argnames=("n", "block_m", "interpret"))
 def column_moments(
-    x: jax.Array, n: int, block_m: int = 1024, interpret: bool = False
+    x: jax.Array, n: int, block_m: int = 1024, interpret: bool = False,
+    lim=None,
 ):
     """(mean (d,), M2 (d,)) over the first axis of an (m, d) f32 array,
     counting only the first ``n`` rows (tail-pad aware). One HBM read."""
@@ -88,10 +91,13 @@ def column_moments(
         x = jnp.pad(x.astype(jnp.float32), ((0, mp - m), (0, dp - d)))
     else:
         x = x.astype(jnp.float32)
+    if lim is None:
+        lim = jnp.full((1,), n, jnp.int32)
     mean_o, m2_o = pl.pallas_call(
-        functools.partial(_moments_kernel, n=n, bm=bm),
+        functools.partial(_moments_kernel, bm=bm),
         grid=(mp // bm,),
         in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec((bm, dp), lambda i: (i, _I0), memory_space=pltpu.VMEM),
         ],
         out_specs=[
@@ -111,15 +117,52 @@ def column_moments(
             dimension_semantics=("arbitrary",),
         ),
         interpret=interpret,
-    )(x)
+    )(lim.astype(jnp.int32), x)
     return mean_o[0, :d], m2_o[0, :d]
 
 
-def pallas_moments_applicable(comm_size: int, ndim: int, axis, d: int, jnp_dtype) -> bool:
-    """Single-device TPU f32 axis-0 reductions on 2-D arrays."""
+@functools.partial(
+    jax.jit, static_argnames=("comm", "n", "block_m", "interpret")
+)
+def sharded_column_moments(
+    comm, x: jax.Array, n: int, block_m: int = 1024, interpret: bool = False
+):
+    """Multi-device variant: per-shard (count, mean, M2) from the fused
+    kernel, then the closed-form Welford merge across shards with two
+    psums — mean_g = psum(n_s mean_s)/n; M2_g = psum(M2_s) +
+    psum(n_s (mean_s - mean_g)^2). X is still read exactly once."""
+    p = comm.size
+    m, _d = x.shape
+    c_rows = m // p
+
+    def shard_fn(xs):
+        rank = comm.axis_index()
+        lim = jnp.clip(n - rank * c_rows, 0, c_rows).astype(jnp.int32)
+        mean_s, m2_s = column_moments(
+            xs, n, block_m=block_m, interpret=interpret,
+            lim=lim.reshape((1,)),
+        )
+        ns = lim.astype(jnp.float32)
+        mean_g = jax.lax.psum(ns * mean_s, comm.axis_name) / jnp.float32(n)
+        dlt = mean_s - mean_g
+        m2_g = jax.lax.psum(m2_s + ns * dlt * dlt, comm.axis_name)
+        return mean_g, m2_g
+
+    return jax.shard_map(
+        shard_fn,
+        mesh=comm.mesh,
+        in_specs=(comm.spec(0, 2),),
+        out_specs=(comm.spec(None, 1), comm.spec(None, 1)),
+        check_vma=False,
+    )(x)
+
+
+def pallas_moments_applicable(comm_size: int, split, ndim: int, axis, d: int, jnp_dtype) -> bool:
+    """TPU f32 axis-0 reductions on 2-D arrays; multi-device needs the
+    rows sharded (split=0)."""
     return (
         jax.default_backend() == "tpu"
-        and comm_size == 1
+        and (comm_size == 1 or split == 0)
         and ndim == 2
         and axis == 0
         and d <= _MAX_D

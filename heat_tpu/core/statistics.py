@@ -654,13 +654,22 @@ def var(x: DNDarray, axis=None, ddof: int = 0, keepdims: bool = False) -> DNDarr
         and x.split in (None, 0)
         and isinstance(x, DNDarray)
     ):
-        from .pallas_moments import column_moments, pallas_moments_applicable
+        from .pallas_moments import (
+            column_moments,
+            pallas_moments_applicable,
+            sharded_column_moments,
+        )
 
         if pallas_moments_applicable(
-            x.comm.size, x.ndim, 0, x.shape[1], x.larray.dtype
+            x.comm.size, x.split, x.ndim, 0, x.shape[1], x.larray.dtype
         ):
             try:
-                _mu, m2 = column_moments(x.larray, x.shape[0])
+                if x.comm.size > 1:
+                    _mu, m2 = sharded_column_moments(
+                        x.comm, x._masked(0), x.shape[0]
+                    )
+                else:
+                    _mu, m2 = column_moments(x.larray, x.shape[0])
                 import jax
 
                 jax.block_until_ready(m2)  # surface Mosaic faults HERE

@@ -48,6 +48,27 @@ class TestColumnMomentsInterpret:
         self._check(rng.standard_normal((50, 4)).astype(np.float32), 50,
                     block_m=64)
 
+    def test_sharded_on_mesh(self):
+        # the multi-device shard_map + closed-form Welford merge, on the
+        # CPU mesh via the interpreter
+        import heat_tpu as ht
+        from heat_tpu.core.pallas_moments import sharded_column_moments
+
+        comm = ht.get_comm()
+        rng = np.random.default_rng(5)
+        n = 50 * comm.size + 3
+        xn = (1e3 + rng.standard_normal((n, 6))).astype(np.float32)
+        xd = ht.array(xn, split=0)
+        mean, m2 = sharded_column_moments(
+            comm, xd._masked(0), n, block_m=32, interpret=True
+        )
+        np.testing.assert_allclose(np.asarray(mean), xn.mean(axis=0),
+                                   rtol=1e-5, atol=1e-4)
+        np.testing.assert_allclose(
+            np.asarray(m2) / n, xn.var(axis=0, dtype=np.float64),
+            rtol=5e-3,
+        )
+
     def test_all_pad_final_block(self):
         # mp rounds up so the last block can be entirely pad rows
         rng = np.random.default_rng(4)
