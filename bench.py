@@ -39,6 +39,8 @@ Workloads (BASELINE.json configs):
                   for the estimator family beyond the bench five)
   * matmul_1b   — BASELINE.md north-star row: 32768² bf16 split DNDarrays
                   (1.074B elements each) through framework matmul
+  * kmeans_1b   — the north star's KMeans half: Lloyd on a 2^24x64
+                  (1.074B-element) split DNDarray via the fused Pallas path
 
 Headline metric: geometric-mean achieved GFLOP/s across completed f32
 workloads. `--profile DIR` additionally captures a jax.profiler trace of the
@@ -326,6 +328,22 @@ def bench_heat_tpu(errors, profile_dir=None, small=False, only=None,
 
         return run, reps * 9.0 * b * h * t * t * d
 
+    def make_kmeans_1b():
+        # BASELINE.md north star, KMeans half: Lloyd on a >=1B-element
+        # split DNDarray (2^24 x 64 f32 = 1.074B elements, 4.3 GB) on the
+        # chip — exercises the fused Pallas Lloyd path at scale. Detail
+        # row (not in the geomean).
+        ns, d, kc, iters = (65_536, 64, 16, 3) if small else (1 << 24, 64, 64, 10)
+        xs = ht.random.randn(ns, d, dtype=ht.float32, split=0)
+
+        def run():
+            km = ht.cluster.KMeans(n_clusters=kc, init="random",
+                                   max_iter=iters, tol=0.0, random_state=1)
+            km.fit(xs)
+            return _sync(km.cluster_centers_.larray)
+
+        return run, iters * 4.0 * ns * kc * d
+
     def make_spectral():
         # Spectral clustering fit (lanczos-bound) — the perf guard for the
         # estimator family beyond the bench five (VERDICT r4 weak 6): rbf
@@ -477,6 +495,7 @@ def bench_heat_tpu(errors, profile_dir=None, small=False, only=None,
         ("matmul_f32", make_matmul_f32),
         ("matmul_int8", make_matmul_int8),
         ("spectral", make_spectral),
+        ("kmeans_1b", make_kmeans_1b),
         ("lm_step", make_lm_step),
     ]
 
@@ -710,7 +729,7 @@ def main():
         known = {
             "matmul", "matmul_f32", "matmul_bf16", "cdist", "kmeans",
             "moments", "lasso", "attention", "attention_bwd", "matmul_int8",
-            "lm_step", "matmul_1b", "spectral",
+            "lm_step", "matmul_1b", "spectral", "kmeans_1b",
         }
         unknown = only - known
         if unknown:
@@ -738,7 +757,7 @@ def main():
             for k, v in ours_now.items()
             if k not in ("matmul_bf16", "matmul_f32", "attention",
                          "attention_bwd", "matmul_int8", "lm_step",
-                         "matmul_1b", "spectral")
+                         "matmul_1b", "spectral", "kmeans_1b")
         }
         geo_ours = (
             float(np.exp(np.mean([np.log(v) for v in f32.values()]))) if f32 else 0.0
