@@ -138,6 +138,10 @@ class TransformerLM(nn.Module):
     comm: Optional[Any] = None
     block_size: Optional[int] = None  # None = each impl's tuned default
     remat: bool = False  # checkpoint each block: O(L) -> O(1) activations
+    # None = full recompute; "dots" = save MXU dot outputs and recompute
+    # only the cheap elementwise ops (jax.checkpoint_policies.
+    # dots_with_no_batch_dims_saveable) — usually faster when HBM allows
+    remat_policy: Optional[str] = None
     dtype: Any = jnp.float32
 
     @nn.compact
@@ -155,7 +159,18 @@ class TransformerLM(nn.Module):
         x = x + pos[None]
         # rematerialization trades backward-pass FLOPs for activation
         # memory — the standard long-context recipe (HBM is the bottleneck)
-        block_cls = nn.remat(TransformerBlock) if self.remat else TransformerBlock
+        if self.remat:
+            if self.remat_policy == "dots":
+                import jax
+
+                block_cls = nn.remat(
+                    TransformerBlock,
+                    policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+                )
+            else:
+                block_cls = nn.remat(TransformerBlock)
+        else:
+            block_cls = TransformerBlock
         for i in range(self.num_layers):
             x = block_cls(
                 self.num_heads, self.mlp_ratio, self.attn_impl, True,

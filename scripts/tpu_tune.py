@@ -181,6 +181,61 @@ def main():
 
             run_guarded(f"matmul_{n_}_{reps_}", do)
 
+    # ---------------- lm_step remat-policy comparison --------------------
+    if want("lm"):
+        import optax
+
+        from heat_tpu.nn import TransformerLM
+
+        (v, dm, nh, nl, b, t, lreps) = (32768, 1024, 16, 12, 8, 1024, 8)
+        key = jax.random.PRNGKey(0)
+        toks = jax.random.randint(key, (b, t), 0, v, dtype=jnp.int32)
+
+        for pol in (None, "dots"):
+            def do(pol=pol):
+                lm = TransformerLM(
+                    vocab_size=v, d_model=dm, num_heads=nh, num_layers=nl,
+                    max_len=t, attn_impl="flash", remat=True,
+                    remat_policy=pol, dtype=jnp.bfloat16,
+                )
+                params = lm.init(key, toks)
+                opt = optax.adamw(1e-3)
+                opt_state = opt.init(params)
+                n_params = sum(
+                    int(np.prod(l.shape))
+                    for path, l in jax.tree_util.tree_leaves_with_path(params)
+                    if not any(getattr(k_, "key", None) in ("embed", "pos")
+                               for k_ in path)
+                )
+
+                def loss_fn(p, tk):
+                    lg = lm.apply(p, tk)
+                    return optax.softmax_cross_entropy_with_integer_labels(
+                        lg[:, :-1].astype(jnp.float32), tk[:, 1:]
+                    ).mean()
+
+                @jax.jit
+                def steps(p, s, tk):
+                    def body(_, carry):
+                        p_, s_ = carry
+                        _, g = jax.value_and_grad(loss_fn)(p_, tk)
+                        u, s_ = opt.update(g, s_, p_)
+                        return optax.apply_updates(p_, u), s_
+
+                    return jax.lax.fori_loop(0, lreps, body, (p, s))
+
+                def run():
+                    p, _ = steps(params, opt_state, toks)
+                    return _sync(jax.tree.leaves(p)[0].astype(jnp.float32))
+
+                run()
+                tm = _time(run)
+                gf = lreps * 6.0 * n_params * b * t / tm / 1e9
+                emit(exp=f"lm_step_remat_{pol or 'full'}", gflops=round(gf, 1),
+                     mfu_v5e=round(gf / 197e3, 3))
+
+            run_guarded(f"lm_{pol}", do)
+
     # ---------------- moments vs HBM roofline ----------------------------
     if want("moments"):
         nm, dm, mreps = 8_000_000, 64, 10
