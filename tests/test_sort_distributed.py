@@ -283,6 +283,28 @@ class TestDistributedRowUnique(BTTestCase):
         uf = ht.unique(ht.array(xn, split=0))  # flat: one NaN
         np.testing.assert_array_equal(uf.numpy(), np.unique(xn))
 
+    def test_randomized_oracle_sweep(self):
+        # deterministic randomized configs: shapes x dtypes x axes x splits
+        rng = np.random.default_rng(97)
+        dtypes = (np.int32, np.int64, np.float32, np.float64)
+        for trial in range(12):
+            ndim = int(rng.integers(2, 4))
+            shape = tuple(int(rng.integers(2, 14)) for _ in range(ndim))
+            axis = int(rng.integers(0, ndim))
+            split = int(rng.integers(0, ndim))
+            dt = dtypes[trial % len(dtypes)]
+            vals = rng.integers(0, 3, shape).astype(dt)
+            x = ht.array(vals, split=split)
+            got = ht.unique(x, axis=axis)
+            want = np.unique(vals, axis=axis)
+            np.testing.assert_array_equal(
+                got.numpy(), want,
+                err_msg=f"trial={trial} shape={shape} axis={axis} split={split} {dt}",
+            )
+            gv, gi = ht.unique(x, axis=axis, return_inverse=True)
+            wv, wi = np.unique(vals, axis=axis, return_inverse=True)
+            np.testing.assert_array_equal(gi.numpy(), wi)
+
     def test_past_old_ceiling(self):
         # 2.1M rows — past the old 2^20 eager-path ceiling (VERDICT r4)
         rng = np.random.default_rng(43)
