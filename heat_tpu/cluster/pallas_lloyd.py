@@ -151,7 +151,10 @@ def lloyd_fit_pallas(
 
     m, d = xb.shape
     k = centers0.shape[0]
-    dp, kp = _round_up(d, 128), _round_up(k, 128)
+    # feature lanes pad at 64-granularity (like 64-wide attention
+    # heads): d=64 stays unpadded — a 128 pad would double X's HBM
+    # footprint and read traffic at the bench shapes
+    dp, kp = _round_up(d, 64), _round_up(k, 128)
     bm = min(block_m, _round_up(m, 8))
     mp = _round_up(m, bm)
     xp = jnp.pad(xb.astype(jnp.float32), ((0, mp - m), (0, dp - d)))
@@ -205,7 +208,10 @@ def lloyd_fit_pallas_sharded(
     p = comm.size
     m, d = xb.shape
     k = centers0.shape[0]
-    dp, kp = _round_up(d, 128), _round_up(k, 128)
+    # feature lanes pad at 64-granularity (like 64-wide attention
+    # heads): d=64 stays unpadded — a 128 pad would double X's HBM
+    # footprint and read traffic at the bench shapes
+    dp, kp = _round_up(d, 64), _round_up(k, 128)
     c_rows = m // p  # physical buffer rows divide the mesh by invariant
     bm = min(block_m, _round_up(c_rows, 8))
     c0 = jnp.pad(centers0.astype(jnp.float32), ((0, kp - k), (0, dp - d)))

@@ -84,7 +84,7 @@ def column_moments(
     """(mean (d,), M2 (d,)) over the first axis of an (m, d) f32 array,
     counting only the first ``n`` rows (tail-pad aware). One HBM read."""
     m, d = x.shape
-    dp = _round_up(d, 128)
+    dp = _round_up(d, 64)  # 64-lane granularity: d=64 stays unpadded
     bm = min(block_m, _round_up(m, 8))
     mp = _round_up(m, bm)
     if (mp, dp) != (m, d):

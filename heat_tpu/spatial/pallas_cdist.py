@@ -91,7 +91,8 @@ def euclid_pallas(
     m, k = x.shape
     n = y.shape[0]
     bm, bn = min(block_m, _round_up(m, 8)), min(block_n, _round_up(n, 128))
-    mp, np_, kp = _round_up(m, bm), _round_up(n, bn), _round_up(k, 128)
+    # feature lanes pad at 64-granularity (k=64/128 stay unpadded)
+    mp, np_, kp = _round_up(m, bm), _round_up(n, bn), _round_up(k, 64)
     if (mp, kp) != (m, k):
         x = jnp.pad(x, ((0, mp - m), (0, kp - k)))
     if (np_, kp) != (n, k):
