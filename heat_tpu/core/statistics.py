@@ -454,8 +454,49 @@ def maximum(x1, x2, out=None) -> DNDarray:
 
 def mean(x: DNDarray, axis=None, keepdims_internal: bool = False, keepdims: bool = False) -> DNDarray:
     """Arithmetic mean (reference statistics.py `mean`: single-pass (n, μ)
-    Allreduce merge :803-828; here masked sum / logical count)."""
+    Allreduce merge :803-828; here masked sum / logical count).
+
+    The TPU f32 axis-0 2-D case routes through the SAME
+    `column_moments` Pallas call as :func:`var` — deliberately identical
+    operands, so a program computing both (the statistical-moments
+    pattern) CSEs the two custom calls into ONE kernel execution: mean
+    AND var from a single HBM read of X."""
     from . import arithmetics
+
+    if (
+        axis == 0
+        and not keepdims
+        and not keepdims_internal
+        and x.split in (None, 0)
+        and isinstance(x, DNDarray)
+    ):
+        from .pallas_moments import (
+            column_moments,
+            pallas_moments_applicable,
+            sharded_column_moments,
+        )
+
+        if pallas_moments_applicable(
+            x.comm.size, x.split, x.ndim, 0, x.shape[1], x.larray.dtype
+        ):
+            try:
+                if x.comm.size > 1:
+                    mu, _m2 = sharded_column_moments(
+                        x.comm, x._masked(0), x.shape[0]
+                    )
+                else:
+                    mu, _m2 = column_moments(x.larray, x.shape[0])
+                import jax
+
+                jax.block_until_ready(mu)  # surface Mosaic faults HERE
+                return DNDarray.from_logical(
+                    mu, None, x.device, x.comm,
+                    types.canonical_heat_type(mu.dtype),
+                )
+            except Exception as e:  # pragma: no cover — TPU-runtime only
+                import warnings
+
+                warnings.warn(f"pallas mean fell back to sum/count: {e!r}")
 
     keep = keepdims or keepdims_internal
     s = arithmetics.sum(x, axis, keepdims=keep)
