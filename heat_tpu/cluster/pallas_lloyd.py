@@ -108,7 +108,10 @@ def _lloyd_update(x, centers_pad, n, k, bm, interpret, lim=None):
         functools.partial(_lloyd_kernel, bm=bm, k=k),
         grid=(mp // bm,),
         in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),
+            # explicit i32 index map: a bare SMEM BlockSpec synthesizes a
+            # default map whose literals trace as i64 under jax_enable_x64,
+            # which Mosaic cannot legalize ("func.return(i64)")
+            pl.BlockSpec((1,), lambda i: (_I0,), memory_space=pltpu.SMEM),
             pl.BlockSpec((bm, dp), lambda i: (i, _I0), memory_space=pltpu.VMEM),
             pl.BlockSpec((kp, dp), lambda i: (_I0, _I0), memory_space=pltpu.VMEM),
         ],

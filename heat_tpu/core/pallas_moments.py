@@ -97,7 +97,10 @@ def column_moments(
         functools.partial(_moments_kernel, bm=bm),
         grid=(mp // bm,),
         in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),
+            # explicit i32 index map: a bare SMEM BlockSpec synthesizes a
+            # default map whose literals trace as i64 under jax_enable_x64,
+            # which Mosaic cannot legalize ("func.return(i64)")
+            pl.BlockSpec((1,), lambda i: (_I0,), memory_space=pltpu.SMEM),
             pl.BlockSpec((bm, dp), lambda i: (i, _I0), memory_space=pltpu.VMEM),
         ],
         out_specs=[
