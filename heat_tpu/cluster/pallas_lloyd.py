@@ -49,7 +49,8 @@ def _round_up(v: int, m: int) -> int:
 
 
 def _lloyd_kernel(
-    lim_ref, x_ref, c_ref, sums_ref, counts_ref, sums_s, counts_s, *, bm, k
+    lim_ref, x_ref, c_ref, sums_ref, counts_ref, sums_s, counts_s, *, bm, k,
+    precision,
 ):
     """Grid = (num_row_blocks,), sequential. Scratch (sums, counts)
     accumulates across blocks; written out at the last block. ``lim_ref``
@@ -66,9 +67,11 @@ def _lloyd_kernel(
 
     xb = x_ref[:]  # (bm, dp) f32
     c = c_ref[:]  # (kp, dp) f32
+    # ``precision`` tier for the scores dot is swept on-chip by
+    # scripts/tpu_tune.py (Mosaic lowering cost per tier is not uniform)
     dot = jax.lax.dot_general(
         xb, c, (((1,), (1,)), ((), ())),
-        precision=jax.lax.Precision.HIGH,
+        precision=precision,
         preferred_element_type=jnp.float32,
     )  # (bm, kp)
     c2 = jnp.sum(c * c, axis=1)[None, :]  # (1, kp)
@@ -95,7 +98,8 @@ def _lloyd_kernel(
         counts_ref[:] = counts_s[:]
 
 
-def _lloyd_update(x, centers_pad, n, k, bm, interpret, lim=None):
+def _lloyd_update(x, centers_pad, n, k, bm, interpret, lim=None,
+                  precision=jax.lax.Precision.HIGH):
     """One fused accumulation pass: (sums (kp, dp), counts (8, kp)).
     ``x`` must already be padded to (mp, dp) with mp % bm == 0;
     ``centers_pad`` to (kp, dp); ``lim`` is the LOCAL valid-row count
@@ -105,7 +109,7 @@ def _lloyd_update(x, centers_pad, n, k, bm, interpret, lim=None):
     if lim is None:
         lim = jnp.full((1,), n, jnp.int32)
     return pl.pallas_call(
-        functools.partial(_lloyd_kernel, bm=bm, k=k),
+        functools.partial(_lloyd_kernel, bm=bm, k=k, precision=precision),
         grid=(mp // bm,),
         in_specs=[
             # explicit i32 index map: a bare SMEM BlockSpec synthesizes a
@@ -135,7 +139,8 @@ def _lloyd_update(x, centers_pad, n, k, bm, interpret, lim=None):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("n", "max_iter", "block_m", "interpret")
+    jax.jit,
+    static_argnames=("n", "max_iter", "block_m", "interpret", "precision"),
 )
 def lloyd_fit_pallas(
     xb: jax.Array,
@@ -145,6 +150,7 @@ def lloyd_fit_pallas(
     tol,
     block_m: int = 512,
     interpret: bool = False,
+    precision: jax.lax.Precision = jax.lax.Precision.HIGH,
 ):
     """The whole K-Means fit with the fused update kernel inside a
     `lax.while_loop`; returns (centers (k, d), labels (m,), inertia,
@@ -169,7 +175,8 @@ def lloyd_fit_pallas(
 
     def body(carry):
         c, it, _ = carry
-        sums, counts = _lloyd_update(xp, c, n, k, bm, interpret)
+        sums, counts = _lloyd_update(xp, c, n, k, bm, interpret,
+                                     precision=precision)
         cnt = counts[0:1, :].T  # (kp, 1); center pads stay 0
         new_c = jnp.where(cnt > 0, sums / jnp.maximum(cnt, 1.0), c)
         shift = jnp.sum((new_c - c) ** 2)
@@ -188,7 +195,10 @@ def lloyd_fit_pallas(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("comm", "n", "max_iter", "block_m", "interpret")
+    jax.jit,
+    static_argnames=(
+        "comm", "n", "max_iter", "block_m", "interpret", "precision"
+    ),
 )
 def lloyd_fit_pallas_sharded(
     comm,
@@ -199,6 +209,7 @@ def lloyd_fit_pallas_sharded(
     tol,
     block_m: int = 512,
     interpret: bool = False,
+    precision: jax.lax.Precision = jax.lax.Precision.HIGH,
 ):
     """Multi-device variant: the fused update runs per row-shard inside
     `shard_map` and one psum per iteration merges the (k, d)+(k,)
@@ -232,7 +243,8 @@ def lloyd_fit_pallas_sharded(
 
         def body(carry):
             c, it, _ = carry
-            sums, counts = _lloyd_update(xp, c, n, k, bm, interpret, lim)
+            sums, counts = _lloyd_update(xp, c, n, k, bm, interpret, lim,
+                                         precision=precision)
             sums = jax.lax.psum(sums, comm.axis_name)
             counts = jax.lax.psum(counts, comm.axis_name)
             cnt = counts[0:1, :].T

@@ -44,14 +44,17 @@ _MAX_K = 512  # f32 (bm, kp)+(bn, kp) tiles must fit VMEM; beyond this the
 # workload is GEMM-bound and the XLA path is the right tool
 
 
-def _kernel(gamma_ref, x_ref, y_ref, o_ref, *, epilogue):
+def _kernel(gamma_ref, x_ref, y_ref, o_ref, *, epilogue, precision):
     xb = x_ref[:]  # (bm, kp) f32
     yb = y_ref[:]  # (bn, kp) f32
-    # contraction over k with f32 accumulation; HIGH = bf16x3 passes (the
-    # XLA path's documented precision choice)
+    # contraction over k with f32 accumulation. ``precision`` is the
+    # lax.Precision for the in-kernel dot — HIGH (bf16x3, the XLA path's
+    # documented guard, distance.py:36-39) by default; exposed because
+    # Mosaic's lowering cost per precision tier is measured on-chip by
+    # scripts/tpu_tune.py rather than assumed
     dot = jax.lax.dot_general(
         xb, yb, (((1,), (1,)), ((), ())),
-        precision=jax.lax.Precision.HIGH,
+        precision=precision,
         preferred_element_type=jnp.float32,
     )
     x2 = jnp.sum(xb * xb, axis=1, keepdims=True)  # (bm, 1)
@@ -68,7 +71,8 @@ def _round_up(v: int, m: int) -> int:
 
 
 @functools.partial(
-    jax.jit, static_argnames=("epilogue", "block_m", "block_n", "interpret")
+    jax.jit,
+    static_argnames=("epilogue", "block_m", "block_n", "interpret", "precision"),
 )
 def euclid_pallas(
     x: jax.Array,
@@ -79,6 +83,7 @@ def euclid_pallas(
     block_m: int = 512,
     block_n: int = 1024,
     interpret: bool = False,
+    precision: jax.lax.Precision = jax.lax.Precision.HIGH,
 ) -> jax.Array:
     """Fused pairwise euclidean kernel on one device's tiles.
 
@@ -100,7 +105,7 @@ def euclid_pallas(
     gamma_arr = jnp.asarray(gamma, jnp.float32).reshape(1, 1)
 
     out = pl.pallas_call(
-        functools.partial(_kernel, epilogue=epilogue),
+        functools.partial(_kernel, epilogue=epilogue, precision=precision),
         grid=(mp // bm, np_ // bn),
         in_specs=[
             pl.BlockSpec((1, 1), lambda i, j: (_I0, _I0), memory_space=pltpu.SMEM),

@@ -5,7 +5,7 @@ the XLA forms, (b) find the matmul steady-state MFU config, (c) measure the
 moments pass against the HBM roofline. Each experiment is isolated — a
 failure prints an error line and the sweep continues. Usage:
 
-    python scripts/tpu_tune.py [--only cdist,kmeans,matmul,moments,rbf]
+    python scripts/tpu_tune.py [--only cdist,kmeans,matmul,moments,rbf,lm,attn_bwd]
 
 Keep sizes bench-equal so winners can be baked straight into bench.py.
 """
@@ -105,6 +105,27 @@ def main():
 
             run_guarded(f"cdist_blk_{bm}_{bn}", do)
 
+        # precision-tier sweep: Mosaic's lowering cost for the in-kernel
+        # dot is not uniform across tiers (HIGH may lower off the MXU);
+        # measure all three plus the XLA quadratic form above
+        for prec in ("DEFAULT", "HIGH", "HIGHEST"):
+            def run_prec(prec=prec):
+                out = None
+                for _ in range(reps):
+                    out = euclid_pallas(
+                        x.larray, x.larray,
+                        precision=getattr(jax.lax.Precision, prec),
+                    )
+                _sync(out)
+
+            def do_prec(prec=prec, run_prec=run_prec):
+                run_prec()
+                t = _time(run_prec)
+                emit(exp=f"cdist_pallas_prec_{prec}",
+                     gflops=round(reps * 2.0 * m * m * k / t / 1e9, 1))
+
+            run_guarded(f"cdist_prec_{prec}", do_prec)
+
     # ---------------- rbf fused epilogue ---------------------------------
     if want("rbf"):
         x = ht.random.rand(8192, 128, dtype=ht.float32, split=0)
@@ -152,6 +173,23 @@ def main():
                      seconds=round(t, 3))
 
             run_guarded(f"kmeans_{tag}", do)
+
+        # precision tier of the in-kernel scores dot, on the fit kernel
+        # directly (same shapes as the estimator path above)
+        from heat_tpu.cluster.pallas_lloyd import lloyd_fit_pallas
+
+        for prec in ("DEFAULT", "HIGH"):
+            def do_lp(prec=prec):
+                pv = getattr(jax.lax.Precision, prec)
+                run = lambda: _sync(lloyd_fit_pallas(
+                    xs.larray, xs.larray[:kc], ns, iters, 0.0, precision=pv
+                )[0])
+                run()
+                t = _time(run)
+                emit(exp=f"kmeans_pallas_prec_{prec}",
+                     gflops=round(iters * 4.0 * ns * kc * d / t / 1e9, 1))
+
+            run_guarded(f"kmeans_prec_{prec}", do_lp)
 
     # ---------------- matmul steady-state sweep --------------------------
     if want("matmul"):
@@ -235,6 +273,45 @@ def main():
                      mfu_v5e=round(gf / 197e3, 3))
 
             run_guarded(f"lm_{pol}", do)
+
+    # ---------------- attention backward block sweep ---------------------
+    if want("attn_bwd"):
+        from heat_tpu.parallel import flash_attention
+
+        (b, t, h, d, areps) = (4, 4096, 8, 128, 10)
+        akey = jax.random.PRNGKey(1)
+        kq, kk, kv = jax.random.split(akey, 3)
+        aq = jax.random.normal(kq, (b, t, h, d), dtype=jnp.bfloat16)
+        ak = jax.random.normal(kk, (b, t, h, d), dtype=jnp.bfloat16)
+        av = jax.random.normal(kv, (b, t, h, d), dtype=jnp.bfloat16)
+
+        for bq, bk in ((256, 512), (512, 512), (512, 1024), (1024, 512),
+                       (1024, 1024), (256, 1024), (512, 2048)):
+            def do_ab(bq=bq, bk=bk):
+                def loss(q_, k_, v_):
+                    return flash_attention(
+                        q_, k_, v_, causal=True, block_q=bq, block_k=bk
+                    ).astype(jnp.float32).sum()
+
+                @jax.jit
+                def chain(q, k, v):
+                    def body(_, carry):
+                        q_, k_, v_ = carry
+                        dq, dk, dv = jax.grad(loss, argnums=(0, 1, 2))(q_, k_, v_)
+                        return (q_ + dq * jnp.bfloat16(1e-3),
+                                k_ + dk * jnp.bfloat16(1e-3),
+                                v_ + dv * jnp.bfloat16(1e-3))
+
+                    return jax.lax.fori_loop(0, areps, body, (q, k, v))[0]
+
+                run = lambda: _sync(chain(aq, ak, av).astype(jnp.float32))
+                run()
+                tm = _time(run)
+                gf = areps * 9.0 * b * h * t * t * d / tm / 1e9
+                emit(exp=f"attn_bwd_bq{bq}_bk{bk}", gflops=round(gf, 1),
+                     mfu_v5e=round(gf / 197e3, 3))
+
+            run_guarded(f"attn_bwd_{bq}_{bk}", do_ab)
 
     # ---------------- moments vs HBM roofline ----------------------------
     if want("moments"):
