@@ -174,11 +174,16 @@ def main():
 
             run_guarded(f"kmeans_{tag}", do)
 
-        # precision tier of the in-kernel scores dot, on the fit kernel
-        # directly (same shapes as the estimator path above)
+        # precision tier of the in-kernel scores dot, on the single-device
+        # fit kernel directly (bench shapes; single-chip only — on a
+        # multi-chip mesh the estimator dispatches to the sharded variant
+        # and a direct single-device call on a sharded buffer would not be
+        # comparable)
         from heat_tpu.cluster.pallas_lloyd import lloyd_fit_pallas
 
-        for prec in ("DEFAULT", "HIGH"):
+        if ht.get_comm().size > 1:
+            emit(exp="kmeans_pallas_prec", skipped="multi-device mesh")
+        for prec in ("DEFAULT", "HIGH") if ht.get_comm().size == 1 else ():
             def do_lp(prec=prec):
                 pv = getattr(jax.lax.Precision, prec)
                 run = lambda: _sync(lloyd_fit_pallas(

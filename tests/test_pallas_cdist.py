@@ -87,3 +87,23 @@ class TestEuclidPallasInterpret:
         assert pallas_cdist_applicable(128, jnp.float32)
         assert not pallas_cdist_applicable(1024, jnp.float32)  # k > _MAX_K
         assert not pallas_cdist_applicable(128, jnp.bfloat16)  # dtype gate
+
+    @pytest.mark.parametrize("prec", ["DEFAULT", "HIGH", "HIGHEST"])
+    def test_precision_kwarg_wiring(self, prec):
+        # wiring smoke test: each tier must trace/jit through the static
+        # kwarg and still produce the oracle result. Interpret mode runs
+        # every tier in f32, so this does NOT pin on-chip tier numerics —
+        # hardware accuracy per tier is a tpu_tune.py concern (DEFAULT is
+        # documented-unsafe for the cdist diagonal, distance.py:36-39)
+        import jax
+
+        rng = np.random.default_rng(3)
+        x = rng.standard_normal((65, 17)).astype(np.float32)
+        y = rng.standard_normal((33, 17)).astype(np.float32)
+        out = euclid_pallas(
+            jnp.asarray(x), jnp.asarray(y), interpret=True,
+            precision=getattr(jax.lax.Precision, prec),
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), _np_cdist(x, y), rtol=2e-4, atol=2e-4
+        )

@@ -113,3 +113,26 @@ class TestPallasLloydInterpret:
         np.testing.assert_allclose(np.asarray(got_c), np.asarray(want_c),
                                    rtol=1e-5, atol=1e-5)
         np.testing.assert_array_equal(np.asarray(got_l), np.asarray(want_l))
+
+    def test_precision_kwarg_wiring(self):
+        # wiring smoke test: each tier must trace/jit through the static
+        # kwarg and still reproduce the XLA fit oracle. Interpret mode
+        # runs every tier in f32, so this does NOT pin on-chip tier
+        # numerics — that is a tpu_tune.py concern
+        import jax
+
+        rng = np.random.default_rng(5)
+        x = rng.standard_normal((120, 6)).astype(np.float32)
+        c0 = x[:4].copy()
+        ref_c, _, _, _ = _lloyd_fit(
+            jnp.asarray(x), jnp.ones((120,), jnp.float32), jnp.asarray(c0),
+            8, jnp.float32(0.0),
+        )
+        for prec in (jax.lax.Precision.DEFAULT, jax.lax.Precision.HIGHEST):
+            got_c, _, _, _ = lloyd_fit_pallas(
+                jnp.asarray(x), jnp.asarray(c0), 120, 8, jnp.float32(0.0),
+                block_m=32, interpret=True, precision=prec,
+            )
+            np.testing.assert_allclose(
+                np.asarray(got_c), np.asarray(ref_c), rtol=1e-5, atol=1e-5
+            )
