@@ -11,10 +11,12 @@ block the assignment scores, argmin, and the (k, d)/(k,) sums+counts
 updates all happen on the tile while it is in VMEM — X is read exactly
 ONCE per Lloyd iteration and nothing (n, k)-sized ever touches HBM.
 
-Two MXU dots per block (scores: (bm,d)x(d,k); update: (k,bm)x(bm,d)), both
+MXU dots per block (scores: (bm,d)x(d,k); update: (k,bm)x(bm,d)), both
 with f32 accumulation. The argmin drops the ||x||^2 term (constant per
-row — it cannot change the winner), so scores are just c2 - 2 x.c at
-``Precision.HIGH`` (the bf16x3 guard from ``_kcluster._d2``).
+row — it cannot change the winner), so scores are just c2 - 2 x.c with
+the manual ``"bf16x3"`` split product by default (HIGH-class accuracy —
+the guard from ``_kcluster._d2`` — via MXU-guaranteed DEFAULT-tier dots,
+see pallas_util.dot_f32).
 
 Scope: TPU f32 fits — single-device directly, multi-device via
 `lloyd_fit_pallas_sharded` (shard_map over row shards + one psum of the
@@ -32,6 +34,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+from ..core.pallas_util import DotPrecision, dot_f32
 
 __all__ = [
     "lloyd_fit_pallas",
@@ -67,13 +71,10 @@ def _lloyd_kernel(
 
     xb = x_ref[:]  # (bm, dp) f32
     c = c_ref[:]  # (kp, dp) f32
-    # ``precision`` tier for the scores dot is swept on-chip by
-    # scripts/tpu_tune.py (Mosaic lowering cost per tier is not uniform)
-    dot = jax.lax.dot_general(
-        xb, c, (((1,), (1,)), ((), ())),
-        precision=precision,
-        preferred_element_type=jnp.float32,
-    )  # (bm, kp)
+    # ``precision`` (a tier or "bf16x3") for the scores dot is swept
+    # on-chip by scripts/tpu_tune.py (Mosaic lowering cost per strategy
+    # is not uniform; see pallas_util.dot_f32)
+    dot = dot_f32(xb, c, (((1,), (1,)), ((), ())), precision)  # (bm, kp)
     c2 = jnp.sum(c * c, axis=1)[None, :]  # (1, kp)
     score = c2 - jnp.float32(2.0) * dot  # argmin-equivalent to d2
     jidx = jax.lax.broadcasted_iota(jnp.int32, score.shape, 1)
@@ -84,9 +85,11 @@ def _lloyd_kernel(
     onehot = jnp.where(
         (labels == jidx) & valid, jnp.float32(1.0), jnp.float32(0.0)
     )  # (bm, kp)
-    sums_s[:] += jax.lax.dot_general(
-        onehot, xb, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
+    # the update dot carries the same guard: onehot is exact in bf16, so
+    # the split product recovers f32-class center sums — a bare DEFAULT
+    # dot would bake ~2^-9 operand rounding into every center coordinate
+    sums_s[:] += dot_f32(
+        onehot, xb, (((0,), (0,)), ((), ())), precision
     )  # (kp, dp)
     counts_s[:] += jnp.broadcast_to(
         jnp.sum(onehot, axis=0, keepdims=True), counts_s.shape
@@ -99,7 +102,7 @@ def _lloyd_kernel(
 
 
 def _lloyd_update(x, centers_pad, n, k, bm, interpret, lim=None,
-                  precision=jax.lax.Precision.HIGH):
+                  precision: DotPrecision = "bf16x3"):
     """One fused accumulation pass: (sums (kp, dp), counts (8, kp)).
     ``x`` must already be padded to (mp, dp) with mp % bm == 0;
     ``centers_pad`` to (kp, dp); ``lim`` is the LOCAL valid-row count
@@ -150,7 +153,7 @@ def lloyd_fit_pallas(
     tol,
     block_m: int = 512,
     interpret: bool = False,
-    precision: jax.lax.Precision = jax.lax.Precision.HIGH,
+    precision: DotPrecision = "bf16x3",
 ):
     """The whole K-Means fit with the fused update kernel inside a
     `lax.while_loop`; returns (centers (k, d), labels (m,), inertia,
@@ -209,7 +212,7 @@ def lloyd_fit_pallas_sharded(
     tol,
     block_m: int = 512,
     interpret: bool = False,
-    precision: jax.lax.Precision = jax.lax.Precision.HIGH,
+    precision: DotPrecision = "bf16x3",
 ):
     """Multi-device variant: the fused update runs per row-shard inside
     `shard_map` and one psum per iteration merges the (k, d)+(k,)

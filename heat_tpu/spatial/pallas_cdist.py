@@ -13,11 +13,14 @@ Epilogues: ``dist`` (euclidean distance, the cdist result) and ``rbf``
 (``exp(-gamma * d2)`` — the Gaussian kernel matrix directly, saving the
 separate exp pass that :func:`heat_tpu.spatial.rbf` otherwise runs).
 
-The MXU dot runs at ``Precision.HIGH`` (bf16x3) like the XLA path — the
-documented guard against catastrophic cancellation on the cdist(X, X)
-diagonal (distance.py:36-39). Scope gate: f32 tiles with k ≤ 512 (the
-small-k regime where the epilogue dominates; larger k is GEMM-bound and
-XLA's path is already fine — and blocks must fit VMEM).
+The in-kernel dot defaults to the manual ``"bf16x3"`` split product
+(pallas_util.dot_f32) — HIGH-class accuracy, the documented guard against
+catastrophic cancellation on the cdist(X, X) diagonal (distance.py:36-39),
+from three DEFAULT-tier dots that provably land on the MXU.
+
+Scope gate: f32 tiles with k ≤ 512 (the small-k regime where the epilogue
+dominates; larger k is GEMM-bound and XLA's path is already fine — and
+blocks must fit VMEM).
 
 No reference analog (the reference's distance engine is ring-MPI torch,
 distance.py:209); this is TPU-native plumbing under the same API.
@@ -33,6 +36,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..core.pallas_util import DotPrecision, dot_f32
+
 __all__ = ["euclid_pallas", "pallas_cdist_applicable"]
 
 # jax_enable_x64 is on framework-wide: pin index-map literals to i32 (a
@@ -47,16 +52,12 @@ _MAX_K = 512  # f32 (bm, kp)+(bn, kp) tiles must fit VMEM; beyond this the
 def _kernel(gamma_ref, x_ref, y_ref, o_ref, *, epilogue, precision):
     xb = x_ref[:]  # (bm, kp) f32
     yb = y_ref[:]  # (bn, kp) f32
-    # contraction over k with f32 accumulation. ``precision`` is the
-    # lax.Precision for the in-kernel dot — HIGH (bf16x3, the XLA path's
-    # documented guard, distance.py:36-39) by default; exposed because
-    # Mosaic's lowering cost per precision tier is measured on-chip by
-    # scripts/tpu_tune.py rather than assumed
-    dot = jax.lax.dot_general(
-        xb, yb, (((1,), (1,)), ((), ())),
-        precision=precision,
-        preferred_element_type=jnp.float32,
-    )
+    # contraction over k with f32 accumulation. ``precision`` is a
+    # lax.Precision tier or "bf16x3" (manual MXU-guaranteed three-pass
+    # split product, pallas_util.dot_f32) — HIGH-class accuracy is the
+    # XLA path's documented cancellation guard (distance.py:36-39);
+    # which strategy is fastest is measured by scripts/tpu_tune.py
+    dot = dot_f32(xb, yb, (((1,), (1,)), ((), ())), precision)
     x2 = jnp.sum(xb * xb, axis=1, keepdims=True)  # (bm, 1)
     y2 = jnp.sum(yb * yb, axis=1)[None, :]  # (1, bn)
     d2 = jnp.maximum(x2 + y2 - jnp.float32(2.0) * dot, jnp.float32(0.0))
@@ -83,7 +84,7 @@ def euclid_pallas(
     block_m: int = 512,
     block_n: int = 1024,
     interpret: bool = False,
-    precision: jax.lax.Precision = jax.lax.Precision.HIGH,
+    precision: DotPrecision = "bf16x3",
 ) -> jax.Array:
     """Fused pairwise euclidean kernel on one device's tiles.
 

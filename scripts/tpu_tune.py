@@ -105,16 +105,15 @@ def main():
 
             run_guarded(f"cdist_blk_{bm}_{bn}", do)
 
-        # precision-tier sweep: Mosaic's lowering cost for the in-kernel
-        # dot is not uniform across tiers (HIGH may lower off the MXU);
-        # measure all three plus the XLA quadratic form above
-        for prec in ("DEFAULT", "HIGH", "HIGHEST"):
+        # precision-strategy sweep: Mosaic's lowering cost for the
+        # in-kernel dot is not uniform (HIGH may lower off the MXU);
+        # measure each strategy against the XLA quadratic form above
+        for prec in ("DEFAULT", "HIGH", "HIGHEST", "bf16x3"):
             def run_prec(prec=prec):
                 out = None
                 for _ in range(reps):
                     out = euclid_pallas(
-                        x.larray, x.larray,
-                        precision=getattr(jax.lax.Precision, prec),
+                        x.larray, x.larray, precision=prec,
                     )
                 _sync(out)
 
@@ -183,11 +182,11 @@ def main():
 
         if ht.get_comm().size > 1:
             emit(exp="kmeans_pallas_prec", skipped="multi-device mesh")
-        for prec in ("DEFAULT", "HIGH") if ht.get_comm().size == 1 else ():
+        for prec in (("DEFAULT", "HIGH", "bf16x3")
+                     if ht.get_comm().size == 1 else ()):
             def do_lp(prec=prec):
-                pv = getattr(jax.lax.Precision, prec)
                 run = lambda: _sync(lloyd_fit_pallas(
-                    xs.larray, xs.larray[:kc], ns, iters, 0.0, precision=pv
+                    xs.larray, xs.larray[:kc], ns, iters, 0.0, precision=prec
                 )[0])
                 run()
                 t = _time(run)
