@@ -152,15 +152,28 @@ def _out_struct(shape, like, dtype=None):
     return jax.ShapeDtypeStruct(shape, dtype)
 
 
-def _pad_blocks(q, k, v, t_q, t_k, d, block_q, block_k):
-    """Clamp blocks for short sequences, pad seq lengths to block multiples
-    and the head dim to the lane width. Returns the padded operands and the
-    resolved geometry."""
+def _block_geometry(t_q, t_k, d, block_q, block_k):
+    """Resolve the effective tiling: clamped blocks and pad amounts.
+
+    The ONE source of truth for this arithmetic — `_pad_blocks` pads with
+    it and `_flash_bwd_dispatch`'s "auto" sizes the fused dQ block with
+    it, so the two can never disagree about the resident-block footprint.
+    """
     block_q = min(block_q, -(-t_q // _LANES) * _LANES)
     block_k = min(block_k, -(-t_k // _LANES) * _LANES)
     pq = -t_q % block_q
     pk = -t_k % block_k
     pd = -d % _LANES
+    return block_q, block_k, pq, pk, pd
+
+
+def _pad_blocks(q, k, v, t_q, t_k, d, block_q, block_k):
+    """Clamp blocks for short sequences, pad seq lengths to block multiples
+    and the head dim to the lane width. Returns the padded operands and the
+    resolved geometry."""
+    block_q, block_k, pq, pk, pd = _block_geometry(
+        t_q, t_k, d, block_q, block_k
+    )
     if pq:
         q = jnp.pad(q, ((0, 0), (0, 0), (0, pq), (0, 0)))
     if pk:
@@ -276,6 +289,34 @@ def _rebuild_probs(q, k, lse, iq, ik, *, scale, causal, kv_valid, block_q, block
     return p
 
 
+def _bwd_block_terms(
+    refs, iq, ik, *, scale, causal, kv_valid, block_q, block_k
+):
+    """Shared backward block math: unpack the (1, 1, blk, D) refs, rebuild
+    p, compute ``dP = dO Vᵀ`` and ``dS = P ∘ (dP − D) · scale`` with the
+    MXU-dtype casts — ONE definition so the dq, dk/dv, and fused kernels
+    can never desynchronize. Returns (q, k, v, do, p_mx, ds_mx)."""
+    q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref = refs
+    q = q_ref[0, 0]
+    k = k_ref[0, 0]
+    v = v_ref[0, 0]
+    do = do_ref[0, 0]
+    lse = lse_ref[0, 0][:, 0:1]  # (bq, 1)
+    dd = dd_ref[0, 0][:, 0:1]
+
+    p = _rebuild_probs(
+        q, k, lse, iq, ik, scale=scale, causal=causal, kv_valid=kv_valid,
+        block_q=block_q, block_k=block_k,
+    )  # (bq, bk)
+    p_mx = p if do.dtype == jnp.float32 else p.astype(do.dtype)
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )  # (bq, bk)
+    ds = p * (dp - dd) * jnp.float32(scale)
+    ds_mx = ds if q.dtype == jnp.float32 else ds.astype(q.dtype)
+    return q, k, v, do, p_mx, ds_mx
+
+
 def _bwd_dq_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref, dq_ref, dq_acc,
     *, scale, causal, kv_valid, block_q, block_k,
@@ -302,22 +343,11 @@ def _bwd_dq_kernel(
 
     @pl.when(live)
     def _accumulate():
-        q = q_ref[0, 0]
-        k = k_ref[0, 0]
-        v = v_ref[0, 0]
-        do = do_ref[0, 0]
-        lse = lse_ref[0, 0][:, 0:1]  # (bq, 1)
-        dd = dd_ref[0, 0][:, 0:1]
-
-        p = _rebuild_probs(
-            q, k, lse, iq, ik, scale=scale, causal=causal, kv_valid=kv_valid,
+        _, k, _, _, _, ds_mx = _bwd_block_terms(
+            (q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref), iq, ik,
+            scale=scale, causal=causal, kv_valid=kv_valid,
             block_q=block_q, block_k=block_k,
-        )  # (bq, bk)
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # (bq, bk)
-        ds = p * (dp - dd) * jnp.float32(scale)
-        ds_mx = ds if k.dtype == jnp.float32 else ds.astype(k.dtype)
+        )
         dq_acc[:] = dq_acc[:] + jax.lax.dot_general(
             ds_mx, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -352,31 +382,19 @@ def _bwd_dkv_kernel(
 
     @pl.when(live)
     def _accumulate():
-        q = q_ref[0, 0]
-        k = k_ref[0, 0]
-        v = v_ref[0, 0]
-        do = do_ref[0, 0]
-        lse = lse_ref[0, 0][:, 0:1]  # (bq, 1)
-        dd = dd_ref[0, 0][:, 0:1]
-
         # same (bq, bk) score orientation as the dq pass — the q-dim
         # contractions below transpose implicitly via dot_general dimension
         # numbers (no Mosaic-side transposes)
-        p = _rebuild_probs(
-            q, k, lse, iq, ik, scale=scale, causal=causal, kv_valid=kv_valid,
+        q, _, _, do, p_mx, ds_mx = _bwd_block_terms(
+            (q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref), iq, ik,
+            scale=scale, causal=causal, kv_valid=kv_valid,
             block_q=block_q, block_k=block_k,
-        )  # (bq, bk)
-        p_mx = p if do.dtype == jnp.float32 else p.astype(do.dtype)
+        )
         # dV += Pᵀ dO: contract the q dim of both operands
         dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
             p_mx, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # (bq, bk)
-        ds = p * (dp - dd) * jnp.float32(scale)
-        ds_mx = ds if q.dtype == jnp.float32 else ds.astype(q.dtype)
         # dK += dSᵀ Q: contract the q dim
         dk_acc[:] = dk_acc[:] + jax.lax.dot_general(
             ds_mx, q, (((0,), (0,)), ((), ())),
@@ -389,33 +407,14 @@ def _bwd_dkv_kernel(
         dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-@functools.partial(
-    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8)
-)
-def _flash(q, k, v, scale, causal, kv_valid, block_q, block_k, interpret):
-    return _flash_forward(
-        q, k, v, scale, causal, kv_valid, block_q, block_k, interpret
-    )
-
-
-def _flash_fwd(q, k, v, scale, causal, kv_valid, block_q, block_k, interpret):
-    out, lse = _flash_forward(
-        q, k, v, scale, causal, kv_valid, block_q, block_k, interpret,
-        return_lse=True,
-    )
-    return out, (q, k, v, out, lse)
-
-
-def _flash_bwd(scale, causal, kv_valid, block_q, block_k, interpret, res, g):
-    """Flash backward as two Pallas kernels (dq; dk/dv) using the saved O
-    and log-sum-exp — O(T) memory, every MXU dot in the input dtype (the
-    r3 XLA-recompute backward ran true-f32 passes; this is the lm_step MFU
-    lever)."""
+def _bwd_prologue(res, g, block_q, block_k):
+    """Shared backward host-side prep: the D = rowsum(dO ∘ O) residual,
+    block clamping/padding of every operand, and the lane-broadcast dd
+    layout. One definition for the two-pass and fused drivers."""
     q, k, v, out, lse = res
     b, h, t_q, d = q.shape
     t_k = k.shape[2]
 
-    # D = rowsum(dO ∘ O) per query row, f32, lane-broadcast padded layout
     dd = (g.astype(jnp.float32) * out.astype(jnp.float32)).sum(axis=-1)
     qp, kp, vp, block_q, block_k, pq, pk, dp = _pad_blocks(
         q, k, v, t_q, t_k, d, block_q, block_k
@@ -427,6 +426,199 @@ def _flash_bwd(scale, causal, kv_valid, block_q, block_k, interpret, res, g):
         do_p = g
     dd_p = jnp.pad(dd, ((0, 0), (0, 0), (0, pq)))[..., None] * jnp.ones(
         (_LANES,), jnp.float32
+    )
+    return qp, kp, vp, do_p, dd_p, block_q, block_k, pq, pk, dp
+
+
+def _bwd_fused_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref, dq_ref, dk_ref, dv_ref,
+    dk_acc, dv_acc,
+    *, scale, causal, kv_valid, block_q, block_k,
+):
+    """Single-pass backward. Grid = (B, H, num_k_blocks, num_q_blocks),
+    the last two sequential.
+
+    The two-pass backward rebuilds p and recomputes the dP dot once per
+    pass — 7 MXU dots, two exp sweeps, and two full Q/K/V/dO streams per
+    live block pair. Here each (ki, qi) pair is visited ONCE: p, dP, dS
+    are shared, dV/dK accumulate in per-ki scratch (flushed when qi
+    wraps, as in the two-pass dkv kernel) and dQ accumulates into its
+    own full-resident f32 output block via a dynamic row-slice — 5 dots,
+    one exp sweep, one stream. Costs VMEM: the whole (T_q, d) f32 dQ
+    block stays resident, which is why the fused path is gated on
+    ``_fused_bwd_fits``."""
+    ik = pl.program_id(2)
+    iq = pl.program_id(3)
+    nq = pl.num_programs(3)
+
+    @pl.when((ik == 0) & (iq == 0))
+    def _init_dq():
+        dq_ref[0, 0] = jnp.zeros_like(dq_ref[0, 0])
+
+    @pl.when(iq == 0)
+    def _init_kv():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    if causal:
+        live = iq * block_q + (block_q - 1) >= ik * block_k
+    else:
+        live = iq >= 0
+
+    @pl.when(live)
+    def _accumulate():
+        q, k, _, do, p_mx, ds_mx = _bwd_block_terms(
+            (q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref), iq, ik,
+            scale=scale, causal=causal, kv_valid=kv_valid,
+            block_q=block_q, block_k=block_k,
+        )
+        dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
+            p_mx, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dk_acc[:] = dk_acc[:] + jax.lax.dot_general(
+            ds_mx, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        row = pl.multiple_of(iq * block_q, block_q)
+        dq_ref[0, 0, pl.ds(row, block_q), :] = dq_ref[
+            0, 0, pl.ds(row, block_q), :
+        ] + jax.lax.dot_general(
+            ds_mx, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(iq == nq - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+# the fused backward keeps the whole (T_q, d) f32 dQ block resident in
+# VMEM (~16 MB/core on v5e); 4 MB leaves room for the streaming blocks,
+# their double buffers, and the dK/dV scratch
+_FUSED_BWD_DQ_BYTES = 4 * 1024 * 1024
+
+
+def _fused_bwd_fits(t_q_padded: int, dp: int) -> bool:
+    return t_q_padded * dp * 4 <= _FUSED_BWD_DQ_BYTES
+
+
+def _flash_bwd_fused(
+    scale, causal, kv_valid, block_q, block_k, interpret, res, g
+):
+    """Fused-kernel backward; same contract as the two-pass `_flash_bwd`."""
+    q, k, v, out, lse = res
+    b, h, t_q, d = q.shape
+    t_k = k.shape[2]
+    qp, kp, vp, do_p, dd_p, block_q, block_k, pq, pk, dp = _bwd_prologue(
+        res, g, block_q, block_k
+    )
+
+    tq_p = t_q + pq
+    grid = (b, h, (t_k + pk) // block_k, tq_p // block_q)
+    qo_spec = pl.BlockSpec(
+        (1, 1, block_q, dp), lambda bi, hi, ki, qi: (bi, hi, qi, _I0),
+        memory_space=pltpu.VMEM,
+    )
+    kv_spec = pl.BlockSpec(
+        (1, 1, block_k, dp), lambda bi, hi, ki, qi: (bi, hi, ki, _I0),
+        memory_space=pltpu.VMEM,
+    )
+    lm_spec = pl.BlockSpec(
+        (1, 1, block_q, _LANES), lambda bi, hi, ki, qi: (bi, hi, qi, _I0),
+        memory_space=pltpu.VMEM,
+    )
+    dq_spec = pl.BlockSpec(
+        (1, 1, tq_p, dp), lambda bi, hi, ki, qi: (bi, hi, _I0, _I0),
+        memory_space=pltpu.VMEM,
+    )
+    dq, dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_fused_kernel, scale=scale, causal=causal, kv_valid=kv_valid,
+            block_q=block_q, block_k=block_k,
+        ),
+        grid=grid,
+        in_specs=[qo_spec, kv_spec, kv_spec, qo_spec, lm_spec, lm_spec],
+        out_specs=[dq_spec, kv_spec, kv_spec],
+        out_shape=[
+            # f32: the output block IS the cross-ki accumulator
+            _out_struct((b, h, tq_p, dp), q, dtype=jnp.float32),
+            _out_struct((b, h, t_k + pk, dp), k),
+            _out_struct((b, h, t_k + pk, dp), v),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, dp), jnp.float32),
+            pltpu.VMEM((block_k, dp), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=(
+                "parallel", "parallel", "arbitrary", "arbitrary"
+            ),
+        ),
+        interpret=interpret,
+    )(qp, kp, vp, do_p, lse, dd_p)
+
+    return (
+        dq[:, :, :t_q, :d].astype(q.dtype),
+        dk[:, :, :t_k, :d].astype(k.dtype),
+        dv[:, :, :t_k, :d].astype(v.dtype),
+    )
+
+
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9)
+)
+def _flash(
+    q, k, v, scale, causal, kv_valid, block_q, block_k, interpret, bwd_impl
+):
+    return _flash_forward(
+        q, k, v, scale, causal, kv_valid, block_q, block_k, interpret
+    )
+
+
+def _flash_fwd(
+    q, k, v, scale, causal, kv_valid, block_q, block_k, interpret, bwd_impl
+):
+    out, lse = _flash_forward(
+        q, k, v, scale, causal, kv_valid, block_q, block_k, interpret,
+        return_lse=True,
+    )
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd_dispatch(
+    scale, causal, kv_valid, block_q, block_k, interpret, bwd_impl, res, g
+):
+    """Pick the backward implementation. ``"auto"`` takes the fused
+    single-pass kernel whenever its resident f32 dQ block fits the VMEM
+    budget, else the two-pass kernels."""
+    if bwd_impl == "auto":
+        t_q, d = res[0].shape[2], res[0].shape[3]
+        t_k = res[1].shape[2]
+        _, _, pq, _, pd = _block_geometry(t_q, t_k, d, block_q, block_k)
+        bwd_impl = (
+            "fused" if _fused_bwd_fits(t_q + pq, d + pd) else "two_pass"
+        )
+    if bwd_impl == "fused":
+        return _flash_bwd_fused(
+            scale, causal, kv_valid, block_q, block_k, interpret, res, g
+        )
+    return _flash_bwd(
+        scale, causal, kv_valid, block_q, block_k, interpret, res, g
+    )
+
+
+def _flash_bwd(scale, causal, kv_valid, block_q, block_k, interpret, res, g):
+    """Flash backward as two Pallas kernels (dq; dk/dv) using the saved O
+    and log-sum-exp — O(T) memory, every MXU dot in the input dtype (the
+    r3 XLA-recompute backward ran true-f32 passes; this is the lm_step MFU
+    lever)."""
+    q, k, v, out, lse = res
+    b, h, t_q, d = q.shape
+    t_k = k.shape[2]
+    qp, kp, vp, do_p, dd_p, block_q, block_k, pq, pk, dp = _bwd_prologue(
+        res, g, block_q, block_k
     )
 
     grid_q = (b, h, (t_q + pq) // block_q, (t_k + pk) // block_k)
@@ -503,7 +695,7 @@ def _flash_bwd(scale, causal, kv_valid, block_q, block_k, interpret, res, g):
     )
 
 
-_flash.defvjp(_flash_fwd, _flash_bwd)
+_flash.defvjp(_flash_fwd, _flash_bwd_dispatch)
 
 
 def _resolve_interpret(x) -> bool:
@@ -536,6 +728,7 @@ def flash_attention(
     block_q: int = 512,
     block_k: int = 1024,
     interpret: Optional[bool] = None,
+    bwd_impl: str = "two_pass",
 ) -> jax.Array:
     """Flash attention as a hand-tiled Pallas TPU kernel.
 
@@ -546,6 +739,13 @@ def flash_attention(
     (README table), 2.7× the XLA online-softmax path. Blocks are clamped for
     short sequences. ``interpret`` defaults to True off-TPU so the same
     tests run on the CPU mesh via the Pallas interpreter.
+
+    ``bwd_impl`` selects the backward strategy: ``"two_pass"`` (the r4
+    dq + dk/dv kernels, the measured default), ``"fused"`` (single-pass
+    kernel sharing the probability rebuild, resident f32 dQ — see
+    `_bwd_fused_kernel`), or ``"auto"`` (fused whenever the dQ block fits
+    the VMEM budget). The fused path stays opt-in until the on-chip sweep
+    (scripts/tpu_tune.py attn_bwd) records it winning.
     """
     if q.ndim != 4:
         raise ValueError(f"expected (B, T, H, D) inputs, got {q.shape}")
@@ -556,9 +756,13 @@ def flash_attention(
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
     kv_valid = t_k if kv_valid is None else int(kv_valid)
     # kernel works in (B, H, T, D); public layout is (B, T, H, D)
+    if bwd_impl not in ("two_pass", "fused", "auto"):
+        raise ValueError(
+            f"bwd_impl must be 'two_pass', 'fused' or 'auto', got {bwd_impl!r}"
+        )
     out = _flash(
         q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
         v.transpose(0, 2, 1, 3),
-        scale, causal, kv_valid, block_q, block_k, interpret,
+        scale, causal, kv_valid, block_q, block_k, interpret, bwd_impl,
     )
     return out.transpose(0, 2, 1, 3)

@@ -289,12 +289,17 @@ def main():
         ak = jax.random.normal(kk, (b, t, h, d), dtype=jnp.bfloat16)
         av = jax.random.normal(kv, (b, t, h, d), dtype=jnp.bfloat16)
 
-        for bq, bk in ((256, 512), (512, 512), (512, 1024), (1024, 512),
-                       (1024, 1024), (256, 1024), (512, 2048)):
-            def do_ab(bq=bq, bk=bk):
+        for impl, (bq, bk) in [
+            (im, blks)
+            for im in ("two_pass", "fused")
+            for blks in ((256, 512), (512, 512), (512, 1024), (1024, 512),
+                         (1024, 1024), (256, 1024), (512, 2048))
+        ]:
+            def do_ab(impl=impl, bq=bq, bk=bk):
                 def loss(q_, k_, v_):
                     return flash_attention(
-                        q_, k_, v_, causal=True, block_q=bq, block_k=bk
+                        q_, k_, v_, causal=True, block_q=bq, block_k=bk,
+                        bwd_impl=impl,
                     ).astype(jnp.float32).sum()
 
                 @jax.jit
@@ -312,10 +317,10 @@ def main():
                 run()
                 tm = _time(run)
                 gf = areps * 9.0 * b * h * t * t * d / tm / 1e9
-                emit(exp=f"attn_bwd_bq{bq}_bk{bk}", gflops=round(gf, 1),
+                emit(exp=f"attn_bwd_{impl}_bq{bq}_bk{bk}", gflops=round(gf, 1),
                      mfu_v5e=round(gf / 197e3, 3))
 
-            run_guarded(f"attn_bwd_{bq}_{bk}", do_ab)
+            run_guarded(f"attn_bwd_{impl}_{bq}_{bk}", do_ab)
 
     # ---------------- moments vs HBM roofline ----------------------------
     if want("moments"):

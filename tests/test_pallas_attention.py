@@ -206,3 +206,77 @@ class TestPallasBackwardKernels:
             af, bf = a.astype(jnp.float32), bb.astype(jnp.float32)
             rel = float(jnp.abs(af - bf).max()) / max(float(jnp.abs(bf).max()), 1.0)
             assert rel < 0.1, (name, rel)
+
+
+class TestFusedBackward:
+    """The fused single-pass backward must produce the SAME grads as the
+    two-pass kernels (shared `_rebuild_probs`; only the accumulation
+    schedule differs — f32 dQ resident vs per-pass scratch)."""
+
+    def _grads(self, fn, q, k, v, g):
+        def loss(q_, k_, v_):
+            return (fn(q_, k_, v_).astype(jnp.float32) * g.astype(jnp.float32)).sum()
+
+        return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+    @pytest.mark.parametrize(
+        "b,t,h,d,causal,kv_valid",
+        [
+            (1, 256, 2, 64, False, None),
+            (2, 384, 2, 32, True, None),   # ragged t -> q/k pad rows
+            (1, 256, 2, 64, True, 200),    # kv padding mask
+        ],
+    )
+    def test_fused_matches_two_pass_f32(self, b, t, h, d, causal, kv_valid):
+        from heat_tpu.parallel import flash_attention
+
+        rng = np.random.default_rng(17)
+        q, k, v, g = (
+            jnp.asarray(rng.standard_normal((b, t, h, d)), jnp.float32)
+            for _ in range(4)
+        )
+        kw = dict(causal=causal, kv_valid=kv_valid, interpret=True,
+                  block_q=128, block_k=128)
+        g2 = self._grads(
+            lambda q_, k_, v_: flash_attention(q_, k_, v_, bwd_impl="two_pass", **kw),
+            q, k, v, g,
+        )
+        gf = self._grads(
+            lambda q_, k_, v_: flash_attention(q_, k_, v_, bwd_impl="fused", **kw),
+            q, k, v, g,
+        )
+        for name, a, bb in zip("qkv", gf, g2):
+            err = float(jnp.abs(a - bb).max())
+            ref = max(float(jnp.abs(bb).max()), 1.0)
+            # identical math modulo f32 summation order
+            assert err < 1e-5 * ref, (name, err, ref)
+
+    def test_auto_resolves_and_matches(self):
+        from heat_tpu.parallel import flash_attention
+
+        rng = np.random.default_rng(23)
+        q, k, v, g = (
+            jnp.asarray(rng.standard_normal((1, 256, 2, 64)), jnp.bfloat16)
+            for _ in range(4)
+        )
+        kw = dict(causal=True, interpret=True, block_q=128, block_k=128)
+        g2 = self._grads(
+            lambda q_, k_, v_: flash_attention(q_, k_, v_, bwd_impl="two_pass", **kw),
+            q, k, v, g,
+        )
+        ga = self._grads(
+            lambda q_, k_, v_: flash_attention(q_, k_, v_, bwd_impl="auto", **kw),
+            q, k, v, g,
+        )
+        for name, a, bb in zip("qkv", ga, g2):
+            af, bf = a.astype(jnp.float32), bb.astype(jnp.float32)
+            rel = float(jnp.abs(af - bf).max()) / max(float(jnp.abs(bf).max()), 1.0)
+            # bf16 cast points differ only in dQ's final rounding
+            assert rel < 2e-2, (name, rel)
+
+    def test_bad_impl_raises(self):
+        from heat_tpu.parallel import flash_attention
+
+        q = jnp.zeros((1, 8, 1, 8), jnp.float32)
+        with pytest.raises(ValueError, match="bwd_impl"):
+            flash_attention(q, q, q, bwd_impl="nope", interpret=True)
