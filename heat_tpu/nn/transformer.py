@@ -35,7 +35,7 @@ import jax
 import jax.numpy as jnp
 
 
-def _attend(q, k, v, *, impl, causal, comm, block_size):
+def _attend(q, k, v, *, impl, causal, comm, block_size, flash_bwd_impl):
     from ..parallel import (
         flash_attention,
         local_attention,
@@ -45,9 +45,12 @@ def _attend(q, k, v, *, impl, causal, comm, block_size):
 
     if impl == "flash":
         if block_size is None:
-            return flash_attention(q, k, v, causal=causal)  # tuned tiles
+            return flash_attention(  # tuned tiles
+                q, k, v, causal=causal, bwd_impl=flash_bwd_impl
+            )
         return flash_attention(
-            q, k, v, causal=causal, block_q=block_size, block_k=block_size
+            q, k, v, causal=causal, block_q=block_size, block_k=block_size,
+            bwd_impl=flash_bwd_impl,
         )
     if impl == "ring":
         # the ring processes one mesh chunk per hop; there is no block knob
@@ -77,6 +80,8 @@ class MultiHeadAttention(nn.Module):
     comm: Optional[Any] = None
     block_size: Optional[int] = None  # None = each impl's tuned default
     dtype: Any = jnp.float32
+    # flash backward strategy (pallas_attention.flash_attention bwd_impl)
+    flash_bwd_impl: str = "two_pass"
 
     @nn.compact
     def __call__(self, x):
@@ -91,6 +96,7 @@ class MultiHeadAttention(nn.Module):
         q, k, v = dense("query")(x), dense("key")(x), dense("value")(x)
         o = _attend(
             q, k, v, impl=self.attn_impl, causal=self.causal, comm=self.comm,
+            flash_bwd_impl=self.flash_bwd_impl,
             block_size=self.block_size,
         )
         return nn.DenseGeneral(
@@ -108,6 +114,7 @@ class TransformerBlock(nn.Module):
     comm: Optional[Any] = None
     block_size: Optional[int] = None  # None = each impl's tuned default
     dtype: Any = jnp.float32
+    flash_bwd_impl: str = "two_pass"
 
     @nn.compact
     def __call__(self, x):
@@ -115,7 +122,7 @@ class TransformerBlock(nn.Module):
         h = nn.LayerNorm(dtype=self.dtype, name="ln1")(x)
         x = x + MultiHeadAttention(
             self.num_heads, self.attn_impl, self.causal, self.comm,
-            self.block_size, self.dtype, name="attn",
+            self.block_size, self.dtype, self.flash_bwd_impl, name="attn",
         )(h)
         h = nn.LayerNorm(dtype=self.dtype, name="ln2")(x)
         d_ff = int(d_model * self.mlp_ratio)
@@ -143,6 +150,7 @@ class TransformerLM(nn.Module):
     # dots_with_no_batch_dims_saveable) — usually faster when HBM allows
     remat_policy: Optional[str] = None
     dtype: Any = jnp.float32
+    flash_bwd_impl: str = "two_pass"
 
     @nn.compact
     def __call__(self, tokens):
@@ -174,7 +182,8 @@ class TransformerLM(nn.Module):
         for i in range(self.num_layers):
             x = block_cls(
                 self.num_heads, self.mlp_ratio, self.attn_impl, True,
-                self.comm, self.block_size, self.dtype, name=f"block{i}",
+                self.comm, self.block_size, self.dtype,
+                self.flash_bwd_impl, name=f"block{i}",
             )(x)
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
         return nn.Dense(self.vocab_size, use_bias=False, dtype=self.dtype, name="lm_head")(x)

@@ -15,10 +15,13 @@ paths agree to tight tolerance (asserted on CPU via the Pallas
 interpreter); for bf16 inputs the MXU dots run in bf16 with f32
 accumulation (and p rounds to bf16 before the PV product — standard flash
 practice), so agreement is to bf16 tolerance, also asserted. The backward
-pass is two hand-tiled Pallas kernels (dq; dk/dv) that rebuild the
-probabilities from the saved O and log-sum-exp residuals — O(T) memory
-(no stored (T, T) matrix), every MXU dot in the input dtype. The
-inference-only forward skips the log-sum-exp output entirely.
+rebuilds probabilities from the saved O and log-sum-exp residuals — O(T)
+memory (no stored (T, T) matrix), every MXU dot in the input dtype — in
+one of two selectable strategies (``flash_attention(bwd_impl=...)``):
+``"two_pass"`` hand-tiled kernels (dq; dk/dv), or the ``"fused"``
+single-pass kernel that shares the rebuild across dq/dk/dv with a
+VMEM-resident f32 dQ block (``"auto"`` picks fused when that block fits).
+The inference-only forward skips the log-sum-exp output entirely.
 """
 
 from __future__ import annotations

@@ -233,12 +233,14 @@ def main():
         key = jax.random.PRNGKey(0)
         toks = jax.random.randint(key, (b, t), 0, v, dtype=jnp.int32)
 
-        for pol in (None, "dots"):
-            def do(pol=pol):
+        for pol, bwd in ((None, "two_pass"), ("dots", "two_pass"),
+                         (None, "fused"), ("dots", "fused")):
+            def do(pol=pol, bwd=bwd):
                 lm = TransformerLM(
                     vocab_size=v, d_model=dm, num_heads=nh, num_layers=nl,
                     max_len=t, attn_impl="flash", remat=True,
                     remat_policy=pol, dtype=jnp.bfloat16,
+                    flash_bwd_impl=bwd,
                 )
                 params = lm.init(key, toks)
                 opt = optax.adamw(1e-3)
@@ -273,10 +275,10 @@ def main():
                 run()
                 tm = _time(run)
                 gf = lreps * 6.0 * n_params * b * t / tm / 1e9
-                emit(exp=f"lm_step_remat_{pol or 'full'}", gflops=round(gf, 1),
-                     mfu_v5e=round(gf / 197e3, 3))
+                emit(exp=f"lm_step_remat_{pol or 'full'}_bwd_{bwd}",
+                     gflops=round(gf, 1), mfu_v5e=round(gf / 197e3, 3))
 
-            run_guarded(f"lm_{pol}", do)
+            run_guarded(f"lm_{pol}_{bwd}", do)
 
     # ---------------- attention backward block sweep ---------------------
     if want("attn_bwd"):

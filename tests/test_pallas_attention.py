@@ -253,7 +253,24 @@ class TestFusedBackward:
 
     def test_auto_resolves_and_matches(self):
         from heat_tpu.parallel import flash_attention
+        from heat_tpu.parallel.pallas_attention import (
+            _flash_bwd_fused,
+            _fused_bwd_fits,
+        )
+        import heat_tpu.parallel.pallas_attention as pa
 
+        # "auto" must actually take the fused branch at this shape (the
+        # grads comparison alone would pass even if dispatch regressed to
+        # two_pass — record the fused driver running)
+        assert _fused_bwd_fits(256, 128)
+        calls = []
+        orig = _flash_bwd_fused
+
+        def spy(*a, **kw):
+            calls.append(1)
+            return orig(*a, **kw)
+
+        pa._flash_bwd_fused = spy
         rng = np.random.default_rng(23)
         q, k, v, g = (
             jnp.asarray(rng.standard_normal((1, 256, 2, 64)), jnp.bfloat16)
@@ -268,6 +285,8 @@ class TestFusedBackward:
             lambda q_, k_, v_: flash_attention(q_, k_, v_, bwd_impl="auto", **kw),
             q, k, v, g,
         )
+        pa._flash_bwd_fused = orig
+        assert calls, "auto did not dispatch to the fused backward"
         for name, a, bb in zip("qkv", ga, g2):
             af, bf = a.astype(jnp.float32), bb.astype(jnp.float32)
             rel = float(jnp.abs(af - bf).max()) / max(float(jnp.abs(bf).max()), 1.0)
