@@ -480,17 +480,20 @@ def bench_heat_tpu(errors, profile_dir=None, small=False, only=None,
     # Priority order (round-5 contract): the rows the judge reads first —
     # matmul (headline + profile target), matmul_bf16 (MFU), matmul_1b
     # (BASELINE.md north star), attention_bwd — run BEFORE everything else,
-    # so a driver timeout still captures them; then the rest of the geomean
-    # set; detail extras last.
+    # so a driver timeout still captures them. lasso (pure XLA) completes
+    # the geomean set BEFORE the three new-Pallas-kernel rows: a Mosaic
+    # compile crash can wedge the accelerator tunnel for every LATER
+    # compile (the r5 wedge, artifacts/bench_tpu_session_r5a.json), so the
+    # riskiest rows must not sit in front of safe unmeasured ones.
     workloads = [
         ("matmul", make_matmul),
         ("matmul_bf16", make_matmul_bf16),
         ("matmul_1b", make_matmul_1b),
         ("attention_bwd", make_attention_bwd),
+        ("lasso", make_lasso),
         ("cdist", make_cdist),
         ("kmeans", make_kmeans),
         ("moments", make_moments),
-        ("lasso", make_lasso),
         ("attention", make_attention),
         ("matmul_f32", make_matmul_f32),
         ("matmul_int8", make_matmul_int8),
