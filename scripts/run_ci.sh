@@ -75,13 +75,23 @@ for n in $SIZES; do
                 args+=("--junitxml=${REPORT}/junit_${n}_${k}.xml")
             fi
         fi
-        crc=0
-        if [ "$have_coverage" = 1 ]; then
-            HEAT_TPU_TEST_DEVICES=$n COVERAGE_FILE="${REPORT}/.coverage.${n}.${k}" \
-                python -m coverage run --source=heat_tpu -m pytest "${files[@]}" "${args[@]}" || crc=$?
-        else
-            HEAT_TPU_TEST_DEVICES=$n python -m pytest "${files[@]}" "${args[@]}" || crc=$?
-        fi
+        # rc 134 = SIGABRT: the XLA CPU client nondeterministically
+        # corrupts the glibc heap on this host ("corrupted size vs.
+        # prev_size", seen only on odd virtual-mesh sizes; the abort
+        # detonates at an arbitrary LATER allocation, so it is not a
+        # test failure). A fresh process gets a fresh heap layout —
+        # retry an aborted chunk once before declaring the size failed.
+        for attempt in 1 2; do
+            crc=0
+            if [ "$have_coverage" = 1 ]; then
+                HEAT_TPU_TEST_DEVICES=$n COVERAGE_FILE="${REPORT}/.coverage.${n}.${k}" \
+                    python -m coverage run --source=heat_tpu -m pytest "${files[@]}" "${args[@]}" || crc=$?
+            else
+                HEAT_TPU_TEST_DEVICES=$n python -m pytest "${files[@]}" "${args[@]}" || crc=$?
+            fi
+            [ "$crc" != 134 ] && break
+            echo "=== chunk ${k} aborted (SIGABRT, known XLA CPU heap flake) — retrying once ==="
+        done
         # pytest rc 5 = no tests collected in this chunk — not a failure
         # on its own, but at least one chunk must actually run tests
         if [ "$crc" = 0 ]; then

@@ -34,4 +34,10 @@ def _bound_jax_memory():
     pass died in a compile-time C++ abort from memory exhaustion without
     this). Costs some re-compiles across modules — correctness unaffected."""
     yield
+    import gc
+
+    gc.collect()  # drop dead Array refs BEFORE the cache clear: clearing
+    # executables that still have (garbage) references aborts in the XLA
+    # CPU client on this host at some module compositions (3-device
+    # sweeps; r4 saw the same class of abort without any clearing)
     jax.clear_caches()
