@@ -1,0 +1,51 @@
+"""Minimal upstream-bug reproduction: glibc heap corruption in the XLA CPU
+client ("corrupted size vs. prev_size", SIGABRT) from EAGER sharded f64
+elementwise binary ops on a 3-device virtual CPU mesh.
+
+Findings (2026-08-01, jax/jaxlib in this image):
+- f64 + 3 virtual devices: aborts (the corruption is seeded early; the
+  abort detonates at an arbitrary LATER allocation — compile, device_put,
+  or cache clear — so stack traces point anywhere).
+- f32 + 3 devices: clean.  f64 + 5 devices: clean.  f64 + 2/8 devices:
+  full 1090+-test suites pass.
+- No heat_tpu code involved: this script is pure jax.
+
+Impact on this repo: the CPU CI fuzz sweep skips its f64 cases at exactly
+(platform=cpu, 3 devices) — tests/test_fuzz.py — and scripts/run_ci.sh
+retries SIGABRT chunks once. The TPU product path is unaffected (no f64
+on TPU).
+
+Run: python artifacts/xla_cpu_f64_3dev_heap_corruption.py  (expect SIGABRT)
+"""
+
+import os
+os.environ["JAX_PLATFORMS"]="cpu"
+os.environ["XLA_FLAGS"]="--xla_force_host_platform_device_count=3"
+import jax, numpy as np
+jax.config.update("jax_platforms","cpu")
+jax.config.update("jax_enable_x64", True)
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+mesh = Mesh(np.array(jax.devices()[:3]), ("proc",))
+rng = np.random.default_rng(0)
+ops = [jnp.add, jnp.subtract, jnp.multiply, jnp.divide, jnp.minimum, jnp.maximum, jnp.power, jnp.arctan2, jnp.hypot, jnp.copysign, jnp.fmod]
+for it in range(4):
+    for op in ops:
+        for _ in range(3):
+            nd = int(rng.integers(1, 4))
+            shape = tuple(int(rng.integers(1, 12)) for _ in range(nd))
+            an = np.abs(rng.standard_normal(shape).astype("float32")) + 0.5
+            bn = np.abs(rng.standard_normal(shape).astype("float32")) + 0.5
+            for split in [None] + list(range(nd)):
+                if split is None:
+                    sh = NamedSharding(mesh, P())
+                    a = jax.device_put(jnp.asarray(an), sh); b = jax.device_put(jnp.asarray(bn), sh)
+                else:
+                    pad = (-shape[split]) % 3
+                    padded = [(0,0)]*nd; padded[split]=(0,pad)
+                    spec = [None]*nd; spec[split]="proc"
+                    sh = NamedSharding(mesh, P(*spec))
+                    a = jax.device_put(jnp.pad(jnp.asarray(an), padded), sh)
+                    b = jax.device_put(jnp.pad(jnp.asarray(bn), padded), sh)
+                r = np.asarray(op(a, b))
+print("CLEAN")

@@ -11,7 +11,23 @@ import zlib
 import numpy as np
 import pytest
 
+import jax
+
 import heat_tpu as ht
+
+# Upstream jaxlib bug, NOT a framework bug: eager sharded f64 elementwise
+# ops on a 3-device virtual CPU mesh corrupt the glibc heap ("corrupted
+# size vs. prev_size"; SIGABRT detonates at an arbitrary later
+# allocation). Reproduced WITHOUT heat_tpu — see
+# artifacts/xla_cpu_f64_3dev_heap_corruption.py (f32@3dev, f64@5dev, and
+# the full 2/8-device suites are all clean). This module's sweeps are
+# f64, so they skip at exactly that configuration; every other mesh size
+# runs them in full.
+if jax.default_backend() == "cpu" and ht.get_comm().size == 3:
+    pytestmark = pytest.mark.skip(
+        reason="upstream XLA-CPU f64 heap corruption at exactly 3 virtual "
+        "devices — artifacts/xla_cpu_f64_3dev_heap_corruption.py"
+    )
 
 # (name, numpy oracle, domain) — domain picks the input sampler:
 # "real" = standard normal, "pos" = |x|+0.1, "unit" = open (-1, 1)
