@@ -1016,6 +1016,26 @@ def unique(a: DNDarray, sorted: bool = False, return_inverse: bool = False, axis
         ):
             return _distributed_unique_rows_nd(a, ax, return_inverse)
     log = a._logical()
+    if axis is not None and jnp.issubdtype(log.dtype, jnp.inexact):
+        # numpy, not jnp: np.unique(axis=k) compares rows with elementwise
+        # == where NaN != NaN, so NaN-carrying duplicate rows stay
+        # DISTINCT — the oracle the distributed rows path implements.
+        # jnp.unique collapses them (structural NaN equality), which
+        # diverged on single-device meshes. Only inexact dtypes can carry
+        # NaN, and this is the eager host fallback already, so the host
+        # round trip costs nothing new where it applies.
+        ax = sanitize_axis(a.shape, axis)
+        host = np.asarray(log)
+        if return_inverse:
+            res, inverse = np.unique(host, return_inverse=True, axis=ax)
+            res_ht = _rewrap(
+                jnp.asarray(res), 0 if a.split is not None else None, a
+            )
+            return res_ht, _rewrap(jnp.asarray(inverse), None, a)
+        res = np.unique(host, axis=ax)
+        return _rewrap(
+            jnp.asarray(res), 0 if a.split is not None else None, a
+        )
     if axis is not None:
         axis = sanitize_axis(a.shape, axis)
     if return_inverse:
