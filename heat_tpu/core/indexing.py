@@ -72,12 +72,18 @@ def _is_int_array(k) -> bool:
     )
 
 
-def _is_bool_mask(k, x: DNDarray) -> bool:
+def _is_bool_array(k, min_ndim: int = 1) -> bool:
+    """Boolean array-like of at least ``min_ndim`` dims (shared predicate
+    for getitem split metadata and setitem fallback routing)."""
     return (
         hasattr(k, "dtype")
         and np.dtype(k.dtype) == np.bool_
-        and getattr(k, "ndim", 0) == x.ndim
+        and getattr(k, "ndim", 0) >= min_ndim
     )
+
+
+def _is_bool_mask(k, x: DNDarray) -> bool:
+    return _is_bool_array(k) and getattr(k, "ndim", 0) == x.ndim
 
 
 def _expand_key(key, ndim: int):
@@ -111,6 +117,21 @@ def _result_split(x: DNDarray, key) -> Optional[int]:
     # full-shape boolean mask
     if len(key) == 1 and _is_bool_mask(key[0], x):
         return 0
+    # 1-D boolean row mask over the leading axis: the compacted axis
+    # replaces axis 0, so a split=0 input stays split=0 — the layout the
+    # distributed row-compaction path produces; the single-device
+    # fallback must report the same metadata (caught by the 1-device CI
+    # sweep: split silently became None)
+    if (
+        len(key) == 1
+        and _is_bool_array(key[0])
+        and getattr(key[0], "ndim", 0) == 1
+        and x.ndim >= 1
+        and tuple(np.shape(key[0])) == (x.shape[0],)
+    ):
+        # non-leading splits also carry through: the mask only compacts
+        # axis 0 and no axes shift
+        return x.split
     expanded = _expand_key(key, x.ndim)
     in_dim = 0
     out_dim = 0
@@ -569,9 +590,6 @@ def setitem(x: DNDarray, key, value) -> None:
                 return
             except (TypeError, IndexError, ValueError):
                 pass  # ragged values etc. — host fallback below
-
-    def _is_bool_array(k):
-        return hasattr(k, "dtype") and np.dtype(k.dtype) == np.bool_ and getattr(k, "ndim", 0) >= 1
 
     # basic / integer-array keys: normalize against logical extents and
     # update the physical buffer in place — pads are unreachable. Tuple keys
