@@ -1,0 +1,122 @@
+"""Shared runner plumbing for the scaling-benchmark harness.
+
+Every per-algorithm runner (reference: per-framework scripts like
+benchmarks/kmeans/heat-gpu.py:1-27) goes through here: mesh bootstrap,
+workload construction (synthetic or HDF5 via ``ht.load``), timed trials,
+and JSON reporting.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def base_parser(description: str) -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=description)
+    p.add_argument("--n", type=int, default=100_000,
+                   help="rows of the synthetic workload")
+    p.add_argument("--features", type=int, default=64,
+                   help="columns of the synthetic workload")
+    p.add_argument("--trials", type=int, default=3)
+    p.add_argument("--file", type=str, default=None,
+                   help="HDF5 file to load instead of synthetic data "
+                        "(reference data parity: cityscapes/SUSY/eurad)")
+    p.add_argument("--dataset", type=str, default=None,
+                   help="dataset name inside --file")
+    p.add_argument("--mesh", type=int, default=0,
+                   help="force an n-device virtual CPU mesh (0 = use the "
+                        "attached platform as-is)")
+    return p
+
+
+def bootstrap(args):
+    """Apply --mesh BEFORE jax initializes, then import heat_tpu."""
+    if args.mesh:
+        import re
+
+        flags = os.environ.get("XLA_FLAGS", "")
+        want = f"--xla_force_host_platform_device_count={args.mesh}"
+        m = re.search(r"--xla_force_host_platform_device_count=\d+", flags)
+        if m:  # an inherited count (e.g. a test env) must not win over --mesh
+            flags = flags.replace(m.group(0), want)
+        else:
+            flags = (flags + " " + want).strip()
+        os.environ["XLA_FLAGS"] = flags
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import heat_tpu as ht
+
+    return ht
+
+
+def load_or_make(ht, args, *, dtype=None, split=0):
+    """The benchmark operand: ``ht.load`` when --file is given (per-slab
+    range reads on multi-host, io.py), synthetic ``randn`` otherwise."""
+    dtype = dtype or ht.float32
+    if args.file:
+        if not args.dataset:
+            raise SystemExit("--file requires --dataset (the HDF5 dataset "
+                             "name inside the file)")
+        data = ht.load(args.file, dataset=args.dataset, split=split)
+        return data.astype(dtype) if data.dtype != dtype else data
+    return ht.random.randn(args.n, args.features, dtype=dtype, split=split)
+
+
+def timed_trials(args, fit, sync):
+    """Run ``fit`` ``args.trials`` times; print one JSON line per trial
+    (the reference prints per-trial wall-clock, heat-gpu.py:22-27) and a
+    summary with the best time."""
+    times = []
+    for trial in range(args.trials):
+        t0 = time.perf_counter()
+        out = fit()
+        sync(out)  # device-queue barrier: timing must include the work
+        dt = time.perf_counter() - t0
+        times.append(dt)
+        print(json.dumps({"trial": trial, "seconds": round(dt, 4)}),
+              flush=True)
+    summary = {
+        "best_seconds": round(min(times), 4),
+        "mean_seconds": round(sum(times) / len(times), 4),
+        "trials": args.trials,
+        "devices": _device_info(),
+    }
+    print(json.dumps(summary), flush=True)
+    return summary
+
+
+def _device_info():
+    import jax
+
+    d = jax.devices()
+    return {"count": len(d), "kind": d[0].device_kind}
+
+
+def run(description, add_args, build, fit_factory):
+    """Standard runner main: parse → bootstrap → build workload →
+    timed trials. ``add_args(parser)`` adds algorithm flags;
+    ``build(ht, args)`` returns the operand(s); ``fit_factory(ht, args,
+    operands)`` returns (fit, sync)."""
+    parser = base_parser(description)
+    add_args(parser)
+    args = parser.parse_args()
+    ht = bootstrap(args)
+    operands = build(ht, args)
+    fit, sync = fit_factory(ht, args, operands)
+    fit_c = fit  # first call compiles; time it separately as trial -1
+    t0 = time.perf_counter()
+    sync(fit_c())
+    print(json.dumps({"compile_seconds": round(time.perf_counter() - t0, 4)}),
+          flush=True)
+    timed_trials(args, fit, sync)
+
+
+if __name__ == "__main__":
+    print("import me from a per-algorithm runner", file=sys.stderr)
+    sys.exit(2)

@@ -1,0 +1,39 @@
+#!/usr/bin/env python
+"""Distance-matrix scaling benchmark (reference:
+benchmarks/distance_matrix/config.json — ht.spatial.cdist on SUSY h5,
+split=0). ``--ring`` uses the ppermute ring kernel (the reference's
+ring-MPI design, distance.py:209); the default quadratic-expansion GEMM
+form dispatches the fused Pallas epilogue kernel on TPU."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+from benchmarks._harness import load_or_make, run
+
+
+def add_args(p):
+    p.add_argument("--ring", action="store_true",
+                   help="ppermute ring schedule instead of the GEMM form")
+
+
+def build(ht, args):
+    return load_or_make(ht, args, split=0)
+
+
+def fit_factory(ht, args, data):
+    def fit():
+        if args.ring:
+            return ht.spatial.cdist(data, data, ring=True)
+        return ht.spatial.cdist(data, data, quadratic_expansion=True)
+
+    def sync(d):
+        return float(d.larray[0, 0])
+
+    return fit, sync
+
+
+if __name__ == "__main__":
+    run("heat_tpu cdist scaling benchmark", add_args, build, fit_factory)

@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""Enumerate the scaling sweep into a shell script — the TPU-native
+analog of the reference's SLURM jobscript generator
+(benchmarks/generate_jobscripts.py:12-50). No scheduler is assumed: each
+line is a plain `python` invocation (mesh forcing happens in-process via
+the runner's ``--mesh`` flag, benchmarks/_harness.bootstrap); on a
+SLURM-fronted pod the same lines drop into srun wrappers.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shlex
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+ALGOS = ("kmeans", "distance_matrix", "statistical_moments", "lasso")
+
+
+def _param_flags(params: dict) -> list[str]:
+    # config "params" keys map 1:1 to runner flags; sizes map to --n
+    out = []
+    for k, v in params.items():
+        out += [f"--{k}", str(v)]
+    return out
+
+
+def enumerate_runs(algos=ALGOS):
+    """Yield (algo, benchmark, mode, mesh, n, argv) for every scale point."""
+    for algo in algos:
+        cfg_path = os.path.join(HERE, algo, "config.json")
+        with open(cfg_path) as f:
+            cfg = json.load(f)
+        runner = os.path.join("benchmarks", algo, cfg["runner"])
+        base = _param_flags(cfg.get("params", {}))
+        base += ["--trials", str(cfg.get("trials", 3))]
+        for name, bench in cfg["benchmarks"].items():
+            meshes = bench["mesh"]
+            strong = bench["size"]["strong"]
+            weak = bench["size"]["weak"]
+            if len(weak) not in (1, len(meshes)):
+                raise ValueError(
+                    f"{algo}/{name}: weak sizes must match the mesh list "
+                    f"({len(weak)} vs {len(meshes)})"
+                )
+            for i, mesh in enumerate(meshes):
+                w = weak[i] if len(weak) == len(meshes) else weak[0]
+                points = [("strong", strong)]
+                if w == strong:
+                    # identical argv — tag one run with both modes instead
+                    # of re-running a multi-minute scale point for no data
+                    points = [("strong+weak", strong)]
+                else:
+                    points.append(("weak", w))
+                for mode, n in points:
+                    argv = [sys.executable or "python", runner,
+                            "--n", str(n), "--mesh", str(mesh)] + base
+                    yield algo, name, mode, mesh, n, argv
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="runs.sh")
+    ap.add_argument("--algos", default=",".join(ALGOS),
+                    help="comma-separated subset")
+    args = ap.parse_args()
+    algos = [a.strip() for a in args.algos.split(",") if a.strip()]
+    for a in algos:
+        if a not in ALGOS:
+            raise SystemExit(f"unknown algorithm {a!r}; choose from {ALGOS}")
+
+    lines = ["#!/bin/bash", "set -e", f"cd {shlex.quote(REPO)}"]
+    count = 0
+    for algo, name, mode, mesh, n, argv in enumerate_runs(algos):
+        tag = f"{algo}/{name} {mode} mesh={mesh} n={n}"
+        lines.append(f"echo '=== {tag} ==='")
+        lines.append(" ".join(shlex.quote(a) for a in argv))
+        count += 1
+    with open(args.out, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    os.chmod(args.out, 0o755)
+    print(f"wrote {args.out}: {count} scale points over {len(algos)} "
+          "algorithms")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,42 @@
+#!/usr/bin/env python
+"""KMeans scaling benchmark (reference: benchmarks/kmeans/heat-gpu.py,
+config.json — cityscapes h5, 8 clusters, 30 iterations, 10 trials).
+On TPU the fit dispatches the fused Pallas Lloyd kernel when applicable
+(cluster/pallas_lloyd.py); elsewhere the one-program XLA lax.while_loop
+fit."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+from benchmarks._harness import load_or_make, run
+
+
+def add_args(p):
+    p.add_argument("--clusters", type=int, default=8)
+    p.add_argument("--iterations", type=int, default=30)
+
+
+def build(ht, args):
+    return load_or_make(ht, args, split=0)
+
+
+def fit_factory(ht, args, data):
+    def fit():
+        km = ht.cluster.KMeans(
+            n_clusters=args.clusters, init="random",
+            max_iter=args.iterations, tol=0.0, random_state=1,
+        )
+        km.fit(data)
+        return km.cluster_centers_
+
+    def sync(centers):
+        return float(centers.larray[0, 0])
+
+    return fit, sync
+
+
+if __name__ == "__main__":
+    run("heat_tpu KMeans scaling benchmark", add_args, build, fit_factory)

@@ -1,0 +1,43 @@
+#!/usr/bin/env python
+"""Lasso scaling benchmark (reference: benchmarks/lasso/config.json —
+coordinate descent on eurad h5, 1e7 samples strong scaling). The whole
+fit is ONE compiled dispatch (lax.while_loop over epochs,
+regression/lasso.py)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+from benchmarks._harness import load_or_make, run
+
+
+def add_args(p):
+    p.add_argument("--sweeps", type=int, default=100)
+    p.add_argument("--lam", type=float, default=0.01)
+
+
+def build(ht, args):
+    x = load_or_make(ht, args, split=0)
+    y = ht.matmul(x, ht.random.randn(x.shape[1], 1, dtype=x.dtype))
+    return x, y
+
+
+def fit_factory(ht, args, operands):
+    x, y = operands
+
+    def fit():
+        est = ht.regression.Lasso(lam=args.lam, max_iter=args.sweeps,
+                                  tol=0.0)
+        est.fit(x, y)
+        return est.theta
+
+    def sync(theta):
+        return float(theta.larray.reshape(-1)[0])
+
+    return fit, sync
+
+
+if __name__ == "__main__":
+    run("heat_tpu lasso scaling benchmark", add_args, build, fit_factory)

@@ -1,0 +1,47 @@
+#!/usr/bin/env python
+"""Statistical-moments scaling benchmark (reference:
+benchmarks/statistical_moments/config.json — mean/var over cityscapes
+rows). One jitted pass computes mean+var; on single-device TPU f32 both
+route through the one-HBM-read Welford kernel (core/pallas_moments.py)
+and CSE into one kernel execution."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+from benchmarks._harness import load_or_make, run
+
+
+def add_args(p):
+    pass
+
+
+def build(ht, args):
+    return load_or_make(ht, args, split=0)
+
+
+def fit_factory(ht, args, data):
+    import jax
+
+    @jax.jit
+    def one_pass(buf):
+        from heat_tpu.core.dndarray import DNDarray
+
+        X = DNDarray(buf, data.shape, data.dtype, data.split, data.device,
+                     data.comm, True)
+        return (ht.mean(X, axis=0) + ht.var(X, axis=0)).larray
+
+    def fit():
+        return one_pass(data.larray)
+
+    def sync(m):
+        return float(m[0])
+
+    return fit, sync
+
+
+if __name__ == "__main__":
+    run("heat_tpu statistical-moments scaling benchmark", add_args, build,
+        fit_factory)

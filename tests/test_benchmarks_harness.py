@@ -1,0 +1,66 @@
+"""Smoke of the scaling-benchmark harness (benchmarks/ — the reference's
+per-algorithm config + runner + jobscript-generator tree,
+benchmarks/kmeans/config.json:1-73, generate_jobscripts.py:12-50).
+Runners execute in subprocesses at tiny sizes on a forced 2-device mesh;
+the generator's sweep enumeration is checked in-process."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(cmd):
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # keep subprocesses off a (possibly
+    # wedged) accelerator tunnel — the harness must work CPU-only
+    return subprocess.run(
+        cmd, capture_output=True, text=True, timeout=600, cwd=REPO, env=env
+    )
+
+
+class TestGenerator:
+    def test_enumerates_every_config(self, tmp_path):
+        out = tmp_path / "runs.sh"
+        r = _run([sys.executable, "benchmarks/generate_runs.py",
+                  "--out", str(out)])
+        assert r.returncode == 0, r.stderr[-500:]
+        text = out.read_text()
+        for algo in ("kmeans", "distance_matrix", "statistical_moments",
+                     "lasso"):
+            assert f"benchmarks/{algo}/heat_tpu.py" in text
+        # strong AND weak points for every mesh entry
+        assert text.count("strong") and text.count("weak")
+
+    def test_rejects_unknown_algo(self):
+        r = _run([sys.executable, "benchmarks/generate_runs.py",
+                  "--algos", "nope"])
+        assert r.returncode != 0
+
+
+@pytest.mark.parametrize(
+    "runner,extra",
+    [
+        ("kmeans", ["--clusters", "3", "--iterations", "3"]),
+        ("distance_matrix", []),
+        ("distance_matrix", ["--ring"]),
+        ("statistical_moments", []),
+        ("lasso", ["--sweeps", "3"]),
+    ],
+)
+def test_runner_smoke(runner, extra):
+    r = _run([
+        sys.executable, f"benchmarks/{runner}/heat_tpu.py",
+        "--n", "4000", "--features", "8", "--trials", "2", "--mesh", "2",
+        *extra,
+    ])
+    assert r.returncode == 0, r.stderr[-800:]
+    lines = [json.loads(l) for l in r.stdout.strip().splitlines()]
+    assert any("compile_seconds" in l for l in lines)
+    summary = lines[-1]
+    assert summary["trials"] == 2 and summary["best_seconds"] > 0
+    assert summary["devices"]["count"] == 2
