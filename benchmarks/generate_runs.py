@@ -13,11 +13,11 @@ import argparse
 import json
 import os
 import shlex
-import sys
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 REPO = os.path.dirname(HERE)
-ALGOS = ("kmeans", "distance_matrix", "statistical_moments", "lasso")
+ALGOS = ("kmeans", "distance_matrix", "statistical_moments", "lasso",
+         "resplit")
 
 
 def _param_flags(params: dict) -> list[str]:
@@ -28,8 +28,14 @@ def _param_flags(params: dict) -> list[str]:
     return out
 
 
-def enumerate_runs(algos=ALGOS):
-    """Yield (algo, benchmark, mode, mesh, n, argv) for every scale point."""
+def enumerate_runs(algos=ALGOS, python="python3"):
+    """Yield (algo, benchmark, mode, mesh, n, argv) for every scale point.
+
+    ``python`` is the interpreter token emitted into each line — plain
+    ``python3`` by default so the generated script runs on any host/venv,
+    including python3-only boxes with no ``python`` alias (baking
+    ``sys.executable`` in tied the sweep to the generating machine's
+    interpreter path — advisor round-5 finding)."""
     for algo in algos:
         cfg_path = os.path.join(HERE, algo, "config.json")
         with open(cfg_path) as f:
@@ -56,7 +62,7 @@ def enumerate_runs(algos=ALGOS):
                 else:
                     points.append(("weak", w))
                 for mode, n in points:
-                    argv = [sys.executable or "python", runner,
+                    argv = [python, runner,
                             "--n", str(n), "--mesh", str(mesh)] + base
                     yield algo, name, mode, mesh, n, argv
 
@@ -66,6 +72,10 @@ def main():
     ap.add_argument("--out", default="runs.sh")
     ap.add_argument("--algos", default=",".join(ALGOS),
                     help="comma-separated subset")
+    ap.add_argument("--python", default="python3",
+                    help="interpreter emitted into the script (default: "
+                         "plain `python3`, resolved by the executing host's "
+                         "environment; pass an absolute path to pin one)")
     args = ap.parse_args()
     algos = [a.strip() for a in args.algos.split(",") if a.strip()]
     for a in algos:
@@ -74,7 +84,7 @@ def main():
 
     lines = ["#!/bin/bash", "set -e", f"cd {shlex.quote(REPO)}"]
     count = 0
-    for algo, name, mode, mesh, n, argv in enumerate_runs(algos):
+    for algo, name, mode, mesh, n, argv in enumerate_runs(algos, args.python):
         tag = f"{algo}/{name} {mode} mesh={mesh} n={n}"
         lines.append(f"echo '=== {tag} ==='")
         lines.append(" ".join(shlex.quote(a) for a in argv))

@@ -1,0 +1,42 @@
+#!/usr/bin/env python
+"""Redistribution (resplit) scaling microbenchmark.
+
+No reference analog (the reference's `resplit_` moves bytes through
+explicit MPI Alltoallv, so its cost was always visible in profiles); here
+the relayout is an XLA-emitted all-to-all and this runner is how its cost
+is measured. Each fit round-trips a row-split operand through ``split=1``
+and back — two all-to-alls of analytic volume ``B·(p-1)/p`` each
+(telemetry/collectives.py). With ``HEAT_TPU_TELEMETRY=1`` the summary's
+``telemetry.phases.resplit`` row carries the byte accounting.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+from benchmarks._harness import load_or_make, run
+
+
+def add_args(p):
+    pass
+
+
+def build(ht, args):
+    return load_or_make(ht, args, split=0)
+
+
+def fit_factory(ht, args, data):
+    def fit():
+        return data.resplit(1).resplit(0)
+
+    def sync(out):
+        return float(out.larray[0, 0])
+
+    return fit, sync
+
+
+if __name__ == "__main__":
+    run("heat_tpu resplit (redistribution) scaling benchmark",
+        add_args, build, fit_factory)

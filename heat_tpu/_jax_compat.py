@@ -1,0 +1,62 @@
+"""Compatibility shims for older JAX runtimes.
+
+The framework targets the current JAX surface (``jax.shard_map`` with the
+``check_vma`` varying-axis type system, ``jax.lax.pcast``,
+``pltpu.CompilerParams``); the runtime actually baked into a given container
+may be an older 0.4.x release where those names either do not exist or are
+spelled differently (``jax.experimental.shard_map.shard_map`` with
+``check_rep``, no ``pcast``, ``pltpu.TPUCompilerParams``). Rather than
+scattering version branches through every kernel, :func:`install` fills the
+missing attributes in ONCE at import (heat_tpu/__init__.py), mapping new
+spellings onto the old runtime:
+
+* ``jax.shard_map(f, mesh=..., in_specs=..., out_specs=..., check_vma=...)``
+  → ``shard_map.shard_map(..., check_rep=False)``. The old replication
+  checker predates the vma annotations the kernels carry (``pcast`` marks),
+  so it cannot validate them — run unchecked, matching what ``check_vma=
+  False`` call sites already request.
+* ``jax.lax.pcast(x, axis, to=...)`` → identity. Its only role is typing
+  an array as device-varying for the vma checker; with the checker off the
+  annotation has no semantic effect.
+* ``pltpu.CompilerParams`` → alias of ``pltpu.TPUCompilerParams`` (same
+  ``dimension_semantics`` field).
+
+Everything is additive — on a current runtime every ``hasattr`` check
+passes and this module does nothing.
+"""
+
+from __future__ import annotations
+
+import jax
+
+__all__ = ["install"]
+
+
+def install() -> None:
+    if not hasattr(jax, "shard_map"):
+        from jax.experimental import shard_map as _sm
+
+        def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None, **kw):
+            return _sm.shard_map(
+                f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                check_rep=False,
+            )
+
+        jax.shard_map = shard_map
+
+    if not hasattr(jax.lax, "pcast"):
+
+        def pcast(x, axis_name=None, *, to=None):
+            return x
+
+        jax.lax.pcast = pcast
+
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+
+        if not hasattr(pltpu, "CompilerParams") and hasattr(
+            pltpu, "TPUCompilerParams"
+        ):
+            pltpu.CompilerParams = pltpu.TPUCompilerParams
+    except ImportError:  # pragma: no cover — pallas-free builds
+        pass

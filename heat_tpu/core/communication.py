@@ -37,6 +37,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from .devices import Device, get_device
+from .. import telemetry
 
 __all__ = [
     "Communication",
@@ -235,34 +236,64 @@ class MeshCommunication(Communication):
     def replicated(self, ndim: int = 0) -> NamedSharding:
         return NamedSharding(self.__mesh, PartitionSpec())
 
+    # -- collective cost model ----------------------------------------------
+
+    def relayout_cost(
+        self,
+        gshape: Sequence[int],
+        itemsize: int,
+        old_split: Optional[int],
+        new_split: Optional[int],
+    ) -> "telemetry.collectives.CollectiveCost":
+        """Analytic collective kind + wire bytes of a relayout on this mesh
+        (telemetry/collectives.py — the observability analog of the
+        reference's explicit Alltoallv volume)."""
+        return telemetry.collectives.relayout_cost(
+            gshape, itemsize, old_split, new_split, self.size
+        )
+
     # -- explicit collectives (for hand-written shard_map kernels) -----------
     # These are thin curried wrappers so kernels don't hard-code axis names.
+    # With telemetry enabled each wrapper records a trace-time event: the
+    # wrappers run while a shard_map/jit body is being TRACED, so the event
+    # stream names the collectives that entered a compiled program. A hot
+    # cached program emits nothing — but a caller that builds a fresh
+    # traced closure per invocation (the ring kernels) misses the cache
+    # and re-emits on every call, so trace-event counts are per-trace,
+    # not per-program.
 
     def psum(self, x):
+        telemetry.trace_event("psum", axis=self.__axis)
         return jax.lax.psum(x, self.__axis)
 
     def pmax(self, x):
+        telemetry.trace_event("pmax", axis=self.__axis)
         return jax.lax.pmax(x, self.__axis)
 
     def pmin(self, x):
+        telemetry.trace_event("pmin", axis=self.__axis)
         return jax.lax.pmin(x, self.__axis)
 
     def axis_index(self):
         return jax.lax.axis_index(self.__axis)
 
     def all_gather(self, x, tiled: bool = True):
+        telemetry.trace_event("all_gather", axis=self.__axis)
         return jax.lax.all_gather(x, self.__axis, tiled=tiled)
 
     def ppermute(self, x, perm):
+        telemetry.trace_event("ppermute", axis=self.__axis)
         return jax.lax.ppermute(x, self.__axis, perm=perm)
 
     def ring_permute(self, x, shift: int = 1):
         """Circulate shards around the ring: position i sends to i+shift."""
         n = self.size
         perm = [(i, (i + shift) % n) for i in range(n)]
+        telemetry.trace_event("ppermute", axis=self.__axis, ring_shift=shift)
         return jax.lax.ppermute(x, self.__axis, perm=perm)
 
     def all_to_all(self, x, split_axis: int, concat_axis: int):
+        telemetry.trace_event("all_to_all", axis=self.__axis)
         return jax.lax.all_to_all(
             x, self.__axis, split_axis=split_axis, concat_axis=concat_axis, tiled=True
         )

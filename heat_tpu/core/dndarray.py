@@ -41,6 +41,7 @@ from . import types
 from .communication import MeshCommunication, sanitize_comm
 from .devices import Device, get_device
 from .stride_tricks import sanitize_axis
+from .. import telemetry
 
 __all__ = ["DNDarray", "perf_stats", "reset_perf_stats"]
 
@@ -275,7 +276,26 @@ class DNDarray:
         target sharding. Every step is a compiled op on the global array
         (XLA emits the all-to-all/all-gather), so — unlike :meth:`_logical`,
         which hands the host a non-canonically-shardable view — this is the
-        ONE sanctioned relayout primitive and is multi-host safe."""
+        ONE sanctioned relayout primitive and is multi-host safe.
+
+        The ONE primitive is also the one instrumentation point: with
+        telemetry enabled, every relayout is a ``relayout`` span carrying
+        the analytic collective kind and wire bytes
+        (telemetry/collectives.py) and blocking on the result before the
+        clock stops."""
+        if telemetry.enabled():
+            cost = self.__comm.relayout_cost(
+                self.__gshape, self.__dtype.byte_size(), self.__split,
+                new_split,
+            )
+            with telemetry.span(
+                "relayout", old_split=self.__split, new_split=new_split,
+                gshape=list(self.__gshape), **cost.as_fields(),
+            ) as sp:
+                return sp.output(self.__relayout_impl(new_split))
+        return self.__relayout_impl(new_split)
+
+    def __relayout_impl(self, new_split: Optional[int]) -> jax.Array:
         buf = self.__array
         if self.pad_count != 0:
             sl = tuple(slice(0, g) for g in self.__gshape)

@@ -114,9 +114,15 @@ def _result_split(x: DNDarray, key) -> Optional[int]:
         return None
     if not isinstance(key, tuple):
         key = (key,)
-    # full-shape boolean mask
+    # full-shape boolean mask → 1-D compaction: split inputs land split=0
+    # (the layout the distributed compaction path produces), replicated
+    # inputs must stay replicated. The branch carries its own guard instead
+    # of relying on the early return above — mirroring the row-mask branch
+    # below, so neither silently reports split=0 for a replicated input if
+    # the top guard ever moves (advisor round-5 finding; pinned by the
+    # 1-device test in tests/test_indexing.py)
     if len(key) == 1 and _is_bool_mask(key[0], x):
-        return 0
+        return 0 if x.split is not None else None
     # 1-D boolean row mask over the leading axis: the compacted axis
     # replaces axis 0, so a split=0 input stays split=0 — the layout the
     # distributed row-compaction path produces; the single-device

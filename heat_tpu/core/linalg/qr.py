@@ -32,6 +32,7 @@ import numpy as np
 
 from .. import types
 from ..dndarray import DNDarray
+from ... import telemetry
 
 __all__ = ["qr"]
 
@@ -48,7 +49,6 @@ def _gram_ring(buf: jax.Array, comm) -> jax.Array:
     plus the final n² all-gather of row blocks."""
     p = comm.size
     axis = comm.axis_name
-    perm = [(i, (i + 1) % p) for i in range(p)]
     n_phys = buf.shape[1]
     c = n_phys // p  # per-device column-block width (used by the tile writes)
 
@@ -64,7 +64,9 @@ def _gram_ring(buf: jax.Array, comm) -> jax.Array:
             acc = jax.lax.dynamic_update_slice(
                 acc, tile, (jnp.int32(0), (origin * c).astype(jnp.int32))
             )
-            circ = jax.lax.ppermute(circ, axis, perm=perm)
+            # the comm wrapper (not raw lax.ppermute) so the hop is named
+            # in telemetry's trace-time collective record
+            circ = comm.ring_permute(circ)
             return circ, acc
 
         acc0 = jax.lax.pcast(
@@ -123,7 +125,15 @@ def _cholqr_split1(a: DNDarray, dt, calc_q: bool) -> QR:
     shifted = False
     q_buf = buf
     while passes_left > 0:
-        g = _gram_ring(q_buf, comm)[:n, :n]
+        fields = (
+            telemetry.collectives.gram_ring_cost(
+                m, n, dt.byte_size(), comm.size
+            ).as_fields()
+            if telemetry.enabled()
+            else {}
+        )
+        with telemetry.span("cholqr_gram_ring", gshape=[m, n], **fields) as sp:
+            g = sp.output(_gram_ring(q_buf, comm))[:n, :n]
         ell = jnp.linalg.cholesky(g)
         # breakdown check on the small factor (one n² host fetch): NaNs or a
         # collapsed diagonal mean G is (numerically) singular on THIS pass —
@@ -249,9 +259,18 @@ def qr(
             return q_i, r
 
         # kk == n always: p*k1 >= min(p*chunk, p*n) >= min(m, n) = n
-        q_phys, r_tiled = jax.shard_map(
-            kernel, mesh=comm.mesh, in_specs=spec_row, out_specs=(spec_row, spec_row)
-        )(buf)
+        fields = (
+            telemetry.collectives.tsqr_cost(m, n, dt.byte_size(), p).as_fields()
+            if telemetry.enabled()
+            else {}
+        )
+        with telemetry.span("tsqr", gshape=[m, n], mesh=p, **fields) as sp:
+            q_phys, r_tiled = jax.shard_map(
+                kernel, mesh=comm.mesh, in_specs=spec_row,
+                out_specs=(spec_row, spec_row),
+            )(buf)
+            sp.output(q_phys)
+            sp.output(r_tiled)
         r_log = r_tiled[:n]  # every shard computed the same R; take one copy
         r_ht = DNDarray.from_logical(r_log, None, a.device, comm, dt)
         if not calc_q:

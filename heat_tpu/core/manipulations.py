@@ -24,6 +24,7 @@ import numpy as np
 from . import types
 from .dndarray import DNDarray
 from .stride_tricks import sanitize_axis, sanitize_shape
+from .. import telemetry
 
 __all__ = [
     "balance",
@@ -503,9 +504,23 @@ def reshape(a: DNDarray, *shape, new_split: Optional[int] = None) -> DNDarray:
 
 def resplit(arr: DNDarray, axis: Optional[int] = None) -> DNDarray:
     """Out-of-place redistribution to a new split axis (reference
-    manipulations.py:3351). One compiled relayout — multi-host safe."""
+    manipulations.py:3351). One compiled relayout — multi-host safe.
+
+    With telemetry enabled the op is a ``resplit`` span carrying the
+    analytic collective kind and wire bytes; the inner ``relayout`` span
+    (the primitive) nests under it."""
     axis = sanitize_axis(arr.shape, axis)
-    buf = arr._relayout(axis)
+    if telemetry.enabled():
+        cost = arr.comm.relayout_cost(
+            arr.shape, arr.dtype.byte_size(), arr.split, axis
+        )
+        with telemetry.span(
+            "resplit", old_split=arr.split, new_split=axis,
+            gshape=list(arr.shape), **cost.as_fields(),
+        ) as sp:
+            buf = sp.output(arr._relayout(axis))
+    else:
+        buf = arr._relayout(axis)
     return DNDarray(buf, arr.shape, arr.dtype, axis, arr.device, arr.comm, True)
 
 

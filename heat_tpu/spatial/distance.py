@@ -27,6 +27,7 @@ import jax.numpy as jnp
 
 from ..core import types
 from ..core.dndarray import DNDarray
+from .. import telemetry
 
 __all__ = ["cdist", "manhattan", "rbf"]
 
@@ -101,7 +102,6 @@ def _ring_dist(x: DNDarray, y: DNDarray, block_fn: Callable) -> jax.Array:
     ym = y.larray
     cy = ym.shape[0] // p
     n_cols = ym.shape[0]
-    perm = [(i, (i + 1) % p) for i in range(p)]
 
     def kernel(xb, yb):
         rank = jax.lax.axis_index(axis)
@@ -111,12 +111,15 @@ def _ring_dist(x: DNDarray, y: DNDarray, block_fn: Callable) -> jax.Array:
 
         def step(t, carry):
             yblk, out = carry
-            # perm sends i→i+1, so after t hops shard i holds origin (i−t) mod p
+            # the ring sends i→i+1, so after t hops shard i holds origin
+            # (i−t) mod p
             col = ((rank - t) % p) * cy
             tile = block_fn(xb, yblk)
             zero = jnp.zeros((), dtype=col.dtype)
             out = jax.lax.dynamic_update_slice(out, tile, (zero, col))
-            yblk = jax.lax.ppermute(yblk, axis, perm=perm)
+            # the comm wrapper (not raw lax.ppermute) so the hop is named
+            # in telemetry's trace-time collective record
+            yblk = comm.ring_permute(yblk)
             return (yblk, out)
 
         _, out = jax.lax.fori_loop(0, p, step, (yb, out))
@@ -205,11 +208,21 @@ def _dist(
     if use_ring:
         # ring kernel works on the padded buffers; x pad rows land in output
         # pad rows, y pad columns are sliced off below
-        xm = x._masked(0).astype(promoted.jnp_type())
-        ym = y._masked(0).astype(promoted.jnp_type())
-        xw = DNDarray(xm, x.shape, promoted, 0, x.device, x.comm, True)
-        yw = DNDarray(ym, y.shape, promoted, 0, y.device, y.comm, True)
-        out = _ring_dist(xw, yw, block_fn)
+        fields = (
+            telemetry.collectives.ring_cdist_cost(
+                n, x.shape[1], promoted.byte_size(), x.comm.size
+            ).as_fields()
+            if telemetry.enabled()
+            else {}
+        )
+        with telemetry.span(
+            "ring_cdist", gshape=[m, n], mesh=x.comm.size, **fields
+        ) as sp:
+            xm = x._masked(0).astype(promoted.jnp_type())
+            ym = y._masked(0).astype(promoted.jnp_type())
+            xw = DNDarray(xm, x.shape, promoted, 0, x.device, x.comm, True)
+            yw = DNDarray(ym, y.shape, promoted, 0, y.device, y.comm, True)
+            out = sp.output(_ring_dist(xw, yw, block_fn))
         out = out[:, :n]
         return _finish(out)
 

@@ -37,6 +37,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ..core.pallas_util import DotPrecision, dot_f32
+from .. import telemetry
 
 __all__ = ["euclid_pallas", "pallas_cdist_applicable"]
 
@@ -71,10 +72,6 @@ def _round_up(v: int, m: int) -> int:
     return -(-v // m) * m
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("epilogue", "block_m", "block_n", "interpret", "precision"),
-)
 def euclid_pallas(
     x: jax.Array,
     y: jax.Array,
@@ -93,7 +90,47 @@ def euclid_pallas(
     (``epilogue='rbf'`` with ``gamma``). Inputs are zero-padded to block
     multiples (zero feature columns contribute nothing to dot or norms;
     pad rows are sliced off the result).
+
+    With telemetry enabled, host-level calls become a ``pallas_cdist``
+    span whose ``bytes`` is the kernel's one obligatory HBM output write
+    (the quantity the fusion exists to minimize — see module docstring);
+    calls from inside a trace (the sharded `shard_map` wrapping in
+    distance.py hands tracers in) bypass instrumentation, since the span
+    would measure trace time, not the kernel.
     """
+    if telemetry.enabled() and not isinstance(x, jax.core.Tracer):
+        m, n = int(x.shape[0]), int(y.shape[0])
+        with telemetry.span(
+            "pallas_cdist", bytes=m * n * 4, gshape=[m, n],
+            epilogue=epilogue, hbm_write=True,
+        ) as sp:
+            return sp.output(
+                _euclid_pallas_jit(
+                    x, y, gamma, epilogue=epilogue, block_m=block_m,
+                    block_n=block_n, interpret=interpret, precision=precision,
+                )
+            )
+    return _euclid_pallas_jit(
+        x, y, gamma, epilogue=epilogue, block_m=block_m, block_n=block_n,
+        interpret=interpret, precision=precision,
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("epilogue", "block_m", "block_n", "interpret", "precision"),
+)
+def _euclid_pallas_jit(
+    x: jax.Array,
+    y: jax.Array,
+    gamma=0.0,
+    *,
+    epilogue: str = "dist",
+    block_m: int = 512,
+    block_n: int = 1024,
+    interpret: bool = False,
+    precision: DotPrecision = "bf16x3",
+) -> jax.Array:
     m, k = x.shape
     n = y.shape[0]
     bm, bn = min(block_m, _round_up(m, 8)), min(block_n, _round_up(n, 128))

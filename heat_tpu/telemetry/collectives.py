@@ -1,0 +1,138 @@
+"""Analytic collective cost model — bytes on the wire per relayout/kernel.
+
+The reference framework moves every byte through an explicit MPI call, so
+communication volume is readable off the source (reference
+heat/core/communication.py:120-1864). Here XLA emits the collectives from
+sharding annotations and the hand-scheduled `shard_map` kernels, so the
+volume must be *derived* from the layout contract instead: given a logical
+global shape, an element size, the old/new split axes and the mesh size,
+the rules below name the collective XLA materializes and count its wire
+bytes. The same arithmetic is what the redistribution literature optimizes
+(arXiv:2112.01075 §2 counts all-to-all volume exactly this way).
+
+Conventions
+-----------
+* Volumes are **total bytes crossing links, summed over all devices** —
+  the quantity a bisection-bandwidth model divides by link count.
+* Volumes are computed on the **logical** element count; the tail-pad
+  rounds each shard up to ``ceil(n/p)`` in flight, so the physical number
+  is within one shard-row of these figures (exact when the split dim is
+  divisible by the mesh size — the configuration the tests pin).
+* A replicated→split relayout is a local slice (each device already holds
+  every element), hence zero wire bytes.
+
+This module is import-light (numpy only) so instrumentation call sites can
+use it without pulling in the array machinery.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Dict, Optional, Sequence
+
+__all__ = [
+    "CollectiveCost",
+    "relayout_cost",
+    "ring_cdist_cost",
+    "tsqr_cost",
+    "gram_ring_cost",
+]
+
+
+@dataclass(frozen=True)
+class CollectiveCost:
+    """One collective's analytic cost.
+
+    kind : the collective XLA/shard_map emits ("all-gather", "all-to-all",
+        "ppermute-ring", "local-slice", "none", or a "+"-joined compound).
+    bytes : total wire bytes summed over devices (see module conventions).
+    steps : number of sequential communication rounds (1 for one-shot
+        collectives, p for a p-hop ring).
+    """
+
+    kind: str
+    bytes: int
+    steps: int = 1
+
+    def as_fields(self) -> Dict[str, object]:
+        """Span/event field dict (`collective=`, `bytes=`, `steps=`)."""
+        return {"collective": self.kind, "bytes": self.bytes, "steps": self.steps}
+
+
+def _numel(gshape: Sequence[int]) -> int:
+    n = 1
+    for s in gshape:
+        n *= int(s)
+    return n
+
+
+def relayout_cost(
+    gshape: Sequence[int],
+    itemsize: int,
+    old_split: Optional[int],
+    new_split: Optional[int],
+    nproc: int,
+) -> CollectiveCost:
+    """Cost of the canonical relayout (`DNDarray._relayout` /
+    `manipulations.resplit`) from ``old_split`` to ``new_split``.
+
+    * split → same split, or any relayout on a 1-position mesh: no comm;
+    * split s → replicated: **all-gather** — every device receives the
+      (p-1)/p of the array it does not own: ``(p-1) · B`` total;
+    * replicated → split s: **local slice** — zero wire bytes;
+    * split s → split t (s ≠ t): **all-to-all** — each device keeps the
+      1/p of its shard destined for itself and sends the rest:
+      ``B · (p-1)/p`` total (the analytic all-to-all volume).
+    """
+    b = _numel(gshape) * int(itemsize)
+    if nproc <= 1 or old_split == new_split:
+        return CollectiveCost("none", 0)
+    if old_split is None:
+        return CollectiveCost("local-slice", 0)
+    if new_split is None:
+        return CollectiveCost("all-gather", b * (nproc - 1))
+    return CollectiveCost("all-to-all", (b * (nproc - 1)) // nproc)
+
+
+def ring_cdist_cost(n: int, k: int, itemsize: int, nproc: int) -> CollectiveCost:
+    """Cost of the ppermute ring distance kernel
+    (:func:`heat_tpu.spatial.distance._ring_dist`): the row-split ``y``
+    block circulates one hop per step for ``p`` steps (the kernel's
+    `fori_loop` permutes on every iteration, including the final hop that
+    returns each block home), every device sending its ``ceil(n/p)·k``
+    block each step. Only ``y`` moves — the stationary x rows never touch
+    the wire, so the volume is independent of the x-row count."""
+    if nproc <= 1:
+        return CollectiveCost("none", 0)
+    block = math.ceil(n / nproc) * int(k) * int(itemsize)
+    return CollectiveCost("ppermute-ring", nproc * nproc * block, steps=nproc)
+
+
+def tsqr_cost(m: int, n: int, itemsize: int, nproc: int) -> CollectiveCost:
+    """Cost of the TSQR kernel (:func:`heat_tpu.core.linalg.qr.qr`, row-split
+    path): one in-kernel all-gather of the per-shard ``(min(chunk, n), n)``
+    R factors — every device receives the ``p-1`` blocks it did not
+    compute. The two GEMM stages are local."""
+    if nproc <= 1:
+        return CollectiveCost("none", 0)
+    chunk = math.ceil(m / nproc)
+    k1 = min(chunk, int(n))
+    return CollectiveCost(
+        "all-gather", nproc * (nproc - 1) * k1 * int(n) * int(itemsize)
+    )
+
+
+def gram_ring_cost(m: int, n: int, itemsize: int, nproc: int) -> CollectiveCost:
+    """Cost of the CholeskyQR2 ring Gram kernel
+    (:func:`heat_tpu.core.linalg.qr._gram_ring`): ``p`` ring hops of the
+    stationary-transpose schedule (each device circulates its
+    ``(ceil(n/p), m)`` block every step) plus the final tiled all-gather
+    of the ``(ceil(n/p), n_phys)`` row blocks of G."""
+    if nproc <= 1:
+        return CollectiveCost("none", 0)
+    c = math.ceil(n / nproc)
+    n_phys = c * nproc
+    ring = nproc * nproc * c * int(m) * int(itemsize)
+    gather = nproc * (nproc - 1) * c * n_phys * int(itemsize)
+    return CollectiveCost("ppermute-ring+all-gather", ring + gather, steps=nproc)

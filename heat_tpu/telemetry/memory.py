@@ -1,0 +1,83 @@
+"""Per-device memory watermarks.
+
+Two sources, best available wins:
+
+* ``device.memory_stats()`` — the runtime's own allocator statistics
+  (``bytes_in_use`` / ``peak_bytes_in_use``), populated on TPU and GPU
+  backends; returns ``None`` per device on CPU;
+* ``jax.live_arrays()`` — framework-level accounting that works on every
+  backend: the sum of shard bytes per device over all live ``jax.Array``\\ s.
+  Replicated arrays count once per device (each replica occupies real
+  memory). This sees only jax arrays, not scratch the compiler holds, so it
+  is a lower bound — but it is the portion the framework controls.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Dict, Optional
+
+import jax
+
+__all__ = ["live_bytes", "device_memory_stats", "watermark"]
+
+
+def live_bytes() -> dict:
+    """Framework-level live-array accounting: ``{"total": bytes,
+    "per_device": {device: bytes}, "arrays": count}`` over
+    ``jax.live_arrays()`` (addressable shards only)."""
+    per_device: Dict[str, int] = defaultdict(int)
+    count = 0
+    for arr in jax.live_arrays():
+        count += 1
+        try:
+            for shard in arr.addressable_shards:
+                per_device[str(shard.device)] += shard.data.nbytes
+        except Exception:
+            # deleted/donated buffers raise on access mid-iteration
+            continue
+    return {
+        "total": sum(per_device.values()),
+        "per_device": dict(per_device),
+        "arrays": count,
+    }
+
+
+def device_memory_stats() -> Optional[Dict[str, dict]]:
+    """Runtime allocator statistics per device (``bytes_in_use``,
+    ``peak_bytes_in_use``, …), or None when no device reports any (CPU)."""
+    out: Dict[str, dict] = {}
+    for d in jax.devices():
+        stats = None
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if stats:
+            out[str(d)] = dict(stats)
+    return out or None
+
+
+def watermark(tag: str = "watermark") -> dict:
+    """Snapshot memory now, update the registry's high-water marks, and —
+    when telemetry is enabled — emit a ``memory`` event. Returns the
+    snapshot either way (callable as a plain probe)."""
+    from . import enabled, get_registry
+
+    snap = live_bytes()
+    stats = device_memory_stats()
+    if stats is not None:
+        snap["device_stats"] = stats
+    if enabled():
+        reg = get_registry()
+        reg.high_water("live_bytes.total", snap["total"])
+        for dev, b in snap["per_device"].items():
+            reg.high_water(f"live_bytes.{dev}", b)
+        if stats is not None:
+            for dev, s in stats.items():
+                if "peak_bytes_in_use" in s:
+                    reg.high_water(
+                        f"device_bytes.{dev}", s["peak_bytes_in_use"]
+                    )
+        reg.emit("memory", tag, **snap)
+    return snap

@@ -1,0 +1,111 @@
+"""Aggregate telemetry events into the benchmark harness's JSON shape.
+
+``benchmarks/_harness.py`` emits one JSON object per trial plus a summary
+dict; :func:`summarize` produces the ``telemetry`` block that the summary
+(and hence the committed ``BENCH_*`` files) gains when telemetry is on —
+per-phase compile/execute/bytes-moved columns keyed by span name:
+
+.. code-block:: json
+
+    {"phases": {"resplit": {"calls": 2, "execute_seconds": 0.01,
+                            "bytes_moved": 14336}},
+     "compile_seconds": 0.4, "compile_events": 3,
+     "traced_collectives": {"all_gather": 1},
+     "peak_live_bytes": 1048576, "events": 17}
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Iterable, List, Optional
+
+__all__ = ["load_events", "summarize", "bench_fields"]
+
+
+def load_events(path: str) -> List[dict]:
+    """Read a JSONL event sink back into a list of event dicts (skips
+    blank/truncated lines — the sink is append-only across runs)."""
+    out: List[dict] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    return out
+
+
+def summarize(
+    events: Optional[Iterable[dict]] = None,
+    watermarks: Optional[dict] = None,
+) -> dict:
+    """Aggregate events (default: the live registry's) into the per-phase
+    summary block documented in the module docstring. Only top-level
+    (``depth == 0``) spans become phase rows: a ``resplit`` and the
+    ``relayout`` primitive it wraps carry the same analytic cost over the
+    same wall-clock window, so counting both would double every byte and
+    second a consumer sums across phases. Nesting stays visible in the raw
+    stream (each span event carries ``depth``/``parent``); a ``relayout``
+    invoked outside any op span is depth 0 and still gets its own row."""
+    if events is None:
+        from . import get_registry
+
+        reg = get_registry()
+        events = list(reg.events)
+        if watermarks is None:
+            watermarks = dict(reg.watermarks)
+
+    phases: dict = {}
+    compile_seconds = 0.0
+    compile_events = 0
+    traced: dict = {}
+    n = 0
+    for ev in events:
+        n += 1
+        kind = ev.get("kind")
+        if kind == "span":
+            if int(ev.get("depth", 0) or 0) != 0:
+                continue
+            row = phases.setdefault(
+                ev.get("name"),
+                {"calls": 0, "execute_seconds": 0.0, "bytes_moved": 0},
+            )
+            row["calls"] += 1
+            row["execute_seconds"] += float(ev.get("seconds", 0.0))
+            row["bytes_moved"] += int(ev.get("bytes", 0) or 0)
+            if ev.get("collective"):
+                row["collective"] = ev["collective"]
+        elif kind == "compile":
+            compile_seconds += float(ev.get("seconds", 0.0))
+            compile_events += 1
+        elif kind == "collective_trace":
+            name = ev.get("name")
+            traced[name] = traced.get(name, 0) + 1
+    for row in phases.values():
+        row["execute_seconds"] = round(row["execute_seconds"], 6)
+
+    out = {
+        "phases": phases,
+        "compile_seconds": round(compile_seconds, 6),
+        "compile_events": compile_events,
+        "traced_collectives": traced,
+        "events": n,
+    }
+    if watermarks:
+        peak = watermarks.get("live_bytes.total")
+        if peak is not None:
+            out["peak_live_bytes"] = int(peak)
+    return out
+
+
+def bench_fields() -> dict:
+    """The dict the benchmark harness merges into its summary line:
+    ``{"telemetry": summarize()}`` when enabled, ``{}`` otherwise."""
+    from . import enabled
+
+    if not enabled():
+        return {}
+    return {"telemetry": summarize()}
