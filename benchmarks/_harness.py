@@ -30,28 +30,33 @@ def base_parser(description: str) -> argparse.ArgumentParser:
     p.add_argument("--mesh", type=int, default=0,
                    help="force an n-device virtual CPU mesh (0 = use the "
                         "attached platform as-is)")
+    p.add_argument("--audit", action="store_true",
+                   help="enable telemetry plus the HLO collective auditor: "
+                        "every instrumented op lower-compiles its program "
+                        "and diffs the collectives XLA actually emitted "
+                        "against the analytic cost model; the summary gains "
+                        "a telemetry.hlo_collectives section "
+                        "(docs/OBSERVABILITY.md)")
     return p
 
 
 def bootstrap(args):
-    """Apply --mesh BEFORE jax initializes, then import heat_tpu."""
+    """Apply --mesh BEFORE jax initializes a backend, then import heat_tpu."""
     if args.mesh:
-        import re
+        # one canonical copy of the XLA_FLAGS/JAX_PLATFORMS dance, shared
+        # with the telemetry audit CLI (backend init is lazy, so importing
+        # the package to reach the helper is safe)
+        from heat_tpu.utils.backend_probe import force_virtual_cpu_mesh
 
-        flags = os.environ.get("XLA_FLAGS", "")
-        want = f"--xla_force_host_platform_device_count={args.mesh}"
-        m = re.search(r"--xla_force_host_platform_device_count=\d+", flags)
-        if m:  # an inherited count (e.g. a test env) must not win over --mesh
-            flags = flags.replace(m.group(0), want)
-        else:
-            flags = (flags + " " + want).strip()
-        os.environ["XLA_FLAGS"] = flags
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        import jax
-
-        jax.config.update("jax_platforms", "cpu")
+        force_virtual_cpu_mesh(args.mesh)
     import heat_tpu as ht
 
+    if getattr(args, "audit", False):
+        # ground-truth collective accounting rides on the telemetry event
+        # stream, so --audit implies recording
+        if not ht.telemetry.enabled():
+            ht.telemetry.enable()
+        ht.telemetry.hlo.enable_audit()
     return ht
 
 
@@ -73,7 +78,9 @@ def timed_trials(args, fit, sync):
     (the reference prints per-trial wall-clock, heat-gpu.py:22-27) and a
     summary with the best time. With ``HEAT_TPU_TELEMETRY=1`` the summary
     gains a ``telemetry`` block: per-phase compile/execute/bytes-moved
-    columns plus the memory high-water mark (docs/OBSERVABILITY.md)."""
+    columns plus the memory high-water mark; with ``--audit`` also an
+    ``hlo_collectives`` section of ground-truth emitted collective
+    counts/bytes and the drift verdict (docs/OBSERVABILITY.md)."""
     times = []
     for trial in range(args.trials):
         t0 = time.perf_counter()

@@ -270,7 +270,7 @@ class DNDarray:
         sl = tuple(slice(0, n) for n in self.__gshape)
         return self.__array[sl]
 
-    def _relayout(self, new_split: Optional[int]) -> jax.Array:
+    def _relayout(self, new_split: Optional[int], *, audit: bool = False) -> jax.Array:
         """Physical buffer re-laid-out to the canonical layout of
         ``new_split``: logical slice, tail re-pad, `device_put` with the
         target sharding. Every step is a compiled op on the global array
@@ -282,18 +282,87 @@ class DNDarray:
         telemetry enabled, every relayout is a ``relayout`` span carrying
         the analytic collective kind and wire bytes
         (telemetry/collectives.py) and blocking on the result before the
-        clock stops."""
+        clock stops. ``audit=True`` additionally lower-compiles the
+        equivalent single program and diffs the collectives XLA actually
+        emitted against that prediction (telemetry/hlo.py). Op-level
+        callers (`resplit`) audit at their own site, so the global
+        ``HEAT_TPU_HLO_AUDIT`` flag is deliberately NOT consulted here —
+        one relayout must never produce two audit records."""
+        _cost, fields, do_audit = telemetry.op_cost(
+            self.__comm.relayout_cost, self.__gshape,
+            self.__dtype.byte_size(), self.__split, new_split,
+            audit=audit, use_global=False,
+        )
+        if do_audit:
+            self._audit_relayout(new_split, site="relayout")
         if telemetry.enabled():
-            cost = self.__comm.relayout_cost(
-                self.__gshape, self.__dtype.byte_size(), self.__split,
-                new_split,
-            )
             with telemetry.span(
                 "relayout", old_split=self.__split, new_split=new_split,
-                gshape=list(self.__gshape), **cost.as_fields(),
+                gshape=list(self.__gshape), **fields,
             ) as sp:
                 return sp.output(self.__relayout_impl(new_split))
         return self.__relayout_impl(new_split)
+
+    def _audit_relayout(self, new_split: Optional[int], site: str):
+        """Ground-truth the relayout: lower-and-compile the equivalent
+        single XLA program (slice → re-pad → re-shard, the same steps as
+        :meth:`__relayout_impl`) and record the emitted collectives diffed
+        against the analytic prediction for that program's (padded,
+        physical) shapes (telemetry/hlo.py). Memoized on the layout
+        signature; never raises. No-op on 1-position meshes and
+        split→same-split (no communication to audit)."""
+        from ..telemetry import hlo
+
+        comm = self.__comm
+        if comm.size <= 1 or new_split == self.__split:
+            return None
+        gshape = self.__gshape
+        pshape = comm.padded_shape(gshape, new_split)
+        tgt = (
+            comm.sharding(new_split, len(gshape))
+            if new_split is not None
+            else comm.replicated()
+        )
+        pad_count = self.pad_count
+        buf = self.__array
+
+        # the compare target is the cost of the PROGRAM BEING AUDITED: XLA
+        # moves the tail-padded physical buffer (padded along both the old
+        # and the new split), not the logical array, so predicting on the
+        # logical shape would flag spurious byte-drift on any shape the
+        # mesh does not divide (83% over on a (7,5)/4-mesh resplit). The
+        # span/phase accounting keeps the logical `cost` — two different
+        # questions, two different volumes.
+        phys_shape = list(gshape)
+        for ax in (self.__split, new_split):
+            if ax is not None:
+                phys_shape[ax] = comm.padded_size(gshape[ax])
+        phys_cost = telemetry.collectives.relayout_cost(
+            phys_shape, self.__dtype.byte_size(), self.__split, new_split,
+            comm.size,
+        )
+
+        def build():
+            def relayout_program(b):
+                if pad_count != 0:
+                    b = b[tuple(slice(0, g) for g in gshape)]
+                if tuple(b.shape) != pshape:
+                    b = jnp.pad(
+                        b, [(0, p - s) for p, s in zip(pshape, b.shape)]
+                    )
+                return b
+
+            return jax.jit(relayout_program, out_shardings=tgt), (buf,)
+
+        return hlo.audit_call(
+            site,
+            build,
+            predicted=phys_cost,
+            key=(site, tuple(buf.shape), str(buf.dtype), self.__split,
+                 new_split, comm.size),
+            fields={"old_split": self.__split, "new_split": new_split,
+                    "gshape": list(gshape)},
+        )
 
     def __relayout_impl(self, new_split: Optional[int]) -> jax.Array:
         buf = self.__array
@@ -469,10 +538,10 @@ class DNDarray:
         self.__lshape_map = None
         return self
 
-    def resplit(self, axis: Optional[int] = None) -> "DNDarray":
+    def resplit(self, axis: Optional[int] = None, *, audit: bool = False) -> "DNDarray":
         from . import manipulations
 
-        return manipulations.resplit(self, axis)
+        return manipulations.resplit(self, axis, audit=audit)
 
     def redistribute_(self, lshape_map=None, target_map=None) -> None:
         """API-parity shim (reference dndarray.py:1007 reshuffles to an

@@ -39,14 +39,16 @@ __all__ = ["qr"]
 QR = collections.namedtuple("QR", "Q, R")
 
 
-def _gram_ring(buf: jax.Array, comm) -> jax.Array:
+def _gram_ring(buf: jax.Array, comm, audit_cost=None) -> jax.Array:
     """``G = AᵀA`` for a column-sharded (pad-zeroed) physical buffer
     ``(m, n_phys)``; returns G ``(n_phys, n_phys)`` replicated.
 
     Ring schedule: device i keeps its transposed block stationary, the
     blocks circulate; step t computes tile ``G[my cols, origin's cols]``.
     p steps × one (c, m)·(m, c) MXU GEMM each; comm = m·n around the ring
-    plus the final n² all-gather of row blocks."""
+    plus the final n² all-gather of row blocks. ``audit_cost`` (an
+    analytic CollectiveCost) turns on the HLO collective audit of the
+    kernel program (telemetry/hlo.py)."""
     p = comm.size
     axis = comm.axis_name
     n_phys = buf.shape[1]
@@ -77,7 +79,7 @@ def _gram_ring(buf: jax.Array, comm) -> jax.Array:
         _, acc = jax.lax.fori_loop(0, p, body, (xt_blk, acc0))
         return jax.lax.all_gather(acc, axis, tiled=True)  # replicated G
 
-    return jax.shard_map(
+    smapped = jax.shard_map(
         kernel,
         mesh=comm.mesh,
         in_specs=comm.spec(0, 2),
@@ -86,7 +88,17 @@ def _gram_ring(buf: jax.Array, comm) -> jax.Array:
         # device, but the varying-axis type system can't infer that through
         # the fori_loop carry
         check_vma=False,
-    )(xt)
+    )
+    if audit_cost is not None:
+        telemetry.hlo.audit_call(
+            "cholqr_gram_ring",
+            lambda: (jax.jit(smapped), (xt,)),
+            predicted=audit_cost,
+            key=("cholqr_gram_ring", tuple(buf.shape), str(buf.dtype), p),
+            fields={"gshape": [int(buf.shape[0]), int(buf.shape[1])],
+                    "mesh": p},
+        )
+    return smapped(xt)
 
 
 def _panel_solve(buf: jax.Array, rinv_pad: jax.Array, comm) -> jax.Array:
@@ -110,7 +122,7 @@ def _panel_solve(buf: jax.Array, rinv_pad: jax.Array, comm) -> jax.Array:
     )(buf, rinv_pad)
 
 
-def _cholqr_split1(a: DNDarray, dt, calc_q: bool) -> QR:
+def _cholqr_split1(a: DNDarray, dt, calc_q: bool, audit: bool = False) -> QR:
     """CholeskyQR2 (+ shifted-Cholesky fallback) for tall column-split
     matrices; see module docstring."""
     comm = a.comm
@@ -125,15 +137,14 @@ def _cholqr_split1(a: DNDarray, dt, calc_q: bool) -> QR:
     shifted = False
     q_buf = buf
     while passes_left > 0:
-        fields = (
-            telemetry.collectives.gram_ring_cost(
-                m, n, dt.byte_size(), comm.size
-            ).as_fields()
-            if telemetry.enabled()
-            else {}
+        cost, fields, do_audit = telemetry.op_cost(
+            telemetry.collectives.gram_ring_cost, m, n, dt.byte_size(),
+            comm.size, audit=audit,
         )
         with telemetry.span("cholqr_gram_ring", gshape=[m, n], **fields) as sp:
-            g = sp.output(_gram_ring(q_buf, comm))[:n, :n]
+            g = sp.output(
+                _gram_ring(q_buf, comm, audit_cost=cost if do_audit else None)
+            )[:n, :n]
         ell = jnp.linalg.cholesky(g)
         # breakdown check on the small factor (one n² host fetch): NaNs or a
         # collapsed diagonal mean G is (numerically) singular on THIS pass —
@@ -211,6 +222,7 @@ def qr(
     tiles_per_proc: int = 1,
     calc_q: bool = True,
     overwrite_a: bool = False,
+    audit: bool = False,
 ) -> QR:
     """Reduced QR factorization ``a = Q @ R`` (reference qr.py:17).
 
@@ -227,6 +239,11 @@ def qr(
     piece) and finish with shard-local GEMMs. Replicated inputs use one XLA
     QR. Column signs of Q/R are not unique — compare ``Q @ R`` and
     ``Q.T @ Q``, as the reference tests do.
+
+    ``audit=True`` (or the global ``HEAT_TPU_HLO_AUDIT=1``) additionally
+    lower-compiles the distributed kernel (TSQR / ring Gram) and diffs
+    the collectives XLA actually emitted against the analytic cost model
+    (telemetry/hlo.py) — docs/OBSERVABILITY.md.
     """
     if not isinstance(a, DNDarray):
         raise TypeError(f"'a' must be a DNDarray, but was {type(a)}")
@@ -259,16 +276,24 @@ def qr(
             return q_i, r
 
         # kk == n always: p*k1 >= min(p*chunk, p*n) >= min(m, n) = n
-        fields = (
-            telemetry.collectives.tsqr_cost(m, n, dt.byte_size(), p).as_fields()
-            if telemetry.enabled()
-            else {}
+        cost, fields, do_audit = telemetry.op_cost(
+            telemetry.collectives.tsqr_cost, m, n, dt.byte_size(), p,
+            audit=audit,
         )
+        smapped = jax.shard_map(
+            kernel, mesh=comm.mesh, in_specs=spec_row,
+            out_specs=(spec_row, spec_row),
+        )
+        if do_audit:
+            telemetry.hlo.audit_call(
+                "tsqr",
+                lambda: (jax.jit(smapped), (buf,)),
+                predicted=cost,
+                key=("tsqr", (m, n), str(buf.dtype), p, tiles_per_proc),
+                fields={"gshape": [m, n], "mesh": p},
+            )
         with telemetry.span("tsqr", gshape=[m, n], mesh=p, **fields) as sp:
-            q_phys, r_tiled = jax.shard_map(
-                kernel, mesh=comm.mesh, in_specs=spec_row,
-                out_specs=(spec_row, spec_row),
-            )(buf)
+            q_phys, r_tiled = smapped(buf)
             sp.output(q_phys)
             sp.output(r_tiled)
         r_log = r_tiled[:n]  # every shard computed the same R; take one copy
@@ -282,7 +307,7 @@ def qr(
     # leading-block factorization (wide) — no gather, multi-host safe
     if a.split == 1 and comm.size > 1:
         if m >= n:
-            return _cholqr_split1(a, dt, calc_q)
+            return _cholqr_split1(a, dt, calc_q, audit=audit)
         return _wide_split1(a, dt, calc_q)
 
     # wide row-split: factor the m×m leading block (the small-dim² piece,

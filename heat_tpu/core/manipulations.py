@@ -502,21 +502,29 @@ def reshape(a: DNDarray, *shape, new_split: Optional[int] = None) -> DNDarray:
     return _rewrap(res, new_split, a)
 
 
-def resplit(arr: DNDarray, axis: Optional[int] = None) -> DNDarray:
+def resplit(
+    arr: DNDarray, axis: Optional[int] = None, *, audit: bool = False
+) -> DNDarray:
     """Out-of-place redistribution to a new split axis (reference
     manipulations.py:3351). One compiled relayout — multi-host safe.
 
     With telemetry enabled the op is a ``resplit`` span carrying the
     analytic collective kind and wire bytes; the inner ``relayout`` span
-    (the primitive) nests under it."""
+    (the primitive) nests under it. With ``audit=True`` (or the global
+    ``HEAT_TPU_HLO_AUDIT=1`` opt-in) the equivalent program is also
+    lower-compiled and the collectives XLA actually emitted are diffed
+    against the analytic prediction — docs/OBSERVABILITY.md."""
     axis = sanitize_axis(arr.shape, axis)
+    _cost, fields, do_audit = telemetry.op_cost(
+        arr.comm.relayout_cost, arr.shape, arr.dtype.byte_size(),
+        arr.split, axis, audit=audit,
+    )
+    if do_audit:
+        arr._audit_relayout(axis, site="resplit")
     if telemetry.enabled():
-        cost = arr.comm.relayout_cost(
-            arr.shape, arr.dtype.byte_size(), arr.split, axis
-        )
         with telemetry.span(
             "resplit", old_split=arr.split, new_split=axis,
-            gshape=list(arr.shape), **cost.as_fields(),
+            gshape=list(arr.shape), **fields,
         ) as sp:
             buf = sp.output(arr._relayout(axis))
     else:
@@ -1330,7 +1338,9 @@ DNDarray.expand_dims = lambda self, axis: expand_dims(self, axis)
 DNDarray.flatten = lambda self: flatten(self)
 DNDarray.ravel = lambda self: ravel(self)
 DNDarray.reshape = lambda self, *shape, new_split=None: reshape(self, *shape, new_split=new_split)
-DNDarray.resplit = lambda self, axis=None: resplit(self, axis)
+DNDarray.resplit = lambda self, axis=None, audit=False: resplit(
+    self, axis, audit=audit
+)
 DNDarray.squeeze = lambda self, axis=None: squeeze(self, axis)
 DNDarray.unique = lambda self, sorted=False, return_inverse=False, axis=None: unique(
     self, sorted, return_inverse, axis

@@ -88,12 +88,16 @@ def _rbf_from_dist(d: jax.Array, gamma) -> jax.Array:
     return jnp.exp(-gamma * d * d)
 
 
-def _ring_dist(x: DNDarray, y: DNDarray, block_fn: Callable) -> jax.Array:
+def _ring_dist(
+    x: DNDarray, y: DNDarray, block_fn: Callable, audit_cost=None
+) -> jax.Array:
     """Ring-pipelined block distance matrix (reference distance.py:280-326).
 
     Both operands row-split. Each mesh position keeps its stationary x-block
     and circulates the y-block one hop per step; after p steps every position
-    has filled its (local rows × all columns) slab.
+    has filled its (local rows × all columns) slab. ``audit_cost`` (an
+    analytic CollectiveCost) turns on the HLO collective audit of the
+    kernel program (telemetry/hlo.py).
     """
     comm = x.comm
     p = comm.size
@@ -127,9 +131,19 @@ def _ring_dist(x: DNDarray, y: DNDarray, block_fn: Callable) -> jax.Array:
 
     spec = comm.spec(0, 2)
     out_spec = spec
-    return jax.shard_map(
+    smapped = jax.shard_map(
         kernel, mesh=comm.mesh, in_specs=(spec, spec), out_specs=out_spec
-    )(xm, ym)
+    )
+    if audit_cost is not None:
+        telemetry.hlo.audit_call(
+            "ring_cdist",
+            lambda: (jax.jit(smapped), (xm, ym)),
+            predicted=audit_cost,
+            key=("ring_cdist", tuple(xm.shape), tuple(ym.shape),
+                 str(xm.dtype), p),
+            fields={"mesh": p},
+        )
+    return smapped(xm, ym)
 
 
 def _pallas_local(
@@ -167,6 +181,7 @@ def _dist(
     ring_ok: bool,
     ring: bool,
     rbf_gamma: Optional[float] = None,
+    audit: bool = False,
 ) -> DNDarray:
     """Distance engine (reference distance.py:209): result is
     (n_x, n_y) distributed along the rows of x. ``rbf_gamma`` composes the
@@ -208,12 +223,9 @@ def _dist(
     if use_ring:
         # ring kernel works on the padded buffers; x pad rows land in output
         # pad rows, y pad columns are sliced off below
-        fields = (
-            telemetry.collectives.ring_cdist_cost(
-                n, x.shape[1], promoted.byte_size(), x.comm.size
-            ).as_fields()
-            if telemetry.enabled()
-            else {}
+        cost, fields, do_audit = telemetry.op_cost(
+            telemetry.collectives.ring_cdist_cost, n, x.shape[1],
+            promoted.byte_size(), x.comm.size, audit=audit,
         )
         with telemetry.span(
             "ring_cdist", gshape=[m, n], mesh=x.comm.size, **fields
@@ -222,7 +234,12 @@ def _dist(
             ym = y._masked(0).astype(promoted.jnp_type())
             xw = DNDarray(xm, x.shape, promoted, 0, x.device, x.comm, True)
             yw = DNDarray(ym, y.shape, promoted, 0, y.device, y.comm, True)
-            out = sp.output(_ring_dist(xw, yw, block_fn))
+            out = sp.output(
+                _ring_dist(
+                    xw, yw, block_fn,
+                    audit_cost=cost if do_audit else None,
+                )
+            )
         out = out[:, :n]
         return _finish(out)
 
@@ -264,19 +281,22 @@ def _dist(
     return _finish(out)
 
 
-def cdist(X: DNDarray, Y: Optional[DNDarray] = None, quadratic_expansion: bool = False, ring: bool = False) -> DNDarray:
+def cdist(X: DNDarray, Y: Optional[DNDarray] = None, quadratic_expansion: bool = False, ring: bool = False, audit: bool = False) -> DNDarray:
     """Euclidean distance matrix (reference distance.py:136).
 
     ``quadratic_expansion`` selects the GEMM form (reference offers the same
     switch); ``ring=True`` (extension) forces the ppermute ring kernel for
-    O(n·m/p) per-chip memory when both operands are row-split."""
+    O(n·m/p) per-chip memory when both operands are row-split.
+    ``audit=True`` (or ``HEAT_TPU_HLO_AUDIT=1``) lower-compiles the ring
+    kernel and diffs the collectives XLA actually emitted against the
+    analytic cost model (telemetry/hlo.py)."""
     fn = _quadratic_euclidean if quadratic_expansion else _blocked_euclidean
-    return _dist(X, Y, fn, ring_ok=True, ring=ring)
+    return _dist(X, Y, fn, ring_ok=True, ring=ring, audit=audit)
 
 
-def manhattan(X: DNDarray, Y: Optional[DNDarray] = None, expand: bool = False, ring: bool = False) -> DNDarray:
+def manhattan(X: DNDarray, Y: Optional[DNDarray] = None, expand: bool = False, ring: bool = False, audit: bool = False) -> DNDarray:
     """City-block distance matrix (reference distance.py:186)."""
-    return _dist(X, Y, _blocked_manhattan, ring_ok=True, ring=ring)
+    return _dist(X, Y, _blocked_manhattan, ring_ok=True, ring=ring, audit=audit)
 
 
 def rbf(
@@ -285,6 +305,7 @@ def rbf(
     sigma: float = 1.0,
     quadratic_expansion: bool = False,
     ring: bool = False,
+    audit: bool = False,
 ) -> DNDarray:
     """Gaussian kernel matrix exp(−‖x−y‖²/2σ²) (reference distance.py:159).
 
@@ -293,4 +314,5 @@ def rbf(
     compiled pass over the distance matrix."""
     gamma = 1.0 / (2.0 * sigma * sigma)
     fn = _quadratic_euclidean if quadratic_expansion else _blocked_euclidean
-    return _dist(X, Y, fn, ring_ok=True, ring=ring, rbf_gamma=gamma)
+    return _dist(X, Y, fn, ring_ok=True, ring=ring, rbf_gamma=gamma,
+                 audit=audit)

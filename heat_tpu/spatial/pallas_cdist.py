@@ -29,6 +29,9 @@ distance.py:209); this is TPU-native plumbing under the same API.
 from __future__ import annotations
 
 import functools
+import os
+import warnings
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -39,7 +42,7 @@ from jax.experimental.pallas import tpu as pltpu
 from ..core.pallas_util import DotPrecision, dot_f32
 from .. import telemetry
 
-__all__ = ["euclid_pallas", "pallas_cdist_applicable"]
+__all__ = ["euclid_pallas", "pallas_cdist_applicable", "cdist_precision"]
 
 # jax_enable_x64 is on framework-wide: pin index-map literals to i32 (a
 # Python-int 0 would trace as i64, which Mosaic cannot legalize — same
@@ -48,6 +51,30 @@ _I0 = np.int32(0)
 
 _MAX_K = 512  # f32 (bm, kp)+(bn, kp) tiles must fit VMEM; beyond this the
 # workload is GEMM-bound and the XLA path is the right tool
+
+# In-kernel dot strategy override. The "bf16x3" default is analysis-backed
+# but UNMEASURED on hardware (advisor r5); until the scripts/tpu_tune.py
+# sweep lands on-chip numbers, this env var is the one-line revert knob —
+# no source edit, no redeploy (docs/TUNING_RUNBOOK.md).
+_PREC_ENV = "HEAT_TPU_CDIST_PREC"
+_PREC_VALUES = ("bf16x3", "default", "high", "highest")
+
+
+def cdist_precision() -> DotPrecision:
+    """The in-kernel dot strategy for the fused cdist kernel: ``"bf16x3"``
+    unless ``HEAT_TPU_CDIST_PREC`` names one of ``bf16x3`` / ``default`` /
+    ``high`` / ``highest`` (the ``jax.lax.Precision`` tiers). Read at call
+    time, so a sweep can flip it between runs of one process."""
+    v = os.environ.get(_PREC_ENV, "").strip().lower()
+    if not v or v == "bf16x3":
+        return "bf16x3"
+    if v in _PREC_VALUES:
+        return v.upper()  # dot_f32 resolves tier names via lax.Precision
+    warnings.warn(
+        f"{_PREC_ENV}={v!r} is not one of {_PREC_VALUES}; "
+        "keeping the bf16x3 default"
+    )
+    return "bf16x3"
 
 
 def _kernel(gamma_ref, x_ref, y_ref, o_ref, *, epilogue, precision):
@@ -81,7 +108,7 @@ def euclid_pallas(
     block_m: int = 512,
     block_n: int = 1024,
     interpret: bool = False,
-    precision: DotPrecision = "bf16x3",
+    precision: Optional[DotPrecision] = None,
 ) -> jax.Array:
     """Fused pairwise euclidean kernel on one device's tiles.
 
@@ -97,7 +124,13 @@ def euclid_pallas(
     calls from inside a trace (the sharded `shard_map` wrapping in
     distance.py hands tracers in) bypass instrumentation, since the span
     would measure trace time, not the kernel.
+
+    ``precision=None`` (the default) resolves :func:`cdist_precision` —
+    ``"bf16x3"`` unless the ``HEAT_TPU_CDIST_PREC`` env override names a
+    ``jax.lax.Precision`` tier.
     """
+    if precision is None:
+        precision = cdist_precision()
     if telemetry.enabled() and not isinstance(x, jax.core.Tracer):
         m, n = int(x.shape[0]), int(y.shape[0])
         with telemetry.span(

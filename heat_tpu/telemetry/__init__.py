@@ -21,9 +21,17 @@ measurement substrate that makes them visible again:
   path measures, attributed to a first call;
 * an analytic **collective cost model** (:mod:`.collectives`) giving
   bytes-on-the-wire for relayouts and the hand-scheduled kernels;
+* an **HLO collective auditor** (:mod:`.hlo`) that closes the
+  predicted-vs-emitted loop: lower-and-compile a jitted computation,
+  parse the ground-truth collectives XLA emitted, and flag drift against
+  the analytic prediction (``audit=`` on resplit/qr/cdist, or globally
+  via ``HEAT_TPU_HLO_AUDIT=1``);
 * per-device **memory watermarks** (:mod:`.memory`);
 * a :mod:`.report` summarizer aggregating events into the JSON shape the
-  benchmark harness emits.
+  benchmark harness emits;
+* a :mod:`.trace` exporter turning the event stream into
+  Chrome-trace/Perfetto JSON (:func:`export_trace`), plus a
+  ``python -m heat_tpu.telemetry.audit`` CLI.
 
 Disabled (the default), every hook compiles down to one module-flag check:
 ``span()`` returns a shared no-op context manager, call sites skip field
@@ -53,10 +61,14 @@ __all__ = [
     "get_registry",
     "span",
     "trace_event",
+    "op_cost",
     "measure_compile",
     "collectives",
+    "hlo",
     "memory",
     "report",
+    "trace",
+    "export_trace",
 ]
 
 # Module-level fast path: every instrumentation site guards on this single
@@ -278,13 +290,14 @@ class Span:
     for the compile/execute split.
     """
 
-    __slots__ = ("name", "fields", "_outputs", "_t0")
+    __slots__ = ("name", "fields", "_outputs", "_t0", "_wall0")
 
     def __init__(self, name: str, fields: Dict[str, Any]):
         self.name = name
         self.fields = fields
         self._outputs: List[Any] = []
         self._t0 = 0.0
+        self._wall0 = 0.0
 
     def add_fields(self, **fields: Any) -> "Span":
         self.fields.update(fields)
@@ -297,6 +310,11 @@ class Span:
 
     def __enter__(self) -> "Span":
         _stack().append(self)
+        # wall-clock start recorded alongside the perf_counter duration
+        # clock: deriving the start as `ts - seconds` would mix the two
+        # clocks and break nesting containment in the trace export at
+        # µs scale (trace.py anchors slices on start_ts)
+        self._wall0 = time.time()
         self._t0 = time.perf_counter()
         return self
 
@@ -311,7 +329,8 @@ class Span:
         reg = get_registry()
         if exc_type is not None:
             reg.emit(
-                "span_error", self.name, seconds=dt, error=repr(exc), **self.fields
+                "span_error", self.name, seconds=dt, start_ts=self._wall0,
+                error=repr(exc), **self.fields
             )
             return False
         reg.add(f"span.{self.name}.count", 1)
@@ -321,7 +340,7 @@ class Span:
             reg.add(f"span.{self.name}.bytes", b)
         reg.emit(
             "span", self.name, seconds=dt, depth=len(stack), parent=parent,
-            **self.fields,
+            start_ts=self._wall0, **self.fields,
         )
         return False
 
@@ -332,6 +351,29 @@ def span(name: str, **fields: Any):
     if not _ENABLED:
         return _NOOP_SPAN
     return Span(name, fields)
+
+
+def op_cost(cost_fn, *cost_args, audit: bool = False, use_global: bool = True):
+    """Shared preamble for instrumented op sites; returns
+    ``(cost, fields, do_audit)``:
+
+    * ``cost`` — the analytic :class:`~.collectives.CollectiveCost`,
+      computed only when recording or auditing will consume it (None on
+      the cold path, preserving the one-flag-check disabled contract);
+    * ``fields`` — the span field dict (``cost.as_fields()`` when
+      recording, ``{}`` otherwise);
+    * ``do_audit`` — whether this call should run the HLO audit:
+      explicit ``audit=True``, plus the global ``HEAT_TPU_HLO_AUDIT``
+      opt-in unless ``use_global=False`` (the ``_relayout`` primitive
+      opts out so an op-level audit is never doubled).
+
+    Every instrumented site goes through here so the flag semantics live
+    in ONE place — a new op site cannot silently pick a diverged variant.
+    """
+    do_audit = audit or (use_global and hlo.audit_enabled())
+    cost = cost_fn(*cost_args) if (_ENABLED or do_audit) else None
+    fields = cost.as_fields() if (_ENABLED and cost is not None) else {}
+    return cost, fields, do_audit
 
 
 def trace_event(name: str, **fields: Any) -> None:
@@ -446,9 +488,14 @@ def measure_compile(fn, *args, **kwargs):
     return dt, compiled
 
 
-# memory/report import the registry machinery above, so they load last.
+# memory/report/hlo/trace import the registry machinery above, so they
+# load last.
 from . import memory  # noqa: E402,F401
 from . import report  # noqa: E402,F401
+from . import hlo  # noqa: E402,F401
+from . import trace  # noqa: E402,F401
+
+export_trace = trace.export_trace
 
 # Environment activation: HEAT_TPU_TELEMETRY=1 turns recording on at import
 # (heat_tpu/__init__ imports this package, so `import heat_tpu` suffices).

@@ -12,6 +12,12 @@ per-phase compile/execute/bytes-moved columns keyed by span name:
      "compile_seconds": 0.4, "compile_events": 3,
      "traced_collectives": {"all_gather": 1},
      "peak_live_bytes": 1048576, "events": 17}
+
+When the HLO collective auditor recorded any ``hlo_audit`` events
+(``--audit`` / ``HEAT_TPU_HLO_AUDIT=1``), the summary also gains an
+``hlo_collectives`` section of *ground-truth* emitted counts and wire
+bytes next to the analytic ``phases`` — see docs/BENCHMARKS.md for the
+field schema.
 """
 
 from __future__ import annotations
@@ -62,6 +68,9 @@ def summarize(
     compile_seconds = 0.0
     compile_events = 0
     traced: dict = {}
+    hlo_sites: dict = {}
+    hlo_audits = 0
+    hlo_drift = 0
     n = 0
     for ev in events:
         n += 1
@@ -84,6 +93,23 @@ def summarize(
         elif kind == "collective_trace":
             name = ev.get("name")
             traced[name] = traced.get(name, 0) + 1
+        elif kind == "hlo_audit":
+            hlo_audits += 1
+            drift = int(ev.get("drift", 0) or 0)
+            hlo_drift += drift
+            row = hlo_sites.setdefault(
+                ev.get("name"),
+                {"audits": 0, "instructions": {}, "wire_bytes": {},
+                 "emitted_bytes": 0, "predicted_bytes": 0, "drift": 0},
+            )
+            row["audits"] += 1
+            row["drift"] += drift
+            for op, cnt in (ev.get("ops") or {}).items():
+                row["instructions"][op] = row["instructions"].get(op, 0) + cnt
+            for op, b in (ev.get("bytes_by_op") or {}).items():
+                row["wire_bytes"][op] = row["wire_bytes"].get(op, 0) + int(b)
+            row["emitted_bytes"] += int(ev.get("emitted_bytes", 0) or 0)
+            row["predicted_bytes"] += int(ev.get("predicted_bytes", 0) or 0)
     for row in phases.values():
         row["execute_seconds"] = round(row["execute_seconds"], 6)
 
@@ -94,6 +120,15 @@ def summarize(
         "traced_collectives": traced,
         "events": n,
     }
+    if hlo_audits:
+        # ground-truth emitted collectives (telemetry/hlo.py) next to the
+        # analytic phases — only present when the auditor actually ran, so
+        # non-audited summaries keep their exact shape
+        out["hlo_collectives"] = {
+            "audits": hlo_audits,
+            "drift": hlo_drift,
+            "sites": hlo_sites,
+        }
     if watermarks:
         peak = watermarks.get("live_bytes.total")
         if peak is not None:

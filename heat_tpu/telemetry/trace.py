@@ -1,0 +1,147 @@
+"""Chrome-trace / Perfetto export of the telemetry event stream.
+
+Turns the registry's events (or a JSONL sink read back via
+:func:`..report.load_events`) into a `Trace Event Format
+<https://docs.google.com/document/d/1CvAClvFfyA5R-PhYUmn5OOQtYMH4h6I0nSsKchNAySU>`_
+JSON file loadable in ``chrome://tracing`` or https://ui.perfetto.dev:
+
+* ``span`` / ``span_error`` events → complete (``"X"``) slices on the
+  *spans* track, with their user fields (``bytes``, ``collective``,
+  ``gshape``, anything via ``add_fields``) as ``args``;
+* ``compile`` events → ``"X"`` slices on the *compile* track (the
+  AOT/backend-compile durations, visually separated from execution);
+* ``memory`` events → a ``live_bytes`` counter (``"C"``) track;
+* everything else (``collective_trace``, ``hlo_audit``, …) → instant
+  (``"i"``) markers on the *events* track.
+
+Timestamps: the registry records wall-clock *end* times plus durations;
+slices are re-anchored to their start (``ts - seconds``), shifted so the
+earliest event is t=0, and emitted in microseconds, sorted — the
+monotonic, pid/tid-complete stream the format requires.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Iterable, List, Optional
+
+__all__ = ["to_trace_events", "export_trace"]
+
+_TID_SPANS = 1
+_TID_COMPILE = 2
+_TID_EVENTS = 3
+_TID_MEMORY = 4
+
+_THREAD_NAMES = {
+    _TID_SPANS: "spans",
+    _TID_COMPILE: "compile",
+    _TID_EVENTS: "events",
+    _TID_MEMORY: "memory",
+}
+
+_META_KEYS = ("ts", "kind", "name", "seconds", "depth", "parent", "start_ts")
+
+
+def _args(ev: dict) -> dict:
+    out = {k: v for k, v in ev.items() if k not in _META_KEYS}
+    # depth/parent are span structure, useful to keep visible in the UI
+    if "parent" in ev and ev.get("parent") is not None:
+        out["parent"] = ev["parent"]
+    return out
+
+
+def to_trace_events(
+    events: Optional[Iterable[dict]] = None, pid: Optional[int] = None
+) -> List[dict]:
+    """Convert telemetry events (default: the live registry's) into a
+    sorted Trace Event Format list (``ts``/``dur`` in microseconds,
+    earliest event at t=0, ``pid``/``tid`` on every record)."""
+    if events is None:
+        from . import get_registry
+
+        events = list(get_registry().events)
+    else:
+        events = list(events)
+    if pid is None:
+        pid = os.getpid()
+
+    out: List[dict] = [
+        {"name": "process_name", "ph": "M", "ts": 0, "pid": pid, "tid": 0,
+         "args": {"name": "heat_tpu.telemetry"}},
+    ]
+    for tid, tname in _THREAD_NAMES.items():
+        out.append({"name": "thread_name", "ph": "M", "ts": 0, "pid": pid,
+                    "tid": tid, "args": {"name": tname}})
+
+    rows: List[dict] = []
+    t0 = None
+    for ev in events:
+        kind = ev.get("kind")
+        ts_end = float(ev.get("ts", 0.0))
+        dur = float(ev.get("seconds", 0.0) or 0.0)
+        if kind in ("span", "span_error", "compile"):
+            # spans carry their wall-clock start explicitly (deriving it as
+            # `ts - seconds` mixes the wall and perf_counter clocks and
+            # breaks slice containment at µs scale); compile events do not,
+            # so they fall back to the derived start
+            start = float(ev.get("start_ts") or (ts_end - dur))
+        else:
+            start = ts_end
+        if t0 is None or start < t0:
+            t0 = start
+        rows.append({"_start": start, "_dur": dur, **ev})
+    t0 = t0 or 0.0
+
+    for ev in rows:
+        kind = ev.get("kind")
+        name = str(ev.get("name", "?"))
+        ts_us = (ev["_start"] - t0) * 1e6
+        dur_us = ev["_dur"] * 1e6
+        clean = {k: v for k, v in ev.items() if k not in ("_start", "_dur")}
+        if kind in ("span", "span_error"):
+            out.append({
+                "name": name, "cat": kind, "ph": "X", "ts": ts_us,
+                "dur": dur_us, "pid": pid, "tid": _TID_SPANS,
+                "args": _args(clean),
+            })
+        elif kind == "compile":
+            out.append({
+                "name": name, "cat": "compile", "ph": "X", "ts": ts_us,
+                "dur": dur_us, "pid": pid, "tid": _TID_COMPILE,
+                "args": _args(clean),
+            })
+        elif kind == "memory":
+            out.append({
+                "name": "live_bytes", "cat": "memory", "ph": "C",
+                "ts": ts_us, "pid": pid, "tid": _TID_MEMORY,
+                "args": {"total": ev.get("total", 0)},
+            })
+        else:  # collective_trace, hlo_audit, and future kinds
+            out.append({
+                "name": name, "cat": str(kind), "ph": "i", "ts": ts_us,
+                "s": "p", "pid": pid, "tid": _TID_EVENTS,
+                "args": _args(clean),
+            })
+
+    # metadata first, then everything else in monotonic ts order
+    meta = [e for e in out if e["ph"] == "M"]
+    rest = sorted((e for e in out if e["ph"] != "M"), key=lambda e: e["ts"])
+    return meta + rest
+
+
+def export_trace(
+    path: str, events: Optional[Iterable[dict]] = None
+) -> str:
+    """Write the event stream as a Chrome-trace JSON object
+    (``{"traceEvents": [...]}``) loadable in ``chrome://tracing`` /
+    Perfetto; returns ``path``. ``events`` defaults to the live
+    registry's stream — pass ``report.load_events(sink)`` to convert a
+    JSONL sink from an earlier run."""
+    trace = {
+        "traceEvents": to_trace_events(events),
+        "displayTimeUnit": "ms",
+    }
+    with open(path, "w") as f:
+        json.dump(trace, f, default=str)
+    return path

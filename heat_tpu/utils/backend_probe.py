@@ -8,12 +8,40 @@ no analog (MPI init either works or aborts); this is TPU-runtime plumbing.
 
 from __future__ import annotations
 
+import os
+import re
 import subprocess
 import sys
 import time
 from typing import List, Optional, Tuple
 
-__all__ = ["probe_default_platform"]
+__all__ = ["probe_default_platform", "force_virtual_cpu_mesh"]
+
+
+def force_virtual_cpu_mesh(n: int) -> None:
+    """Point jax at an ``n``-device virtual CPU mesh. Must run before the
+    first *backend use* (``jax.devices()`` / first dispatch) — importing
+    jax earlier is fine, backend init is lazy. One canonical copy of the
+    dance (the benchmark harness ``--mesh`` flag and the
+    ``python -m heat_tpu.telemetry.audit --mesh`` CLI both go through
+    here):
+
+    * splice ``--xla_force_host_platform_device_count=n`` into
+      ``XLA_FLAGS``, replacing an inherited count (a test env's value
+      must not win over an explicit request);
+    * pin ``JAX_PLATFORMS=cpu`` in the environment AND the live jax
+      config — a sitecustomize (the axon TPU plugin) can force another
+      platform, so the env var alone is not enough.
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    want = f"--xla_force_host_platform_device_count={int(n)}"
+    m = re.search(r"--xla_force_host_platform_device_count=\d+", flags)
+    flags = flags.replace(m.group(0), want) if m else (flags + " " + want).strip()
+    os.environ["XLA_FLAGS"] = flags
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
 
 _PROBE_CODE = "import jax; d = jax.devices(); print('PROBE', d[0].platform, len(d))"
 

@@ -126,6 +126,57 @@ for n in $SIZES; do
     fi
 done
 
+# HLO collective audit: run the resplit redistribution microbenchmark with
+# the predicted-vs-emitted auditor on and fail on any drift above tolerance
+# (telemetry/hlo.py; HEAT_TPU_HLO_TOLERANCE overrides the default 10%).
+# This is the schedule-level regression oracle: a jax/XLA upgrade that
+# changes the emitted collectives breaks HERE, not in a wall-clock graph.
+# HEAT_TPU_CI_SKIP_AUDIT=1 opts out.
+if [ -z "${HEAT_TPU_CI_SKIP_AUDIT:-}" ]; then
+    echo "=== hlo collective audit (resplit microbenchmark, 4-device mesh) ==="
+    audit_out=$(mktemp)
+    audit_rc=0
+    if HEAT_TPU_TELEMETRY=1 python benchmarks/resplit/heat_tpu.py \
+        --n 4096 --features 64 --trials 1 --mesh 4 --audit > "$audit_out"; then
+        python - "$audit_out" <<'EOF' || audit_rc=$?
+import json, sys
+
+summary = None
+for line in open(sys.argv[1]):
+    line = line.strip()
+    if not line:
+        continue
+    try:
+        obj = json.loads(line)
+    except json.JSONDecodeError:
+        continue
+    if "telemetry" in obj:
+        summary = obj
+if summary is None:
+    raise SystemExit("audit: no summary line with a telemetry block")
+hlo = summary["telemetry"].get("hlo_collectives")
+if not hlo or not hlo.get("audits"):
+    raise SystemExit(f"audit: auditor recorded no audits: {hlo}")
+if hlo.get("drift", 0) > 0:
+    raise SystemExit(
+        "audit: predicted-vs-emitted drift detected:\n"
+        + json.dumps(hlo, indent=2)
+    )
+print(f"audit ok: {hlo['audits']} audits, 0 drift")
+EOF
+    else
+        audit_rc=$?
+    fi
+    if [ -n "$REPORT" ]; then
+        cp "$audit_out" "${REPORT}/audit_resplit.jsonl" || true
+    fi
+    rm -f "$audit_out"
+    if [ "$audit_rc" != 0 ]; then
+        echo "=== hlo collective audit FAILED (rc=$audit_rc) ==="
+        FAILED_SIZES="$FAILED_SIZES audit"
+    fi
+fi
+
 if [ "$have_coverage" = 1 ]; then
     # merge the per-size coverage files, as the reference CI merges its
     # 8 mpirun passes (Jenkinsfile:33-44 / codecov)

@@ -9,6 +9,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -17,6 +19,7 @@ class TestBenchSmallMode:
     CPU host — the guard for the driver's headline artifact (bench.py runs
     unattended at round end)."""
 
+    @pytest.mark.slow
     def test_small_mode_subset_produces_json(self):
         # force the CPU backend via jax.config BEFORE bench runs: the
         # sandbox's sitecustomize pins JAX_PLATFORMS=axon, so the env var

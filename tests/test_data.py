@@ -253,6 +253,7 @@ class TestOfflineUtils:
         assert offs[1][1] == 8 + 4 + 25 + 4
         assert open(tmp_path / "vi" / "part-0.idx").read() == ""
 
+    @pytest.mark.slow
     def test_merge_gate(self):
         from heat_tpu.utils.data._utils import merge_files_imagenet_tfrecord
 

@@ -54,6 +54,7 @@ class TestExamples:
         r = _run("examples/cluster/demo_kclustering.py")
         assert r.returncode == 0, r.stderr[-1500:]
 
+    @pytest.mark.slow
     def test_lm_training(self):
         # flagship LM converging on the 3-gram task (asserts internally
         # that held-out perplexity at least halves from the uniform start)
@@ -61,20 +62,24 @@ class TestExamples:
         assert r.returncode == 0, r.stderr[-1500:]
         assert "converged: perplexity" in r.stdout
 
+    @pytest.mark.slow
     def test_mnist_demo(self):
         r = _run("examples/nn/mnist.py", timeout=300)
         assert r.returncode == 0, r.stderr[-1500:]
         assert "eval accuracy" in r.stdout
 
+    @pytest.mark.slow
     def test_daso_training_demo(self):
         r = _run("examples/nn/daso_training.py", timeout=300)
         assert r.returncode == 0, r.stderr[-1500:]
 
+    @pytest.mark.slow
     def test_ring_attention_demo(self):
         r = _run("examples/long_context/ring_attention_demo.py", timeout=300)
         assert r.returncode == 0, r.stderr[-1500:]
         assert "max |diff|" in r.stdout
 
+    @pytest.mark.slow
     def test_scaleout_tour(self):
         # pipeline/expert/FSDP schedules each check against their oracle
         # internally; the script asserts and exits non-zero on mismatch
@@ -82,6 +87,7 @@ class TestExamples:
         assert r.returncode == 0, r.stderr[-1500:]
         assert "all three schedules match" in r.stdout
 
+    @pytest.mark.slow
     def test_multihost_demo(self):
         # the one example that spawns ITS OWN 2-process jax.distributed run
         import socket

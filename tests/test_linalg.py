@@ -316,6 +316,7 @@ class TestQRSplit1Distributed(TestCase):
             np.abs(rn), np.abs(np.linalg.qr(an)[1][: rn.shape[0]]), atol=2e-3
         )
 
+    @pytest.mark.slow
     def test_device_count_sweep(self):
         import jax
 

@@ -368,6 +368,7 @@ class TestSortDeep(TestCase):
 
 
 class TestUniqueDeep(TestCase):
+    @pytest.mark.slow
     def test_unique_inverse_reconstructs_across_sizes(self):
         rng = np.random.default_rng(15)
         for n in (1, self.comm.size, 5 * self.comm.size + 3):

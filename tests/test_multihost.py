@@ -393,6 +393,7 @@ class TestMultiHostStage1:
     EMPTY canonical block."""
 
     @pytest.mark.parametrize("nprocs,ldc", [(2, 4), (4, 2)])
+    @pytest.mark.slow
     def test_process_topologies(self, tmp_path, nprocs, ldc):
         script = tmp_path / "mh_worker.py"
         script.write_text(WORKER)
@@ -433,6 +434,7 @@ class TestMultiHostOpSurface:
     (VERDICT r3 item 4)."""
 
     @pytest.mark.parametrize("nprocs,ldc", [(2, 2)])
+    @pytest.mark.slow
     def test_op_table(self, tmp_path, nprocs, ldc):
         script = tmp_path / "mh_ops.py"
         script.write_text(OP_WORKER)
@@ -467,6 +469,7 @@ class TestOpTableSingleController:
     """The same table's "ok" rows must hold on the single-controller
     8-device mesh (guards the table itself against rot)."""
 
+    @pytest.mark.slow
     def test_ok_rows(self):
         import numpy as np
 

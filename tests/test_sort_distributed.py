@@ -125,6 +125,7 @@ class TestUniqueCeiling:
         u = ht.unique(x)
         np.testing.assert_array_equal(np.sort(u.numpy()), np.unique(xn))
 
+    @pytest.mark.slow
     def test_unique_above_ceiling_host_bound_not_failure(self):
         # PARITY.md promises "host-memory-bound, not failure" ABOVE the
         # ceiling — pin that for the eager axis-unique path (r3 weak #7)
@@ -231,18 +232,21 @@ class TestDistributedRowUnique(BTTestCase):
         np.testing.assert_array_equal(inv.numpy(), winv)
         np.testing.assert_array_equal(uv.numpy(), wv)
 
+    @pytest.mark.slow
     def test_axis0_all_splits(self):
         rng = np.random.default_rng(29)
         xn = rng.integers(0, 4, (4 * self.comm.size + 3, 3)).astype(np.float32)
         for split in (0, 1):
             self._check(xn, 0, split)
 
+    @pytest.mark.slow
     def test_axis1_all_splits(self):
         rng = np.random.default_rng(31)
         xn = rng.integers(0, 2, (4, 3 * self.comm.size + 1)).astype(np.int64)
         for split in (0, 1):
             self._check(xn, 1, split)
 
+    @pytest.mark.slow
     def test_3d_axis0(self):
         rng = np.random.default_rng(37)
         xn = rng.integers(0, 3, (2 * self.comm.size + 5, 2, 2)).astype(np.int32)
@@ -283,6 +287,7 @@ class TestDistributedRowUnique(BTTestCase):
         uf = ht.unique(ht.array(xn, split=0))  # flat: one NaN
         np.testing.assert_array_equal(uf.numpy(), np.unique(xn))
 
+    @pytest.mark.slow
     def test_randomized_oracle_sweep(self):
         # deterministic randomized configs: shapes x dtypes x axes x splits
         rng = np.random.default_rng(97)
@@ -305,6 +310,7 @@ class TestDistributedRowUnique(BTTestCase):
             wv, wi = np.unique(vals, axis=axis, return_inverse=True)
             np.testing.assert_array_equal(gi.numpy(), wi)
 
+    @pytest.mark.slow
     def test_past_old_ceiling(self):
         # 2.1M rows — past the old 2^20 eager-path ceiling (VERDICT r4)
         rng = np.random.default_rng(43)
@@ -318,6 +324,7 @@ class TestUniqueNDim(BTTestCase):
     and runs the distributed algorithm; inverses come back input-shaped
     (numpy semantics)."""
 
+    @pytest.mark.slow
     def test_matrix_and_3d(self):
         rng = np.random.default_rng(161)
         for shape in ((2 * self.comm.size + 1, 4), (3, self.comm.size + 2, 2)):

@@ -146,6 +146,7 @@ class TestOrderStatisticsGrid(TestCase):
                 got = float(ht.median(ht.array(a, split=split)))
                 np.testing.assert_allclose(got, np.median(a), rtol=1e-5)
 
+    @pytest.mark.slow
     def test_percentile_interpolations(self):
         a = self._a()
         x = ht.array(a, split=0)
@@ -296,6 +297,7 @@ class TestDistributedPercentile(TestCase):
     def _spy(self):
         return _spy_percentile_fast_path()
 
+    @pytest.mark.slow
     def test_fast_path_taken_and_numpy_exact(self):
         rng = np.random.default_rng(71)
         a = rng.standard_normal(5 * self.comm.size + 3)
@@ -412,6 +414,7 @@ class TestDistributedHistograms(TestCase):
         with pytest.raises(ValueError):
             ht.bincount(ht.array(np.asarray([0, 1, -1]), split=0))
 
+    @pytest.mark.slow
     def test_histogram_splits_bins_weights_density(self):
         rng = np.random.default_rng(82)
         t = rng.standard_normal((2 * self.comm.size + 1, 5))
@@ -501,6 +504,7 @@ class TestAxisPercentileDistributed(TestCase):
     """percentile along the SPLIT axis of n-D arrays: distributed sort per
     lane + replicated order-statistic slice gather — no logical gather."""
 
+    @pytest.mark.slow
     def test_grid_vs_numpy(self):
         rng = np.random.default_rng(171)
         calls, undo = _spy_percentile_fast_path()

@@ -1,0 +1,138 @@
+"""heat_tpu.telemetry.trace — Chrome-trace / Perfetto export.
+
+Validates the exported JSON against the Trace Event Format contract the
+viewers rely on: complete (``"X"``) slices for spans and compiles with
+nonnegative durations, ``pid``/``tid`` on every record, monotonic
+timestamps starting at t=0, counter tracks for memory events, and args
+that round-trip the span ``add_fields`` payloads."""
+
+import json
+
+import pytest
+
+import heat_tpu as ht  # noqa: F401 — conftest mesh bootstrap
+from heat_tpu import telemetry as tm
+from heat_tpu.telemetry import trace as ttrace
+
+
+@pytest.fixture
+def telem(tmp_path):
+    sink = tmp_path / "events.jsonl"
+    reg = tm.enable(str(sink))
+    reg.clear()
+    yield reg, sink
+    tm.disable()
+    reg.clear()
+
+
+def _body(events):
+    return [e for e in events if e["ph"] != "M"]
+
+
+class TestTraceEventFormat:
+    def test_schema_and_monotonic_ts(self, telem):
+        reg, _ = telem
+        with tm.span("outer", bytes=128, collective="all-to-all"):
+            with tm.span("inner"):
+                pass
+        tm.trace_event("psum", axis="d")
+        reg.emit("compile", "backend_compile", seconds=0.25)
+        tm.memory.watermark("w")
+        evs = ttrace.to_trace_events()
+        # pid/tid/ts on EVERY record (metadata included)
+        for e in evs:
+            assert {"pid", "tid", "ts", "ph", "name"} <= set(e)
+        body = _body(evs)
+        # monotonic, t0-anchored microsecond timestamps
+        ts = [e["ts"] for e in body]
+        assert ts == sorted(ts)
+        assert min(ts) >= 0.0
+        # every phase is a Trace-Event-Format phase; durations are X-only
+        assert {e["ph"] for e in evs} <= {"X", "i", "C", "M"}
+        for e in body:
+            if e["ph"] == "X":
+                assert e["dur"] >= 0.0
+
+    def test_spans_are_complete_slices(self, telem):
+        with tm.span("gemm", bytes=64):
+            pass
+        evs = _body(ttrace.to_trace_events())
+        (x,) = [e for e in evs if e.get("cat") == "span"]
+        assert x["ph"] == "X" and x["name"] == "gemm"
+        assert x["args"]["bytes"] == 64
+
+    def test_nested_spans_contained(self, telem):
+        with tm.span("outer"):
+            with tm.span("inner"):
+                pass
+        evs = [e for e in _body(ttrace.to_trace_events()) if e["ph"] == "X"]
+        outer = next(e for e in evs if e["name"] == "outer")
+        inner = next(e for e in evs if e["name"] == "inner")
+        assert outer["tid"] == inner["tid"]
+        # slice containment is what makes chrome://tracing nest them
+        assert outer["ts"] <= inner["ts"]
+        # ends: start is wall-clock, dur is perf_counter — allow µs skew
+        assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"] + 5.0
+        assert inner["args"]["parent"] == "outer"
+
+    def test_add_fields_payload_roundtrip(self, telem):
+        with tm.span("op") as sp:
+            sp.add_fields(tag="abc", n=3, gshape=[4, 4])
+        evs = _body(ttrace.to_trace_events())
+        (x,) = [e for e in evs if e.get("cat") == "span"]
+        assert x["args"]["tag"] == "abc"
+        assert x["args"]["n"] == 3
+        assert x["args"]["gshape"] == [4, 4]
+
+    def test_compile_and_instant_and_counter_tracks(self, telem):
+        reg, _ = telem
+        reg.emit("compile", "backend_compile", seconds=0.5)
+        tm.trace_event("all_gather", axis="d")
+        reg.emit("memory", "w", total=4096)
+        evs = _body(ttrace.to_trace_events())
+        comp = next(e for e in evs if e.get("cat") == "compile")
+        assert comp["ph"] == "X" and comp["dur"] == pytest.approx(0.5e6)
+        inst = next(e for e in evs if e.get("cat") == "collective_trace")
+        assert inst["ph"] == "i" and inst["args"]["axis"] == "d"
+        ctr = next(e for e in evs if e["ph"] == "C")
+        assert ctr["name"] == "live_bytes" and ctr["args"]["total"] == 4096
+        # distinct tracks keep the viewer lanes separated
+        assert len({comp["tid"], inst["tid"], ctr["tid"]}) == 3
+
+    def test_thread_metadata_present(self, telem):
+        with tm.span("op"):
+            pass
+        evs = ttrace.to_trace_events()
+        names = {e["args"]["name"] for e in evs if e["ph"] == "M"}
+        assert {"heat_tpu.telemetry", "spans", "compile"} <= names
+
+
+class TestExportFile:
+    def test_export_trace_writes_loadable_json(self, telem, tmp_path):
+        with tm.span("op", bytes=7):
+            pass
+        out = tmp_path / "trace.json"
+        path = tm.export_trace(str(out))
+        assert path == str(out)
+        doc = json.loads(out.read_text())
+        assert isinstance(doc["traceEvents"], list) and doc["traceEvents"]
+        assert doc["displayTimeUnit"] == "ms"
+
+    def test_export_from_jsonl_sink(self, telem, tmp_path):
+        reg, sink = telem
+        with tm.span("from_sink"):
+            pass
+        events = tm.report.load_events(str(sink))
+        out = tmp_path / "trace.json"
+        tm.export_trace(str(out), events=events)
+        doc = json.loads(out.read_text())
+        assert any(
+            e.get("name") == "from_sink" for e in doc["traceEvents"]
+        )
+
+    def test_export_works_disabled(self, tmp_path):
+        # exporting an (empty or stale) registry must not require recording
+        out = tmp_path / "trace.json"
+        tm.export_trace(str(out), events=[])
+        doc = json.loads(out.read_text())
+        assert all(e["ph"] == "M" for e in doc["traceEvents"])

@@ -70,6 +70,7 @@ class TestTransformerBlock:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
                                        rtol=5e-4, atol=5e-4)
 
+    @pytest.mark.slow
     def test_sequence_parallel_grads_match_local(self, comm):
         # the ring/ulysses backward re-runs the schedule under autodiff —
         # gradients must match the single-shard oracle, not just the forward
@@ -155,6 +156,7 @@ class TestTransformerLM:
 
 
 class TestRemat:
+    @pytest.mark.slow
     def test_remat_same_numerics_and_grads(self):
         import optax
 
