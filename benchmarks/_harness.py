@@ -37,11 +37,24 @@ def base_parser(description: str) -> argparse.ArgumentParser:
                         "against the analytic cost model; the summary gains "
                         "a telemetry.hlo_collectives section "
                         "(docs/OBSERVABILITY.md)")
+    p.add_argument("--compile-cache", metavar="DIR",
+                   default=os.environ.get("HEAT_TPU_COMPILE_CACHE") or None,
+                   help="persistent on-disk XLA compilation cache directory "
+                        "(default: $HEAT_TPU_COMPILE_CACHE). Repeated sweep "
+                        "processes over the same workload skip backend "
+                        "compiles entirely — compile_seconds in the summary "
+                        "drops to the cache-deserialization cost "
+                        "(docs/TUNING_RUNBOOK.md)")
     return p
 
 
 def bootstrap(args):
     """Apply --mesh BEFORE jax initializes a backend, then import heat_tpu."""
+    if getattr(args, "compile_cache", None):
+        # FIRST, before anything imports heat_tpu (force_virtual_cpu_mesh
+        # below already does): program_cache reads the env at import and
+        # wires jax's persistent compilation cache from it
+        os.environ["HEAT_TPU_COMPILE_CACHE"] = args.compile_cache
     if args.mesh:
         # one canonical copy of the XLA_FLAGS/JAX_PLATFORMS dance, shared
         # with the telemetry audit CLI (backend init is lazy, so importing

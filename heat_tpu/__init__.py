@@ -27,7 +27,7 @@ from . import telemetry
 
 from .core import *
 from . import core
-from .core import linalg, random, version
+from .core import linalg, program_cache, random, version
 from .core.version import version as __version__
 
 # ML subpackages (assembled as they are built; reference heat/__init__.py

@@ -1,6 +1,7 @@
 """heat_tpu core namespace assembly (reference: heat/core/__init__.py)."""
 
 from .communication import *
+from . import program_cache
 from .devices import *
 from .dndarray import *
 from .types import *

@@ -270,22 +270,33 @@ class DNDarray:
         sl = tuple(slice(0, n) for n in self.__gshape)
         return self.__array[sl]
 
-    def _relayout(self, new_split: Optional[int], *, audit: bool = False) -> jax.Array:
+    def _relayout(
+        self, new_split: Optional[int], *, audit: bool = False,
+        donate: bool = False,
+    ) -> jax.Array:
         """Physical buffer re-laid-out to the canonical layout of
-        ``new_split``: logical slice, tail re-pad, `device_put` with the
-        target sharding. Every step is a compiled op on the global array
-        (XLA emits the all-to-all/all-gather), so — unlike :meth:`_logical`,
-        which hands the host a non-canonically-shardable view — this is the
-        ONE sanctioned relayout primitive and is multi-host safe.
+        ``new_split``: ONE cached compiled program (logical slice, tail
+        re-pad, target sharding as ``out_shardings`` — XLA emits the
+        all-to-all/all-gather), so — unlike :meth:`_logical`, which hands
+        the host a non-canonically-shardable view — this is the ONE
+        sanctioned relayout primitive and is multi-host safe. The program
+        is memoized in :mod:`heat_tpu.core.program_cache` keyed on
+        (gshape, dtype, old split, new split, comm): the second identical
+        relayout compiles nothing and dispatches through a dict lookup.
+
+        ``donate=True`` (the in-place ``resplit_`` path, where the source
+        buffer is dead after the call) donates the input buffer to XLA so
+        its memory can be reused instead of holding source + destination
+        live; donating and non-donating callers never share a program.
 
         The ONE primitive is also the one instrumentation point: with
         telemetry enabled, every relayout is a ``relayout`` span carrying
         the analytic collective kind and wire bytes
         (telemetry/collectives.py) and blocking on the result before the
-        clock stops. ``audit=True`` additionally lower-compiles the
-        equivalent single program and diffs the collectives XLA actually
-        emitted against that prediction (telemetry/hlo.py). Op-level
-        callers (`resplit`) audit at their own site, so the global
+        clock stops. ``audit=True`` additionally lower-compiles the same
+        cached program and diffs the collectives XLA actually emitted
+        against that prediction (telemetry/hlo.py). Op-level callers
+        (`resplit`) audit at their own site, so the global
         ``HEAT_TPU_HLO_AUDIT`` flag is deliberately NOT consulted here —
         one relayout must never produce two audit records."""
         _cost, fields, do_audit = telemetry.op_cost(
@@ -300,8 +311,8 @@ class DNDarray:
                 "relayout", old_split=self.__split, new_split=new_split,
                 gshape=list(self.__gshape), **fields,
             ) as sp:
-                return sp.output(self.__relayout_impl(new_split))
-        return self.__relayout_impl(new_split)
+                return sp.output(self.__relayout_impl(new_split, donate))
+        return self.__relayout_impl(new_split, donate)
 
     def _audit_relayout(self, new_split: Optional[int], site: str):
         """Ground-truth the relayout: lower-and-compile the equivalent
@@ -317,13 +328,6 @@ class DNDarray:
         if comm.size <= 1 or new_split == self.__split:
             return None
         gshape = self.__gshape
-        pshape = comm.padded_shape(gshape, new_split)
-        tgt = (
-            comm.sharding(new_split, len(gshape))
-            if new_split is not None
-            else comm.replicated()
-        )
-        pad_count = self.pad_count
         buf = self.__array
 
         # the compare target is the cost of the PROGRAM BEING AUDITED: XLA
@@ -341,6 +345,44 @@ class DNDarray:
             phys_shape, self.__dtype.byte_size(), self.__split, new_split,
             comm.size,
         )
+        from . import program_cache
+
+        # the audit lowers the SAME cached jitted program the dispatch path
+        # executes, under the same registry signature — one program, one key
+        return hlo.audit_call(
+            site,
+            lambda: (self.__relayout_program(new_split), (buf,)),
+            predicted=phys_cost,
+            key=program_cache.program_key(
+                "relayout", self._relayout_key(new_split), comm=comm
+            ),
+            fields={"old_split": self.__split, "new_split": new_split,
+                    "gshape": list(gshape)},
+        )
+
+    def _relayout_key(self, new_split: Optional[int]) -> tuple:
+        """Static-config portion of the relayout program signature."""
+        return (
+            self.__gshape, str(self.__array.dtype), self.__split, new_split
+        )
+
+    def __relayout_program(self, new_split: Optional[int], donate: bool = False):
+        """The cached compiled relayout program for this layout signature:
+        logical slice → tail re-pad → canonical ``out_shardings``."""
+        from . import program_cache
+
+        comm = self.__comm
+        gshape = self.__gshape
+        pshape = comm.padded_shape(gshape, new_split)
+        pad_count = self.pad_count
+        if comm.size > 1:
+            tgt = (
+                comm.sharding(new_split, len(gshape))
+                if new_split is not None
+                else comm.replicated()
+            )
+        else:
+            tgt = None
 
         def build():
             def relayout_program(b):
@@ -352,37 +394,33 @@ class DNDarray:
                     )
                 return b
 
-            return jax.jit(relayout_program, out_shardings=tgt), (buf,)
+            return relayout_program
 
-        return hlo.audit_call(
-            site,
-            build,
-            predicted=phys_cost,
-            key=(site, tuple(buf.shape), str(buf.dtype), self.__split,
-                 new_split, comm.size),
-            fields={"old_split": self.__split, "new_split": new_split,
-                    "gshape": list(gshape)},
+        return program_cache.cached_program(
+            "relayout", self._relayout_key(new_split), build, comm=comm,
+            out_shardings=tgt, donate=(0,) if donate else (),
         )
 
-    def __relayout_impl(self, new_split: Optional[int]) -> jax.Array:
+    def __relayout_impl(
+        self, new_split: Optional[int], donate: bool = False
+    ) -> jax.Array:
         buf = self.__array
-        if self.pad_count != 0:
-            sl = tuple(slice(0, g) for g in self.__gshape)
-            buf = buf[sl]
         pshape = self.__comm.padded_shape(self.__gshape, new_split)
-        if tuple(buf.shape) != pshape:
+        if (
+            self.pad_count == 0
+            and tuple(buf.shape) == pshape
+            and self.__comm.size <= 1
+        ):
+            return buf
+        # host-side bookkeeping mirrors what the compiled program does, so
+        # the perf-counter contract (fast paths stay at 0) is unchanged
+        logical = self.__gshape if self.pad_count else tuple(buf.shape)
+        if pshape != tuple(logical):
             _PERF_STATS["repads"] += 1
-            pad = [(0, p - g) for p, g in zip(pshape, buf.shape)]
-            buf = jnp.pad(buf, pad)
         if self.__comm.size > 1:
             _PERF_STATS["device_puts"] += 1
-            tgt = (
-                self.__comm.sharding(new_split, len(self.__gshape))
-                if new_split is not None
-                else self.__comm.replicated()
-            )
-            buf = jax.device_put(buf, tgt)
-        return buf
+        fn = self.__relayout_program(new_split, donate)
+        return fn(buf)
 
     def _replicated(self) -> jax.Array:
         """Logical global array replicated on every device — the raw buffer
@@ -526,13 +564,17 @@ class DNDarray:
 
     def resplit_(self, axis: Optional[int] = None) -> "DNDarray":
         """In-place redistribution to a new split axis (reference
-        dndarray.py:1213). On TPU this is a relayout: slice to logical,
-        re-pad for the new axis, `device_put` with the new sharding — XLA
-        emits the all-to-all."""
+        dndarray.py:1213). On TPU this is one cached compiled relayout
+        (slice to logical, re-pad for the new axis, canonical target
+        sharding — XLA emits the all-to-all). The source buffer is dead
+        after the call, so it is **donated** to XLA (the ``out=``-style
+        memory contract): its storage may be reused for the result instead
+        of holding both layouts live. Any previously captured ``.larray``
+        handle is invalidated by the donation."""
         axis = sanitize_axis(self.__gshape, axis)
         if axis == self.__split:
             return self
-        self.__array = self._relayout(axis)
+        self.__array = self._relayout(axis, donate=True)
         self._invalidate_halo()
         self.__split = axis
         self.__lshape_map = None

@@ -17,7 +17,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from . import types
+from . import program_cache, types
 from .communication import MeshCommunication, sanitize_comm
 from .devices import Device, sanitize_device
 from .dndarray import DNDarray
@@ -105,8 +105,13 @@ def array(
     if isinstance(obj, DNDarray):
         if dtype is None and split is None and is_split is None:
             if copy:
+                # a real buffer copy, not an aliasing wrapper: the source
+                # may later be resplit_ in place, which DONATES its buffer
+                # (core/program_cache.py) — an aliased "copy" would die
+                # with it on backends that honor the donation
                 return DNDarray(
-                    obj.larray, obj.shape, obj.dtype, obj.split, device, comm, True
+                    jnp.copy(obj.larray), obj.shape, obj.dtype, obj.split,
+                    device, comm, True,
                 )
             return obj
         import jax as _jax
@@ -214,11 +219,16 @@ def _assemble_ragged(
     src = np.where(j < n, slot_start[q] + (j - prefix[q]), 0)
     idx = jnp.asarray(src)
 
-    gather = jax.jit(
-        lambda b: jnp.take(b, idx, axis=split),
-        out_shardings=comm.sharding(split, len(gshape)),
+    # one cached compiled re-chunk gather: the index map is data (an
+    # argument), so repeated is_split assemblies over the same (split,
+    # rank) layout reuse one program even when the per-process lengths —
+    # and hence the map's values — differ
+    gather = program_cache.cached_program(
+        "is_split_gather", (split, len(gshape)),
+        lambda: (lambda b, ix: jnp.take(b, ix, axis=split)),
+        comm=comm, out_shardings=comm.sharding(split, len(gshape)),
     )
-    buf = gather(staged)
+    buf = gather(staged, idx)
     return DNDarray(buf, gshape, ht_dtype, split, device, comm, True)
 
 

@@ -33,7 +33,6 @@ cheapest physical route:
 from __future__ import annotations
 
 import builtins
-import functools
 import warnings
 from typing import Any, Optional, Tuple, Union
 
@@ -41,7 +40,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from . import types
+from . import program_cache, types
 from .communication import MeshCommunication
 from .dndarray import DNDarray
 
@@ -160,16 +159,22 @@ def _result_split(x: DNDarray, key) -> Optional[int]:
     return None
 
 
-@functools.lru_cache(maxsize=64)
 def _sharded_take_fn(comm: MeshCommunication, axis: int, out_split: Optional[int], ndim: int):
     """Jit-compiled gather whose output is laid out with the result's
     canonical NamedSharding — XLA emits the cross-shard gather + relayout as
-    one program, with no replicated intermediate."""
+    one program, with no replicated intermediate. Memoized in the
+    process-global :mod:`.program_cache` registry."""
 
-    def take(buf, idx):
-        return jnp.take(buf, idx, axis=axis)
+    def build():
+        def take(buf, idx):
+            return jnp.take(buf, idx, axis=axis)
 
-    return jax.jit(take, out_shardings=comm.sharding(out_split, ndim))
+        return take
+
+    return program_cache.cached_program(
+        "sharded_take", (axis, out_split, ndim), build, comm=comm,
+        out_shardings=comm.sharding(out_split, ndim),
+    )
 
 
 def _check_bounds(idx, n: int, axis: int) -> None:

@@ -30,7 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .. import types
+from .. import program_cache, types
 from ..dndarray import DNDarray
 from ... import telemetry
 
@@ -79,22 +79,29 @@ def _gram_ring(buf: jax.Array, comm, audit_cost=None) -> jax.Array:
         _, acc = jax.lax.fori_loop(0, p, body, (xt_blk, acc0))
         return jax.lax.all_gather(acc, axis, tiled=True)  # replicated G
 
-    smapped = jax.shard_map(
-        kernel,
-        mesh=comm.mesh,
-        in_specs=comm.spec(0, 2),
-        out_specs=jax.sharding.PartitionSpec(),
-        # the tiled all_gather makes the output bitwise-identical on every
-        # device, but the varying-axis type system can't infer that through
-        # the fori_loop carry
-        check_vma=False,
+    key = (tuple(buf.shape), str(buf.dtype))
+    smapped = program_cache.cached_program(
+        "cholqr_gram_ring", key,
+        lambda: jax.shard_map(
+            kernel,
+            mesh=comm.mesh,
+            in_specs=comm.spec(0, 2),
+            out_specs=jax.sharding.PartitionSpec(),
+            # the tiled all_gather makes the output bitwise-identical on
+            # every device, but the varying-axis type system can't infer
+            # that through the fori_loop carry
+            check_vma=False,
+        ),
+        comm=comm,
     )
     if audit_cost is not None:
+        # the audit lowers the SAME cached program the call executes —
+        # one signature shared between registry and auditor memo
         telemetry.hlo.audit_call(
             "cholqr_gram_ring",
-            lambda: (jax.jit(smapped), (xt,)),
+            lambda: (smapped, (xt,)),
             predicted=audit_cost,
-            key=("cholqr_gram_ring", tuple(buf.shape), str(buf.dtype), p),
+            key=program_cache.program_key("cholqr_gram_ring", key, comm=comm),
             fields={"gshape": [int(buf.shape[0]), int(buf.shape[1])],
                     "mesh": p},
         )
@@ -114,12 +121,17 @@ def _panel_solve(buf: jax.Array, rinv_pad: jax.Array, comm) -> jax.Array:
             partial, axis, scatter_dimension=1, tiled=True
         )  # (m, c)
 
-    return jax.shard_map(
-        kernel,
-        mesh=comm.mesh,
-        in_specs=(comm.spec(1, 2), comm.spec(0, 2)),
-        out_specs=comm.spec(1, 2),
-    )(buf, rinv_pad)
+    smapped = program_cache.cached_program(
+        "cholqr_panel_solve", (),
+        lambda: jax.shard_map(
+            kernel,
+            mesh=comm.mesh,
+            in_specs=(comm.spec(1, 2), comm.spec(0, 2)),
+            out_specs=comm.spec(1, 2),
+        ),
+        comm=comm,
+    )
+    return smapped(buf, rinv_pad)
 
 
 def _cholqr_split1(a: DNDarray, dt, calc_q: bool, audit: bool = False) -> QR:
@@ -188,7 +200,12 @@ def _wide_split1(a: DNDarray, dt, calc_q: bool) -> QR:
     comm = a.comm
     m, n = a.shape
     buf = a._masked(0).astype(dt.jnp_type())
-    lead = jax.jit(lambda x: x[:, :m], out_shardings=comm.replicated())(buf)
+    lead_fn = program_cache.cached_program(
+        "qr_wide_lead", (m,),
+        lambda: (lambda x: x[:, :m]),
+        comm=comm, out_shardings=comm.replicated(),
+    )
+    lead = lead_fn(buf)
     q_log, _ = jnp.linalg.qr(lead)  # (m, m), computed redundantly per device
     # R = Qᵀ A: contraction over rows (not split) — local GEMMs, no comm
     r_buf = jnp.matmul(q_log.T, buf)
@@ -280,16 +297,21 @@ def qr(
             telemetry.collectives.tsqr_cost, m, n, dt.byte_size(), p,
             audit=audit,
         )
-        smapped = jax.shard_map(
-            kernel, mesh=comm.mesh, in_specs=spec_row,
-            out_specs=(spec_row, spec_row),
+        key = ((m, n), str(buf.dtype), tiles_per_proc)
+        smapped = program_cache.cached_program(
+            "tsqr", key,
+            lambda: jax.shard_map(
+                kernel, mesh=comm.mesh, in_specs=spec_row,
+                out_specs=(spec_row, spec_row),
+            ),
+            comm=comm,
         )
         if do_audit:
             telemetry.hlo.audit_call(
                 "tsqr",
-                lambda: (jax.jit(smapped), (buf,)),
+                lambda: (smapped, (buf,)),
                 predicted=cost,
-                key=("tsqr", (m, n), str(buf.dtype), p, tiles_per_proc),
+                key=program_cache.program_key("tsqr", key, comm=comm),
                 fields={"gshape": [m, n], "mesh": p},
             )
         with telemetry.span("tsqr", gshape=[m, n], mesh=p, **fields) as sp:

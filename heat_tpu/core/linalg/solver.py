@@ -82,7 +82,7 @@ def cg(A: DNDarray, b: DNDarray, x0: DNDarray, out: Optional[DNDarray] = None) -
         kernel_jit = _cg_jit_for(A.comm)
     else:
         a_log = A._replicated().astype(dt.jnp_type())
-        kernel_jit = _cg_jit
+        kernel_jit = _cg_jit()
     b_log = b._replicated().astype(dt.jnp_type())
     x0_log = x0._replicated().astype(dt.jnp_type())
 
@@ -163,37 +163,45 @@ def _lanczos_kernel(a: "jnp.ndarray", v0: "jnp.ndarray", m: int, n: int):
     return Vb.T, alphas, betas
 
 
-import functools as _functools
-
-import jax as _jax
-
-# module-level jit: compiles once per (shape, dtype, m), not per call
-_lanczos_jit = _jax.jit(_lanczos_kernel, static_argnums=(2, 3))
+from .. import program_cache
 
 
-# module-level jit: compiles once per (shape, dtype), not per call
-_cg_jit = _jax.jit(_cg_kernel, static_argnums=(3,))
-
-
-@_functools.lru_cache(maxsize=32)
-def _cg_jit_for(comm):
-    """cg jit variant with replicated out_shardings for sharded operands
-    (same multi-host reshard-assertion guard as `_lanczos_jit_for`)."""
-    return _jax.jit(
-        _cg_kernel, static_argnums=(3,), out_shardings=comm.replicated()
+def _cg_jit():
+    """cg program compiled once per (shape, dtype) — memoized in the
+    process-global program registry."""
+    return program_cache.cached_program(
+        "cg", "plain", lambda: _cg_kernel, static_argnums=(3,)
     )
 
 
-@_functools.lru_cache(maxsize=32)
+def _cg_jit_for(comm):
+    """cg variant with replicated out_shardings for sharded operands
+    (same multi-host reshard-assertion guard as `_lanczos_jit_for`)."""
+    return program_cache.cached_program(
+        "cg", "replicated", lambda: _cg_kernel, comm=comm,
+        out_shardings=comm.replicated(), static_argnums=(3,),
+    )
+
+
+def _lanczos_jit():
+    """lanczos program compiled once per (shape, dtype, m) — memoized in
+    the process-global program registry."""
+    return program_cache.cached_program(
+        "lanczos", "plain", lambda: _lanczos_kernel, static_argnums=(2, 3)
+    )
+
+
 def _lanczos_jit_for(comm):
     """jit variant with explicit replicated out_shardings for sharded
     operands — an XLA-chosen output sharding can otherwise hit jax's
     device-order reshard assertion in the downstream device_put under
     multi-host."""
-    return _jax.jit(
-        _lanczos_kernel,
+    return program_cache.cached_program(
+        "lanczos", "replicated", lambda: _lanczos_kernel, comm=comm,
+        out_shardings=(
+            comm.replicated(), comm.replicated(), comm.replicated()
+        ),
         static_argnums=(2, 3),
-        out_shardings=(comm.replicated(), comm.replicated(), comm.replicated()),
     )
 
 
@@ -226,7 +234,7 @@ def lanczos(
         kernel_jit = _lanczos_jit_for(A.comm)
     else:
         a_log = A._replicated().astype(dt.jnp_type())
-        kernel_jit = _lanczos_jit
+        kernel_jit = _lanczos_jit()
 
     if v0 is None:
         import numpy as _np

@@ -13,7 +13,6 @@ shapes are jit-hostile — the documented host path, SURVEY §7 hard parts).
 from __future__ import annotations
 
 import builtins
-import functools
 import operator
 from typing import List, Optional, Sequence, Tuple, Union
 
@@ -21,7 +20,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from . import types
+from . import program_cache, types
 from .dndarray import DNDarray
 from .stride_tricks import sanitize_axis, sanitize_shape
 from .. import telemetry
@@ -93,43 +92,58 @@ def balance(array: DNDarray, copy: bool = False) -> DNDarray:
     return _copy(array) if copy else array
 
 
-@functools.lru_cache(maxsize=128)
 def _reshape_split_fn(comm, in_shape, out_shape, out_split):
     """Cached jitted slice→reshape→re-pad program for a reshape that crosses
     the split axis — the genuine all-to-all data movement (the reference's
     Alltoallv relayout, manipulations.py:1962) as ONE compiled XLA program
-    laid out to the result's canonical sharding; multi-host safe."""
+    laid out to the result's canonical sharding; multi-host safe. Memoized
+    in the process-global :mod:`..program_cache` registry."""
     pshape = comm.padded_shape(out_shape, out_split)
+    out_shardings = (
+        comm.replicated()
+        if out_split is None
+        else comm.sharding(out_split, len(out_shape))
+    )
 
-    def f(buf):
-        log = buf[tuple(slice(0, g) for g in in_shape)]
-        res = jnp.reshape(log, out_shape)
-        pad = [(0, p - g) for p, g in zip(pshape, out_shape)]
-        return jnp.pad(res, pad)
+    def build():
+        def f(buf):
+            log = buf[tuple(slice(0, g) for g in in_shape)]
+            res = jnp.reshape(log, out_shape)
+            pad = [(0, p - g) for p, g in zip(pshape, out_shape)]
+            return jnp.pad(res, pad)
 
-    if out_split is None:
-        return jax.jit(f, out_shardings=comm.replicated())
-    return jax.jit(f, out_shardings=comm.sharding(out_split, len(out_shape)))
+        return f
+
+    return program_cache.cached_program(
+        "reshape_split", (in_shape, out_shape, out_split), build,
+        comm=comm, out_shardings=out_shardings,
+    )
 
 
-@functools.lru_cache(maxsize=128)
 def _concat_split_fn(comm, axis, out_split, in_shapes, gshape, out_dtype):
     """Cached jitted slice→concat→re-pad program for concatenation along
-    the split axis (keyed on shapes/dtype so repeated calls reuse the
-    compile, the `_sharded_take_fn` pattern)."""
+    the split axis (keyed on shapes/dtype in the process-global
+    :mod:`..program_cache` registry so repeated calls reuse the compile)."""
     pshape = comm.padded_shape(gshape, out_split)
     jdt = out_dtype.jnp_type()
 
-    def cat(*bufs):
-        logs = [
-            b[tuple(slice(0, g) for g in shp)].astype(jdt)
-            for b, shp in zip(bufs, in_shapes)
-        ]
-        res = jnp.concatenate(logs, axis=axis)
-        pad = [(0, p - g) for p, g in zip(pshape, gshape)]
-        return jnp.pad(res, pad)
+    def build():
+        def cat(*bufs):
+            logs = [
+                b[tuple(slice(0, g) for g in shp)].astype(jdt)
+                for b, shp in zip(bufs, in_shapes)
+            ]
+            res = jnp.concatenate(logs, axis=axis)
+            pad = [(0, p - g) for p, g in zip(pshape, gshape)]
+            return jnp.pad(res, pad)
 
-    return jax.jit(cat, out_shardings=comm.sharding(out_split, len(gshape)))
+        return cat
+
+    return program_cache.cached_program(
+        "concat_split", (axis, out_split, in_shapes, gshape, str(jdt)),
+        build, comm=comm,
+        out_shardings=comm.sharding(out_split, len(gshape)),
+    )
 
 
 def concatenate(arrays: Sequence[DNDarray], axis: int = 0) -> DNDarray:
@@ -289,16 +303,33 @@ def flatten(a: DNDarray) -> DNDarray:
 def _permute_split_axis(a: DNDarray, idx_of: "jnp.ndarray") -> "jax.Array":
     """Physical buffer with the padded split axis permuted by a logical
     index map: output position ``j < n`` reads input position ``idx_of[j]``;
-    pad positions read themselves. One compiled sharded gather (XLA emits
-    the collective permutes) — no host relayout, multi-host safe."""
+    pad positions read themselves. One cached compiled sharded gather (XLA
+    emits the collective permutes) — no host relayout, multi-host safe.
+    The index map is data (an argument), so every flip/roll over the same
+    layout shares one program (the roll/pad passes of ISSUE 3)."""
     s = a.split
     n = a.shape[s]
-    iota = jnp.arange(a.larray.shape[s])
-    idx = jnp.where(iota < n, idx_of, iota)
-    buf = jnp.take(a.larray, idx, axis=s)
-    if a.comm.size > 1:
-        buf = jax.lax.with_sharding_constraint(buf, a.comm.sharding(s, a.ndim))
-    return buf
+    comm = a.comm
+    ndim = a.ndim
+    sharded = comm.size > 1
+
+    def build():
+        def permute(buf, idx_of):
+            iota = jnp.arange(buf.shape[s])
+            idx = jnp.where(iota < n, idx_of, iota)
+            out = jnp.take(buf, idx, axis=s)
+            if sharded:
+                out = jax.lax.with_sharding_constraint(
+                    out, comm.sharding(s, ndim)
+                )
+            return out
+
+        return permute
+
+    fn = program_cache.cached_program(
+        "permute_split_axis", (s, n, ndim, sharded), build, comm=comm,
+    )
+    return fn(a.larray, idx_of)
 
 
 def flip(a: DNDarray, axis=None) -> DNDarray:
@@ -580,7 +611,11 @@ def rot90(m: DNDarray, k: int = 1, axes=(0, 1)) -> DNDarray:
         raise ValueError("rot90 axes must be different")
     k = k % 4
     if k == 0:
-        return DNDarray(m.larray, m.shape, m.dtype, m.split, m.device, m.comm, True)
+        # buffer copy, not an alias: a later donating resplit_ of ``m``
+        # must not invalidate the rotation result
+        return DNDarray(
+            jnp.copy(m.larray), m.shape, m.dtype, m.split, m.device, m.comm, True
+        )
     if k == 2:
         return flip(flip(m, a0), a1)
     if k == 1:
@@ -731,9 +766,19 @@ def _oddeven_sort_physical(a: DNDarray, axis: int, descending: bool):
         return jax.lax.fori_loop(0, p, round_body, (v, i))
 
     spec = comm.spec(axis, a.ndim)
-    vals, idx = jax.shard_map(
-        kernel, mesh=comm.mesh, in_specs=(spec, spec), out_specs=(spec, spec)
-    )(buf, idx0)
+    # the merge network program is cached per (axis, chunk, rank) layout —
+    # repeated sorts of the same shape family dispatch a dict lookup
+    # instead of re-tracing the shard_map closure (descending is handled
+    # entirely outside the kernel, so both directions share one program)
+    smapped = program_cache.cached_program(
+        "oddeven_sort", (axis, c, a.ndim),
+        lambda: jax.shard_map(
+            kernel, mesh=comm.mesh, in_specs=(spec, spec),
+            out_specs=(spec, spec),
+        ),
+        comm=comm,
+    )
+    vals, idx = smapped(buf, idx0)
     if descending:
         vals = jnp.flip(vals, axis=axis)
         idx = -jnp.flip(idx, axis=axis)

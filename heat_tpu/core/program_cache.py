@@ -1,0 +1,271 @@
+"""Process-global compiled-program registry — the one choke point every
+jitted program in the framework routes through.
+
+Motivation (ISSUE 3 / PAPERS.md "Memory-efficient array redistribution"):
+Heat's MPI choreography becomes *compiled XLA programs* in this port, so
+compile time and program reuse are first-class performance axes. Before this
+module, three sites memoized their jitted programs behind ad-hoc
+``functools.lru_cache``\\ s (each with its own key convention) while ~18
+other ``jax.jit`` call sites rebuilt fresh closures per invocation — every
+``resplit``, repeated factory assembly, and re-entered kernel retraced and
+recompiled an identical program. Now:
+
+* :func:`cached_program` memoizes jitted executables in one process-global
+  LRU registry keyed on ``(site, comm identity, static config, donation)``
+  — input *avals* are still handled by jax's own dispatch inside each
+  cached wrapper, so one registry entry serves every shape that reaches
+  the same program builder while distinct static configs get distinct
+  entries. Steady-state dispatch is a dict lookup.
+* Telemetry counters (``program_cache.hits`` / ``.misses`` /
+  ``.evictions`` plus per-site retrace counts) feed
+  :func:`heat_tpu.telemetry.report.summarize` and the Chrome trace (each
+  retrace/eviction is an instant event on the *events* track).
+* The registry size is tunable via ``HEAT_TPU_PROGRAM_CACHE`` (max
+  entries; least-recently-used programs are evicted — the *executables*
+  they held are additionally bounded by jax's own caches, which the test
+  conftest clears per module).
+* ``donate=(argnums...)`` passes through to ``jax.jit(donate_argnums=...)``
+  so callers whose source buffer is dead after the call (in-place
+  ``resplit_``, ``out=`` paths) let XLA reuse the input memory instead of
+  holding source + destination live. Donation is part of the cache key: a
+  donating and a non-donating caller never share an executable.
+* The site/key signature is shared with the HLO collective auditor
+  (:func:`heat_tpu.telemetry.hlo.audit_call` sites build their memo key via
+  :func:`program_key`), so an audited program and the cached program that
+  actually executes carry ONE signature — the audit lowers the very same
+  jitted callable the dispatch path runs.
+
+Persistent (cross-process) compilation cache
+--------------------------------------------
+Orthogonal to the in-process registry, :func:`enable_persistent_cache`
+wires JAX's on-disk XLA compilation cache: with
+``HEAT_TPU_COMPILE_CACHE=<dir>`` in the environment (read at import, the
+same activation pattern as ``HEAT_TPU_TELEMETRY``), repeated CI shards and
+benchmark sweep processes skip backend compiles entirely — the measured
+dominant cost of the tier-1 suite. ``scripts/run_ci.sh`` and
+``benchmarks/_harness.py`` enable it by default; see
+docs/TUNING_RUNBOOK.md for the knob semantics.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import warnings
+from collections import OrderedDict
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import jax
+
+from .. import telemetry
+
+__all__ = [
+    "cached_program",
+    "program_key",
+    "stats",
+    "reset",
+    "clear",
+    "enable_persistent_cache",
+    "persistent_cache_dir",
+    "DEFAULT_MAXSIZE",
+]
+
+# Default registry capacity. Entries are jit *wrappers* (closures + jit
+# machinery, not executables), so the per-entry footprint is small; the knob
+# exists for long-lived services that sweep unbounded shape families.
+DEFAULT_MAXSIZE = 512
+
+# A donated buffer whose layout cannot alias the output (e.g. a relayout
+# whose physical shapes differ) makes XLA warn "Some donated buffers were
+# not usable" at lowering time. The donation is still correct — the
+# framework caller declared the buffer dead — so for programs built HERE
+# with donate= the warning is pure noise. It is suppressed around those
+# calls only (see cached_program), never process-globally: user code
+# keeps the diagnostic for its own donate_argnums mistakes.
+_DONATION_NOISE = "Some donated buffers were not usable"
+
+_LOCK = threading.RLock()
+_PROGRAMS: "OrderedDict[Tuple, Callable]" = OrderedDict()
+_STATS = {"hits": 0, "misses": 0, "evictions": 0}
+_SITE_STATS: dict = {}
+
+
+def _maxsize() -> int:
+    raw = os.environ.get("HEAT_TPU_PROGRAM_CACHE", "").strip()
+    if raw:
+        try:
+            n = int(raw)
+            if n > 0:
+                return n
+        except ValueError:
+            pass
+    return DEFAULT_MAXSIZE
+
+
+def program_key(
+    site: str,
+    key: Any,
+    comm: Any = None,
+    donate: Sequence[int] = (),
+) -> Tuple:
+    """The full registry key for one program site — also the memo key the
+    HLO auditor uses for the same program, so audited and cached programs
+    share one signature. ``comm`` participates by identity (two
+    communicators over the same devices are distinct meshes to XLA too);
+    ``key`` is the caller's static config (shapes, dtypes, splits, flags —
+    anything that changes the traced program)."""
+    return (site, comm, key, tuple(donate))
+
+
+def cached_program(
+    site: str,
+    key: Any,
+    build: Callable[[], Callable],
+    *,
+    comm: Any = None,
+    out_shardings: Any = None,
+    donate: Sequence[int] = (),
+    static_argnums: Any = None,
+    static_argnames: Any = None,
+) -> Callable:
+    """Return the memoized jitted program for ``(site, comm, key, donate)``,
+    building and jitting it on first use.
+
+    ``build()`` returns the plain python callable to compile — it runs only
+    on a registry miss (and must therefore be cheap and side-effect free;
+    no tracing happens until the returned program is called).
+    ``out_shardings`` / ``static_argnums`` / ``static_argnames`` pass
+    through to ``jax.jit``; ``donate`` becomes ``donate_argnums``. The
+    returned wrapper handles aval-level dispatch itself, so callers key
+    only on *static config* — two calls with the same key but different
+    shapes share one registry entry and retrace inside it.
+
+    This is the ONLY sanctioned ``jax.jit`` site in the framework
+    (enforced by ``tests/test_no_stray_jit.py``).
+    """
+    donate = tuple(donate)
+    full_key = program_key(site, key, comm=comm, donate=donate)
+    evicted = 0
+    miss = False
+    with _LOCK:
+        fn = _PROGRAMS.get(full_key)
+        srow = _SITE_STATS.setdefault(site, {"hits": 0, "misses": 0})
+        if fn is not None:
+            _PROGRAMS.move_to_end(full_key)
+            _STATS["hits"] += 1
+            srow["hits"] += 1
+        else:
+            miss = True
+            _STATS["misses"] += 1
+            srow["misses"] += 1
+            jit_kwargs: dict = {"donate_argnums": donate}
+            if out_shardings is not None:
+                jit_kwargs["out_shardings"] = out_shardings
+            if static_argnums is not None:
+                jit_kwargs["static_argnums"] = static_argnums
+            if static_argnames is not None:
+                jit_kwargs["static_argnames"] = static_argnames
+            fn = jax.jit(build(), **jit_kwargs)
+            if donate:
+                fn = _quiet_donation(fn)
+            maxsize = _maxsize()
+            while len(_PROGRAMS) >= maxsize:
+                _PROGRAMS.popitem(last=False)
+                _STATS["evictions"] += 1
+                evicted += 1
+            _PROGRAMS[full_key] = fn
+    if telemetry.enabled():
+        reg = telemetry.get_registry()
+        if miss:
+            reg.add("program_cache.misses", 1)
+            reg.add(f"program_cache.retrace.{site}", 1)
+            # instant event → the Chrome trace's *events* track: when and
+            # where a retrace happened (the expensive path)
+            reg.emit("program_cache", site, event="retrace", key=repr(key))
+        else:
+            reg.add("program_cache.hits", 1)
+        if evicted:
+            reg.add("program_cache.evictions", evicted)
+            reg.emit("program_cache", site, event="eviction", count=evicted)
+    return fn
+
+
+def _quiet_donation(jitted: Callable) -> Callable:
+    """Wrap a donating jitted program so the lowering-time "donated
+    buffers were not usable" warning is suppressed for ITS calls only.
+    ``lower`` is forwarded so the HLO auditor can still AOT-compile the
+    wrapped program."""
+
+    def call(*args, **kwargs):
+        with warnings.catch_warnings():
+            warnings.filterwarnings("ignore", message=_DONATION_NOISE)
+            return jitted(*args, **kwargs)
+
+    call.lower = jitted.lower
+    return call
+
+
+def stats() -> dict:
+    """Snapshot of the registry counters:
+    ``{"hits", "misses", "evictions", "size", "maxsize", "sites"}`` with
+    per-site hit/miss (retrace) counts under ``sites``."""
+    with _LOCK:
+        return {
+            "hits": _STATS["hits"],
+            "misses": _STATS["misses"],
+            "evictions": _STATS["evictions"],
+            "size": len(_PROGRAMS),
+            "maxsize": _maxsize(),
+            "sites": {s: dict(row) for s, row in _SITE_STATS.items()},
+        }
+
+
+def reset() -> None:
+    """Drop every cached program and zero the counters (tests)."""
+    with _LOCK:
+        _PROGRAMS.clear()
+        _STATS.update(hits=0, misses=0, evictions=0)
+        _SITE_STATS.clear()
+
+
+clear = reset
+
+
+# -- persistent (cross-process) XLA compilation cache -------------------------
+
+_PERSISTENT_DIR: Optional[str] = None
+
+
+def enable_persistent_cache(path: str) -> str:
+    """Point JAX's on-disk compilation cache at ``path`` (created if
+    missing) and drop the min-compile-time threshold to 0 so every
+    executable is eligible — the tier-1 suite and the bench sweeps are
+    dominated by many *small* compiles, exactly the entries the default
+    1-second threshold skips. Returns the path. Idempotent."""
+    global _PERSISTENT_DIR
+    path = os.fspath(path)
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    _PERSISTENT_DIR = path
+    return path
+
+
+def persistent_cache_dir() -> Optional[str]:
+    """The active on-disk compilation cache directory, or None."""
+    return _PERSISTENT_DIR
+
+
+# Environment activation (mirrors HEAT_TPU_TELEMETRY): HEAT_TPU_COMPILE_CACHE
+# names the cache directory; `import heat_tpu` is enough to enable it.
+_env_dir = os.environ.get("HEAT_TPU_COMPILE_CACHE", "").strip()
+if _env_dir:
+    try:
+        enable_persistent_cache(_env_dir)
+    except Exception as _e:  # pragma: no cover — bad path must not kill import
+        warnings.warn(
+            f"heat_tpu.program_cache: cannot enable persistent compile "
+            f"cache at {_env_dir!r} ({_e}); continuing without it"
+        )
+del _env_dir

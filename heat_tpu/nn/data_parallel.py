@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 import optax
 
+from ..core import program_cache
 from ..core.communication import MeshCommunication, sanitize_comm
 from ..core.dndarray import DNDarray
 
@@ -117,9 +118,13 @@ class DataParallel:
 
     def __call__(self, params, *inputs):
         """Forward pass; inputs are batch-sharded, output comes back sharded
-        along axis 0 (one jit-compiled program, cached)."""
+        along axis 0 (one compiled program, memoized in the process-global
+        program registry — two wrappers over the same module share it)."""
         if self._compiled_call is None:
-            self._compiled_call = jax.jit(self.apply_fn)
+            self._compiled_call = program_cache.cached_program(
+                "dp_forward", self.apply_fn, lambda: self.apply_fn,
+                comm=self.comm,
+            )
         return self._compiled_call(params, *self.shard_batch(*inputs))
 
     # -- training ------------------------------------------------------------
@@ -151,7 +156,6 @@ class DataParallel:
 
         if self.blocking_parameter_updates:
 
-            @jax.jit
             def step(params, opt_state, *batch):
                 loss, grads = jax.value_and_grad(loss_fn)(params, *batch)
                 updates, opt_state = optimizer.update(grads, opt_state, params)
@@ -160,7 +164,6 @@ class DataParallel:
 
         else:
 
-            @jax.jit
             def step(params, opt_state, pending_grads, *batch):
                 # trace-time guard: the 3rd argument must be a gradient
                 # pytree, catching callers using the blocking-mode arity
@@ -184,8 +187,17 @@ class DataParallel:
                 params = optax.apply_updates(params, updates)
                 return params, opt_state, grads, loss
 
-        self._train_step = step
-        return step
+        # (loss_fn, optimizer, mode) is the static config: two wrappers
+        # building the same train step share one compiled program
+        raw_step = step
+        compiled = program_cache.cached_program(
+            "dp_train_step",
+            (loss_fn, optimizer, self.blocking_parameter_updates),
+            lambda: raw_step,
+            comm=self.comm,
+        )
+        self._train_step = compiled
+        return compiled
 
     @staticmethod
     def init_pending(params):

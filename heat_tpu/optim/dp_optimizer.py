@@ -38,6 +38,7 @@ import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..core import program_cache
 from ..core.communication import MeshCommunication, sanitize_comm
 from .utils import DetectMetricPlateau
 
@@ -63,7 +64,11 @@ class DataParallelOptimizer:
         self.torch_optimizer = optimizer  # parity attribute name
         self.optimizer = optimizer
         self.blocking = blocking
-        self._step = jax.jit(self._apply)
+        # keyed on the optax transform: two wrappers over the same
+        # optimizer share one compiled step
+        self._step = program_cache.cached_program(
+            "dp_optimizer_step", optimizer, lambda: self._apply
+        )
 
     def init(self, params):
         return self.optimizer.init(params)
@@ -281,7 +286,6 @@ class DASO:
         stacked = P(("node", "local"))
         batch_spec = P(("node", "local"))
 
-        @jax.jit
         def step(params, opt_state, batch):
             specs_p = jax.tree.map(lambda _: stacked, params)
             specs_o = jax.tree.map(lambda _: stacked, opt_state)
@@ -293,8 +297,16 @@ class DASO:
                 out_specs=(specs_p, specs_o, P()),
             )(params, opt_state, batch)
 
-        self._compiled[key] = step
-        return step
+        # process-global registry on top of the per-instance memo: two DASO
+        # instances over the same (loss, optimizer, mesh, sync mode) share
+        # one compiled step
+        compiled = program_cache.cached_program(
+            "daso_step",
+            (loss_fn, opt, mesh, local_sync, full_sync),
+            lambda: step,
+        )
+        self._compiled[key] = compiled
+        return compiled
 
     def _get_global_send(self):
         if "send" in self._compiled:
@@ -315,22 +327,23 @@ class DASO:
 
         stacked = P(("node", "local"))
 
-        @jax.jit
         def send(params):
             specs_p = jax.tree.map(lambda _: stacked, params)
             return jax.shard_map(
                 kernel, mesh=mesh, in_specs=(specs_p,), out_specs=specs_p
             )(params)
 
-        self._compiled["send"] = send
-        return send
+        compiled = program_cache.cached_program(
+            "daso_send", (mesh, str(cast)), lambda: send
+        )
+        self._compiled["send"] = compiled
+        return compiled
 
     def _get_merge(self):
         if "merge" in self._compiled:
             return self._compiled["merge"]
         n_nodes = self.n_nodes
 
-        @jax.jit
         def merge(params, payload, numer):
             denom = numer + n_nodes
 
@@ -342,8 +355,11 @@ class DASO:
 
             return jax.tree.map(one, params, payload)
 
-        self._compiled["merge"] = merge
-        return merge
+        compiled = program_cache.cached_program(
+            "daso_merge", (n_nodes,), lambda: merge
+        )
+        self._compiled["merge"] = compiled
+        return compiled
 
     # -- schedule ------------------------------------------------------------
 

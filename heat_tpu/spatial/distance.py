@@ -25,7 +25,7 @@ from typing import Callable, Optional
 import jax
 import jax.numpy as jnp
 
-from ..core import types
+from ..core import program_cache, types
 from ..core.dndarray import DNDarray
 from .. import telemetry
 
@@ -131,16 +131,24 @@ def _ring_dist(
 
     spec = comm.spec(0, 2)
     out_spec = spec
-    smapped = jax.shard_map(
-        kernel, mesh=comm.mesh, in_specs=(spec, spec), out_specs=out_spec
+    # block_fn is a module-level function (stable identity), so the ring
+    # program is shared across calls of the same kernel + layout family
+    key = (block_fn, cy, n_cols)
+    smapped = program_cache.cached_program(
+        "ring_cdist", key,
+        lambda: jax.shard_map(
+            kernel, mesh=comm.mesh, in_specs=(spec, spec),
+            out_specs=out_spec,
+        ),
+        comm=comm,
     )
     if audit_cost is not None:
+        # the audit lowers the SAME cached program the call executes
         telemetry.hlo.audit_call(
             "ring_cdist",
-            lambda: (jax.jit(smapped), (xm, ym)),
+            lambda: (smapped, (xm, ym)),
             predicted=audit_cost,
-            key=("ring_cdist", tuple(xm.shape), tuple(ym.shape),
-                 str(xm.dtype), p),
+            key=program_cache.program_key("ring_cdist", key, comm=comm),
             fields={"mesh": p},
         )
     return smapped(xm, ym)

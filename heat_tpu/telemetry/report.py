@@ -56,6 +56,7 @@ def summarize(
     second a consumer sums across phases. Nesting stays visible in the raw
     stream (each span event carries ``depth``/``parent``); a ``relayout``
     invoked outside any op span is depth 0 and still gets its own row."""
+    live = events is None
     if events is None:
         from . import get_registry
 
@@ -65,6 +66,8 @@ def summarize(
             watermarks = dict(reg.watermarks)
 
     phases: dict = {}
+    pc_retraces: dict = {}
+    pc_evictions = 0
     compile_seconds = 0.0
     compile_events = 0
     traced: dict = {}
@@ -93,6 +96,12 @@ def summarize(
         elif kind == "collective_trace":
             name = ev.get("name")
             traced[name] = traced.get(name, 0) + 1
+        elif kind == "program_cache":
+            if ev.get("event") == "retrace":
+                name = ev.get("name")
+                pc_retraces[name] = pc_retraces.get(name, 0) + 1
+            elif ev.get("event") == "eviction":
+                pc_evictions += int(ev.get("count", 1) or 1)
         elif kind == "hlo_audit":
             hlo_audits += 1
             drift = int(ev.get("drift", 0) or 0)
@@ -128,6 +137,22 @@ def summarize(
             "audits": hlo_audits,
             "drift": hlo_drift,
             "sites": hlo_sites,
+        }
+    # compiled-program registry counters (core/program_cache.py): live
+    # summaries read the registry directly (hit/miss/eviction totals plus
+    # per-site retrace counts); offline summaries reconstruct retraces
+    # from the recorded instant events. Absent entirely when the registry
+    # never ran, so pre-existing summary shapes are unchanged.
+    if live:
+        from ..core import program_cache as _pc
+
+        pc = _pc.stats()
+        if pc["hits"] or pc["misses"]:
+            out["program_cache"] = pc
+    elif pc_retraces or pc_evictions:
+        out["program_cache"] = {
+            "retraces": pc_retraces,
+            "evictions": pc_evictions,
         }
     if watermarks:
         peak = watermarks.get("live_bytes.total")

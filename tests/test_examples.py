@@ -54,6 +54,14 @@ class TestExamples:
         r = _run("examples/cluster/demo_kclustering.py")
         assert r.returncode == 0, r.stderr[-1500:]
 
+    def test_ragged_layout_demo(self):
+        # the redistribute_ ragged-map substitute as a demonstration
+        # (PARITY.md "redistribute_ and ragged target maps")
+        r = _run("examples/ragged_layout.py")
+        assert r.returncode == 0, r.stderr[-1500:]
+        assert "raises as documented" in r.stdout
+        assert "ragged-layout result: OK" in r.stdout
+
     @pytest.mark.slow
     def test_lm_training(self):
         # flagship LM converging on the 3-gram task (asserts internally

@@ -385,14 +385,30 @@ if not failures:
 """
 
 
-class TestMultiHostStage1:
-    """The worker list runs under two topologies of the same 8-position
-    mesh — 2 procs × 4 devices and 4 procs × 2 devices (SURVEY §4's
-    world-size sweep; VERDICT r3 item 9). The 10-row gshape is
-    non-divisible under both, and 4×2 leaves the last process with an
-    EMPTY canonical block."""
+def _record_ci_r6(name: str, outs) -> None:
+    """Persist a topology run's per-rank output under artifacts/ci_r6/
+    (VERDICT r5 #8: the multi-host breadth sweep leaves a committed
+    record). Best-effort — an unwritable checkout must not fail the test."""
+    try:
+        d = os.path.join(REPO, "artifacts", "ci_r6")
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(d, f"{name}.log"), "w") as f:
+            for r, out in enumerate(outs):
+                f.write(f"===== rank {r} =====\n{out}\n")
+    except OSError:
+        pass
 
-    @pytest.mark.parametrize("nprocs,ldc", [(2, 4), (4, 2)])
+
+class TestMultiHostStage1:
+    """The worker list runs under three topologies — 2 procs × 4 devices,
+    4 procs × 2 devices (the same 8-position mesh; SURVEY §4's world-size
+    sweep, VERDICT r3 item 9), and 4 procs × 1 device (VERDICT r5 #8:
+    4-way process breadth on a 4-position mesh, one device per process —
+    the pure-DCN shape). The 10-row gshape is non-divisible under all
+    three, and 4×2 leaves the last process with an EMPTY canonical
+    block. Results are recorded under artifacts/ci_r6/."""
+
+    @pytest.mark.parametrize("nprocs,ldc", [(2, 4), (4, 2), (4, 1)])
     @pytest.mark.slow
     def test_process_topologies(self, tmp_path, nprocs, ldc):
         script = tmp_path / "mh_worker.py"
@@ -423,6 +439,7 @@ class TestMultiHostStage1:
             for p in procs:
                 if p.poll() is None:
                     p.kill()
+        _record_ci_r6(f"multihost_{nprocs}x{ldc}", outs)
         for r, (p, out) in enumerate(zip(procs, outs)):
             assert p.returncode == 0, f"rank {r} failed:\n{out}"
             assert f"RANK{r}_OK" in out, f"rank {r} output:\n{out}"
