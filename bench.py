@@ -29,6 +29,8 @@ Workloads (BASELINE.json configs):
   * cdist       — ht.spatial.cdist euclidean, split=0 (distance_matrix bench)
   * kmeans      — ht.cluster.KMeans Lloyd iterations on synthetic blobs
   * moments     — mean/var over split rows (statistical_moments bench)
+  * elementwise — chained normalize/scale/clip pipeline; the fusion-engine
+                  guard (7 ops defer into ONE cached program, core/fusion.py)
   * lasso       — coordinate-descent sweeps (lasso bench; incremental-residual
                   epochs, one jit per sweep)
   * lm_step     — flagship TransformerLM training step (fwd+bwd+AdamW in one
@@ -245,6 +247,31 @@ def bench_heat_tpu(errors, profile_dir=None, small=False, only=None,
 
         # mean ~n*d, var ~3*n*d flops per pass
         return run, reps * 4.0 * nm * dm
+
+    def make_elementwise():
+        # chained normalize -> scale -> clip pipeline (the committed
+        # microbenchmark benchmarks/elementwise/): 7 elementwise ops that
+        # the fusion engine (core/fusion.py) defers into ONE cached XLA
+        # program per rep — the weight-update-shaped small-op traffic of
+        # arXiv:2004.13336. Eager dispatch (HEAT_TPU_FUSION=0) launches 7
+        # programs with materialized intermediates instead; the row is the
+        # steady-state guard for that gap. ~7 counted flops per element,
+        # bandwidth-bound.
+        ne, de, reps = (1_000_000, 64, 3) if small else (8_000_000, 64, 10)
+        xe = ht.random.randn(ne, de, dtype=ht.float32, split=0)
+        mean_ = ht.array(np.float32(0.1))
+        std_ = ht.array(np.float32(1.3))
+
+        def run():
+            out = None
+            for _ in range(reps):  # async dispatch queues all reps
+                z = (xe - mean_) / (std_ + 1e-6)
+                z = z * 0.125 + 0.5
+                z = ht.clip(z, 0.0, 1.0) * 255.0
+                out = z.larray  # flush boundary: ONE fused program per rep
+            return _sync(out)
+
+        return run, reps * 7.0 * ne * de
 
     def make_lasso():
         # coordinate-descent sweeps (lasso bench). The whole fit is ONE
@@ -494,6 +521,7 @@ def bench_heat_tpu(errors, profile_dir=None, small=False, only=None,
         ("cdist", make_cdist),
         ("kmeans", make_kmeans),
         ("moments", make_moments),
+        ("elementwise", make_elementwise),
         ("attention", make_attention),
         ("matmul_f32", make_matmul_f32),
         ("matmul_int8", make_matmul_int8),
@@ -620,6 +648,19 @@ def _torch_cpu_workloads(results, only=None):
         t = _best_time(lloyd, repeats=2)
         results["kmeans"] = (iters * 4.0 * ns * kc * d) / t / 1e9
 
+    if want("elementwise"):
+        ne, de = 1_000_000, 64
+        xe = torch.randn(ne, de)
+
+        def chain():
+            z = (xe - 0.1) / (1.3 + 1e-6)
+            z = z * 0.125 + 0.5
+            return z.clamp(0.0, 1.0) * 255.0
+
+        chain()
+        t = _best_time(chain, repeats=2)
+        results["elementwise"] = (7.0 * ne * de) / t / 1e9
+
     if want("moments"):
         nm, dm = 1_000_000, 64
         xm = torch.randn(nm, dm)
@@ -732,8 +773,8 @@ def main():
         only = {s.strip() for s in args.only.split(",") if s.strip()}
         known = {
             "matmul", "matmul_f32", "matmul_bf16", "cdist", "kmeans",
-            "moments", "lasso", "attention", "attention_bwd", "matmul_int8",
-            "lm_step", "matmul_1b", "spectral", "kmeans_1b",
+            "moments", "elementwise", "lasso", "attention", "attention_bwd",
+            "matmul_int8", "lm_step", "matmul_1b", "spectral", "kmeans_1b",
         }
         unknown = only - known
         if unknown:

@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""Elementwise-chain (fusion) microbenchmark — eager vs fused dispatch.
+
+A chained normalize → scale → clip pipeline (7 elementwise ops end to
+end), the steady-state weight-update-shaped traffic that
+"Automatic Cross-Replica Sharding of Weight Update in Data-Parallel
+Training" (arXiv:2004.13336) identifies as a dominant small-op cost. With
+``HEAT_TPU_FUSION=0`` each op dispatches (and first compiles) its own XLA
+program; with fusion on (the default) the whole chain defers into one
+FusedExpr DAG and executes as ONE cached program (core/fusion.py).
+
+This runner measures BOTH modes in one process and prints a comparison
+line::
+
+    {"elementwise_compare": {"eager": {...}, "fused": {...},
+     "fused_programs": 1, "chain_ops": 7, "speedup": ...}}
+
+``fused_programs`` counts the programs the fusion registry actually
+compiled for the chain (the dispatch-count oracle scripts/run_ci.sh
+asserts on), and each mode's row carries best/mean wall clock over
+``--trials`` runs.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+from benchmarks._harness import base_parser, bootstrap, load_or_make
+
+
+CHAIN_OPS = 7  # sub, div, mul, add, clip, mul, add — see pipeline()
+
+
+def pipeline(ht, data, mean, std):
+    """normalize → scale → clip: 7 elementwise ops, zero reductions."""
+    z = (data - mean) / (std + 1e-6)          # sub, add, div
+    z = z * 0.125 + 0.5                       # mul, add
+    z = ht.clip(z, 0.0, 1.0) * 255.0          # clip, mul
+    return z
+
+
+def _time_mode(ht, data, mean, std, trials, sync):
+    from heat_tpu.core import fusion, program_cache
+
+    f0 = fusion.stats()
+    site0 = dict(program_cache.stats()["sites"].get(
+        "fusion", {"hits": 0, "misses": 0}))
+    with ht.telemetry.CompileWatcher() as cw:
+        t0 = time.perf_counter()
+        sync(pipeline(ht, data, mean, std))
+        first_call = time.perf_counter() - t0
+    times = []
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        sync(pipeline(ht, data, mean, std))
+        times.append(time.perf_counter() - t0)
+    f1 = fusion.stats()
+    site1 = dict(program_cache.stats()["sites"].get(
+        "fusion", {"hits": 0, "misses": 0}))
+    return {
+        "compile_seconds": round(cw.seconds, 4),
+        "first_call_seconds": round(first_call, 4),
+        "programs_compiled": cw.backend_compiles,
+        "best_seconds": round(min(times), 6),
+        "mean_seconds": round(sum(times) / len(times), 6),
+        "deferred_ops": f1["deferred"] - f0["deferred"],
+        "flushes": f1["flushes"] - f0["flushes"],
+        "fused_programs_compiled": site1["misses"] - site0["misses"],
+    }
+
+
+def main():
+    parser = base_parser(
+        "heat_tpu elementwise-chain (fusion) microbenchmark")
+    parser.add_argument(
+        "--split", type=int, default=0,
+        help="distribution axis of the operand (default 0)")
+    args = parser.parse_args()
+    ht = bootstrap(args)
+
+    data = load_or_make(ht, args, split=args.split)
+    import numpy as np
+
+    mean = ht.array(np.float32(0.1))
+    std = ht.array(np.float32(1.3))
+
+    def sync(out):
+        return float(out.larray[(0,) * out.ndim])
+
+    rows = {}
+    for mode, flag in (("eager", "0"), ("fused", "1")):
+        os.environ["HEAT_TPU_FUSION"] = flag
+        rows[mode] = _time_mode(ht, data, mean, std, args.trials, sync)
+        print(json.dumps({"mode": mode, **rows[mode]}), flush=True)
+    os.environ.pop("HEAT_TPU_FUSION", None)
+
+    compare = {
+        "chain_ops": CHAIN_OPS,
+        "eager": rows["eager"],
+        "fused": rows["fused"],
+        "fused_programs": rows["fused"]["fused_programs_compiled"],
+        "speedup": round(
+            rows["eager"]["best_seconds"]
+            / max(rows["fused"]["best_seconds"], 1e-9), 3),
+    }
+    from heat_tpu import telemetry
+
+    summary = {"elementwise_compare": compare}
+    if telemetry.enabled():
+        summary.update(telemetry.report.bench_fields())
+    print(json.dumps(summary), flush=True)
+
+
+if __name__ == "__main__":
+    main()

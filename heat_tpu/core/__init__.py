@@ -11,6 +11,8 @@ from .memory import *
 from .stride_tricks import *
 from .sanitation import *
 from ._operations import *
+from . import fusion
+from .fusion import fuse, fusing
 from .arithmetics import *
 from .relational import *
 from .rounding import *

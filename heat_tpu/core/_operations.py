@@ -91,6 +91,17 @@ def binary_op(
 
     padded = any(isinstance(a, DNDarray) and a.pad_count for a in (t1, t2))
 
+    if out is None:
+        from . import fusion
+
+        if fusion.active():
+            deferred = fusion.defer_binary(
+                operation, t1, t2, fn_kwargs, out_shape, out_split,
+                comm, device, padded,
+            )
+            if deferred is not None:
+                return deferred
+
     def phys(a):
         if not isinstance(a, DNDarray):
             return a
@@ -140,6 +151,13 @@ def local_op(
     """Elementwise operation, embarrassingly parallel across shards
     (reference _operations.py:281-352)."""
     sanitation.sanitize_in(x)
+    if out is None:
+        from . import fusion
+
+        if fusion.active():
+            deferred = fusion.defer_local(operation, x, kwargs)
+            if deferred is not None:
+                return deferred
     result = operation(x.larray, **kwargs)
     res = DNDarray(
         result,

@@ -14,8 +14,7 @@ __all__ = ["angle", "conj", "conjugate", "imag", "real"]
 def angle(x: DNDarray, deg: bool = False, out=None) -> DNDarray:
     """Argument of a complex array, in radians (degrees if deg)
     (reference complex_math.py `angle`)."""
-    res = local_op(lambda a: jnp.angle(a, deg=deg), x, out)
-    return res
+    return local_op(jnp.angle, x, out, deg=deg)
 
 
 def conjugate(x: DNDarray, out=None) -> DNDarray:

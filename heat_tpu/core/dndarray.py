@@ -108,6 +108,9 @@ class DNDarray:
         balanced: Optional[bool] = True,
     ):
         self.__array = array
+        self.__pshape = tuple(array.shape) if array is not None else None
+        self.__fused = None
+        self.__leaf_captured = False
         self.__gshape = tuple(int(s) for s in gshape)
         self.__dtype = dtype
         self.__split = split
@@ -116,22 +119,81 @@ class DNDarray:
         self.__balanced = True if balanced is None else balanced
         self.__lshape_map = None
 
+    # --------------------------------------------------------- fusion state
+
+    @classmethod
+    def _from_fused(
+        cls, node, gshape, dtype, split, device, comm, pshape
+    ) -> "DNDarray":
+        """Wrap a pending :class:`heat_tpu.core.fusion.FusedNode` — the
+        physical buffer does not exist yet; any ``larray`` read
+        materializes the whole chain as ONE cached program."""
+        obj = cls(None, gshape, dtype, split, device, comm, True)
+        obj.__fused = node
+        obj.__pshape = tuple(int(s) for s in pshape)
+        return obj
+
+    def _fused_node(self):
+        """The pending fusion DAG node, or None when materialized."""
+        return self.__fused
+
+    def _fusion_flush(self) -> None:
+        """Materialize a pending fused chain into the physical buffer
+        (no-op when already materialized)."""
+        node = self.__fused
+        if node is None:
+            return
+        self.__array = node.materialize(self.__comm)
+        self.__fused = None
+        # a chain another DAG consumed leaves its flushed buffer reachable
+        # (node.buffer re-enters those DAGs as a leaf) — donating it would
+        # hand their later flush a deleted array
+        self.__leaf_captured = bool(node.shared)
+
+    def _mark_leaf_captured(self) -> None:
+        """Called by the fusion engine when the CURRENT buffer is captured
+        by value into a deferred DAG: it must not be donated to XLA while
+        that chain may still flush (see :meth:`resplit_`)."""
+        self.__leaf_captured = True
+
+    def _buffer_donatable(self) -> bool:
+        """Whether the current physical buffer is provably unreferenced by
+        any pending fused chain (safe to ``donate_argnums``)."""
+        return not self.__leaf_captured
+
     # ------------------------------------------------------------------ meta
 
     @property
     def larray(self) -> jax.Array:
         """The underlying physical jax.Array (the reference's process-local
-        torch tensor, dndarray.py:106; here the padded sharded global buffer)."""
+        torch tensor, dndarray.py:106; here the padded sharded global
+        buffer). Reading it is THE fusion flush boundary: a pending
+        elementwise chain materializes here as one cached program."""
+        if self.__array is None:
+            self._fusion_flush()
         return self.__array
 
     @larray.setter
     def larray(self, array: jax.Array):
-        if tuple(array.shape) != tuple(self.__array.shape):
+        if self.__fused is not None:
+            # out=-style overwrite of a deferred destination: if another
+            # DAG consumed the pending node, flush first so it can reuse
+            # the computed buffer; otherwise the pending value is dead —
+            # discard it without compiling a program whose result the
+            # overwrite would immediately throw away. Either way the
+            # destination never serves a stale deferred value
+            # (tests/test_fusion.py).
+            if self.__fused.shared:
+                self._fusion_flush()
+            else:
+                self.__fused = None
+        if tuple(array.shape) != tuple(self.__pshape):
             raise ValueError(
                 f"larray setter: shape {tuple(array.shape)} does not match physical shape "
-                f"{tuple(self.__array.shape)}"
+                f"{tuple(self.__pshape)}"
             )
         self.__array = array
+        self.__leaf_captured = False
         self._invalidate_halo()
 
     @property
@@ -212,7 +274,9 @@ class DNDarray:
 
     @property
     def padded_shape(self) -> Tuple[int, ...]:
-        return tuple(self.__array.shape)
+        """Physical (tail-padded) shape — metadata, so reading it never
+        flushes a pending fused chain."""
+        return tuple(self.__pshape)
 
     @property
     def pad_count(self) -> int:
@@ -220,7 +284,7 @@ class DNDarray:
         replicated)."""
         if self.__split is None:
             return 0
-        return self.__array.shape[self.__split] - self.__gshape[self.__split]
+        return self.__pshape[self.__split] - self.__gshape[self.__split]
 
     @property
     def imag(self) -> "DNDarray":
@@ -245,19 +309,20 @@ class DNDarray:
     def _masked(self, fill_value) -> jax.Array:
         """The physical buffer with pad positions replaced by ``fill_value``
         — call before any computation that crosses the split axis."""
+        buf = self.larray
         if self.pad_count == 0:
-            return self.__array
+            return buf
         s = self.__split
-        idx = jax.lax.broadcasted_iota(jnp.int32, self.__array.shape, s)
-        fill = jnp.asarray(fill_value, dtype=self.__array.dtype)
-        return jnp.where(idx < self.__gshape[s], self.__array, fill)
+        idx = jax.lax.broadcasted_iota(jnp.int32, buf.shape, s)
+        fill = jnp.asarray(fill_value, dtype=buf.dtype)
+        return jnp.where(idx < self.__gshape[s], buf, fill)
 
     def _logical(self) -> jax.Array:
         """The buffer sliced to the logical global shape (drops tail pad).
         The result is generally not evenly shardable; use only at host/compute
         boundaries."""
         if self.pad_count == 0:
-            return self.__array
+            return self.larray
         if jax.process_count() > 1:
             # slicing off the tail pad yields a non-canonically-shardable
             # array; on multi-host XLA would relayout it over DCN invisibly
@@ -268,7 +333,7 @@ class DNDarray:
             )
         _PERF_STATS["logical_slices"] += 1
         sl = tuple(slice(0, n) for n in self.__gshape)
-        return self.__array[sl]
+        return self.larray[sl]
 
     def _relayout(
         self, new_split: Optional[int], *, audit: bool = False,
@@ -328,7 +393,7 @@ class DNDarray:
         if comm.size <= 1 or new_split == self.__split:
             return None
         gshape = self.__gshape
-        buf = self.__array
+        buf = self.larray
 
         # the compare target is the cost of the PROGRAM BEING AUDITED: XLA
         # moves the tail-padded physical buffer (padded along both the old
@@ -404,7 +469,7 @@ class DNDarray:
     def __relayout_impl(
         self, new_split: Optional[int], donate: bool = False
     ) -> jax.Array:
-        buf = self.__array
+        buf = self.larray
         pshape = self.__comm.padded_shape(self.__gshape, new_split)
         if (
             self.pad_count == 0
@@ -429,7 +494,7 @@ class DNDarray:
         centroids, class statistics); unlike :meth:`_logical` it never hands
         the host a non-canonically-shardable view."""
         if self.__split is None:
-            return self.__array
+            return self.larray
         return self._relayout(None)
 
     @classmethod
@@ -516,10 +581,16 @@ class DNDarray:
     # -------------------------------------------------------------- methods
 
     def astype(self, dtype, copy: bool = True) -> "DNDarray":
-        """Cast to the given heat type (reference dndarray.py:424)."""
+        """Cast to the given heat type (reference dndarray.py:424).
+        ``copy=True`` returns a REAL buffer copy even for a same-dtype
+        cast (jax's convert_element_type is a no-op then and would alias
+        the source — which a later donating ``resplit_`` of either array
+        could invalidate; same fix class as ``ht.array(copy=True)``)."""
         dtype = types.canonical_heat_type(dtype)
-        casted = self.__array.astype(dtype.jnp_type())
+        casted = self.larray.astype(dtype.jnp_type())
         if copy:
+            if casted is self.larray:
+                casted = jnp.copy(casted)
             return DNDarray(
                 casted, self.__gshape, dtype, self.__split, self.__device, self.__comm, True
             )
@@ -570,11 +641,24 @@ class DNDarray:
         after the call, so it is **donated** to XLA (the ``out=``-style
         memory contract): its storage may be reused for the result instead
         of holding both layouts live. Any previously captured ``.larray``
-        handle is invalidated by the donation."""
+        handle is invalidated by the donation — EXCEPT buffers a pending
+        fused chain captured by value (core/fusion.py marks them via
+        :meth:`_mark_leaf_captured`): those relayouts skip donation so the
+        chain's later flush never sees a deleted array."""
         axis = sanitize_axis(self.__gshape, axis)
         if axis == self.__split:
             return self
-        self.__array = self._relayout(axis, donate=True)
+        # donation requires the source buffer to be truly dead: a pending
+        # fused chain that captured it by value (core/fusion.py) would
+        # flush against a deleted array, so those relayouts copy instead.
+        # Flush OUR OWN pending chain first — flushing is what discovers
+        # whether the result buffer is shared with sibling DAGs
+        # (node.shared), so deciding donate before the flush would donate
+        # a buffer a sibling still references.
+        self._fusion_flush()
+        self.__array = self._relayout(axis, donate=self._buffer_donatable())
+        self.__pshape = tuple(self.__array.shape)
+        self.__leaf_captured = False
         self._invalidate_halo()
         self.__split = axis
         self.__lshape_map = None
@@ -633,7 +717,7 @@ class DNDarray:
         if self.ndim != 2:
             raise ValueError("DNDarray must be 2D")
         k = min(self.__gshape)
-        buf = self.__array
+        buf = self.larray
         rows = jax.lax.broadcasted_iota(jnp.int32, buf.shape, 0)
         cols = jax.lax.broadcasted_iota(jnp.int32, buf.shape, 1)
         on_diag = (rows == cols) & (rows < k) & (cols < k)
@@ -668,7 +752,7 @@ class DNDarray:
         invariant); edge positions get zero blocks."""
         from ..parallel.halo import halo_exchange
 
-        buf = self._masked(0) if self.pad_count else self.__array
+        buf = self._masked(0) if self.pad_count else self.larray
         return halo_exchange(
             buf, halo_size, comm=self.__comm, axis=self.__split,
             return_parts=True,
@@ -731,7 +815,7 @@ class DNDarray:
         reused instead of re-running the exchange."""
         self.__check_halo_size(halo_size)
         if self.__split is None or self.__comm.size == 1:
-            return self.__array
+            return self.larray
         comm = self.__comm
         s = self.__split
         cached = (
@@ -740,7 +824,7 @@ class DNDarray:
         )
         # both paths take the pad-masked center so the result is identical
         # whether or not a prior get_halo populated the cache
-        buf = self._masked(0) if self.pad_count else self.__array
+        buf = self._masked(0) if self.pad_count else self.larray
         if cached:
             spec = comm.spec(s, self.ndim)
             return jax.shard_map(
@@ -784,6 +868,9 @@ class DNDarray:
     def __internal_set(self, array: jax.Array, gshape, split) -> None:
         """Mutate storage after an indexing update (internal)."""
         self.__array = array
+        self.__fused = None
+        self.__leaf_captured = False
+        self.__pshape = tuple(array.shape)
         self.__gshape = tuple(gshape)
         self.__split = split
         self.__lshape_map = None

@@ -1,0 +1,629 @@
+"""Eager fusion engine — defer-and-fuse elementwise chains into ONE cached
+XLA program (ISSUE 4).
+
+Heat's op machinery pays one dispatch per public op (reference
+heat/core/_operations.py); the port kept that granularity, so a chain like
+``ht.exp(a) - b * 2`` used to launch three separately compiled XLA programs
+with intermediate buffers materialized between each. This module makes the
+elementwise wrappers *lazy*: ``local_op`` / ``binary_op`` append a node to a
+per-result :class:`FusedNode` DAG carried on the DNDarray instead of
+dispatching, and the whole chain compiles as ONE jitted program — through
+:func:`heat_tpu.core.program_cache.cached_program`, so repeated chains hit
+the existing LRU registry and the HLO auditor lowers the very program that
+executes — the first time any consumer touches the physical buffer.
+
+Flush (materialization) boundaries
+----------------------------------
+Every read of ``DNDarray.larray`` flushes a pending chain, which makes the
+boundary set *emergent* rather than enumerated: reductions and scans
+(``_masked``), resplit/relayout, indexing, comm wrappers, ``.numpy()`` /
+``__repr__`` / I/O, halo exchanges, ``out=`` aliasing (the ``larray`` setter
+force-flushes a pending destination) — anything that is not itself a
+deferrable elementwise op materializes the chain first. Deferral additionally
+stops at the depth/node caps (``HEAT_TPU_FUSION_DEPTH``, default 16; node cap
+is 4x the depth cap), at non-allowlisted callables (lambdas, partials), at
+non-static kwargs, and whenever the abstract result would not obey the
+tail-pad invariant — those fall back to the exact eager path and count as
+``fusion.fallbacks``.
+
+Pad semantics
+-------------
+A fused chain propagates the tail-pad invariant exactly as the eager path
+does: operands that span the full logical extent of the output's split dim
+while replicated get an explicit ``pad`` node (the lazy twin of eager
+``binary_op``'s ``phys()`` re-pad), so physical shapes broadcast inside the
+single program and pad positions of the result depend only on pad positions
+of the operands — nothing chain-internal can leak a pad value into a logical
+position, mirroring eager op-by-op behavior bit for bit.
+
+Program identity
+----------------
+The cached-program key is the DAG's *structural signature*: post-order op
+ids, static kwargs, operand slot wiring, leaf physical shapes/dtypes,
+scalar-vs-array operand kinds, and the result split (it pins
+``out_shardings``). **Float/complex scalar values are runtime arguments**
+— ``x * 2.0`` and ``x * 3.0`` (or a changing learning rate) share one
+executable — while **integer/bool scalars are static constants** baked
+into the program so XLA folds them exactly as eager dispatch does
+(``x ** 3`` lowers to repeated multiplication in both modes — the
+bit-for-bit parity contract). The compiled plan holds no buffer
+references — a registry entry can never pin a device allocation alive.
+
+Knobs / API
+-----------
+* ``HEAT_TPU_FUSION=0`` restores pure-eager dispatch (bit-for-bit identical
+  results); default is on.
+* ``HEAT_TPU_FUSION_DEPTH`` bounds chain depth before a forced flush
+  (default 16; the node cap is 4x).
+* :func:`fusing` — ``with ht.fusing():`` scoped (thread-local) override.
+* :func:`fuse` — ``@ht.fuse`` decorator: enables fusion inside the call and
+  flushes returned DNDarrays on exit.
+* Telemetry counters ``fusion.deferred`` / ``fusion.flushes`` /
+  ``fusion.nodes_flushed`` / ``fusion.fallbacks`` plus one instant
+  ``fusion`` event per flush feed ``report.summarize()`` (which derives
+  ``nodes_per_flush``) and the Chrome trace.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import telemetry
+
+__all__ = [
+    "fuse",
+    "fusing",
+    "active",
+    "depth_cap",
+    "node_cap",
+    "stats",
+    "reset_stats",
+    "DEFAULT_DEPTH",
+]
+
+DEFAULT_DEPTH = 16
+
+# kwarg values that may be folded into a program key (static config)
+_STATIC_KW = (int, float, bool, str, bytes, type(None))
+
+_TLS = threading.local()
+_LOCK = threading.Lock()
+# Always-on lightweight counters (ints behind one lock) — the bench and the
+# tests read dispatch counts here without enabling full telemetry.
+_STATS = {"deferred": 0, "flushes": 0, "nodes_flushed": 0, "fallbacks": 0}
+
+
+# -- enablement ---------------------------------------------------------------
+
+
+def _env_enabled() -> bool:
+    return os.environ.get("HEAT_TPU_FUSION", "1").strip().lower() not in (
+        "0", "false", "off",
+    )
+
+
+def active() -> bool:
+    """Whether elementwise deferral is currently on for this thread: a
+    :func:`fusing` override wins, else ``HEAT_TPU_FUSION`` (default on).
+    Read per call so tests/CLIs can flip the env var without a reload."""
+    ov = getattr(_TLS, "override", None)
+    if ov is not None:
+        return ov
+    return _env_enabled()
+
+
+def depth_cap() -> int:
+    """Max chain depth before a forced flush (``HEAT_TPU_FUSION_DEPTH``)."""
+    raw = os.environ.get("HEAT_TPU_FUSION_DEPTH", "").strip()
+    if raw:
+        try:
+            n = int(raw)
+            if n > 0:
+                return n
+        except ValueError:
+            pass
+    return DEFAULT_DEPTH
+
+
+def node_cap() -> int:
+    """Max DAG size before a forced flush (4x the depth cap: a bushy tree
+    of modest depth can still grow a program XLA chews on for seconds)."""
+    return 4 * depth_cap()
+
+
+class fusing:
+    """``with ht.fusing():`` — scoped (thread-local) fusion enable;
+    ``fusing(False)`` scopes a disable. Nestable and exception-safe."""
+
+    def __init__(self, enable: bool = True):
+        self._enable = bool(enable)
+        self._prev: Any = None
+
+    def __enter__(self) -> "fusing":
+        self._prev = getattr(_TLS, "override", None)
+        _TLS.override = self._enable
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        _TLS.override = self._prev
+        return False
+
+
+def _flush_tree(obj):
+    """Materialize every DNDarray reachable through (nested) tuples, lists
+    and dict values — the decorator's exit boundary."""
+    from .dndarray import DNDarray
+
+    if isinstance(obj, DNDarray):
+        obj.larray  # property read flushes
+    elif isinstance(obj, (tuple, list)):
+        for v in obj:
+            _flush_tree(v)
+    elif isinstance(obj, dict):
+        for v in obj.values():
+            _flush_tree(v)
+    return obj
+
+
+def fuse(fn: Callable) -> Callable:
+    """Decorator: run ``fn`` with fusion enabled and flush returned
+    DNDarrays on exit, so the function boundary is a materialization
+    boundary (``@ht.fuse`` on a step function = one program per chain)."""
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        with fusing(True):
+            out = fn(*args, **kwargs)
+        return _flush_tree(out)
+
+    return wrapper
+
+
+def stats() -> dict:
+    """Snapshot of the fusion counters, plus the derived mean
+    ``nodes_per_flush``."""
+    with _LOCK:
+        out = dict(_STATS)
+    out["nodes_per_flush"] = (
+        round(out["nodes_flushed"] / out["flushes"], 3) if out["flushes"] else 0.0
+    )
+    return out
+
+
+def reset_stats() -> None:
+    with _LOCK:
+        for k in _STATS:
+            _STATS[k] = 0
+
+
+def _count(key: str, delta: int = 1) -> None:
+    with _LOCK:
+        _STATS[key] += delta
+
+
+# -- DAG ----------------------------------------------------------------------
+
+
+class _Leaf:
+    """A materialized operand: one committed jax.Array entering the chain.
+    Captured **by value** at defer time, so later in-place mutation of the
+    source DNDarray cannot change an already-issued chain (exactly the
+    eager snapshot semantics)."""
+
+    __slots__ = ("buffer",)
+
+    def __init__(self, buffer):
+        self.buffer = buffer
+
+
+class _ScalarOperand:
+    """A python / numpy scalar operand. The *kind* (python type or numpy
+    dtype) is part of the program signature; float/complex values are
+    runtime arguments (chains differing only in those share one
+    executable), int/bool values are static constants (exact eager
+    constant-folding parity) — see ``_compile_plan``."""
+
+    __slots__ = ("value",)
+
+    def __init__(self, value):
+        self.value = value
+
+
+class FusedNode:
+    """One deferred elementwise op. ``operands`` are ``FusedNode`` /
+    ``_Leaf`` / ``_ScalarOperand``; ``op_id`` of ``"__pad__"`` marks the
+    lazy twin of eager ``binary_op``'s ``phys()`` tail re-pad (``kwargs``
+    then holds the static pad widths). ``buffer`` caches the materialized
+    result, so a node that was flushed as a *root* re-enters later
+    consumers as a leaf instead of recomputing; an *interior* node shared
+    by two DAGs (``t = log(a); u = t+1; v = t*2`` with ``t`` never read)
+    is re-traced inside each consumer's program — duplicated elementwise
+    device work bounded by the depth cap, never duplicated buffers.
+    ``split`` is the result's logical split (set on root wrap — it pins
+    the program's ``out_shardings``)."""
+
+    __slots__ = (
+        "op_id", "fn", "kwargs", "operands",
+        "pshape", "dtype", "split", "depth", "nnodes", "buffer", "shared",
+    )
+
+    def __init__(self, op_id, fn, kwargs, operands, pshape, dtype):
+        self.op_id = op_id
+        self.fn = fn
+        self.kwargs = kwargs
+        self.operands = tuple(operands)
+        self.pshape = tuple(int(s) for s in pshape)
+        self.dtype = dtype  # jnp dtype of the (strong-typed) result
+        self.split = None
+        # True once another DAG consumed this node as an operand: the
+        # owner's eventual flush result may then be referenced by other
+        # pending chains, so its buffer must never be donated to XLA
+        # (DNDarray._fusion_flush propagates this into the owner's
+        # donation guard).
+        self.shared = False
+        d = 1
+        n = 1
+        for o in self.operands:
+            if isinstance(o, FusedNode):
+                d = max(d, o.depth + 1)
+                n += o.nnodes
+        self.depth = d
+        self.nnodes = n
+        self.buffer = None
+
+    # -- materialization ------------------------------------------------------
+
+    def materialize(self, comm):
+        """Compile-or-reuse the chain as ONE cached program and run it.
+        Idempotent (the result is cached on the node, so sibling DNDarrays
+        sharing a sub-DAG reuse the buffer instead of recomputing)."""
+        if self.buffer is not None:
+            return self.buffer
+        sig, plan, leaf_bufs, scalar_vals = _compile_plan(self)
+        from . import program_cache
+
+        if comm is not None and comm.size > 1:
+            tgt = (
+                comm.sharding(self.split, len(self.pshape))
+                if self.split is not None
+                else comm.replicated()
+            )
+        else:
+            tgt = None
+
+        def build():
+            return _plan_program(plan)
+
+        fn = program_cache.cached_program(
+            "fusion", sig, build, comm=comm, out_shardings=tgt
+        )
+        buf = fn(*leaf_bufs, *scalar_vals)
+        self.buffer = buf
+        _count("flushes")
+        _count("nodes_flushed", self.nnodes)
+        if telemetry.enabled():
+            reg = telemetry.get_registry()
+            reg.add("fusion.flushes", 1)
+            reg.add("fusion.nodes_flushed", self.nnodes)
+            reg.emit(
+                "fusion", "flush", nodes=self.nnodes, depth=self.depth,
+                leaves=len(leaf_bufs), scalars=len(scalar_vals),
+            )
+        return buf
+
+
+def _compile_plan(root: FusedNode):
+    """Post-order walk of the DAG producing
+    ``(signature, plan, leaf_buffers, scalar_values)``.
+
+    ``plan`` is a buffer-free instruction list (the only thing the compiled
+    closure captures): ``("leaf", argpos)`` / ``("scalar", argpos)`` /
+    ``("pad", widths, slot)`` / ``("op", fn, kwargs, slots)``; each
+    instruction's result occupies the next slot, the final slot is the
+    chain result. The signature serializes the same walk with leaf
+    shapes/dtypes and scalar kinds in place of values, making it injective
+    over program structure: two DAGs with equal signatures compile to
+    interchangeable executables with identical argument order."""
+    plan: List[tuple] = []
+    sig: List[tuple] = []
+    leaf_bufs: List[Any] = []
+    scalar_vals: List[Any] = []
+    leaf_pos: Dict[int, int] = {}      # id(buffer) -> arg index
+    scalar_pos: Dict[tuple, int] = {}  # (kind, value) -> scalar index
+    slot_of: Dict[int, int] = {}       # id(node) -> slot
+
+    def scalar_kind(v):
+        if isinstance(v, np.generic):
+            return ("np", str(v.dtype))
+        return ("py", type(v).__name__)
+
+    def walk(entry) -> int:
+        if isinstance(entry, FusedNode) and entry.buffer is not None:
+            # a chain another consumer already flushed re-enters as a leaf
+            entry = _Leaf(entry.buffer)
+        if isinstance(entry, _Leaf):
+            buf = entry.buffer
+            pos = leaf_pos.get(id(buf))
+            if pos is None:
+                pos = leaf_pos[id(buf)] = len(leaf_bufs)
+                leaf_bufs.append(buf)
+            plan.append(("leaf", pos))
+            sig.append(("leaf", pos, tuple(buf.shape), str(buf.dtype)))
+            return len(plan) - 1
+        if isinstance(entry, _ScalarOperand):
+            v = entry.value
+            kind = scalar_kind(v)
+            if isinstance(v, (bool, int, np.bool_, np.integer)):
+                # integer/bool scalars are STATIC constants baked into the
+                # program, not runtime args: XLA then folds them exactly
+                # as eager dispatch does (x**3 lowers to repeated
+                # multiplication, not generic pow — bit-for-bit parity),
+                # at the cost of one program per distinct value. Float
+                # scalars stay runtime args (empirically bit-clean across
+                # mul/div/add/pow/mod — the traced-vs-constant battery in
+                # tests/test_fusion.py pins the pow case).
+                plan.append(("const", v))
+                sig.append(("const",) + kind + (repr(v),))
+                return len(plan) - 1
+            # dedup key uses repr, not ==: python equality merges 0.0 with
+            # -0.0 (and 1 with 1.0), which would silently substitute one
+            # scalar for the other in sign-sensitive ops like copysign
+            key = (kind, repr(v))
+            pos = scalar_pos.get(key)
+            if pos is None:
+                pos = len(scalar_vals)
+                scalar_vals.append(v)
+                scalar_pos[key] = pos
+            plan.append(("scalar", pos))
+            sig.append(("scalar", pos) + kind)
+            return len(plan) - 1
+        # FusedNode
+        slot = slot_of.get(id(entry))
+        if slot is not None:
+            return slot
+        opnd_slots = tuple(walk(o) for o in entry.operands)
+        if entry.op_id == "__pad__":
+            widths = entry.kwargs["pad"]
+            plan.append(("pad", widths, opnd_slots[0]))
+            sig.append(("pad", widths, opnd_slots[0]))
+        else:
+            plan.append(("op", entry.fn, entry.kwargs, opnd_slots))
+            kw_key = tuple(sorted(entry.kwargs.items())) if entry.kwargs else ()
+            sig.append(("op", entry.op_id, kw_key, opnd_slots))
+        slot = len(plan) - 1
+        slot_of[id(entry)] = slot
+        return slot
+
+    out_slot = walk(root)
+    sig.append(("out", out_slot, root.split))
+    return (
+        tuple(sig),
+        (tuple(plan), out_slot, len(leaf_bufs)),
+        leaf_bufs,
+        scalar_vals,
+    )
+
+
+def _plan_program(plan_tuple):
+    """Build the traced callable for one plan. Captures only the plan
+    (fns + static config + slot ints) — never device buffers."""
+    plan, out_slot, n_leaves = plan_tuple
+
+    def fused_program(*args):
+        slots: List[Any] = []
+        for ins in plan:
+            kind = ins[0]
+            if kind == "leaf":
+                slots.append(args[ins[1]])
+            elif kind == "scalar":
+                slots.append(args[n_leaves + ins[1]])
+            elif kind == "const":
+                slots.append(ins[1])
+            elif kind == "pad":
+                slots.append(jnp.pad(slots[ins[2]], ins[1]))
+            else:  # ("op", fn, kwargs, slots)
+                _, fn, kw, opnds = ins
+                slots.append(fn(*(slots[i] for i in opnds), **kw))
+        return slots[out_slot]
+
+    return fused_program
+
+
+# -- deferral entry points (called by _operations) ----------------------------
+
+
+def _op_id(fn: Callable) -> Optional[str]:
+    """Stable identity for an allowlisted elementwise callable, or None.
+
+    Only module-level ``jax.numpy`` functions qualify: their
+    (module, name) uniquely identifies the computation. Lambdas and
+    partials are refused — two closures over different constants share a
+    qualname, and keying a process-global program cache on one would
+    silently reuse the wrong program."""
+    name = getattr(fn, "__qualname__", None) or getattr(fn, "__name__", None)
+    mod = getattr(fn, "__module__", None)
+    if not name or not mod or "<" in name:
+        return None
+    if not (mod == "jax.numpy" or mod.startswith("jax.numpy.")
+            or mod.startswith("jax._src.numpy")):
+        return None
+    return f"{mod}.{name}"
+
+
+def _static_kwargs(kwargs: dict) -> bool:
+    return all(isinstance(v, _STATIC_KW) for v in kwargs.values())
+
+
+def _entry_of(a):
+    """DNDarray -> DAG entry: its pending node (never flushed here!) or a
+    by-value leaf of its physical buffer. Side-effect free — capture
+    marks are applied by :func:`_commit_captures` only once the op has
+    actually deferred, so a fallback to eager dispatch leaves no stale
+    non-donatable flags behind."""
+    node = a._fused_node()
+    if node is not None and node.buffer is None:
+        return node
+    if node is not None:
+        return _Leaf(node.buffer)
+    return _Leaf(a.larray)
+
+
+def _commit_captures(pairs):
+    """Record that a new node consumed these operands: the source arrays'
+    CURRENT buffers (or their future flush results) are now reachable
+    from another DAG, so they are marked non-donatable — an in-place
+    ``resplit_`` donating one to XLA would hand a later flush a deleted
+    array (eager dispatch computed consumers immediately, so this
+    ordering could never fail there). ``pairs`` holds ``(entry, source
+    DNDarray)`` for the pre-pad operand entries."""
+    for entry, src in pairs:
+        if isinstance(entry, FusedNode) and entry.buffer is None:
+            entry.shared = True
+        else:
+            src._mark_leaf_captured()
+
+
+def _entry_sds(entry):
+    """Abstract value of an entry for ``jax.eval_shape``. Nodes/leaves are
+    strong-typed arrays (every node has at least one array operand, so its
+    dtype is never weak); scalars pass through as concrete values so jax's
+    own weak-type promotion applies exactly as in eager mode."""
+    if isinstance(entry, FusedNode):
+        return jax.ShapeDtypeStruct(entry.pshape, entry.dtype)
+    if isinstance(entry, _Leaf):
+        return jax.ShapeDtypeStruct(tuple(entry.buffer.shape), entry.buffer.dtype)
+    return entry.value
+
+
+def _entry_pshape(entry) -> Tuple[int, ...]:
+    if isinstance(entry, FusedNode):
+        return entry.pshape
+    return tuple(entry.buffer.shape)
+
+
+def _fallback():
+    _count("fallbacks")
+    if telemetry.enabled():
+        telemetry.get_registry().add("fusion.fallbacks", 1)
+    return None
+
+
+def _wrap_deferred(node: FusedNode, gshape, out_split, device, comm):
+    """Attach the result split and hand back a deferred DNDarray — or, at
+    the depth/node caps, flush immediately so unbounded chains degrade to
+    windowed fusion instead of unbounded program growth."""
+    from . import types
+    from .dndarray import DNDarray
+
+    node.split = out_split
+    ht_dtype = types.canonical_heat_type(node.dtype)
+    _count("deferred")
+    if telemetry.enabled():
+        telemetry.get_registry().add("fusion.deferred", 1)
+    if node.depth >= depth_cap() or node.nnodes >= node_cap():
+        buf = node.materialize(comm)
+        return DNDarray(buf, gshape, ht_dtype, out_split, device, comm, True)
+    return DNDarray._from_fused(
+        node, gshape, ht_dtype, out_split, device, comm, node.pshape
+    )
+
+
+def defer_local(operation: Callable, x, kwargs: dict):
+    """Lazy twin of eager ``local_op``: returns a deferred DNDarray, or
+    None to fall back. The result must preserve the physical shape (the
+    elementwise contract) — anything else eagers out."""
+    if not active():
+        return None
+    op_id = _op_id(operation)
+    if op_id is None or not _static_kwargs(kwargs):
+        return _fallback()
+    entry = _entry_of(x)
+    try:
+        out = jax.eval_shape(
+            functools.partial(operation, **kwargs), _entry_sds(entry)
+        )
+    except Exception:
+        return _fallback()
+    if tuple(out.shape) != _entry_pshape(entry):
+        return _fallback()
+    _commit_captures([(entry, x)])
+    node = FusedNode(op_id, operation, dict(kwargs), (entry,), out.shape, out.dtype)
+    return _wrap_deferred(node, x.shape, x.split, x.device, x.comm)
+
+
+def defer_binary(
+    operation: Callable,
+    t1,
+    t2,
+    fn_kwargs: dict,
+    out_shape: Tuple[int, ...],
+    out_split: Optional[int],
+    comm,
+    device,
+    padded: bool,
+):
+    """Lazy twin of eager ``binary_op`` (operands already normalized and
+    split-reconciled by the caller). Re-creates the eager ``phys()`` pad
+    alignment as explicit pad nodes, abstractly evaluates the result, and
+    defers only when the physical result obeys the tail-pad invariant."""
+    from .dndarray import DNDarray
+
+    if not active():
+        return None
+    op_id = _op_id(operation)
+    if op_id is None or not _static_kwargs(fn_kwargs):
+        return _fallback()
+    ndim_out = len(out_shape)
+    entries = []
+    captures = []
+    for a in (t1, t2):
+        if isinstance(a, DNDarray):
+            e = _entry_of(a)
+            captures.append((e, a))
+            if out_split is not None and padded:
+                # eager phys(): a replicated operand spanning the full
+                # logical extent of the output's split dim is tail-padded
+                # so physical shapes broadcast — here as a lazy pad node
+                own_dim = out_split - (ndim_out - a.ndim)
+                eshape = _entry_pshape(e)
+                if (
+                    own_dim >= 0
+                    and a.split is None
+                    and eshape[own_dim] == out_shape[out_split]
+                ):
+                    P = comm.padded_size(out_shape[out_split])
+                    if P != eshape[own_dim]:
+                        widths = [(0, 0)] * a.ndim
+                        widths[own_dim] = (0, P - eshape[own_dim])
+                        pshape = tuple(
+                            s + w[1] for s, w in zip(eshape, widths)
+                        )
+                        e = FusedNode(
+                            "__pad__", None, {"pad": tuple(widths)}, (e,),
+                            pshape, _entry_sds(e).dtype,
+                        )
+            entries.append(e)
+        else:
+            entries.append(_ScalarOperand(a))
+    try:
+        out = jax.eval_shape(
+            lambda u, v: operation(u, v, **fn_kwargs),
+            *(_entry_sds(e) for e in entries),
+        )
+    except Exception:
+        return _fallback()
+    expected = comm.padded_shape(out_shape, out_split)
+    if tuple(out.shape) != tuple(expected):
+        return _fallback()
+    _commit_captures(captures)
+    node = FusedNode(
+        op_id, operation, dict(fn_kwargs), entries, out.shape, out.dtype
+    )
+    return _wrap_deferred(node, out_shape, out_split, device, comm)

@@ -62,9 +62,11 @@ def any(x: DNDarray, axis=None, out=None, keepdims: bool = False) -> DNDarray:
 
 
 def isclose(x, y, rtol: float = 1e-05, atol: float = 1e-08, equal_nan: bool = False) -> DNDarray:
-    """Elementwise closeness (reference logical.py:240)."""
+    """Elementwise closeness (reference logical.py:240). Tolerances ride as
+    static fn_kwargs (not a closure) so isclose joins fused chains."""
     return binary_op(
-        lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan), x, y
+        jnp.isclose, x, y,
+        fn_kwargs={"rtol": rtol, "atol": atol, "equal_nan": equal_nan},
     )
 
 

@@ -31,10 +31,12 @@ def ceil(x, out=None) -> DNDarray:
 
 
 def clip(x: DNDarray, min, max, out=None) -> DNDarray:
-    """Clip values to [min, max] (reference rounding.py `clip`)."""
+    """Clip values to [min, max] (reference rounding.py `clip`). Passed as
+    keyword config (not a closure) so scalar-bound clips join fused
+    elementwise chains (core/fusion.py)."""
     if min is None and max is None:
         raise ValueError("either min or max must be set")
-    return local_op(lambda a: jnp.clip(a, min, max), x, out)
+    return local_op(jnp.clip, x, out, min=min, max=max)
 
 
 def sign(x, out=None) -> DNDarray:
@@ -73,7 +75,7 @@ def modf(x: DNDarray, out=None):
 
 def round(x: DNDarray, decimals: int = 0, out=None, dtype=None) -> DNDarray:
     """Round to `decimals` digits (reference rounding.py `round`)."""
-    res = local_op(lambda a: jnp.round(a, decimals), x, out)
+    res = local_op(jnp.round, x, out, decimals=decimals)
     if dtype is not None:
         res = res.astype(types.canonical_heat_type(dtype), copy=False)
     return res

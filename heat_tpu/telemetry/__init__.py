@@ -439,6 +439,7 @@ class CompileWatcher:
 
     def __init__(self):
         self.stages: Dict[str, float] = defaultdict(float)
+        self.counts: Dict[str, int] = defaultdict(int)
         self.events = 0
 
     @property
@@ -450,8 +451,17 @@ class CompileWatcher:
     def backend_seconds(self) -> float:
         return self.stages.get("backend_compile_duration", 0.0)
 
+    @property
+    def backend_compiles(self) -> int:
+        """Number of backend-compile events in the window — i.e. how many
+        distinct XLA programs were built (the fusion microbenchmark's
+        dispatch-count oracle: an N-op chain fused into one program shows
+        1 here where eager shows ~N)."""
+        return self.counts.get("backend_compile_duration", 0)
+
     def _record(self, stage: str, secs: float) -> None:
         self.stages[stage] += secs
+        self.counts[stage] += 1
         self.events += 1
 
     def __enter__(self) -> "CompileWatcher":

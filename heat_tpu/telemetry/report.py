@@ -149,6 +149,15 @@ def summarize(
         pc = _pc.stats()
         if pc["hits"] or pc["misses"]:
             out["program_cache"] = pc
+        # fusion-engine counters (core/fusion.py): deferred elementwise
+        # ops, chain flushes, mean nodes per flushed program, and eager
+        # fallbacks. Absent when no elementwise op ran deferred, so
+        # fusion-off summaries keep their exact shape.
+        from ..core import fusion as _fz
+
+        fz = _fz.stats()
+        if fz["deferred"] or fz["flushes"] or fz["fallbacks"]:
+            out["fusion"] = fz
     elif pc_retraces or pc_evictions:
         out["program_cache"] = {
             "retraces": pc_retraces,

@@ -265,6 +265,78 @@ EOF
     fi
 fi
 
+# Fusion dispatch check (ISSUE 4): run the elementwise-chain microbenchmark
+# (normalize→scale→clip, 7 ops) in both dispatch modes and assert the fused
+# chain compiled FEWER XLA programs than eager while matching or beating its
+# wall clock — the defer-and-fuse engine's regression oracle
+# (core/fusion.py). HEAT_TPU_CI_SKIP_FUSION=1 opts out.
+if [ -z "${HEAT_TPU_CI_SKIP_FUSION:-}" ]; then
+    echo "=== fusion dispatch check (elementwise microbenchmark, 4-device mesh) ==="
+    fusion_out=$(mktemp)
+    fusion_rc=0
+    # a fresh compile-cache-free run: the program-count comparison must see
+    # real backend compiles, not deserializations from the sweep's cache
+    if env -u HEAT_TPU_COMPILE_CACHE python benchmarks/elementwise/heat_tpu.py \
+            --n 100000 --features 64 --trials 2 --mesh 4 > "$fusion_out"; then
+        python - "$fusion_out" <<'EOF' || fusion_rc=$?
+import json, sys
+
+cmp = None
+for line in open(sys.argv[1]):
+    line = line.strip()
+    if not line:
+        continue
+    try:
+        obj = json.loads(line)
+    except json.JSONDecodeError:
+        continue
+    if "elementwise_compare" in obj:
+        cmp = obj["elementwise_compare"]
+if cmp is None:
+    raise SystemExit("fusion: no elementwise_compare summary line")
+eager, fused = cmp["eager"], cmp["fused"]
+print(
+    f"fusion: eager programs={eager['programs_compiled']} "
+    f"best={eager['best_seconds']}s | fused programs={fused['programs_compiled']} "
+    f"best={fused['best_seconds']}s | chain flushed as "
+    f"{cmp['fused_programs']} cached program(s)"
+)
+if not fused["programs_compiled"] < eager["programs_compiled"]:
+    raise SystemExit(
+        f"fusion: fused chain did not compile fewer programs than eager "
+        f"(fused={fused['programs_compiled']}, eager={eager['programs_compiled']})"
+    )
+if cmp["fused_programs"] != 1:
+    raise SystemExit(
+        f"fusion: the 7-op chain should flush as exactly ONE registry "
+        f"program, got {cmp['fused_programs']}"
+    )
+if fused["deferred_ops"] == 0:
+    raise SystemExit("fusion: no ops deferred — engine disabled?")
+print("fusion ok")
+EOF
+    else
+        fusion_rc=$?
+    fi
+    if [ -n "$REPORT" ]; then
+        cp "$fusion_out" "${REPORT}/fusion_elementwise.jsonl" || true
+    fi
+    rm -f "$fusion_out"
+    if [ "$fusion_rc" != 0 ]; then
+        echo "=== fusion dispatch check FAILED (rc=$fusion_rc) ==="
+        FAILED_SIZES="$FAILED_SIZES fusion"
+    fi
+    # Bit-for-bit parity spot check: the fusion test module's numeric
+    # oracles re-run with fusion forced OFF (the sweep above already ran
+    # them with the default ON), pinning HEAT_TPU_FUSION=0 == eager.
+    echo "=== fusion-off parity spot check (tests/test_fusion.py eager mode) ==="
+    if ! HEAT_TPU_FUSION=0 python -m pytest tests/test_fusion.py \
+            -q -p no:cacheprovider -k "NumpyParity or FusionOff"; then
+        echo "=== fusion-off parity check FAILED ==="
+        FAILED_SIZES="$FAILED_SIZES fusion-off"
+    fi
+fi
+
 if [ "$have_coverage" = 1 ]; then
     # merge the per-size coverage files, as the reference CI merges its
     # 8 mpirun passes (Jenkinsfile:33-44 / codecov)

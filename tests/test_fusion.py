@@ -1,0 +1,429 @@
+"""Eager fusion engine oracles (ISSUE 4, core/fusion.py).
+
+The contract under test: with fusion on (the default), an N-op elementwise
+chain defers into one FusedExpr DAG and materializes as exactly ONE cached
+XLA program at the first non-elementwise boundary — compiling once on first
+use and never again (CompileWatcher oracle); results are numpy-exact across
+every split, padded tails and mixed scalar operands; ``HEAT_TPU_FUSION=0``
+restores pure-eager dispatch bit for bit; ``out=`` destinations never serve
+stale deferred values; depth caps window unbounded chains.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+import heat_tpu as ht
+from heat_tpu import telemetry as tm
+from heat_tpu.core import fusion
+from heat_tpu.core import program_cache as pc
+
+
+def _chain(a, b):
+    """A 5-op elementwise pipeline: exp → sub → mul → clip → add."""
+    return ht.clip(ht.exp(a) - b * 2.0, -1.0, 50.0) + 0.5
+
+
+def _chain_np(an, bn):
+    return np.clip(np.exp(an) - bn * 2.0, -1.0, 50.0) + 0.5
+
+
+def _fusion_site():
+    return dict(pc.stats()["sites"].get("fusion", {"hits": 0, "misses": 0}))
+
+
+class TestOneProgram:
+    """The dispatch oracle: a >=4-op chain is ONE program, compiled once."""
+
+    def test_chain_is_one_cached_program(self):
+        rng = np.random.default_rng(0)
+        an = rng.standard_normal(13)
+        bn = rng.standard_normal(13)
+        a0, b0 = ht.array(an, split=0), ht.array(bn, split=0)
+        a1, b1 = ht.array(an, split=0), ht.array(bn, split=0)
+
+        before_site = _fusion_site()
+        before = fusion.stats()
+        r = _chain(a0, b0)
+        assert r._fused_node() is not None, "chain did not defer"
+        got = r.numpy()  # flush boundary
+        after = fusion.stats()
+        site = _fusion_site()
+        assert after["deferred"] - before["deferred"] >= 4
+        assert after["flushes"] - before["flushes"] == 1
+        assert after["fallbacks"] == before["fallbacks"]
+        # exactly ONE program entered the registry for the whole chain
+        assert site["misses"] - before_site["misses"] == 1
+        np.testing.assert_allclose(got, _chain_np(an, bn), rtol=1e-6)
+
+        # second, identical chain: zero XLA compiles (deferral still runs
+        # eval_shape, which is a jaxpr trace, not a compile), registry hit,
+        # no new fused program
+        hits0 = pc.stats()["hits"]
+        misses0 = _fusion_site()["misses"]
+        with tm.CompileWatcher() as w:
+            got2 = _chain(a1, b1).numpy()
+        assert w.backend_seconds == 0.0, (
+            f"repeat chain recompiled: {dict(w.stages)}"
+        )
+        assert w.stages.get("backend_compile_duration", 0.0) == 0.0
+        assert pc.stats()["hits"] > hits0
+        assert _fusion_site()["misses"] == misses0
+        np.testing.assert_array_equal(got, got2)
+
+    def test_scalar_values_share_one_program(self):
+        an = np.arange(11.0)
+        a = ht.array(an, split=0)
+        (a * 2.0).numpy()
+        site0 = _fusion_site()
+        np.testing.assert_array_equal((a * 3.0).numpy(), an * 3.0)
+        site1 = _fusion_site()
+        assert site1["misses"] == site0["misses"], (
+            "x*2 and x*3 must share one executable (scalar is a runtime arg)"
+        )
+        assert site1["hits"] == site0["hits"] + 1
+
+
+class TestNumpyParity:
+    """Numpy-oracle equality across every split, padded tails included."""
+
+    @pytest.mark.parametrize("split", [None, 0, 1])
+    def test_chain_all_splits_padded_tail(self, split):
+        rng = np.random.default_rng(42)
+        an = rng.standard_normal((7, 5))  # 8-device mesh: both axes pad
+        bn = rng.standard_normal((7, 5))
+        a = ht.array(an, split=split)
+        b = ht.array(bn, split=split)
+        np.testing.assert_allclose(
+            _chain(a, b).numpy(), _chain_np(an, bn), rtol=1e-6, atol=1e-9
+        )
+
+    @pytest.mark.parametrize("split", [None, 0])
+    def test_mixed_replicated_and_split_operands(self, split):
+        rng = np.random.default_rng(3)
+        an = rng.standard_normal(11)
+        bn = rng.standard_normal(11)
+        a = ht.array(an, split=split)
+        b = ht.array(bn)  # replicated, full logical extent -> pad node
+        got = (ht.sqrt(ht.abs(a)) * b - 1).numpy()
+        np.testing.assert_allclose(got, np.sqrt(np.abs(an)) * bn - 1, rtol=1e-6)
+
+    def test_mixed_scalar_kinds(self):
+        an = np.arange(9.0)
+        a = ht.array(an, split=0)
+        got = ((a + 2) * 0.5 - np.float32(1.25)).numpy()
+        np.testing.assert_allclose(
+            got, (an + 2) * 0.5 - np.float32(1.25), rtol=1e-7
+        )
+
+    def test_int_scalars_fold_bitwise_like_eager(self, monkeypatch):
+        """Integer scalars are static constants: x**3 must lower to the
+        same repeated-multiplication XLA folds for eager dispatch, not
+        generic pow — bitwise-identical results."""
+        rng = np.random.default_rng(11)
+        an = (np.abs(rng.standard_normal(10007)) + 0.5).astype(np.float32)
+        fused = ((ht.array(an, split=0) ** 3) * 1.0).numpy()
+        monkeypatch.setenv("HEAT_TPU_FUSION", "0")
+        eager = ((ht.array(an, split=0) ** 3) * 1.0).numpy()
+        np.testing.assert_array_equal(fused, eager)
+
+    def test_negative_zero_scalars_not_merged(self):
+        """Scalar dedup must not merge 0.0 with -0.0 (python == equality
+        would): copysign against -0.0 flips every sign."""
+        an = np.arange(1.0, 6.0)
+        a = ht.array(an, split=0)
+        # ONE chain containing both +0.0 and -0.0 scalar operands
+        r = ht.copysign(a + 0.0, -0.0)
+        np.testing.assert_array_equal(r.numpy(), np.copysign(an + 0.0, -0.0))
+        np.testing.assert_array_equal(
+            np.signbit(r.numpy()), np.ones(5, dtype=bool)
+        )
+
+    def test_int_bool_chains(self):
+        an = np.arange(-5, 8)
+        a = ht.array(an, split=0)
+        np.testing.assert_array_equal(
+            ((a % 3 == 0) & (a > 0)).numpy(), ((an % 3 == 0) & (an > 0))
+        )
+
+    def test_reduction_is_a_flush_boundary(self):
+        rng = np.random.default_rng(7)
+        an = rng.standard_normal((6, 4))
+        a = ht.array(an, split=0)
+        r = ht.exp(a) * 2
+        if fusion.active():  # class also runs under HEAT_TPU_FUSION=0 in CI
+            assert r._fused_node() is not None
+        np.testing.assert_allclose(
+            ht.sum(r, axis=0).numpy(), (np.exp(an) * 2).sum(axis=0),
+            rtol=1e-6,
+        )
+
+    def test_snapshot_semantics_on_inplace_mutation(self):
+        """A chain captures operand buffers by value (eager parity): a
+        later in-place write to the source must not change the chain."""
+        an = np.arange(5.0)
+        a = ht.array(an, split=0)
+        r = a * 10
+        a.lloc[0] = 99.0
+        np.testing.assert_array_equal(r.numpy(), an * 10)
+
+    def test_shared_subchain_computes_once(self):
+        if not fusion.active():
+            pytest.skip("flush-count oracle needs fusion on")
+        an = np.arange(6.0) + 1
+        a = ht.array(an, split=0)
+        t = ht.log(a)  # shared sub-DAG
+        u = t + 1
+        v = t * 2
+        before = fusion.stats()["flushes"]
+        np.testing.assert_allclose(u.numpy(), np.log(an) + 1, rtol=1e-6)
+        np.testing.assert_allclose(v.numpy(), np.log(an) * 2, rtol=1e-6)
+        # one program per consumer; t is an interior shared node, so log
+        # re-traces inside each program (documented FusedNode semantics)
+        # rather than forcing an extra flush of t itself
+        assert fusion.stats()["flushes"] - before == 2
+
+
+class TestDepthCap:
+    def test_depth_cap_flushes_in_windows(self, monkeypatch):
+        monkeypatch.setenv("HEAT_TPU_FUSION_DEPTH", "4")
+        an = np.arange(10.0)
+        a = ht.array(an, split=0)
+        before = fusion.stats()["flushes"]
+        r = a
+        for _ in range(9):
+            r = r + 1.0
+        got = r.numpy()
+        flushed = fusion.stats()["flushes"] - before
+        assert flushed >= 2, "a 9-op chain under depth cap 4 must window-flush"
+        np.testing.assert_array_equal(got, an + 9.0)
+
+    def test_default_cap_read_from_env(self, monkeypatch):
+        monkeypatch.setenv("HEAT_TPU_FUSION_DEPTH", "7")
+        assert fusion.depth_cap() == 7
+        assert fusion.node_cap() == 28
+        monkeypatch.delenv("HEAT_TPU_FUSION_DEPTH")
+        assert fusion.depth_cap() == fusion.DEFAULT_DEPTH
+
+
+class TestFusionOff:
+    """HEAT_TPU_FUSION=0 restores pure-eager dispatch, bit for bit."""
+
+    def test_env_zero_is_eager_and_bit_identical(self, monkeypatch):
+        rng = np.random.default_rng(5)
+        an = rng.standard_normal((7, 3))
+        bn = rng.standard_normal((7, 3))
+        for split in (None, 0, 1):
+            a, b = ht.array(an, split=split), ht.array(bn, split=split)
+            fused = _chain(a, b).numpy()
+            monkeypatch.setenv("HEAT_TPU_FUSION", "0")
+            before = fusion.stats()["deferred"]
+            a2, b2 = ht.array(an, split=split), ht.array(bn, split=split)
+            r = _chain(a2, b2)
+            assert r._fused_node() is None, "fusion off must not defer"
+            assert fusion.stats()["deferred"] == before
+            eager = r.numpy()
+            monkeypatch.delenv("HEAT_TPU_FUSION")
+            np.testing.assert_array_equal(fused, eager)
+
+    def test_fusing_context_overrides_env(self, monkeypatch):
+        an = np.arange(4.0)
+        monkeypatch.setenv("HEAT_TPU_FUSION", "0")
+        a = ht.array(an, split=0)
+        with ht.fusing():
+            r = a + 1
+            assert r._fused_node() is not None
+        np.testing.assert_array_equal(r.numpy(), an + 1)
+        monkeypatch.delenv("HEAT_TPU_FUSION")
+        with ht.fusing(False):
+            r2 = a + 1
+            assert r2._fused_node() is None
+
+    def test_fuse_decorator_flushes_at_return(self):
+        an = np.arange(6.0)
+
+        @ht.fuse
+        def step(x):
+            return ht.exp(x) * 0.5 - 1
+
+        out = step(ht.array(an, split=0))
+        assert out._fused_node() is None, "@ht.fuse must flush on return"
+        np.testing.assert_allclose(out.numpy(), np.exp(an) * 0.5 - 1, rtol=1e-6)
+
+
+class TestOutAliasing:
+    """Satellite: an ``out=`` destination never observes stale deferred
+    values, and chains referencing its old value stay correct."""
+
+    def test_unshared_pending_out_target_discards_without_flush(self):
+        """Overwriting an out= destination whose pending chain nothing
+        else references must NOT compile-and-run the dead chain."""
+        an, bn = np.arange(6.0), np.arange(6.0) * 3
+        z = ht.array(an, split=0) * 3.0  # deferred, unshared
+        before = fusion.stats()["flushes"]
+        ht.add(ht.array(an, split=0), ht.array(bn, split=0), out=z)
+        got = z.numpy()
+        # the out= path computes eagerly and the dead `an*3` chain is
+        # discarded, so NO fused program ran for this write
+        assert fusion.stats()["flushes"] == before
+        np.testing.assert_array_equal(got, an + bn)
+
+    def test_out_target_pending_chain_is_flushed_before_write(self):
+        an, bn = np.arange(5.0), np.arange(5.0) * 2
+        xn, yn = np.ones(5), np.full(5, 3.0)
+        a, b = ht.array(an, split=0), ht.array(bn, split=0)
+        c = a + b  # deferred chain pending on c
+        assert c._fused_node() is not None
+        d = c * 2  # references c's node
+        ht.add(ht.array(xn, split=0), ht.array(yn, split=0), out=c)
+        np.testing.assert_array_equal(c.numpy(), xn + yn)
+        # d captured c's OLD chain by node, not by destination
+        np.testing.assert_array_equal(d.numpy(), (an + bn) * 2)
+
+    def test_out_equal_to_operand(self):
+        an = np.arange(7.0)
+        a = ht.array(an, split=0)
+        c = a * 3  # deferred
+        ht.add(c, c, out=c)
+        np.testing.assert_array_equal(c.numpy(), an * 6)
+
+
+class TestFallbacks:
+    def test_lambda_ops_fall_back_eager(self):
+        before = fusion.stats()["fallbacks"]
+        an = np.arange(5.0) + 0.25
+        frac, intg = ht.modf(ht.array(an, split=0))  # lambda-wrapped jnp.modf
+        assert fusion.stats()["fallbacks"] > before
+        np.testing.assert_allclose(frac.numpy(), np.modf(an)[0])
+        np.testing.assert_allclose(intg.numpy(), np.modf(an)[1])
+
+    def test_kwarg_ops_fuse(self):
+        an = np.linspace(-2, 2, 9)
+        a = ht.array(an, split=0)
+        before = fusion.stats()["deferred"]
+        got = ht.round(ht.clip(a, -1.0, 1.0), decimals=1)
+        assert got._fused_node() is not None
+        assert fusion.stats()["deferred"] - before == 2
+        np.testing.assert_allclose(
+            got.numpy(), np.round(np.clip(an, -1.0, 1.0), 1)
+        )
+
+    def test_isclose_fuses(self):
+        an = np.arange(6.0)
+        a, b = ht.array(an, split=0), ht.array(an + 1e-9, split=0)
+        r = ht.isclose(a, b)
+        assert r._fused_node() is not None
+        np.testing.assert_array_equal(r.numpy(), np.isclose(an, an + 1e-9))
+
+
+class TestTelemetry:
+    def test_counters_and_summarize_block(self):
+        reg = tm.enable()
+        reg.clear()
+        try:
+            an = np.arange(8.0)
+            a = ht.array(an, split=0)
+            (ht.exp(a) * 2 + 1).numpy()
+            snap = reg.snapshot()["counters"]
+            assert snap.get("fusion.deferred", 0) >= 3
+            assert snap.get("fusion.flushes", 0) >= 1
+            summary = tm.report.summarize()
+            assert "fusion" in summary
+            assert summary["fusion"]["flushes"] >= 1
+            assert summary["fusion"]["nodes_per_flush"] > 0
+            # one instant flush event feeds the Chrome trace
+            assert any(e.get("kind") == "fusion" for e in reg.events)
+        finally:
+            tm.disable()
+            reg.clear()
+
+
+class TestMetadataWithoutFlush:
+    def test_shape_queries_do_not_materialize(self):
+        p = ht.get_comm().size
+        a = ht.array(np.arange(11.0), split=0)
+        r = a * 2 + 1
+        assert r._fused_node() is not None
+        assert r.shape == (11,)
+        padded = -(-11 // p) * p  # ceil-rule tail pad for the active mesh
+        assert r.padded_shape == (padded,)
+        assert r.pad_count == padded - 11
+        assert r.split == 0
+        assert r._fused_node() is not None, "metadata reads must not flush"
+        np.testing.assert_array_equal(r.numpy(), np.arange(11.0) * 2 + 1)
+
+
+class TestDonationGuard:
+    """A buffer captured by value into a pending chain must never be
+    donated by a later in-place resplit_ (on donation-capable backends
+    the chain's flush would read a deleted array)."""
+
+    def test_captured_leaf_blocks_resplit_donation(self):
+        an = np.arange(12.0).reshape(6, 2)
+        a = ht.array(an, split=0)
+        assert a._buffer_donatable()
+        r = a * 2  # deferred chain captures a's buffer by value
+        assert not a._buffer_donatable()
+        a.resplit_(1)  # must relayout WITHOUT donating the old buffer
+        assert a._buffer_donatable()  # fresh post-relayout buffer
+        np.testing.assert_array_equal(r.numpy(), an * 2)
+        np.testing.assert_array_equal(a.numpy(), an)
+
+    def test_shared_chain_result_blocks_donation(self):
+        an = np.arange(10.0)
+        a = ht.array(an, split=0)
+        r = a + 1          # deferred root
+        d = r * 3          # consumes r's node -> r's flush is shared
+        r.larray           # flush r; its buffer re-enters d's DAG as a leaf
+        assert not r._buffer_donatable()
+        r.resplit_(None)   # copies instead of donating
+        np.testing.assert_array_equal(d.numpy(), (an + 1) * 3)
+
+    def test_unshared_flush_keeps_donation(self):
+        a = ht.array(np.arange(8.0), split=0)
+        r = a + 1
+        r.larray  # flushed, root never consumed by another DAG
+        assert r._buffer_donatable()
+
+    def test_resplit_of_still_deferred_shared_owner(self, monkeypatch):
+        """resplit_ on an owner whose chain is still PENDING and shared
+        must flush first and then skip donation — deciding donate before
+        the flush would donate the buffer the sibling DAG references."""
+        an = np.arange(12.0).reshape(6, 2)
+        z = ht.array(an, split=0) + 1   # deferred
+        w = z * 2                        # consumes z's pending node
+        seen = {}
+        orig = ht.DNDarray._relayout
+
+        def spy(self, new_split, *, audit=False, donate=False):
+            seen["donate"] = donate
+            return orig(self, new_split, audit=audit, donate=donate)
+
+        monkeypatch.setattr(ht.DNDarray, "_relayout", spy)
+        z.resplit_(1)                    # flush happens inside, pre-decision
+        assert seen["donate"] is False
+        np.testing.assert_array_equal(w.numpy(), (an + 1) * 2)
+        np.testing.assert_array_equal(z.numpy(), an + 1)
+
+    def test_fallback_leaves_no_stale_capture_marks(self):
+        """An op that falls back to eager dispatch must not leave its
+        operands marked non-donatable."""
+        an = np.arange(5.0) + 0.25
+        a = ht.array(an, split=0)
+        assert a._buffer_donatable()
+        ht.modf(a)  # lambda-wrapped jnp.modf -> eager fallback
+        assert a._buffer_donatable(), "fallback left a stale capture mark"
+
+    def test_astype_copy_is_a_real_copy_same_dtype(self):
+        """Same-dtype astype(copy=True) must not alias the source buffer
+        (a donating resplit_ of either array would invalidate the other)."""
+        a = ht.array(np.arange(6.0, dtype=np.float32).reshape(3, 2), split=0)
+        b = a.astype(ht.float32)  # same dtype: jax cast is a no-op
+        assert b.larray is not a.larray
+        a.resplit_(1)  # donation-capable backends delete a's old buffer
+        np.testing.assert_array_equal(
+            b.numpy(), np.arange(6.0, dtype=np.float32).reshape(3, 2)
+        )

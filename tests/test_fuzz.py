@@ -15,19 +15,16 @@ import jax
 
 import heat_tpu as ht
 
-# Upstream jaxlib bug, NOT a framework bug: eager sharded f64 elementwise
-# ops on a 3-device virtual CPU mesh corrupt the glibc heap ("corrupted
-# size vs. prev_size"; SIGABRT detonates at an arbitrary later
-# allocation). Reproduced WITHOUT heat_tpu — see
-# artifacts/xla_cpu_f64_3dev_heap_corruption.py (f32@3dev, f64@5dev, and
-# the full 2/8-device suites are all clean). This module's sweeps are
-# f64, so they skip at exactly that configuration; every other mesh size
-# runs them in full.
-if jax.default_backend() == "cpu" and ht.get_comm().size == 3:
-    pytestmark = pytest.mark.skip(
-        reason="upstream XLA-CPU f64 heap corruption at exactly 3 virtual "
-        "devices — artifacts/xla_cpu_f64_3dev_heap_corruption.py"
-    )
+# UNFENCED 2026-08 (ISSUE 4 hygiene retest): the upstream XLA-CPU glibc
+# heap corruption from eager sharded f64 elementwise ops on a 3-device
+# virtual mesh ("corrupted size vs. prev_size", SIGABRT at an arbitrary
+# later allocation) no longer reproduces on the installed jaxlib 0.4.36 —
+# artifacts/xla_cpu_f64_3dev_heap_corruption.py ran CLEAN 5/5 times and
+# the full f64 sweep passes at 3 devices, so the module-level skip that
+# previously fenced (cpu, 3 devices) is removed. The repro script stays
+# committed (its docstring records both findings), and scripts/run_ci.sh
+# keeps its once-per-chunk SIGABRT retry at odd mesh sizes as the
+# backstop if a future jaxlib regresses.
 
 # (name, numpy oracle, domain) — domain picks the input sampler:
 # "real" = standard normal, "pos" = |x|+0.1, "unit" = open (-1, 1)
