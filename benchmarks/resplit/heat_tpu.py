@@ -20,7 +20,11 @@ from benchmarks._harness import load_or_make, run
 
 
 def add_args(p):
-    pass
+    p.add_argument("--digest", action="store_true",
+                   help="print one {'result_sha256': ...} JSON line — the "
+                        "bit-exactness oracle the chaos CI step compares "
+                        "between a fault-free and a fault-injected run "
+                        "(scripts/run_ci.sh)")
 
 
 def build(ht, args):
@@ -31,7 +35,18 @@ def fit_factory(ht, args, data):
     def fit():
         return data.resplit(1).resplit(0)
 
+    printed = []
+
     def sync(out):
+        if args.digest and not printed:
+            import hashlib
+            import json
+
+            import numpy as np
+
+            h = hashlib.sha256(np.ascontiguousarray(out.numpy()).tobytes())
+            print(json.dumps({"result_sha256": h.hexdigest()}), flush=True)
+            printed.append(1)
         return float(out.larray[0, 0])
 
     return fit, sync

@@ -25,6 +25,12 @@ _compat.install()
 # environment turns recording on here (docs/OBSERVABILITY.md)
 from . import telemetry
 
+# resilience second: program_cache wraps every dispatch through it, so it
+# must exist before core loads; HEAT_TPU_FAULTS / HEAT_TPU_RETRIES /
+# HEAT_TPU_HBM_BUDGET arm it here (docs/RESILIENCE.md). Core-facing pieces
+# (checkpoint) import core lazily to keep the load order acyclic.
+from . import resilience
+
 from .core import *
 from . import core
 from .core import linalg, program_cache, random, version

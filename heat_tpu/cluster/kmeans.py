@@ -45,6 +45,42 @@ def _lloyd_step(xb: jax.Array, w: jax.Array, centers: jax.Array):
 
 
 @partial(jax.jit, static_argnames=("max_iter",))
+def _lloyd_fit_carry(
+    xb: jax.Array, w: jax.Array, centers: jax.Array, shift0, max_iter: int, tol
+):
+    """A resumable window of Lloyd iterations: same body as
+    :func:`_lloyd_fit`, but the convergence carry (``shift``) enters and
+    leaves the program, so the checkpoint driver can run the fit as exact
+    chunks — ``k`` windows of ``checkpoint_every`` iterations apply the
+    identical per-iteration math as one uninterrupted ``while_loop``
+    (the resume-equivalence oracle in tests/test_resilience.py)."""
+
+    def cond(carry):
+        _, it, shift = carry
+        return jnp.logical_and(it < max_iter, shift > tol)
+
+    def body(carry):
+        c, it, _ = carry
+        new_c, _, _, shift = _lloyd_step.__wrapped__(xb, w, c)
+        return new_c, it + 1, shift
+
+    centers, n_iter, shift = jax.lax.while_loop(
+        cond, body, (centers, jnp.int32(0), shift0)
+    )
+    return centers, n_iter, shift
+
+
+@jax.jit
+def _lloyd_final(xb: jax.Array, w: jax.Array, centers: jax.Array):
+    """Final assignment + inertia for converged centers — the tail of
+    :func:`_lloyd_fit`, shared by the checkpointed driver."""
+    d2 = _d2(xb, centers)
+    labels = jnp.argmin(d2, axis=1)
+    inertia = jnp.sum(jnp.min(d2, axis=1) * w)
+    return labels, inertia
+
+
+@partial(jax.jit, static_argnames=("max_iter",))
 def _lloyd_fit(xb: jax.Array, w: jax.Array, centers: jax.Array, max_iter: int, tol):
     """The whole Lloyd loop as one on-device `lax.while_loop` — the reference
     drives iterations from Python with a per-iteration convergence fetch
@@ -81,6 +117,19 @@ class KMeans(_KCluster):
     tol : float
         Convergence threshold on the squared centroid shift.
     random_state : int, optional
+    checkpoint_every : int, optional
+        Opt-in resilience hook (ISSUE 5): checkpoint the fit state every
+        this many Lloyd iterations via
+        :func:`heat_tpu.resilience.save_checkpoint` — the fit then runs as
+        exact iteration windows, so a killed run resumes at the last
+        completed window with bit-identical results to an uninterrupted
+        fit. Requires ``checkpoint_path``.
+    checkpoint_path : str, optional
+        Checkpoint directory (atomically swapped on every save).
+    resume : bool
+        Load ``checkpoint_path`` (when it exists and is a kmeans
+        checkpoint) and continue from its iteration count instead of the
+        initial centers.
     """
 
     def __init__(
@@ -90,8 +139,25 @@ class KMeans(_KCluster):
         max_iter: int = 300,
         tol: float = 1e-4,
         random_state: Optional[int] = None,
+        checkpoint_every: Optional[int] = None,
+        checkpoint_path: Optional[str] = None,
+        resume: bool = False,
     ):
         super().__init__("euclidean", n_clusters, init, max_iter, tol, random_state)
+        if checkpoint_every is not None:
+            if checkpoint_every <= 0:
+                raise ValueError(
+                    f"checkpoint_every must be positive, got {checkpoint_every}"
+                )
+            if not checkpoint_path:
+                raise ValueError("checkpoint_every requires checkpoint_path")
+        elif resume:
+            # resume only works through the windowed driver — ignoring the
+            # flag would silently redo every completed iteration
+            raise ValueError("resume=True requires checkpoint_every")
+        self.checkpoint_every = checkpoint_every
+        self.checkpoint_path = checkpoint_path
+        self.resume = resume
 
     def fit(self, x: DNDarray) -> "KMeans":
         """Run Lloyd iterations to convergence (reference kmeans.py:102)."""
@@ -101,6 +167,24 @@ class KMeans(_KCluster):
             raise ValueError("input needs to be 2D")
 
         dt, xb, w, centers = self._fit_buffers(x)
+
+        if self.checkpoint_every is not None:
+            # checkpointed fit: exact iteration windows (the pallas path is
+            # a whole-fit program with no resumable carry, so the windowed
+            # XLA driver serves this mode on every backend)
+            centers, labels, inertia, n_iter = self._fit_checkpointed(
+                xb, w, centers
+            )
+            self._cluster_centers = DNDarray.from_logical(
+                centers, None, x.device, x.comm, dt
+            )
+            self._labels = DNDarray(
+                labels.astype(jnp.int64), (x.shape[0],), types.int64,
+                x.split, x.device, x.comm, True,
+            )
+            self._inertia = float(inertia)
+            self._n_iter = n_iter
+            return self
 
         from .pallas_lloyd import (
             lloyd_fit_pallas,
@@ -147,3 +231,45 @@ class KMeans(_KCluster):
         self._inertia = float(inertia)
         self._n_iter = n_iter
         return self
+
+    def _fit_checkpointed(self, xb, w, centers):
+        """Drive Lloyd iterations in windows of ``checkpoint_every``,
+        checkpointing (centers, iteration count, convergence carry) after
+        each window. The carried ``shift`` makes the chunking exact: the
+        sequence of per-iteration updates is identical to one uninterrupted
+        :func:`_lloyd_fit` run, and a resumed fit continues it bit-for-bit
+        (``shift`` round-trips through the manifest as a python float —
+        exact for f32/f64 values)."""
+        import os
+
+        import numpy as np
+
+        from .. import resilience
+
+        path = self.checkpoint_path
+        every = int(self.checkpoint_every)
+        tol = jnp.asarray(self.tol, xb.dtype)
+        it_done = 0
+        shift = jnp.asarray(jnp.inf, xb.dtype)
+        if self.resume and resilience.checkpoint.exists(path):
+            leaves, extra = resilience.load_checkpoint(path, with_extra=True)
+            if extra.get("algo") != "kmeans" or len(leaves) != 1:
+                raise resilience.CheckpointError(
+                    f"{path!r} is a {extra.get('algo')!r} checkpoint, not kmeans"
+                )
+            centers = jnp.asarray(leaves[0], dtype=xb.dtype)
+            it_done = int(extra["n_iter"])
+            shift = jnp.asarray(extra["shift"], xb.dtype)
+        while it_done < self.max_iter and bool(shift > tol):
+            window = min(every, self.max_iter - it_done)
+            centers, n_it, shift = _lloyd_fit_carry(
+                xb, w, centers, shift, window, tol
+            )
+            it_done += int(n_it)
+            resilience.save_checkpoint(
+                [np.asarray(centers)], path,
+                extra={"algo": "kmeans", "n_iter": it_done,
+                       "shift": float(shift)},
+            )
+        labels, inertia = _lloyd_final(xb, w, centers)
+        return centers, labels, inertia, it_done

@@ -37,7 +37,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from .devices import Device, get_device
-from .. import telemetry
+from .. import resilience, telemetry
 
 __all__ = [
     "Communication",
@@ -262,40 +262,52 @@ class MeshCommunication(Communication):
     # and re-emits on every call, so trace-event counts are per-trace,
     # not per-program.
 
+    def _coll(self, name: str, fn, *args, **kwargs):
+        """One collective wrapper body: with the resilience subsystem armed
+        (ISSUE 5), the lax call runs under the fault injector + transient-
+        retry guard at site ``collective.<name>`` — the wrappers execute
+        while a program is being *traced*, so a retried transient simply
+        re-issues the lax op into the same trace (nothing recompiles).
+        Disarmed, the cost is one flag check."""
+        if resilience.armed():
+            return resilience.guarded_call(f"collective.{name}", fn, args, kwargs)
+        return fn(*args, **kwargs)
+
     def psum(self, x):
         telemetry.trace_event("psum", axis=self.__axis)
-        return jax.lax.psum(x, self.__axis)
+        return self._coll("psum", jax.lax.psum, x, self.__axis)
 
     def pmax(self, x):
         telemetry.trace_event("pmax", axis=self.__axis)
-        return jax.lax.pmax(x, self.__axis)
+        return self._coll("pmax", jax.lax.pmax, x, self.__axis)
 
     def pmin(self, x):
         telemetry.trace_event("pmin", axis=self.__axis)
-        return jax.lax.pmin(x, self.__axis)
+        return self._coll("pmin", jax.lax.pmin, x, self.__axis)
 
     def axis_index(self):
         return jax.lax.axis_index(self.__axis)
 
     def all_gather(self, x, tiled: bool = True):
         telemetry.trace_event("all_gather", axis=self.__axis)
-        return jax.lax.all_gather(x, self.__axis, tiled=tiled)
+        return self._coll("all_gather", jax.lax.all_gather, x, self.__axis, tiled=tiled)
 
     def ppermute(self, x, perm):
         telemetry.trace_event("ppermute", axis=self.__axis)
-        return jax.lax.ppermute(x, self.__axis, perm=perm)
+        return self._coll("ppermute", jax.lax.ppermute, x, self.__axis, perm=perm)
 
     def ring_permute(self, x, shift: int = 1):
         """Circulate shards around the ring: position i sends to i+shift."""
         n = self.size
         perm = [(i, (i + shift) % n) for i in range(n)]
         telemetry.trace_event("ppermute", axis=self.__axis, ring_shift=shift)
-        return jax.lax.ppermute(x, self.__axis, perm=perm)
+        return self._coll("ppermute", jax.lax.ppermute, x, self.__axis, perm=perm)
 
     def all_to_all(self, x, split_axis: int, concat_axis: int):
         telemetry.trace_event("all_to_all", axis=self.__axis)
-        return jax.lax.all_to_all(
-            x, self.__axis, split_axis=split_axis, concat_axis=concat_axis, tiled=True
+        return self._coll(
+            "all_to_all", jax.lax.all_to_all, x, self.__axis,
+            split_axis=split_axis, concat_axis=concat_axis, tiled=True,
         )
 
 

@@ -83,12 +83,21 @@ __all__ = [
     "active",
     "depth_cap",
     "node_cap",
+    "set_pressure_cap",
+    "pressure_cap",
     "stats",
     "reset_stats",
     "DEFAULT_DEPTH",
 ]
 
 DEFAULT_DEPTH = 16
+
+# Memory-pressure override (resilience/memory_guard.py, ISSUE 5): when the
+# pre-flight HBM budget predicts an overflow, the guard drops this to 1 so
+# pending elementwise DAGs flush in minimal windows instead of accumulating
+# wide programs with large temporaries; cleared again once a later
+# preflight sees comfortable headroom. None = no pressure.
+_PRESSURE_CAP: Optional[int] = None
 
 # kwarg values that may be folded into a program key (static config)
 _STATIC_KW = (int, float, bool, str, bytes, type(None))
@@ -120,16 +129,34 @@ def active() -> bool:
 
 
 def depth_cap() -> int:
-    """Max chain depth before a forced flush (``HEAT_TPU_FUSION_DEPTH``)."""
+    """Max chain depth before a forced flush (``HEAT_TPU_FUSION_DEPTH``;
+    clamped down by the memory guard's pressure cap while the HBM budget
+    predicts overflow — see :func:`set_pressure_cap`)."""
+    cap = DEFAULT_DEPTH
     raw = os.environ.get("HEAT_TPU_FUSION_DEPTH", "").strip()
     if raw:
         try:
             n = int(raw)
             if n > 0:
-                return n
+                cap = n
         except ValueError:
             pass
-    return DEFAULT_DEPTH
+    if _PRESSURE_CAP is not None:
+        cap = min(cap, _PRESSURE_CAP)
+    return cap
+
+
+def set_pressure_cap(cap: Optional[int]) -> None:
+    """Install (or with None clear) the memory-pressure window cap — the
+    degradation lever the resilience memory guard pulls before failing a
+    dispatch (resilience/memory_guard.py)."""
+    global _PRESSURE_CAP
+    _PRESSURE_CAP = int(cap) if cap is not None else None
+
+
+def pressure_cap() -> Optional[int]:
+    """The active memory-pressure cap, or None."""
+    return _PRESSURE_CAP
 
 
 def node_cap() -> int:

@@ -145,6 +145,26 @@ class _NcWrite:
         return False
 
 
+def _atomic_write(path: str, write_fn) -> None:
+    """Partial-write hardening (ISSUE 5 satellite): run ``write_fn(tmp)``
+    against a sibling temp path, then atomically rename over ``path`` —
+    a crash or exception mid-write leaves the previous file intact and no
+    temp debris behind. Single-writer paths only (the multi-host slab
+    rings modify one shared file in place and keep their own barrier
+    protocol)."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        write_fn(tmp)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            if os.path.exists(tmp):
+                os.remove(tmp)
+        except OSError:
+            pass
+        raise
+
+
 def supports_hdf5() -> bool:
     """Whether h5py is available (reference io.py `supports_hdf5`)."""
     return __HDF5
@@ -334,13 +354,17 @@ def save_csv(data: DNDarray, path: str, header_lines: Optional[str] = None, sep:
     from .. import native
 
     host = data.numpy()
-    if host.ndim in (1, 2) and np.issubdtype(host.dtype, np.floating):
-        h2 = host if host.ndim == 2 else host[:, None]
-        with open(path, "w") as f:
-            f.write(header_text())
-        if native.write_csv(path, h2, sep=sep, append=True):
-            return
-    np.savetxt(path, host, delimiter=sep, header=header_lines or "")
+
+    def write(tmp):
+        if host.ndim in (1, 2) and np.issubdtype(host.dtype, np.floating):
+            h2 = host if host.ndim == 2 else host[:, None]
+            with open(tmp, "w") as f:
+                f.write(header_text())
+            if native.write_csv(tmp, h2, sep=sep, append=True):
+                return
+        np.savetxt(tmp, host, delimiter=sep, header=header_lines or "")
+
+    _atomic_write(path, write)
 
 
 def load_npy(path: str, dtype=None, split=None, device=None, comm=None) -> DNDarray:
@@ -350,7 +374,20 @@ def load_npy(path: str, dtype=None, split=None, device=None, comm=None) -> DNDar
     ONLY its canonical slab's pages — per-process slab reads for free."""
     import jax
 
-    data = np.load(path, mmap_mode="r")
+    try:
+        data = np.load(path, mmap_mode="r", allow_pickle=False)
+    except (ValueError, OSError, EOFError) as e:
+        # truncated file, non-.npy content, pickled object arrays — surface
+        # one clear error instead of a raw numpy traceback (ISSUE 5
+        # satellite)
+        raise ValueError(
+            f"load_npy: {path!r} is not a readable .npy array file ({e})"
+        ) from None
+    if data.dtype == object or data.dtype.hasobject:
+        raise ValueError(
+            f"load_npy: {path!r} holds dtype=object data, which has no "
+            "DNDarray representation — save numeric arrays only"
+        )
     if jax.process_count() > 1 and split is not None:
         c = sanitize_comm(comm)
         split_s = sanitize_axis(data.shape, split)
@@ -389,7 +426,13 @@ def save_npy(data: DNDarray, path: str) -> None:
 
         _serialized_slab_write(write, "npy")
         return
-    np.save(path, data.numpy())
+    # open() the temp handle ourselves: np.save(path_without_suffix)
+    # would append ".npy" to the temp name and the rename would miss it
+    def _write_npy(tmp):
+        with open(tmp, "wb") as f:
+            np.save(f, data.numpy())
+
+    _atomic_write(path, _write_npy)
 
 
 def _process_slab(comm, n: int):
@@ -557,6 +600,16 @@ def save_hdf5(data: DNDarray, path: str, dataset: str, mode: str = "w", **kwargs
 
         _serialized_slab_write(write0, f"h5r:{dataset}")
         return
+    if mode == "w":
+        # fresh-file writes go through the atomic temp+rename protocol;
+        # append/modify modes ("a"/"r+") edit an existing file in place and
+        # cannot be made atomic without copying it wholesale
+        def write(tmp):
+            with h5py.File(tmp, "w") as handle:
+                handle.create_dataset(dataset, data=data.numpy(), **kwargs)
+
+        _atomic_write(path, write)
+        return
     with h5py.File(path, mode) as handle:
         handle.create_dataset(dataset, data=data.numpy(), **kwargs)
 
@@ -637,11 +690,20 @@ def save_netcdf(data: DNDarray, path: str, variable: str, mode: str = "w", **kwa
 
 
 def save_netcdf_local(data: DNDarray, path: str, variable: str, mode: str = "w", **kwargs):
-    """Single-writer NetCDF save (the local body of :func:`save_netcdf`)."""
-    with _NcWrite(path, mode) as handle:
-        np_data = data.numpy()
-        var = handle.create(variable, np_data.dtype, np_data.shape)
-        var[:] = np_data
+    """Single-writer NetCDF save (the local body of :func:`save_netcdf`).
+    Fresh-file writes (``mode="w"``) are atomic (temp + rename); modify
+    modes edit in place."""
+    np_data = data.numpy()
+
+    def write(target):
+        with _NcWrite(target, mode) as handle:
+            var = handle.create(variable, np_data.dtype, np_data.shape)
+            var[:] = np_data
+
+    if mode == "w":
+        _atomic_write(path, write)
+    else:
+        write(path)
 
 
 if __HDF5:

@@ -51,13 +51,78 @@ def _cg_kernel(a: "jnp.ndarray", b: "jnp.ndarray", x0: "jnp.ndarray", n: int):
     return x
 
 
-def cg(A: DNDarray, b: DNDarray, x0: DNDarray, out: Optional[DNDarray] = None) -> DNDarray:
+def _cg_init_kernel(a: "jnp.ndarray", b: "jnp.ndarray", x0: "jnp.ndarray", n: int):
+    """Initial CG carry ``(x, r, p, rsold, it)`` — the pre-loop segment of
+    :func:`_cg_kernel`, split out so the checkpointed driver can resume the
+    iteration mid-solve (resilience hooks, ISSUE 5)."""
+    r0 = b - (a @ x0)[:n]
+    rs0 = jnp.dot(r0, r0)
+    return x0, r0, r0, rs0, jnp.asarray(0, dtype=jnp.int32)
+
+
+def _cg_chunk_kernel(
+    a: "jnp.ndarray",
+    x: "jnp.ndarray",
+    r: "jnp.ndarray",
+    p: "jnp.ndarray",
+    rsold: "jnp.ndarray",
+    it: "jnp.ndarray",
+    n: int,
+    k: int,
+):
+    """Up to ``k`` more CG iterations from an arbitrary carry — the loop
+    body is byte-identical to :func:`_cg_kernel`'s, so a chunked run (and
+    hence a checkpoint/resume cycle) applies the exact same per-iteration
+    math as one uninterrupted solve."""
+    import jax.lax as lax
+
+    def matvec(v):
+        return (a @ v)[:n]
+
+    tol2 = jnp.asarray(1e-20, dtype=a.dtype)
+    lim = jnp.minimum(it + k, n)
+
+    def cond(carry):
+        _x, _r, _p, rsold, it = carry
+        return (it < lim) & (rsold >= tol2)
+
+    def body(carry):
+        x, r, p, rsold, it = carry
+        Ap = matvec(p)
+        alpha = rsold / jnp.dot(p, Ap)
+        x = x + alpha * p
+        r = r - alpha * Ap
+        rsnew = jnp.dot(r, r)
+        p = r + (rsnew / rsold) * p
+        return x, r, p, rsnew, it + 1
+
+    return lax.while_loop(cond, body, (x, r, p, rsold, it))
+
+
+def cg(
+    A: DNDarray,
+    b: DNDarray,
+    x0: DNDarray,
+    out: Optional[DNDarray] = None,
+    *,
+    checkpoint_every: Optional[int] = None,
+    checkpoint_path: Optional[str] = None,
+    resume: bool = False,
+) -> DNDarray:
     """Conjugate gradients for s.p.d. ``A x = b`` (reference solver.py:13).
 
     The entire solve — matvecs, vector updates, and the residual-norm
     convergence check — runs as one jitted `lax.while_loop` dispatch, the
     same treatment `lanczos` gets below; A stays sharded (split=0 matvecs
-    partition over the mesh) and no scalar reaches the host mid-solve."""
+    partition over the mesh) and no scalar reaches the host mid-solve.
+
+    ``checkpoint_every=k`` (resilience hook, ISSUE 5) instead drives the
+    solve as exact ``k``-iteration windows, checkpointing the CG carry
+    ``(x, r, p, rsold, it)`` to ``checkpoint_path`` after each window via
+    :func:`heat_tpu.resilience.save_checkpoint`; ``resume=True`` continues
+    a killed solve from the last completed window with bit-identical
+    results to an uninterrupted run (the window kernel's body is the same
+    per-iteration math)."""
     if (
         not isinstance(A, DNDarray)
         or not isinstance(b, DNDarray)
@@ -75,7 +140,8 @@ def cg(A: DNDarray, b: DNDarray, x0: DNDarray, out: Optional[DNDarray] = None) -
     dt = types.promote_types(
         types.promote_types(A.dtype, b.dtype), types.promote_types(x0.dtype, types.float32)
     )
-    if A.split == 0 and A.comm.size > 1:
+    sharded = A.split == 0 and A.comm.size > 1
+    if sharded:
         # keep A sharded: the matvec partitions over the mesh (pad rows are
         # zeroed and sliced off inside the kernel) — A never replicates
         a_log = A._masked(0).astype(dt.jnp_type())
@@ -86,7 +152,21 @@ def cg(A: DNDarray, b: DNDarray, x0: DNDarray, out: Optional[DNDarray] = None) -
     b_log = b._replicated().astype(dt.jnp_type())
     x0_log = x0._replicated().astype(dt.jnp_type())
 
-    x_log = kernel_jit(a_log, b_log, x0_log, n)
+    if checkpoint_every is not None:
+        if checkpoint_every <= 0:
+            raise ValueError(
+                f"checkpoint_every must be positive, got {checkpoint_every}"
+            )
+        if not checkpoint_path:
+            raise ValueError("checkpoint_every requires checkpoint_path")
+        x_log = _cg_checkpointed(
+            A.comm if sharded else None, a_log, b_log, x0_log, n,
+            int(checkpoint_every), checkpoint_path, resume,
+        )
+    elif resume:
+        raise ValueError("resume=True requires checkpoint_every")
+    else:
+        x_log = kernel_jit(a_log, b_log, x0_log, n)
     if not bool(jnp.all(jnp.isfinite(x_log))):
         # breakdown (p^T A p = 0 ⇒ alpha = inf inside the kernel) exits the
         # while_loop via the NaN residual; surface it loudly — the solve is
@@ -163,6 +243,78 @@ def _lanczos_kernel(a: "jnp.ndarray", v0: "jnp.ndarray", m: int, n: int):
     return Vb.T, alphas, betas
 
 
+def _lanczos_init_kernel(a: "jnp.ndarray", v0: "jnp.ndarray", m: int, n: int):
+    """Initial Lanczos carry ``(Vb, alphas, betas, w)`` — the pre-loop
+    segment of :func:`_lanczos_kernel`, split out for the checkpointed
+    driver (resilience hooks, ISSUE 5)."""
+
+    def norm(x):
+        return jnp.sqrt(jnp.sum(x * x))
+
+    def matvec(x):
+        return (a @ x)[:n]
+
+    v = v0 / norm(v0)
+    Vb = jnp.zeros((m, n), dtype=a.dtype).at[0].set(v)
+    alphas = jnp.zeros((m,), dtype=a.dtype)
+    betas = jnp.zeros((m,), dtype=a.dtype)
+    w = matvec(v)
+    alpha = jnp.dot(w, v)
+    w = w - alpha * v
+    alphas = alphas.at[0].set(alpha)
+    return Vb, alphas, betas, w
+
+
+def _lanczos_chunk_kernel(
+    a: "jnp.ndarray",
+    Vb: "jnp.ndarray",
+    alphas: "jnp.ndarray",
+    betas: "jnp.ndarray",
+    w: "jnp.ndarray",
+    i0: "jnp.ndarray",
+    m: int,
+    n: int,
+    k: int,
+):
+    """Krylov steps ``[i0, min(i0+k, m))`` from an arbitrary carry. The
+    body is byte-identical to :func:`_lanczos_kernel`'s — deterministic in
+    the step index ``i`` (the breakdown restart folds ``i`` into a fixed
+    key), so chunked execution reproduces the uninterrupted iteration
+    exactly."""
+    import jax
+    import jax.lax as lax
+
+    def norm(x):
+        return jnp.sqrt(jnp.sum(x * x))
+
+    def matvec(x):
+        return (a @ x)[:n]
+
+    key = jax.random.PRNGKey(0)
+    eps = 1e-13 if a.dtype == jnp.float64 else 1e-6
+
+    def body(i, carry):
+        Vb, alphas, betas, w = carry
+        beta = norm(w)
+        ok = beta > eps
+        restart = jax.random.normal(jax.random.fold_in(key, i), (n,), dtype=a.dtype)
+        v_next = jnp.where(ok, w / jnp.where(ok, beta, 1.0), restart)
+        proj = (Vb @ v_next) * (jnp.arange(m) < i)
+        v_next = v_next - Vb.T @ proj
+        v_next = v_next / norm(v_next)
+        beta_rec = jnp.where(ok, beta, 0.0)
+        Vb = Vb.at[i].set(v_next)
+        betas = betas.at[i].set(beta_rec)
+        w = matvec(v_next)
+        alpha = jnp.dot(w, v_next)
+        w = w - alpha * v_next - beta_rec * Vb[i - 1]
+        alphas = alphas.at[i].set(alpha)
+        return Vb, alphas, betas, w
+
+    lim = jnp.minimum(i0 + k, m)
+    return lax.fori_loop(i0, lim, body, (Vb, alphas, betas, w))
+
+
 from .. import program_cache
 
 
@@ -181,6 +333,75 @@ def _cg_jit_for(comm):
         "cg", "replicated", lambda: _cg_kernel, comm=comm,
         out_shardings=comm.replicated(), static_argnums=(3,),
     )
+
+
+def _cg_chunk_jits(comm):
+    """(init, chunk) cached programs for the checkpointed CG driver —
+    ``comm=None`` for replicated operands, else replicated out_shardings
+    over the sharded-matvec mesh (same guard as :func:`_cg_jit_for`)."""
+    if comm is None:
+        init = program_cache.cached_program(
+            "cg_init", "plain", lambda: _cg_init_kernel, static_argnums=(3,)
+        )
+        chunk = program_cache.cached_program(
+            "cg_chunk", "plain", lambda: _cg_chunk_kernel,
+            static_argnums=(6, 7),
+        )
+    else:
+        rep = comm.replicated()
+        init = program_cache.cached_program(
+            "cg_init", "replicated", lambda: _cg_init_kernel, comm=comm,
+            out_shardings=(rep,) * 5, static_argnums=(3,),
+        )
+        chunk = program_cache.cached_program(
+            "cg_chunk", "replicated", lambda: _cg_chunk_kernel, comm=comm,
+            out_shardings=(rep,) * 5, static_argnums=(6, 7),
+        )
+    return init, chunk
+
+
+def _cg_checkpointed(comm, a_log, b_log, x0_log, n, every, path, resume):
+    """Window-driven CG with checkpoint/resume (see :func:`cg`). Progress
+    is measured by the carried iteration counter, so a window that makes
+    no progress (converged, or iteration budget reached) terminates the
+    loop regardless of host-side tolerance arithmetic."""
+    import os
+
+    import numpy as np
+
+    from ... import resilience
+
+    init_jit, chunk_jit = _cg_chunk_jits(comm)
+    carry = None
+    if resume and resilience.checkpoint.exists(path):
+        leaves, extra = resilience.load_checkpoint(path, with_extra=True)
+        if extra.get("algo") != "cg" or len(leaves) != 3:
+            raise resilience.CheckpointError(
+                f"{path!r} is a {extra.get('algo')!r} checkpoint, not cg"
+            )
+        x, r, p = leaves
+        dt = a_log.dtype
+        carry = (
+            jnp.asarray(x, dt), jnp.asarray(r, dt), jnp.asarray(p, dt),
+            jnp.asarray(extra["rsold"], dt),
+            jnp.asarray(extra["it"], jnp.int32),
+        )
+    if carry is None:
+        carry = init_jit(a_log, b_log, x0_log, n)
+    while True:
+        it_before = int(carry[4])
+        if it_before >= n:
+            break
+        carry = chunk_jit(a_log, *carry[:5], n, every)
+        it_after = int(carry[4])
+        if it_after == it_before:
+            break  # converged (rsold under tolerance) — no progress made
+        x, r, p, rsold, _it = carry
+        resilience.save_checkpoint(
+            [np.asarray(x), np.asarray(r), np.asarray(p)], path,
+            extra={"algo": "cg", "it": it_after, "rsold": float(rsold)},
+        )
+    return carry[0]
 
 
 def _lanczos_jit():
@@ -205,19 +426,95 @@ def _lanczos_jit_for(comm):
     )
 
 
+def _lanczos_chunk_jits(comm):
+    """(init, chunk) cached programs for the checkpointed Lanczos driver
+    (``comm=None`` → replicated operands)."""
+    if comm is None:
+        init = program_cache.cached_program(
+            "lanczos_init", "plain", lambda: _lanczos_init_kernel,
+            static_argnums=(2, 3),
+        )
+        chunk = program_cache.cached_program(
+            "lanczos_chunk", "plain", lambda: _lanczos_chunk_kernel,
+            static_argnums=(6, 7, 8),
+        )
+    else:
+        rep = comm.replicated()
+        init = program_cache.cached_program(
+            "lanczos_init", "replicated", lambda: _lanczos_init_kernel,
+            comm=comm, out_shardings=(rep,) * 4, static_argnums=(2, 3),
+        )
+        chunk = program_cache.cached_program(
+            "lanczos_chunk", "replicated", lambda: _lanczos_chunk_kernel,
+            comm=comm, out_shardings=(rep,) * 4, static_argnums=(6, 7, 8),
+        )
+    return init, chunk
+
+
+def _lanczos_checkpointed(comm, a_log, v, m, n, every, path, resume):
+    """Window-driven Lanczos with checkpoint/resume (see :func:`lanczos`).
+    The trip count is exact (no convergence test), so windows advance by
+    ``every`` steps until ``m``."""
+    import os
+
+    import numpy as np
+
+    from ... import resilience
+
+    init_jit, chunk_jit = _lanczos_chunk_jits(comm)
+    carry = None
+    i = 1
+    if resume and resilience.checkpoint.exists(path):
+        leaves, extra = resilience.load_checkpoint(path, with_extra=True)
+        if extra.get("algo") != "lanczos" or len(leaves) != 4:
+            raise resilience.CheckpointError(
+                f"{path!r} is a {extra.get('algo')!r} checkpoint, not lanczos"
+            )
+        Vb, alphas, betas, w = leaves
+        dt = a_log.dtype
+        carry = (
+            jnp.asarray(Vb, dt), jnp.asarray(alphas, dt),
+            jnp.asarray(betas, dt), jnp.asarray(w, dt),
+        )
+        i = int(extra["i"])
+    if carry is None:
+        carry = init_jit(a_log, v, m, n)
+    while i < m:
+        carry = chunk_jit(
+            a_log, *carry, jnp.asarray(i, jnp.int32), m, n, every
+        )
+        i = min(i + every, m)
+        resilience.save_checkpoint(
+            [np.asarray(x) for x in carry], path,
+            extra={"algo": "lanczos", "i": i},
+        )
+    Vb, alphas, betas, _w = carry
+    return Vb.T, alphas, betas
+
+
 def lanczos(
     A: DNDarray,
     m: int,
     v0: Optional[DNDarray] = None,
     V_out: Optional[DNDarray] = None,
     T_out: Optional[DNDarray] = None,
+    *,
+    checkpoint_every: Optional[int] = None,
+    checkpoint_path: Optional[str] = None,
+    resume: bool = False,
 ) -> Tuple[DNDarray, DNDarray]:
     """Lanczos tridiagonalization with full reorthogonalization (reference
     solver.py:68: Krylov iteration with Gram-Schmidt against all previous
     Lanczos vectors, used by spectral clustering). Returns (V, T) with
     ``V (n×m)`` orthonormal Krylov basis and ``T (m×m)`` tridiagonal.
     The iteration itself runs as one jit dispatch (see `_lanczos_kernel`),
-    in the input's promoted dtype (f64 inputs iterate at f64)."""
+    in the input's promoted dtype (f64 inputs iterate at f64).
+
+    ``checkpoint_every=k`` (resilience hook, ISSUE 5) instead runs the
+    Krylov iteration as exact ``k``-step windows, checkpointing the carry
+    to ``checkpoint_path`` after each; ``resume=True`` continues a killed
+    run from the last completed window — the step body is deterministic in
+    the step index, so the chunked results match the uninterrupted run."""
     if not isinstance(A, DNDarray):
         raise TypeError(f"A needs to be of type ht.DNDarray, but was {type(A)}")
     if A.ndim != 2 or A.shape[0] != A.shape[1]:
@@ -227,7 +524,8 @@ def lanczos(
 
     n = A.shape[0]
     dt = types.promote_types(A.dtype, types.float32)
-    if A.split == 0 and A.comm.size > 1:
+    sharded = A.split == 0 and A.comm.size > 1
+    if sharded:
         # keep A sharded: the matvec partitions over the mesh (pad rows are
         # zeroed and sliced off inside the kernel) — A never replicates
         a_log = A._masked(0).astype(dt.jnp_type())
@@ -244,7 +542,21 @@ def lanczos(
     else:
         v = v0._replicated().astype(dt.jnp_type())
 
-    V_mat, alphas, betas = kernel_jit(a_log, v, m, n)
+    if checkpoint_every is not None:
+        if checkpoint_every <= 0:
+            raise ValueError(
+                f"checkpoint_every must be positive, got {checkpoint_every}"
+            )
+        if not checkpoint_path:
+            raise ValueError("checkpoint_every requires checkpoint_path")
+        V_mat, alphas, betas = _lanczos_checkpointed(
+            A.comm if sharded else None, a_log, v, m, n,
+            int(checkpoint_every), checkpoint_path, resume,
+        )
+    elif resume:
+        raise ValueError("resume=True requires checkpoint_every")
+    else:
+        V_mat, alphas, betas = kernel_jit(a_log, v, m, n)
 
     T_mat = (
         jnp.diag(alphas)

@@ -57,7 +57,7 @@ from typing import Any, Callable, Optional, Sequence, Tuple
 
 import jax
 
-from .. import telemetry
+from .. import resilience, telemetry
 
 __all__ = [
     "cached_program",
@@ -168,6 +168,12 @@ def cached_program(
             fn = jax.jit(build(), **jit_kwargs)
             if donate:
                 fn = _quiet_donation(fn)
+            # resilience dispatch wrapper (ISSUE 5): disarmed it is one
+            # flag check; armed, every execution of this program runs the
+            # fault injector, the HBM preflight, and the transient-retry
+            # guard. Wrapped ONCE here, so the hit path stays a dict
+            # lookup returning the already-wrapped callable.
+            fn = resilience.wrap_program(site, fn, donated=bool(donate))
             maxsize = _maxsize()
             while len(_PROGRAMS) >= maxsize:
                 _PROGRAMS.popitem(last=False)

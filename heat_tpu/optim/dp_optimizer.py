@@ -115,6 +115,17 @@ class DASO:
     downcast_type : jnp dtype
         Wire dtype of the cross-node parameter average (default bfloat16 —
         native on TPU; reference used custom MPI bf16 sum ops :21-43).
+    checkpoint_every : int, optional
+        Opt-in resilience hook (ISSUE 5): every this many :meth:`step`
+        calls, checkpoint (params, opt_state, schedule state) to
+        ``checkpoint_path`` via :func:`heat_tpu.resilience.save_checkpoint`
+        — a killed run resumes with :meth:`load_checkpoint` at the last
+        completed step. In-flight async payloads are deliberately NOT
+        checkpointed: a resumed run simply re-syncs at its next
+        global-skip boundary (the staleness-weighted merge tolerates a
+        dropped payload by construction).
+    checkpoint_path : str, optional
+        Checkpoint directory for the auto-hook (atomically swapped).
     """
 
     def __init__(
@@ -133,7 +144,16 @@ class DASO:
         skip_reduction_factor: int = 2,
         local_skip_factor: int = 4,
         verbose: bool = False,
+        checkpoint_every: Optional[int] = None,
+        checkpoint_path: Optional[str] = None,
     ):
+        if checkpoint_every is not None:
+            if checkpoint_every <= 0:
+                raise ValueError(
+                    f"checkpoint_every must be positive, got {checkpoint_every}"
+                )
+            if not checkpoint_path:
+                raise ValueError("checkpoint_every requires checkpoint_path")
         if scheduler is None and scheduler_base_lr is not None:
             raise ValueError(
                 "scheduler_base_lr given without a scheduler — pass the "
@@ -211,6 +231,9 @@ class DASO:
         self._gs8_waited = 0
         self.amp = False
         self._compiled = {}
+        self.checkpoint_every = checkpoint_every
+        self.checkpoint_path = checkpoint_path
+        self._steps_done = 0
 
     # -- model binding & parameter layout ------------------------------------
 
@@ -361,6 +384,83 @@ class DASO:
         self._compiled["merge"] = compiled
         return compiled
 
+    # -- checkpoint/restore (resilience hooks, ISSUE 5) -----------------------
+
+    def _schedule_state(self) -> dict:
+        return {
+            "epoch": self.epoch,
+            "current_batch": self.current_batch,
+            "last_batch": self.last_batch,
+            "global_skip": self.global_skip,
+            "local_skip": self.local_skip,
+            "batches_to_wait": self.batches_to_wait,
+            "gs8_waited": self._gs8_waited,
+            "steps_done": self._steps_done,
+            "stability": self.stability.get_state(),
+        }
+
+    def _restore_schedule(self, sched: dict) -> None:
+        self.epoch = int(sched["epoch"])
+        self.current_batch = int(sched["current_batch"])
+        if sched.get("last_batch") is not None:
+            self.last_batch = int(sched["last_batch"])
+        self.global_skip = int(sched["global_skip"])
+        self.local_skip = int(sched["local_skip"])
+        self.batches_to_wait = int(sched["batches_to_wait"])
+        self._gs8_waited = int(sched["gs8_waited"])
+        self._steps_done = int(sched.get("steps_done", 0))
+        self.stability.set_state(sched["stability"])
+        # in-flight async payloads are not checkpointed — the next
+        # global-skip boundary re-syncs (see the class docstring note)
+        self._prev_params = []
+
+    def save_checkpoint(self, path: str, params, opt_state) -> str:
+        """Checkpoint the stacked (params, opt_state) trees plus the full
+        DASO schedule state (skips, waits, plateau-detector state) to the
+        directory ``path`` — per-shard blobs, CRC-checked, atomically
+        swapped (:mod:`heat_tpu.resilience.checkpoint`)."""
+        from .. import resilience
+
+        return resilience.save_checkpoint(
+            {"params": params, "opt_state": opt_state}, path,
+            extra={"algo": "daso", "schedule": self._schedule_state()},
+        )
+
+    def load_checkpoint(self, path: str, params, opt_state):
+        """Restore a :meth:`save_checkpoint` directory. ``params`` /
+        ``opt_state`` supply the tree structure (any pytree of matching
+        shape — e.g. the freshly initialized state); leaves come back
+        re-sharded onto this instance's 2-level mesh, and the schedule
+        state machine resumes where it stopped. Returns
+        ``(params, opt_state)``."""
+        from .. import resilience
+
+        tree, extra = resilience.load_checkpoint(
+            path, like={"params": params, "opt_state": opt_state},
+            with_extra=True,
+        )
+        if extra.get("algo") != "daso":
+            raise resilience.CheckpointError(
+                f"{path!r} is a {extra.get('algo')!r} checkpoint, not daso"
+            )
+        sh = NamedSharding(self.mesh, P(("node", "local")))
+
+        def put(x):
+            x = jnp.asarray(x)
+            return jax.device_put(x, sh) if x.ndim > 0 else x
+
+        tree = jax.tree.map(put, tree)
+        self._restore_schedule(extra["schedule"])
+        return tree["params"], tree["opt_state"]
+
+    def _maybe_checkpoint(self, params, opt_state) -> None:
+        self._steps_done += 1
+        if (
+            self.checkpoint_every
+            and self._steps_done % self.checkpoint_every == 0
+        ):
+            self.save_checkpoint(self.checkpoint_path, params, opt_state)
+
     # -- schedule ------------------------------------------------------------
 
     def print0(self, *args, **kwargs) -> None:
@@ -411,6 +511,7 @@ class DASO:
             step_fn = self._get_step(local_sync=True, full_sync=True)
             params, opt_state, loss = step_fn(params, opt_state, batch)
             self._advance(batch_idx)
+            self._maybe_checkpoint(params, opt_state)
             return params, opt_state, loss
 
         step_fn = self._get_step(local_sync=local_sync_now, full_sync=False)
@@ -440,6 +541,7 @@ class DASO:
             params = self._get_merge()(params, payload, numer)
 
         self._advance(batch_idx)
+        self._maybe_checkpoint(params, opt_state)
         return params, opt_state, loss
 
     def _advance(self, batch_idx: int) -> None:

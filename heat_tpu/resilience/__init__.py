@@ -1,0 +1,150 @@
+"""heat_tpu.resilience — fault injection, guarded retry dispatch,
+memory-pressure degradation, and sharded checkpoint/restore (ISSUE 5).
+
+Heat's MPI lineage is fail-stop: any rank error kills the job. A
+production jax_graft deployment must instead survive transient runtime
+faults, memory pressure, and mid-run interruption of long iterative
+algorithms. PRs 1–4 concentrated every program dispatch into ONE
+chokepoint (:func:`heat_tpu.core.program_cache.cached_program`) — this
+package hangs the resilience machinery exactly there, the way JaxPP-style
+multi-controller systems centralize failure handling at dispatch
+(PAPERS.md, arXiv:2412.14374):
+
+* :mod:`.faults` — deterministic, seeded fault injector
+  (``HEAT_TPU_FAULTS=<spec>`` or :func:`inject`): synthetic
+  RESOURCE_EXHAUSTED / connection-reset errors, latency, NaN corruption,
+  per-site and per-call-index, fully reproducible for chaos CI;
+* :mod:`.guard` — :func:`guarded_call` around every cached-program
+  execution and explicit collective: transient-vs-permanent
+  classification, capped exponential backoff + jitter
+  (``HEAT_TPU_RETRIES``, default 0 = off), escalation to
+  :class:`HeatTpuRuntimeError` with site + attempt history + hints;
+* :mod:`.memory_guard` — pre-flight HBM budgeting
+  (``HEAT_TPU_HBM_BUDGET``): live-bytes watermark + compiled-program
+  temp/output bytes vs the budget, with a degradation ladder (fusion
+  window-flush → gc → actionable :class:`HeatTpuMemoryError`);
+* :mod:`.checkpoint` — per-shard ``.npy`` + JSON-manifest
+  checkpoint/restore with CRC32 integrity and atomic directory swap;
+  consumed by the ``checkpoint_every=``/``resume=`` hooks in
+  ``cluster.KMeans``, ``linalg.solver.cg``/``lanczos`` and the DASO
+  optimizer.
+
+Zero-overhead contract: none of this runs until the package is **armed**
+(retries > 0, faults installed, or a budget set). Disarmed, every program
+dispatch pays exactly one module-flag check — the same design as
+telemetry's disabled path. Arming state is computed once per
+:func:`refresh` (import time, plus every programmatic change), never per
+dispatch.
+"""
+
+from __future__ import annotations
+
+import warnings
+
+from . import checkpoint, faults, guard, memory_guard
+from .checkpoint import (
+    CheckpointCorruptError,
+    CheckpointError,
+    load_checkpoint,
+    save_checkpoint,
+)
+from .faults import clear as clear_faults
+from .faults import inject
+from .guard import HeatTpuRuntimeError, guarded_call
+from .memory_guard import HeatTpuMemoryError
+
+__all__ = [
+    "faults",
+    "guard",
+    "memory_guard",
+    "checkpoint",
+    "inject",
+    "clear_faults",
+    "guarded_call",
+    "wrap_program",
+    "armed",
+    "refresh",
+    "stats",
+    "HeatTpuRuntimeError",
+    "HeatTpuMemoryError",
+    "CheckpointError",
+    "CheckpointCorruptError",
+    "save_checkpoint",
+    "load_checkpoint",
+]
+
+# THE dispatch fast-path flag: wrap_program closures branch on this one
+# module global. False ⇒ a guarded site is a plain call through one
+# comparison; True ⇒ dispatch routes through guard/memory_guard.
+_ARMED = False
+
+
+def armed() -> bool:
+    """Whether any resilience feature is active (retries requested, fault
+    rules installed, or an HBM budget set)."""
+    return _ARMED
+
+
+def refresh() -> bool:
+    """Recompute the armed flag from the injector's rule table and the
+    environment (``HEAT_TPU_RETRIES`` / ``HEAT_TPU_HBM_BUDGET``). Called
+    at import and by :func:`inject`/:func:`clear_faults`; call it manually
+    after changing those env vars mid-process (tests)."""
+    global _ARMED
+    _ARMED = (
+        faults.active()
+        or guard.max_retries() > 0
+        or memory_guard.budget_bytes() is not None
+    )
+    return _ARMED
+
+
+def wrap_program(site: str, fn, *, donated: bool = False):
+    """Wrap one compiled-program callable with the resilience dispatch
+    path. Disarmed (the default), the wrapper is one flag check and a
+    tail call; armed, execution runs the memory-guard preflight and the
+    transient-retry guard. ``lower`` is forwarded so the HLO auditor and
+    the memory guard can still AOT-compile the wrapped program.
+
+    This is called ONCE per program-cache registry miss
+    (core/program_cache.py) — the registry stores the wrapped callable, so
+    the hot path pays no per-dispatch wrapping."""
+
+    def call(*args, **kwargs):
+        if not _ARMED:
+            return fn(*args, **kwargs)
+        if not kwargs and memory_guard.budget_bytes() is not None:
+            memory_guard.preflight(site, fn, args)
+        return guard.guarded_call(site, fn, args, kwargs, donated=donated)
+
+    if hasattr(fn, "lower"):
+        call.lower = fn.lower
+    call.__wrapped__ = fn
+    return call
+
+
+def stats() -> dict:
+    """Snapshot of the subsystem state: armed flag, retry config, fault
+    rules/injections, and the HBM budget."""
+    return {
+        "armed": _ARMED,
+        "retries": guard.max_retries(),
+        "faults": faults.stats(),
+        "hbm_budget": memory_guard.budget_bytes(),
+    }
+
+
+# -- environment activation (mirrors HEAT_TPU_TELEMETRY) ----------------------
+# HEAT_TPU_FAULTS=<spec> installs injection rules at `import heat_tpu`;
+# HEAT_TPU_RETRIES / HEAT_TPU_HBM_BUDGET arm their features the same way.
+_spec = faults.env_spec()
+if _spec:
+    try:
+        faults.install_spec(_spec)
+    except ValueError as _e:  # pragma: no cover — bad spec must not kill import
+        warnings.warn(
+            f"heat_tpu.resilience: ignoring malformed HEAT_TPU_FAULTS spec "
+            f"({_e})"
+        )
+del _spec
+refresh()

@@ -1,0 +1,353 @@
+"""Deterministic, seeded fault injector (ISSUE 5 tentpole piece 1).
+
+Heat's MPI lineage was fail-stop: any rank error killed the job, so the
+reference never needed to *test* recovery paths. This port retries
+transient runtime faults (see :mod:`.guard`) — which means CI must be able
+to *produce* those faults on demand, reproducibly, without TPU hardware or
+real HBM pressure. This module is that producer: a rule table consulted at
+the two framework chokepoints every dispatch already routes through —
+:func:`heat_tpu.core.program_cache.cached_program` executions and the
+:class:`~heat_tpu.core.communication.MeshCommunication` collective
+wrappers — raising synthetic transient errors, adding latency, or
+corrupting outputs with NaNs.
+
+Spec grammar (``HEAT_TPU_FAULTS`` env var or :func:`inject`)
+------------------------------------------------------------
+``rule(;rule)*`` where each rule is ``site_pattern(:key=value)*``:
+
+* ``site_pattern`` — :mod:`fnmatch` glob matched against the dispatch site
+  name (``relayout``, ``fusion``, ``collective.psum``, ``cg_chunk`` …).
+* ``kind=resource|reset|latency|nan`` — what to inject (default
+  ``resource``): a RESOURCE_EXHAUSTED-class error, a connection-reset-class
+  error, a ``delay``-second sleep, or NaN corruption of the call's output.
+  ``nan`` applies at program-execution sites only — the ``collective.*``
+  wrappers run at *trace* time, where poisoning the output would bake the
+  corruption into the cached executable forever, so the guard leaves
+  tracer outputs clean (raising kinds work everywhere). Note also that at
+  trace-time sites ``calls=``/``p=`` count *traces*, not executions — a
+  hot cached program re-enters no wrappers.
+* ``calls=1,3`` — inject at these 1-based call indices (counted per
+  (rule, site) pair, so a glob rule fires independently at each site it
+  matches).
+* ``p=0.25`` — inject with this probability per call. The draw is a pure
+  function of ``(seed, site, call index)`` (CRC32-based — *not* python's
+  salted ``hash``), so a fixed seed reproduces the exact same injection
+  schedule in every process: chaos CI failures replay locally.
+* ``seed=7`` — seed for the ``p`` draw (default 0).
+* ``delay=0.05`` — seconds for ``kind=latency`` (default 0.01).
+* ``times=2`` — stop firing after this many injections (per rule, across
+  all sites). Unset = unlimited.
+
+Example::
+
+    HEAT_TPU_FAULTS='relayout:kind=resource:calls=1;collective.*:kind=reset:calls=1'
+
+injects one synthetic HBM OOM at the first relayout dispatch and one
+connection reset at the first call of every collective wrapper site.
+
+Disabled (no rules), the cost at every chokepoint is one module-flag
+check — the same contract as telemetry.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import os
+import threading
+import time
+import zlib
+from typing import Dict, List, Optional
+
+__all__ = [
+    "FaultRule",
+    "InjectedFault",
+    "InjectedResourceExhausted",
+    "InjectedConnectionReset",
+    "inject",
+    "clear",
+    "active",
+    "check",
+    "parse_spec",
+    "stats",
+]
+
+
+class InjectedFault(RuntimeError):
+    """Base class of every synthetic error this module raises. Carries the
+    site and call index for the guard's attempt history; classified as
+    *transient* by :func:`heat_tpu.resilience.guard.classify`."""
+
+    transient = True
+
+    def __init__(self, message: str, site: str = "?", index: int = 0):
+        super().__init__(message)
+        self.site = site
+        self.index = index
+
+
+class InjectedResourceExhausted(InjectedFault):
+    """Synthetic RESOURCE_EXHAUSTED-class fault (the shape of an XLA HBM
+    OOM / allocator failure)."""
+
+
+class InjectedConnectionReset(InjectedFault):
+    """Synthetic connection-reset-class fault (the shape of a DCN/ICI
+    transport hiccup or a coordinator socket drop)."""
+
+
+_KINDS = ("resource", "reset", "latency", "nan")
+
+
+class FaultRule:
+    """One parsed injection rule. Mutable state: per-site call counters and
+    the fired-injection count (both behind the module lock)."""
+
+    __slots__ = ("pattern", "kind", "calls", "p", "seed", "delay", "times",
+                 "counts", "fired")
+
+    def __init__(
+        self,
+        pattern: str,
+        kind: str = "resource",
+        calls: Optional[tuple] = None,
+        p: Optional[float] = None,
+        seed: int = 0,
+        delay: float = 0.01,
+        times: Optional[int] = None,
+    ):
+        if kind not in _KINDS:
+            raise ValueError(
+                f"fault kind must be one of {_KINDS}, got {kind!r}"
+            )
+        if calls is None and p is None:
+            # a rule with neither trigger fires on every call
+            p = 1.0
+        self.pattern = pattern
+        self.kind = kind
+        self.calls = tuple(int(c) for c in calls) if calls else None
+        self.p = float(p) if p is not None else None
+        self.seed = int(seed)
+        self.delay = float(delay)
+        self.times = int(times) if times is not None else None
+        self.counts: Dict[str, int] = {}
+        self.fired = 0
+
+    def matches(self, site: str) -> bool:
+        return fnmatch.fnmatchcase(site, self.pattern)
+
+    def should_fire(self, site: str) -> Optional[int]:
+        """Advance this rule's per-site call counter and decide whether to
+        inject. Returns the 1-based call index when firing, else None.
+        Caller holds the module lock."""
+        index = self.counts.get(site, 0) + 1
+        self.counts[site] = index
+        if self.times is not None and self.fired >= self.times:
+            return None
+        if self.calls is not None and index in self.calls:
+            return index
+        if self.p is not None and _draw(self.seed, site, index) < self.p:
+            return index
+        return None
+
+    def describe(self) -> dict:
+        return {
+            "pattern": self.pattern,
+            "kind": self.kind,
+            "calls": self.calls,
+            "p": self.p,
+            "seed": self.seed,
+            "delay": self.delay,
+            "times": self.times,
+            "fired": self.fired,
+        }
+
+
+def _draw(seed: int, site: str, index: int) -> float:
+    """Deterministic uniform in [0, 1) — a pure function of its inputs.
+    CRC32 instead of ``hash()``: python salts string hashes per process
+    (PYTHONHASHSEED), which would make a "seeded" schedule unreproducible
+    across processes."""
+    h = zlib.crc32(f"{seed}:{site}:{index}".encode())
+    return h / 2**32
+
+
+# One flag + one lock. `_ACTIVE` mirrors bool(_RULES) so the chokepoint
+# fast path is a single module attribute load.
+_LOCK = threading.Lock()
+_RULES: List[FaultRule] = []
+_ACTIVE = False
+_INJECTED: Dict[str, int] = {}
+
+
+def active() -> bool:
+    """Whether any injection rule is installed (the chokepoint fast-path
+    flag)."""
+    return _ACTIVE
+
+
+def parse_spec(spec: str) -> List[FaultRule]:
+    """Parse a ``HEAT_TPU_FAULTS`` spec string into rules (see module
+    docstring for the grammar). Raises ValueError on malformed specs —
+    a chaos configuration that silently parses to nothing would make CI
+    "pass" without testing anything."""
+    rules = []
+    for chunk in spec.split(";"):
+        chunk = chunk.strip()
+        if not chunk:
+            continue
+        parts = chunk.split(":")
+        pattern = parts[0].strip()
+        if not pattern or "=" in pattern:
+            raise ValueError(
+                f"fault rule {chunk!r} must start with a site pattern "
+                "(e.g. 'relayout:kind=resource:calls=1')"
+            )
+        kw: dict = {}
+        for part in parts[1:]:
+            if "=" not in part:
+                raise ValueError(f"malformed fault option {part!r} in {chunk!r}")
+            k, v = part.split("=", 1)
+            k = k.strip()
+            v = v.strip()
+            if k == "kind":
+                kw["kind"] = v
+            elif k == "calls":
+                kw["calls"] = tuple(int(c) for c in v.split(",") if c)
+            elif k == "p":
+                kw["p"] = float(v)
+            elif k == "seed":
+                kw["seed"] = int(v)
+            elif k == "delay":
+                kw["delay"] = float(v)
+            elif k == "times":
+                kw["times"] = int(v)
+            else:
+                raise ValueError(f"unknown fault option {k!r} in {chunk!r}")
+        rules.append(FaultRule(pattern, **kw))
+    return rules
+
+
+def inject(
+    site: str = "*",
+    kind: str = "resource",
+    calls: Optional[tuple] = None,
+    p: Optional[float] = None,
+    seed: int = 0,
+    delay: float = 0.01,
+    times: Optional[int] = None,
+) -> FaultRule:
+    """Install one injection rule programmatically (the API twin of the
+    ``HEAT_TPU_FAULTS`` env spec). Returns the rule (its ``fired`` counter
+    is live). Arms the resilience dispatch wrapper."""
+    rule = FaultRule(site, kind=kind, calls=calls, p=p, seed=seed,
+                     delay=delay, times=times)
+    global _ACTIVE
+    with _LOCK:
+        _RULES.append(rule)
+        _ACTIVE = True
+    from . import refresh
+
+    refresh()
+    return rule
+
+
+def install_spec(spec: str) -> List[FaultRule]:
+    """Parse and install every rule of ``spec`` (used by env activation)."""
+    rules = parse_spec(spec)
+    global _ACTIVE
+    with _LOCK:
+        _RULES.extend(rules)
+        _ACTIVE = bool(_RULES)
+    return rules
+
+
+def clear() -> None:
+    """Remove every rule and zero the injection counters."""
+    global _ACTIVE
+    with _LOCK:
+        _RULES.clear()
+        _INJECTED.clear()
+        _ACTIVE = False
+    from . import refresh
+
+    refresh()
+
+
+def check(site: str) -> Optional[str]:
+    """Consult the rule table for one dispatch at ``site``.
+
+    Raises the synthetic error for ``resource``/``reset`` rules, sleeps for
+    ``latency`` rules, and returns ``"nan"`` when the caller (the guard)
+    should corrupt the call's output. Returns None when nothing fires.
+    Called only when :func:`active` — the disabled path never enters."""
+    directive = None
+    sleep_s = 0.0
+    fire: Optional[tuple] = None  # (rule, index) of the first raising rule
+    with _LOCK:
+        for rule in _RULES:
+            if not rule.matches(site):
+                continue
+            index = rule.should_fire(site)
+            if index is None:
+                continue
+            rule.fired += 1
+            _INJECTED[site] = _INJECTED.get(site, 0) + 1
+            if rule.kind == "latency":
+                sleep_s += rule.delay
+            elif rule.kind == "nan":
+                directive = "nan"
+            elif fire is None:
+                fire = (rule, index)
+    _record(site, fire, sleep_s, directive)
+    if sleep_s:
+        time.sleep(sleep_s)
+    if fire is not None:
+        rule, index = fire
+        if rule.kind == "resource":
+            raise InjectedResourceExhausted(
+                f"RESOURCE_EXHAUSTED: synthetic HBM allocator failure "
+                f"injected at site {site!r} (call {index}, rule "
+                f"{rule.pattern!r})",
+                site=site, index=index,
+            )
+        raise InjectedConnectionReset(
+            f"connection reset by peer: synthetic transport fault injected "
+            f"at site {site!r} (call {index}, rule {rule.pattern!r})",
+            site=site, index=index,
+        )
+    return directive
+
+
+def _record(site: str, fire, sleep_s: float, directive) -> None:
+    if fire is None and not sleep_s and directive is None:
+        return
+    from .. import telemetry
+
+    if not telemetry.enabled():
+        return
+    reg = telemetry.get_registry()
+    reg.add("resilience.faults_injected", 1)
+    kind = (
+        fire[0].kind if fire is not None
+        else ("nan" if directive == "nan" else "latency")
+    )
+    reg.emit("resilience", site, event="inject", fault_kind=kind)
+
+
+def stats() -> dict:
+    """Snapshot: installed rules and per-site injection counts."""
+    with _LOCK:
+        return {
+            "rules": [r.describe() for r in _RULES],
+            "injected": dict(_INJECTED),
+        }
+
+
+# Environment activation happens in heat_tpu/resilience/__init__.py (the
+# package reads HEAT_TPU_FAULTS once at import, mirroring telemetry's
+# HEAT_TPU_TELEMETRY pattern) — this module stays import-order agnostic.
+_ENV_VAR = "HEAT_TPU_FAULTS"
+
+
+def env_spec() -> str:
+    return os.environ.get(_ENV_VAR, "").strip()

@@ -1,0 +1,298 @@
+"""Guarded dispatch: classify → retry → escalate (ISSUE 5 tentpole piece 2).
+
+Every cached-program execution and explicit collective wrapper routes
+through :func:`guarded_call` when the resilience subsystem is armed
+(retries requested, faults injected, or an HBM budget set — see the
+package ``__init__``). The guard:
+
+* asks the fault injector first (so synthetic faults land *before* the
+  program dispatches — a retry re-executes the already-compiled program,
+  never recompiles it; ``tests/test_resilience.py`` pins that with a
+  CompileWatcher oracle);
+* classifies exceptions into **transient** (injector synthetics, XLA
+  ``RESOURCE_EXHAUSTED``, connection-reset-class transport errors,
+  jaxlib runtime aborts) vs **permanent** (everything else — shape errors,
+  user bugs — which propagate unchanged so existing error contracts hold);
+* retries transients up to ``HEAT_TPU_RETRIES`` times with capped
+  exponential backoff plus deterministic jitter
+  (``HEAT_TPU_RETRY_BASE``/``HEAT_TPU_RETRY_CAP`` seconds);
+* escalates an exhausted transient to :class:`HeatTpuRuntimeError`
+  carrying the site, the full attempt history, and remediation hints —
+  and flushes the telemetry sink first, so the counters/events of the
+  dying run are on disk before the exception unwinds.
+
+Telemetry: ``resilience.transient_faults`` / ``resilience.retries`` /
+``resilience.gave_up`` counters plus one instant ``resilience`` event per
+retry/escalation feed :func:`heat_tpu.telemetry.report.summarize` and the
+Chrome trace.
+
+Donation caveat: a program that donated its input buffer can only be
+retried when the failure happened *before* XLA consumed the donation (the
+injector's faults, allocator failures at launch). A mid-execution fault
+after donation surfaces "Array has been deleted" on the retry — classified
+permanent and escalated with a hint naming the donating site.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+import zlib
+from typing import Any, Callable, Dict, List, Optional
+
+from . import faults
+from .. import telemetry
+
+__all__ = [
+    "HeatTpuRuntimeError",
+    "classify",
+    "guarded_call",
+    "max_retries",
+]
+
+DEFAULT_BASE = 0.05  # seconds; first backoff
+DEFAULT_CAP = 2.0    # seconds; backoff ceiling
+
+
+class HeatTpuRuntimeError(RuntimeError):
+    """A framework dispatch failed permanently (transient retries
+    exhausted, or a memory budget could not be satisfied). Carries:
+
+    * ``site`` — the program-cache/collective site that failed;
+    * ``attempts`` — list of ``{"attempt", "error", "classification"}``
+      dicts, one per try;
+    * ``hints`` — actionable remediation strings (also in the message).
+    """
+
+    def __init__(
+        self,
+        message: str,
+        *,
+        site: Optional[str] = None,
+        attempts: Optional[List[dict]] = None,
+        hints: Optional[List[str]] = None,
+    ):
+        self.site = site
+        self.attempts = list(attempts or [])
+        self.hints = list(hints or [])
+        if self.hints:
+            message = message + "\n  remediation: " + "; ".join(self.hints)
+        super().__init__(message)
+
+
+def max_retries() -> int:
+    """``HEAT_TPU_RETRIES`` (default 0 = retries off). Read live — only
+    consulted once the package is armed, so the disabled hot path never
+    touches the environment."""
+    raw = os.environ.get("HEAT_TPU_RETRIES", "").strip()
+    if raw:
+        try:
+            return max(0, int(raw))
+        except ValueError:
+            pass
+    return 0
+
+
+def _backoff_base() -> float:
+    raw = os.environ.get("HEAT_TPU_RETRY_BASE", "").strip()
+    try:
+        return float(raw) if raw else DEFAULT_BASE
+    except ValueError:
+        return DEFAULT_BASE
+
+
+def _backoff_cap() -> float:
+    raw = os.environ.get("HEAT_TPU_RETRY_CAP", "").strip()
+    try:
+        return float(raw) if raw else DEFAULT_CAP
+    except ValueError:
+        return DEFAULT_CAP
+
+
+# Substrings marking an exception message as transient-infrastructure.
+# Lowercase; matched against str(exc).lower(). RESOURCE_EXHAUSTED is the
+# XLA allocator's status code; DEADLINE_EXCEEDED/UNAVAILABLE are the
+# runtime's RPC-layer codes; "aborted" covers jaxlib runtime aborts.
+_TRANSIENT_MARKERS = (
+    "resource_exhausted",
+    "resource exhausted",
+    "out of memory",
+    "connection reset",
+    "connection aborted",
+    "socket closed",
+    "deadline_exceeded",
+    "deadline exceeded",
+    "unavailable",
+    "aborted",
+)
+
+# Messages that look transient but must NOT be retried: a donated (deleted)
+# buffer can never come back, and retrying a shape error is pointless.
+_PERMANENT_MARKERS = (
+    "deleted",
+    "donated",
+)
+
+
+def classify(exc: BaseException) -> str:
+    """``"transient"`` or ``"permanent"`` for one exception instance."""
+    if isinstance(exc, faults.InjectedFault):
+        return "transient"
+    if isinstance(exc, (ConnectionResetError, ConnectionAbortedError)):
+        return "transient"
+    msg = str(exc).lower()
+    if any(m in msg for m in _PERMANENT_MARKERS):
+        return "permanent"
+    # XlaRuntimeError is not importable on every jaxlib; match by name up
+    # the MRO so wrapped/renamed variants still classify
+    names = {c.__name__ for c in type(exc).__mro__}
+    runtime_like = bool(
+        names & {"XlaRuntimeError", "JaxRuntimeError", "RuntimeError", "OSError"}
+    )
+    if runtime_like and any(m in msg for m in _TRANSIENT_MARKERS):
+        return "transient"
+    return "permanent"
+
+
+def _sleep_backoff(site: str, attempt: int) -> None:
+    base = _backoff_base()
+    if base <= 0:
+        return
+    delay = min(_backoff_cap(), base * (2.0 ** attempt))
+    # deterministic jitter in [0.75, 1.25) of the nominal delay — spreads
+    # concurrent retriers without making test runs irreproducible
+    u = zlib.crc32(f"{site}:{attempt}".encode()) / 2**32
+    time.sleep(delay * (0.75 + 0.5 * u))
+
+
+def _hints_for(site: str, last: BaseException, donated: bool) -> List[str]:
+    hints = []
+    msg = str(last).lower()
+    if "resource" in msg or "memory" in msg:
+        hints.append(
+            "reduce operand size or set HEAT_TPU_HBM_BUDGET to pre-flight "
+            "allocations (docs/RESILIENCE.md §budget)"
+        )
+    if donated:
+        hints.append(
+            f"site {site!r} donates its input buffer; a mid-execution fault "
+            "cannot be replayed — re-create the source array and re-dispatch"
+        )
+    hints.append(
+        "raise HEAT_TPU_RETRIES / HEAT_TPU_RETRY_CAP for flakier substrates"
+    )
+    return hints
+
+
+def guarded_call(
+    site: str,
+    fn: Callable,
+    args: tuple = (),
+    kwargs: Optional[Dict[str, Any]] = None,
+    *,
+    donated: bool = False,
+):
+    """Execute ``fn(*args, **kwargs)`` under the fault injector and the
+    transient-retry policy (see module docstring). Returns the call's
+    result; permanent exceptions propagate unchanged; exhausted transients
+    raise :class:`HeatTpuRuntimeError`."""
+    kwargs = kwargs or {}
+    retries = max_retries()
+    attempts: List[dict] = []
+    attempt = 0
+    injector_on = faults.active()
+    while True:
+        try:
+            directive = faults.check(site) if injector_on else None
+            out = fn(*args, **kwargs)
+        except Exception as e:  # noqa: BLE001 — classification decides
+            cls = classify(e)
+            attempts.append(
+                {"attempt": attempt, "error": repr(e), "classification": cls}
+            )
+            if cls != "transient":
+                if attempt == 0:
+                    # first-attempt permanent errors propagate unchanged —
+                    # existing error contracts (shape/type raises) hold
+                    raise
+                # a permanent error *mid-retry* (e.g. a donated buffer
+                # deleted by the failed first execution) escalates with
+                # the full history instead of a context-free raise
+                if telemetry.enabled():
+                    reg = telemetry.get_registry()
+                    reg.add("resilience.gave_up", 1)
+                    reg.emit(
+                        "resilience", site, event="gave_up",
+                        attempts=len(attempts), error=repr(e),
+                    )
+                    telemetry.flush("escalation")
+                raise HeatTpuRuntimeError(
+                    f"retry of site {site!r} hit a permanent error after "
+                    f"{len(attempts) - 1} transient failure(s): {e!r}",
+                    site=site,
+                    attempts=attempts,
+                    hints=_hints_for(site, e, donated),
+                ) from e
+            if telemetry.enabled():
+                reg = telemetry.get_registry()
+                reg.add("resilience.transient_faults", 1)
+            if attempt >= retries:
+                if telemetry.enabled():
+                    reg = telemetry.get_registry()
+                    reg.add("resilience.gave_up", 1)
+                    reg.emit(
+                        "resilience", site, event="gave_up",
+                        attempts=len(attempts), error=repr(e),
+                    )
+                    telemetry.flush("escalation")
+                raise HeatTpuRuntimeError(
+                    f"transient fault at site {site!r} persisted through "
+                    f"{len(attempts)} attempt(s) "
+                    f"(HEAT_TPU_RETRIES={retries}); last error: {e!r}",
+                    site=site,
+                    attempts=attempts,
+                    hints=_hints_for(site, e, donated),
+                ) from e
+            if telemetry.enabled():
+                reg = telemetry.get_registry()
+                reg.add("resilience.retries", 1)
+                reg.emit(
+                    "resilience", site, event="retry", attempt=attempt,
+                    error=repr(e),
+                )
+            _sleep_backoff(site, attempt)
+            attempt += 1
+            continue
+        if directive == "nan":
+            out = _corrupt_nan(out)
+        return out
+
+
+def _corrupt_nan(out):
+    """Poison every inexact array leaf of ``out`` with NaNs — the injected
+    silent-corruption fault used to exercise downstream detection
+    (checkpoint CRC validation, user-level finiteness checks).
+
+    Tracer outputs are left untouched: a collective wrapper runs while a
+    program is being *traced*, and poisoning a tracer would bake the
+    corruption into the cached executable permanently — every later
+    execution (long after ``clear_faults()``) would return NaNs. ``nan``
+    faults therefore apply only at program-execution sites; trace-time
+    sites count the injection but stay clean."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    if any(
+        isinstance(leaf, jax.core.Tracer) for leaf in jax.tree.leaves(out)
+    ):
+        return out
+
+    def poison(x):
+        if hasattr(x, "dtype") and jnp.issubdtype(
+            np.dtype(x.dtype), np.inexact
+        ):
+            return x * jnp.asarray(float("nan"), dtype=x.dtype)
+        return x
+
+    return jax.tree.map(poison, out)

@@ -1,0 +1,208 @@
+"""Pre-flight HBM budgeting + graceful degradation (ISSUE 5 piece 3).
+
+Memory-aware redistribution planning (PAPERS.md, arXiv:2112.01075) frames
+the question this module answers operationally: *before* a compiled
+program dispatches, will the device fit its temporaries and outputs on top
+of what is already live? With ``HEAT_TPU_HBM_BUDGET`` set (bytes, with
+optional K/M/G suffix), every guarded program dispatch runs
+:func:`preflight`:
+
+``predicted = live-bytes watermark + program temp/output bytes``
+
+where live bytes come from :func:`heat_tpu.telemetry.memory.live_bytes`
+(framework-level accounting, every backend) and program bytes from the
+compiled executable's ``memory_analysis()`` (memoized per (program, aval
+signature) — the compile is the same one the first call pays anyway).
+
+On predicted overflow the guard degrades before it fails:
+
+1. **fusion window-flush** — :func:`heat_tpu.core.fusion.set_pressure_cap`
+   drops the deferral depth cap to 1, so pending elementwise DAGs flush in
+   minimal windows instead of accumulating wide programs;
+2. **garbage collection** — drops dead python references pinning device
+   buffers;
+3. re-measure; if the predicted total now fits, dispatch proceeds (the
+   pressure cap stays until a later preflight sees comfortable headroom);
+4. otherwise raise :class:`HeatTpuMemoryError` naming the site, the
+   predicted/live/budget byte counts, and the remediation ladder.
+
+The cdist/manhattan row-blocked kernels additionally consult
+:func:`temp_budget` so their broadcast temporaries are chunked along the
+batch axis to fit the budget (spatial/distance.py).
+
+Unset (the default), the cost is one flag check — the package is not even
+armed, so :func:`preflight` is never called.
+"""
+
+from __future__ import annotations
+
+import gc
+import os
+import re
+from collections import OrderedDict
+from typing import Optional, Tuple
+
+from .guard import HeatTpuRuntimeError
+from .. import telemetry
+
+__all__ = [
+    "HeatTpuMemoryError",
+    "budget_bytes",
+    "preflight",
+    "program_bytes",
+    "temp_budget",
+]
+
+
+class HeatTpuMemoryError(HeatTpuRuntimeError):
+    """Pre-flight HBM budget check predicted an overflow that degradation
+    could not absorb."""
+
+
+_SUFFIX = {"k": 1 << 10, "m": 1 << 20, "g": 1 << 30, "t": 1 << 40}
+_BUDGET_RE = re.compile(r"^(\d+(?:\.\d+)?)\s*([kmgt]?)i?b?$")
+
+# cache the parsed env var: (raw string, parsed bytes)
+_BUDGET_CACHE: Tuple[Optional[str], Optional[int]] = (None, None)
+
+
+def _parse_budget(raw: str) -> Optional[int]:
+    m = _BUDGET_RE.match(raw.strip().lower().replace("_", ""))
+    if not m:
+        return None
+    val = float(m.group(1)) * _SUFFIX.get(m.group(2), 1)
+    return int(val) if val > 0 else None
+
+
+def budget_bytes() -> Optional[int]:
+    """The active HBM budget in bytes (``HEAT_TPU_HBM_BUDGET``), or None.
+    Accepts plain byte counts or K/M/G/T suffixes (``"512M"``, ``"8G"``,
+    ``"8GiB"``). Malformed values disable the guard (None)."""
+    global _BUDGET_CACHE
+    raw = os.environ.get("HEAT_TPU_HBM_BUDGET", "").strip()
+    if not raw:
+        return None
+    cached_raw, cached_val = _BUDGET_CACHE
+    if raw == cached_raw:
+        return cached_val
+    val = _parse_budget(raw)
+    _BUDGET_CACHE = (raw, val)
+    return val
+
+
+# program-bytes memo: (id(fn), aval signature) -> bytes. Bounded LRU — an
+# id() key can only go stale after the program-cache registry evicts the
+# wrapper AND the allocator reuses the address, at which point the worst
+# case is one wrong (but plausible) byte estimate.
+_COST_CACHE: "OrderedDict[tuple, int]" = OrderedDict()
+_COST_CACHE_MAX = 256
+
+
+def _aval_sig(args: tuple) -> tuple:
+    sig = []
+    for a in args:
+        shape = getattr(a, "shape", None)
+        dtype = getattr(a, "dtype", None)
+        if shape is not None and dtype is not None:
+            sig.append((tuple(shape), str(dtype)))
+        else:
+            sig.append(repr(a)[:32])
+    return tuple(sig)
+
+
+def program_bytes(fn, args: tuple) -> int:
+    """Temp + output bytes of the compiled executable for ``fn(*args)``
+    (memoized). 0 when the program cannot be lowered/analyzed — the guard
+    then budgets on live bytes alone rather than blocking dispatch."""
+    key = (id(fn), _aval_sig(args))
+    cached = _COST_CACHE.get(key)
+    if cached is not None:
+        _COST_CACHE.move_to_end(key)
+        return cached
+    b = 0
+    try:
+        compiled = fn.lower(*args).compile()
+        ma = compiled.memory_analysis()
+        b = int(
+            getattr(ma, "temp_size_in_bytes", 0)
+            + getattr(ma, "output_size_in_bytes", 0)
+        )
+    except Exception:
+        b = 0
+    _COST_CACHE[key] = b
+    while len(_COST_CACHE) > _COST_CACHE_MAX:
+        _COST_CACHE.popitem(last=False)
+    return b
+
+
+def _live_total() -> int:
+    try:
+        return int(telemetry.memory.live_bytes()["total"])
+    except Exception:
+        return 0
+
+
+def _set_pressure(on: bool) -> None:
+    from ..core import fusion
+
+    fusion.set_pressure_cap(1 if on else None)
+
+
+def preflight(site: str, fn, args: tuple) -> None:
+    """Budget check before one guarded program dispatch (see module
+    docstring). No-op without a budget; raises
+    :class:`HeatTpuMemoryError` when degradation cannot make the
+    prediction fit."""
+    budget = budget_bytes()
+    if budget is None:
+        return
+    need = program_bytes(fn, args)
+    live = _live_total()
+    if live + need <= budget:
+        # comfortable headroom (< 50% of budget) releases the degraded
+        # fusion window so throughput recovers once pressure subsides
+        if live + need < budget // 2:
+            from ..core import fusion
+
+            if fusion.pressure_cap() is not None:
+                _set_pressure(False)
+        return
+    # --- degradation ladder -------------------------------------------------
+    if telemetry.enabled():
+        reg = telemetry.get_registry()
+        reg.add("resilience.memory_pressure", 1)
+        reg.emit(
+            "resilience", site, event="memory_pressure",
+            live_bytes=live, program_bytes=need, budget=budget,
+        )
+    _set_pressure(True)   # 1. shrink future fusion windows
+    gc.collect()          # 2. drop dead refs pinning device buffers
+    live = _live_total()  # 3. re-measure
+    if live + need <= budget:
+        return
+    if telemetry.enabled():
+        telemetry.flush("memory_escalation")
+    raise HeatTpuMemoryError(
+        f"pre-flight HBM budget exceeded at site {site!r}: live {live:,} B "
+        f"+ program {need:,} B > HEAT_TPU_HBM_BUDGET {budget:,} B "
+        f"(after fusion window-flush and gc)",
+        site=site,
+        hints=[
+            "raise HEAT_TPU_HBM_BUDGET or unset it to disable pre-flight "
+            "budgeting",
+            "shard the operand over more devices (resplit) so per-chip "
+            "live bytes drop",
+            "chunk the workload along the batch axis (cdist/manhattan do "
+            "this automatically under the budget)",
+        ],
+    )
+
+
+def temp_budget(default: int = 1 << 28) -> int:
+    """Byte budget for one kernel's broadcast temporaries — ``default``
+    without an HBM budget, else a quarter of it (floored at 1 MiB). The
+    row-blocked distance kernels size their batch chunks with this."""
+    b = budget_bytes()
+    if b is None:
+        return default
+    return max(1 << 20, min(default, b // 4))

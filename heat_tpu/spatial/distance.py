@@ -53,12 +53,22 @@ def _pairwise_manhattan(x: jax.Array, y: jax.Array) -> jax.Array:
     return jnp.sum(jnp.abs(x[:, None, :] - y[None, :, :]), axis=-1)
 
 
-def _blocked_rows(fn, x: jax.Array, y: jax.Array, budget_bytes: int = 1 << 28) -> jax.Array:
+def _blocked_rows(fn, x: jax.Array, y: jax.Array, budget_bytes: Optional[int] = None) -> jax.Array:
     """Apply a pairwise *broadcast-form* block fn over row blocks of ``x`` so
     the (block, n, k) broadcast temporary stays under ``budget_bytes`` (the
     reference streams blocks rank-to-rank for the same reason,
     distance.py:280-326; single-chip the stream becomes a `lax.map` over row
-    tiles). GEMM-form fns need no blocking — call them directly."""
+    tiles). GEMM-form fns need no blocking — call them directly.
+
+    The default budget is 256 MiB, shrunk by the resilience memory guard
+    when ``HEAT_TPU_HBM_BUDGET`` is set (ISSUE 5 degradation ladder: the
+    batch axis chunks to fit the declared budget instead of overflowing).
+    Resolved at trace time — a program traced under one budget keeps its
+    block size until the avals change."""
+    if budget_bytes is None:
+        from ..resilience import memory_guard
+
+        budget_bytes = memory_guard.temp_budget(1 << 28)
     m, k = x.shape
     n = y.shape[0]
     per_row = max(1, n * k * x.dtype.itemsize)

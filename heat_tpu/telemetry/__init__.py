@@ -58,6 +58,7 @@ __all__ = [
     "enable",
     "disable",
     "enabled",
+    "flush",
     "get_registry",
     "span",
     "trace_event",
@@ -242,6 +243,7 @@ def enable(sink: Union[str, IO[str], None] = None) -> Telemetry:
                 "recording in memory only"
             )
     _install_monitoring_listener()
+    _install_atexit()
     _ENABLED = True
     return reg
 
@@ -252,6 +254,50 @@ def disable() -> None:
     global _ENABLED
     _ENABLED = False
     get_registry().close_sink()
+
+
+# -- crash safety --------------------------------------------------------------
+# Counters and watermarks live only in process memory: a hard abort used to
+# lose them entirely (events stream to the sink per emit, but the aggregate
+# state did not). flush() writes one "final" record carrying the full
+# counter/watermark snapshot; it runs at interpreter exit (atexit, installed
+# by enable()) and on every resilience escalation (guard.py), so the state
+# of a dying run is on disk before the stack unwinds.
+
+_atexit_installed = False
+
+
+def flush(reason: str = "flush") -> Optional[dict]:
+    """Write a ``final`` event carrying the current counter/watermark
+    snapshot to the registry (and hence the JSONL sink, which is flushed
+    per emit). Safe to call repeatedly; no-op when disabled."""
+    if not _ENABLED:
+        return None
+    reg = get_registry()
+    snap = reg.snapshot()
+    return reg.emit(
+        "final", reason,
+        counters=snap["counters"], watermarks=snap["watermarks"],
+    )
+
+
+def _install_atexit() -> None:
+    global _atexit_installed
+    if _atexit_installed:
+        return
+    import atexit
+
+    atexit.register(_atexit_flush)
+    _atexit_installed = True
+
+
+def _atexit_flush() -> None:  # pragma: no cover — exercised via subprocess
+    try:
+        if _ENABLED and get_registry()._sink is not None:
+            flush("atexit")
+        get_registry().close_sink()
+    except Exception:
+        pass
 
 
 # -- span API -----------------------------------------------------------------

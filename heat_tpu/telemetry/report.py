@@ -67,6 +67,7 @@ def summarize(
 
     phases: dict = {}
     pc_retraces: dict = {}
+    res_events: dict = {}
     pc_evictions = 0
     compile_seconds = 0.0
     compile_events = 0
@@ -102,6 +103,9 @@ def summarize(
                 pc_retraces[name] = pc_retraces.get(name, 0) + 1
             elif ev.get("event") == "eviction":
                 pc_evictions += int(ev.get("count", 1) or 1)
+        elif kind == "resilience":
+            what = ev.get("event") or "event"
+            res_events[what] = res_events.get(what, 0) + 1
         elif kind == "hlo_audit":
             hlo_audits += 1
             drift = int(ev.get("drift", 0) or 0)
@@ -163,6 +167,37 @@ def summarize(
             "retraces": pc_retraces,
             "evictions": pc_evictions,
         }
+    # resilience counters (heat_tpu/resilience, ISSUE 5): live summaries
+    # read the registry's aggregate counters (retries/transient_faults/
+    # gave_up/faults_injected/...); offline summaries reconstruct per-event
+    # counts (retry/inject/gave_up/...) from the recorded instant events.
+    # Absent entirely when the subsystem never fired, so fault-free
+    # summaries keep their exact shape (the chaos CI step's zero-overhead
+    # oracle relies on that).
+    if live:
+        from . import get_registry as _get_registry
+
+        res = {
+            k[len("resilience."):]: (int(v) if float(v).is_integer() else v)
+            for k, v in _get_registry().counters.items()
+            if k.startswith("resilience.")
+        }
+        if res:
+            out["resilience"] = res
+    elif res_events:
+        # event name -> live counter name, so offline and live blocks
+        # carry the SAME keys; transient_faults is derived (every caught
+        # transient emitted either a retry or a gave_up event)
+        rename = {
+            "retry": "retries",
+            "inject": "faults_injected",
+            "checkpoint_save": "checkpoints_saved",
+        }
+        res = {rename.get(k, k): v for k, v in res_events.items()}
+        transients = res.get("retries", 0) + res.get("gave_up", 0)
+        if transients:
+            res["transient_faults"] = transients
+        out["resilience"] = res
     if watermarks:
         peak = watermarks.get("live_bytes.total")
         if peak is not None:

@@ -69,7 +69,7 @@ if [ -n "$REPORT" ]; then
     # drop artifacts of previous (possibly aborted or differently-sized)
     # runs so the merge below only sees this sweep's data
     rm -f "$REPORT"/.coverage* "$REPORT"/junit_*.xml "$REPORT"/coverage.txt \
-        "$REPORT"/retried_aborts.log
+        "$REPORT"/resilience_report.log
     if python -c "import coverage" 2>/dev/null; then
         have_coverage=1
     fi
@@ -78,6 +78,18 @@ fi
 CHUNKS=${HEAT_TPU_CI_CHUNKS:-1}
 FAILED_SIZES=""
 RETRIED_ABORTS=""
+
+# Unified resilience report (ISSUE 5): every fault-tolerance event of the
+# sweep — retried SIGABRT chunks, chaos-step verdicts — lands here in one
+# `<utc-ts> kind=<what> key=value...` line format, archived to
+# ${REPORT}/resilience_report.log when a report dir is set.
+log_resilience() {
+    local line="$(date -u +%FT%TZ) $*"
+    echo "$line"
+    if [ -n "$REPORT" ]; then
+        echo "$line" >> "${REPORT}/resilience_report.log"
+    fi
+}
 
 # entries in the persistent compile cache (each "-cache" file is one XLA
 # executable some process had to backend-compile)
@@ -114,7 +126,7 @@ for n in $SIZES; do
         # retry an aborted chunk once, but ONLY in the known flake
         # configuration (odd size): an abort at an even size is a new
         # native crash and must fail loudly, not be masked. Every retry
-        # is recorded (stdout + ${REPORT}/retried_aborts.log) so a
+        # is recorded (stdout + ${REPORT}/resilience_report.log) so a
         # rising abort rate stays visible in the archived artifacts.
         for attempt in 1 2; do
             crc=0
@@ -131,10 +143,7 @@ for n in $SIZES; do
             fi
             [ "$attempt" = 2 ] && break
             RETRIED_ABORTS="$RETRIED_ABORTS size=${n}/chunk=${k}"
-            if [ -n "$REPORT" ]; then
-                echo "$(date -u +%FT%TZ) size=${n} chunk=${k} attempt=${attempt} rc=134 (known XLA CPU heap flake, retried)" \
-                    >> "${REPORT}/retried_aborts.log"
-            fi
+            log_resilience "kind=sigabrt-retry size=${n} chunk=${k} attempt=${attempt} rc=134 note=known-xla-cpu-heap-flake"
             echo "=== chunk ${k} aborted (SIGABRT, known XLA CPU heap flake at odd size ${n}) — retrying once ==="
         done
         # pytest rc 5 = no tests collected in this chunk — not a failure
@@ -334,6 +343,91 @@ EOF
             -q -p no:cacheprovider -k "NumpyParity or FusionOff"; then
         echo "=== fusion-off parity check FAILED ==="
         FAILED_SIZES="$FAILED_SIZES fusion-off"
+    fi
+fi
+
+# Chaos step (ISSUE 5): run the resplit microbenchmark twice — fault-free,
+# then under deterministic fault injection (one synthetic transient per
+# matched site: the relayout dispatch and every collective wrapper) with
+# retries armed. The guarded dispatch must absorb the faults: the run
+# succeeds, its result digest is BIT-IDENTICAL to the fault-free run, the
+# summary records resilience.retries >= 1, and the fault-free run carries
+# no resilience counters at all (the zero-overhead-when-disarmed oracle).
+# HEAT_TPU_CI_SKIP_CHAOS=1 opts out.
+if [ -z "${HEAT_TPU_CI_SKIP_CHAOS:-}" ]; then
+    echo "=== chaos step: resplit microbenchmark under fault injection ==="
+    chaos_rc=0
+    clean_out=$(mktemp); chaos_out=$(mktemp)
+    if env -u HEAT_TPU_FAULTS -u HEAT_TPU_RETRIES HEAT_TPU_TELEMETRY=1 \
+            python benchmarks/resplit/heat_tpu.py \
+            --n 2048 --features 32 --trials 1 --mesh 4 --digest > "$clean_out" \
+       && HEAT_TPU_TELEMETRY=1 HEAT_TPU_RETRIES=3 HEAT_TPU_RETRY_BASE=0.01 \
+            HEAT_TPU_FAULTS='relayout:kind=resource:calls=1;collective.*:kind=reset:calls=1' \
+            python benchmarks/resplit/heat_tpu.py \
+            --n 2048 --features 32 --trials 1 --mesh 4 --digest > "$chaos_out"; then
+        python - "$clean_out" "$chaos_out" <<'EOF' || chaos_rc=$?
+import json, sys
+
+def parse(path):
+    digest, summary = None, None
+    for line in open(path):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            obj = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if "result_sha256" in obj:
+            digest = obj["result_sha256"]
+        if "telemetry" in obj:
+            summary = obj
+    return digest, summary
+
+clean_digest, clean_summary = parse(sys.argv[1])
+chaos_digest, chaos_summary = parse(sys.argv[2])
+if not clean_digest or not chaos_digest:
+    raise SystemExit("chaos: missing result_sha256 line (need --digest)")
+if clean_summary is None or chaos_summary is None:
+    raise SystemExit("chaos: missing telemetry summary line")
+if chaos_digest != clean_digest:
+    raise SystemExit(
+        f"chaos: fault-injected run diverged from fault-free run "
+        f"({chaos_digest} != {clean_digest}) — retries are not transparent"
+    )
+res = chaos_summary["telemetry"].get("resilience") or {}
+if res.get("retries", 0) < 1:
+    raise SystemExit(
+        f"chaos: injected faults produced no recorded retries: {res}"
+    )
+if res.get("gave_up", 0):
+    raise SystemExit(f"chaos: a guarded site gave up: {res}")
+clean_res = clean_summary["telemetry"].get("resilience")
+if clean_res:
+    raise SystemExit(
+        f"chaos: fault-free run carries resilience counters {clean_res} — "
+        "the disarmed path is not zero-overhead"
+    )
+print(
+    f"chaos ok: bit-identical digest {chaos_digest[:12]}…, "
+    f"retries={res['retries']}, faults_injected={res.get('faults_injected')}, "
+    "fault-free run clean"
+)
+EOF
+    else
+        chaos_rc=$?
+    fi
+    if [ -n "$REPORT" ]; then
+        cp "$clean_out" "${REPORT}/chaos_clean.jsonl" || true
+        cp "$chaos_out" "${REPORT}/chaos_faulted.jsonl" || true
+    fi
+    rm -f "$clean_out" "$chaos_out"
+    if [ "$chaos_rc" != 0 ]; then
+        log_resilience "kind=chaos verdict=FAIL rc=${chaos_rc}"
+        echo "=== chaos step FAILED (rc=$chaos_rc) ==="
+        FAILED_SIZES="$FAILED_SIZES chaos"
+    else
+        log_resilience "kind=chaos verdict=ok sites='relayout collective.*' retries-armed=3"
     fi
 fi
 

@@ -1,0 +1,789 @@
+"""Resilience subsystem tests (ISSUE 5): fault injector determinism,
+guarded retry dispatch, transient/permanent classification, memory-budget
+degradation, sharded checkpoint round-trips with integrity checking,
+iterative-algorithm resume equivalence, and the no-recompile retry oracle.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from heat_tpu import resilience
+from heat_tpu.resilience import checkpoint, faults, guard, memory_guard
+
+
+@pytest.fixture(autouse=True)
+def _clean_resilience(monkeypatch):
+    """Every test starts and ends disarmed: no fault rules, no retries, no
+    budget, no backoff sleeps, no leftover fusion pressure."""
+    monkeypatch.delenv("HEAT_TPU_RETRIES", raising=False)
+    monkeypatch.delenv("HEAT_TPU_HBM_BUDGET", raising=False)
+    monkeypatch.setenv("HEAT_TPU_RETRY_BASE", "0")
+    faults.clear()
+    yield
+    faults.clear()
+    monkeypatch.delenv("HEAT_TPU_RETRIES", raising=False)
+    monkeypatch.delenv("HEAT_TPU_HBM_BUDGET", raising=False)
+    from heat_tpu.core import fusion
+
+    fusion.set_pressure_cap(None)
+    resilience.refresh()
+    if ht.telemetry.enabled():
+        ht.telemetry.disable()
+        ht.telemetry.get_registry().clear()
+
+
+# ---------------------------------------------------------------- injector
+
+
+class TestFaultInjector:
+    def test_spec_parsing(self):
+        rules = faults.parse_spec(
+            "relayout:kind=resource:calls=1,3;collective.*:kind=reset:p=0.5:seed=7"
+        )
+        assert len(rules) == 2
+        assert rules[0].pattern == "relayout"
+        assert rules[0].kind == "resource"
+        assert rules[0].calls == (1, 3)
+        assert rules[1].p == 0.5 and rules[1].seed == 7
+
+    @pytest.mark.parametrize(
+        "bad",
+        ["kind=resource", "site:frobnicate=1", "site:kind=explode", "site:p"],
+    )
+    def test_spec_rejects_malformed(self, bad):
+        with pytest.raises(ValueError):
+            faults.parse_spec(bad)
+
+    def test_probability_schedule_is_deterministic(self):
+        """The same (seed, site, call index) triple always draws the same
+        verdict — two fresh rules replay the identical injection schedule."""
+
+        def schedule(seed):
+            (rule,) = faults.parse_spec(f"demo:kind=reset:p=0.3:seed={seed}")
+            fired = []
+            for i in range(200):
+                if rule.should_fire("demo") is not None:
+                    fired.append(i)
+            return fired
+
+        a, b = schedule(5), schedule(5)
+        assert a == b and len(a) > 0
+        assert schedule(6) != a  # a different seed reshuffles the schedule
+
+    def test_calls_fire_per_site(self):
+        (rule,) = faults.parse_spec("site.*:kind=resource:calls=2")
+        assert rule.should_fire("site.a") is None
+        assert rule.should_fire("site.b") is None
+        assert rule.should_fire("site.a") == 2  # each site has its own count
+        assert rule.should_fire("site.b") == 2
+        assert rule.should_fire("site.a") is None
+
+    def test_check_raises_the_declared_kind(self):
+        resilience.inject(site="demo", kind="resource", calls=(1,))
+        with pytest.raises(faults.InjectedResourceExhausted, match="demo"):
+            faults.check("demo")
+        faults.check("demo")  # second call: rule exhausted, no raise
+        faults.clear()
+        resilience.inject(site="demo", kind="reset", calls=(1,))
+        with pytest.raises(faults.InjectedConnectionReset):
+            faults.check("demo")
+
+    def test_inject_arms_and_clear_disarms(self):
+        assert not resilience.armed()
+        resilience.inject(site="never_dispatched", calls=(999,))
+        assert resilience.armed()
+        resilience.clear_faults()
+        assert not resilience.armed()
+
+
+# ---------------------------------------------------------- classification
+
+
+class TestClassification:
+    @pytest.mark.parametrize(
+        "exc,expected",
+        [
+            (faults.InjectedResourceExhausted("x"), "transient"),
+            (faults.InjectedConnectionReset("x"), "transient"),
+            (ConnectionResetError("peer closed"), "transient"),
+            (RuntimeError("RESOURCE_EXHAUSTED: out of memory on device"), "transient"),
+            (RuntimeError("ABORTED: runtime shut down"), "transient"),
+            (OSError("connection reset by peer"), "transient"),
+            (ValueError("shapes (3,) and (4,) not aligned"), "permanent"),
+            (TypeError("unsupported operand"), "permanent"),
+            (RuntimeError("Array has been deleted with shape=float32[8]"), "permanent"),
+            (RuntimeError("some unrelated failure"), "permanent"),
+        ],
+    )
+    def test_classify(self, exc, expected):
+        assert guard.classify(exc) == expected
+
+
+# ------------------------------------------------------------------- guard
+
+
+class TestGuardedCall:
+    def test_passthrough_without_faults(self):
+        calls = []
+        out = guard.guarded_call("t", lambda v: calls.append(v) or v * 2, (21,))
+        assert out == 42 and calls == [21]
+
+    def test_retry_then_succeed(self, monkeypatch):
+        monkeypatch.setenv("HEAT_TPU_RETRIES", "3")
+        resilience.refresh()
+        resilience.inject(site="t.retry", kind="resource", calls=(1,))
+        calls = []
+        out = guard.guarded_call("t.retry", lambda: calls.append(1) or "ok")
+        assert out == "ok"
+        # attempt 1 was injected before fn ran; attempt 2 executed it
+        assert len(calls) == 1
+
+    def test_give_up_after_n(self, monkeypatch):
+        monkeypatch.setenv("HEAT_TPU_RETRIES", "2")
+        resilience.refresh()
+        resilience.inject(site="t.giveup", kind="resource", p=1.0)
+        with pytest.raises(resilience.HeatTpuRuntimeError) as ei:
+            guard.guarded_call("t.giveup", lambda: "never")
+        e = ei.value
+        assert e.site == "t.giveup"
+        assert len(e.attempts) == 3  # initial try + 2 retries
+        assert all(a["classification"] == "transient" for a in e.attempts)
+        assert e.hints  # remediation hints attached
+        assert isinstance(e.__cause__, faults.InjectedResourceExhausted)
+
+    def test_permanent_errors_propagate_unchanged(self, monkeypatch):
+        monkeypatch.setenv("HEAT_TPU_RETRIES", "5")
+        resilience.refresh()
+        calls = []
+
+        def boom():
+            calls.append(1)
+            raise ValueError("user bug")
+
+        with pytest.raises(ValueError, match="user bug"):
+            guard.guarded_call("t.perm", boom)
+        assert len(calls) == 1  # never retried
+
+    def test_nan_corruption_directive(self):
+        resilience.inject(site="t.nan", kind="nan", calls=(1,))
+        import jax.numpy as jnp
+
+        out = guard.guarded_call("t.nan", lambda: jnp.ones(4, jnp.float32))
+        assert bool(jnp.all(jnp.isnan(out)))
+        # next call is clean
+        out2 = guard.guarded_call("t.nan", lambda: jnp.ones(4, jnp.float32))
+        assert bool(jnp.all(out2 == 1.0))
+
+    def test_permanent_error_mid_retry_escalates_with_history(self, monkeypatch):
+        """A transient followed by a permanent (the donated-buffer-deleted
+        shape) must escalate with the full attempt history, not surface a
+        context-free permanent raise."""
+        monkeypatch.setenv("HEAT_TPU_RETRIES", "3")
+        resilience.refresh()
+        resilience.inject(site="t.mixed", kind="resource", calls=(1,))
+
+        def fn():
+            raise RuntimeError("Array has been deleted with shape=f32[8]")
+
+        with pytest.raises(resilience.HeatTpuRuntimeError) as ei:
+            guard.guarded_call("t.mixed", fn, donated=True)
+        assert len(ei.value.attempts) == 2
+        assert ei.value.attempts[0]["classification"] == "transient"
+        assert ei.value.attempts[1]["classification"] == "permanent"
+        assert any("donate" in h for h in ei.value.hints)
+
+    def test_nan_injection_never_bakes_into_traced_programs(self, monkeypatch):
+        """A nan fault at a trace-time collective site must NOT poison the
+        cached executable — later executions (after clear_faults) stay
+        clean."""
+        comm = ht.get_comm()
+        if comm.size < 2:
+            pytest.skip("needs a multi-device mesh")
+        import jax
+        import jax.numpy as jnp
+
+        rule = resilience.inject(site="collective.psum", kind="nan", calls=(1,))
+        spec = comm.spec(0, 1)
+
+        def run():
+            return jax.shard_map(
+                lambda v: comm.psum(jnp.sum(v)) * jnp.ones_like(v),
+                mesh=comm.mesh, in_specs=(spec,), out_specs=spec,
+            )(jnp.arange(comm.size * 2, dtype=jnp.float32))
+
+        first = run()
+        assert rule.fired == 1
+        assert bool(jnp.all(jnp.isfinite(first)))  # tracer left unpoisoned
+        resilience.clear_faults()
+        assert bool(jnp.all(jnp.isfinite(run())))  # hot program stays clean
+
+    def test_latency_injection_counts(self):
+        rule = resilience.inject(site="t.lag", kind="latency", calls=(1,), delay=0.0)
+        assert guard.guarded_call("t.lag", lambda: 7) == 7
+        assert rule.fired == 1
+
+
+# ------------------------------------------------- end-to-end guarded dispatch
+
+
+class TestGuardedDispatch:
+    def test_resplit_survives_injected_fault_bit_identically(self, monkeypatch):
+        monkeypatch.setenv("HEAT_TPU_RETRIES", "3")
+        resilience.refresh()
+        a = ht.random.randn(19, 6, split=0)
+        want = a.resplit(1).numpy()  # fault-free reference
+        rule = resilience.inject(site="relayout", kind="resource", calls=(1,))
+        got = a.resplit(1).numpy()
+        assert rule.fired == 1
+        assert np.array_equal(want, got)
+
+    def test_retries_do_not_recompile(self, monkeypatch):
+        """CompileWatcher oracle: a retried dispatch re-executes the cached
+        executable — zero new backend compiles."""
+        monkeypatch.setenv("HEAT_TPU_RETRIES", "3")
+        resilience.refresh()
+        a = ht.random.randn(17, 5, split=0)
+        a.resplit(1)  # warmup: compiles the relayout program
+        resilience.inject(site="relayout", kind="resource", calls=(1,))
+        with ht.telemetry.CompileWatcher() as cw:
+            a.resplit(1)
+        assert cw.backend_compiles == 0
+
+    def test_collective_site_guarded(self, monkeypatch):
+        monkeypatch.setenv("HEAT_TPU_RETRIES", "2")
+        resilience.refresh()
+        comm = ht.get_comm()
+        if comm.size < 2:
+            pytest.skip("needs a multi-device mesh")
+        import jax
+        import jax.numpy as jnp
+
+        rule = resilience.inject(site="collective.psum", kind="reset", calls=(1,))
+        spec = comm.spec(0, 1)
+        out = jax.shard_map(
+            lambda x: comm.psum(jnp.sum(x)) * jnp.ones_like(x),
+            mesh=comm.mesh, in_specs=(spec,), out_specs=spec,
+        )(jnp.arange(comm.size * 2, dtype=jnp.float32))
+        assert rule.fired == 1
+        assert float(out[0]) == float(np.arange(comm.size * 2).sum())
+
+    def test_exhausted_retries_escalate_with_history(self, monkeypatch):
+        monkeypatch.setenv("HEAT_TPU_RETRIES", "1")
+        resilience.refresh()
+        resilience.inject(site="relayout", kind="reset", p=1.0)
+        a = ht.random.randn(8, 4, split=0)
+        with pytest.raises(resilience.HeatTpuRuntimeError) as ei:
+            a.resplit(1)
+        assert ei.value.site == "relayout"
+        assert len(ei.value.attempts) == 2
+
+    def test_telemetry_counters_and_summary_block(self, monkeypatch):
+        monkeypatch.setenv("HEAT_TPU_RETRIES", "3")
+        resilience.refresh()
+        reg = ht.telemetry.enable()
+        reg.clear()
+        resilience.inject(site="relayout", kind="resource", calls=(1,))
+        a = ht.random.randn(12, 4, split=0)
+        a.resplit(1)
+        snap = reg.snapshot()["counters"]
+        assert snap.get("resilience.retries", 0) >= 1
+        assert snap.get("resilience.transient_faults", 0) >= 1
+        assert snap.get("resilience.faults_injected", 0) >= 1
+        summary = ht.telemetry.report.summarize()
+        assert summary["resilience"]["retries"] >= 1
+        # offline reconstruction from the recorded events agrees
+        offline = ht.telemetry.report.summarize(events=list(reg.events))
+        assert offline["resilience"]["retries"] >= 1
+
+    def test_disarmed_run_emits_no_resilience_state(self):
+        reg = ht.telemetry.enable()
+        reg.clear()
+        a = ht.random.randn(12, 4, split=0)
+        a.resplit(1)
+        assert not any(
+            k.startswith("resilience.") for k in reg.snapshot()["counters"]
+        )
+        assert "resilience" not in ht.telemetry.report.summarize()
+
+
+# ------------------------------------------------------------ memory guard
+
+
+class TestMemoryGuard:
+    def test_budget_parsing(self, monkeypatch):
+        for raw, want in [
+            ("1024", 1024), ("4K", 4096), ("2M", 2 << 20), ("1G", 1 << 30),
+            ("1.5k", 1536), ("8GiB", 8 << 30), ("junk", None), ("", None),
+        ]:
+            monkeypatch.setenv("HEAT_TPU_HBM_BUDGET", raw)
+            assert memory_guard.budget_bytes() == want, raw
+
+    def test_overflow_degrades_then_raises(self, monkeypatch):
+        from heat_tpu.core import fusion
+
+        monkeypatch.setenv("HEAT_TPU_HBM_BUDGET", "64")
+        resilience.refresh()
+        a = ht.random.randn(64, 32, split=0)
+        with pytest.raises(resilience.HeatTpuMemoryError) as ei:
+            a.resplit(1)
+        assert "HEAT_TPU_HBM_BUDGET" in str(ei.value)
+        assert ei.value.site == "relayout"
+        # ladder step 1 ran: fusion windows collapsed to pressure cap
+        assert fusion.pressure_cap() == 1
+        assert fusion.depth_cap() == 1
+
+    def test_big_budget_dispatches_and_releases_pressure(self, monkeypatch):
+        from heat_tpu.core import fusion
+
+        fusion.set_pressure_cap(1)
+        monkeypatch.setenv("HEAT_TPU_HBM_BUDGET", "8G")
+        resilience.refresh()
+        a = ht.random.randn(16, 8, split=0)
+        b = a.resplit(1)
+        assert b.shape == (16, 8)
+        assert fusion.pressure_cap() is None  # comfortable headroom clears it
+
+    def test_temp_budget_shrinks_under_budget(self, monkeypatch):
+        assert memory_guard.temp_budget(1 << 28) == 1 << 28
+        monkeypatch.setenv("HEAT_TPU_HBM_BUDGET", "8M")
+        assert memory_guard.temp_budget(1 << 28) == 2 << 20  # budget / 4
+
+
+# -------------------------------------------------------------- checkpoint
+
+
+class TestCheckpoint:
+    @pytest.mark.parametrize("split", [0, 1, None])
+    def test_round_trip_across_splits(self, tmp_path, split):
+        path = str(tmp_path / "ck")
+        a = ht.random.randn(19, 7, split=split)  # ragged over the mesh
+        b = ht.arange(13, split=0 if split is not None else None)
+        state = {"a": a, "b": b, "step": 11, "lr": 0.125, "tag": "x", "none": None}
+        resilience.save_checkpoint(state, path, extra={"it": 3})
+        tree, extra = resilience.load_checkpoint(path, like=state, with_extra=True)
+        assert extra == {"it": 3}
+        assert np.array_equal(tree["a"].numpy(), a.numpy())
+        assert tree["a"].split == split and tree["a"].dtype == a.dtype
+        assert tuple(tree["a"].shape) == tuple(a.shape)
+        assert np.array_equal(tree["b"].numpy(), b.numpy())
+        assert tree["step"] == 11 and tree["lr"] == 0.125
+        assert tree["tag"] == "x" and tree["none"] is None
+
+    def test_shard_files_are_per_position(self, tmp_path):
+        path = str(tmp_path / "ck")
+        a = ht.random.randn(19, 7, split=0)
+        resilience.save_checkpoint([a], path)
+        manifest = checkpoint.load_manifest(path)
+        (rec,) = manifest["leaves"]
+        assert rec["kind"] == "dndarray"
+        assert len(rec["shards"]) == a.comm.size
+        # shard shapes are the logical ceil-rule chunks (no tail pad)
+        total = sum(s["shape"][0] for s in rec["shards"])
+        assert total == a.shape[0]
+
+    def test_flipped_byte_detected_by_crc(self, tmp_path):
+        path = str(tmp_path / "ck")
+        a = ht.random.randn(19, 7, split=0)
+        resilience.save_checkpoint([a], path)
+        manifest = checkpoint.load_manifest(path)
+        shard = manifest["leaves"][0]["shards"][1]["file"]
+        fpath = os.path.join(path, shard)
+        blob = bytearray(open(fpath, "rb").read())
+        blob[-3] ^= 0x40  # flip one bit in the payload
+        open(fpath, "wb").write(bytes(blob))
+        with pytest.raises(resilience.CheckpointCorruptError, match="CRC32"):
+            resilience.load_checkpoint(path)
+
+    def test_truncated_manifest_rejected_cleanly(self, tmp_path):
+        path = str(tmp_path / "ck")
+        resilience.save_checkpoint([ht.arange(5)], path)
+        mpath = os.path.join(path, "manifest.json")
+        full = open(mpath).read()
+        open(mpath, "w").write(full[: len(full) // 2])
+        with pytest.raises(resilience.CheckpointError, match="truncated or corrupt"):
+            resilience.load_checkpoint(path)
+
+    def test_missing_manifest_and_missing_blob(self, tmp_path):
+        with pytest.raises(resilience.CheckpointError, match="manifest"):
+            resilience.load_checkpoint(str(tmp_path / "nope"))
+        path = str(tmp_path / "ck")
+        resilience.save_checkpoint([ht.arange(9, split=0)], path)
+        manifest = checkpoint.load_manifest(path)
+        os.remove(os.path.join(path, manifest["leaves"][0]["shards"][0]["file"]))
+        with pytest.raises(resilience.CheckpointError, match="missing"):
+            resilience.load_checkpoint(path)
+
+    def test_save_is_atomic_over_existing(self, tmp_path):
+        path = str(tmp_path / "ck")
+        resilience.save_checkpoint({"v": ht.arange(4)}, path, extra={"gen": 1})
+        # a failing second save (unserializable leaf) must keep gen 1 intact
+        with pytest.raises(resilience.CheckpointError):
+            resilience.save_checkpoint({"v": object()}, path, extra={"gen": 2})
+        _, extra = resilience.load_checkpoint(path, with_extra=True)
+        assert extra == {"gen": 1}
+        assert not [p for p in os.listdir(tmp_path) if ".tmp." in p]
+
+    def test_restores_on_a_different_mesh(self, tmp_path):
+        """The manifest stores the logical layout, so a checkpoint written
+        on an n-device mesh restores on a 1-device communicator."""
+        path = str(tmp_path / "ck")
+        a = ht.random.randn(10, 3, split=0)
+        resilience.save_checkpoint([a], path)
+        one = ht.MeshCommunication(devices=a.comm.devices[:1])
+        (back,) = resilience.load_checkpoint(path, comm=one)
+        assert back.comm.size == 1
+        assert np.array_equal(back.numpy(), a.numpy())
+
+    def test_commit_window_crash_is_recoverable(self, tmp_path):
+        """A save killed between the two commit renames leaves the data in
+        a .old. sibling — exists() sees it and load recovers it."""
+        path = str(tmp_path / "ck")
+        a = ht.arange(9, split=0)
+        resilience.save_checkpoint([a], path, extra={"gen": 1})
+        os.rename(path, path + ".old.99999")  # simulate the crash window
+        assert checkpoint.exists(path)
+        with pytest.warns(UserWarning, match="recovering"):
+            (back,), extra = resilience.load_checkpoint(path, with_extra=True)
+        assert extra == {"gen": 1}
+        assert np.array_equal(back.numpy(), a.numpy())
+        # the next successful save reaps the stale sibling
+        resilience.save_checkpoint([a], path, extra={"gen": 2})
+        assert not [p for p in os.listdir(tmp_path) if ".old." in p]
+
+    def test_structure_mismatch_is_clean(self, tmp_path):
+        path = str(tmp_path / "ck")
+        resilience.save_checkpoint([ht.arange(3), 5], path)
+        with pytest.raises(resilience.CheckpointError, match="leaves"):
+            resilience.load_checkpoint(path, like=[1, 2, 3])
+
+
+# ------------------------------------------------------ algorithm resume hooks
+
+
+class TestResumeEquivalence:
+    def test_kmeans_checkpointed_equals_uninterrupted(self, tmp_path):
+        x = ht.random.randn(120, 6, split=0)
+        base = ht.cluster.KMeans(n_clusters=3, max_iter=30, random_state=2).fit(x)
+        ck = ht.cluster.KMeans(
+            n_clusters=3, max_iter=30, random_state=2,
+            checkpoint_every=4, checkpoint_path=str(tmp_path / "km"),
+        ).fit(x)
+        assert base.n_iter_ == ck.n_iter_
+        assert np.array_equal(
+            base.cluster_centers_.numpy(), ck.cluster_centers_.numpy()
+        )
+        assert np.array_equal(base.labels_.numpy(), ck.labels_.numpy())
+        assert base.inertia_ == ck.inertia_
+
+    def test_kmeans_killed_run_resumes_identically(self, tmp_path):
+        path = str(tmp_path / "km")
+        x = ht.random.randn(120, 6, split=0)
+        base = ht.cluster.KMeans(n_clusters=3, max_iter=30, random_state=2).fit(x)
+        # "kill" after 8 iterations: a budget-truncated first run
+        ht.cluster.KMeans(
+            n_clusters=3, max_iter=8, random_state=2,
+            checkpoint_every=4, checkpoint_path=path,
+        ).fit(x)
+        resumed = ht.cluster.KMeans(
+            n_clusters=3, max_iter=30, random_state=2,
+            checkpoint_every=4, checkpoint_path=path, resume=True,
+        ).fit(x)
+        assert np.array_equal(
+            base.cluster_centers_.numpy(), resumed.cluster_centers_.numpy()
+        )
+        assert np.array_equal(base.labels_.numpy(), resumed.labels_.numpy())
+
+    def _cg_problem(self):
+        rng = np.random.default_rng(3)
+        n = 36
+        M = rng.standard_normal((n, n))
+        A = ht.array((M @ M.T + n * np.eye(n)).astype(np.float32), split=0)
+        b = ht.array(rng.standard_normal(n).astype(np.float32))
+        x0 = ht.zeros(n, dtype=ht.float32)
+        return A, b, x0
+
+    def test_cg_checkpointed_equals_uninterrupted(self, tmp_path):
+        A, b, x0 = self._cg_problem()
+        base = ht.linalg.cg(A, b, x0)
+        ck = ht.linalg.cg(
+            A, b, x0, checkpoint_every=5,
+            checkpoint_path=str(tmp_path / "cg"),
+        )
+        assert np.array_equal(base.numpy(), ck.numpy())
+
+    def test_cg_fault_interrupted_run_resumes_identically(self, tmp_path, monkeypatch):
+        """Integration of injector + checkpoint: a fault kills the solve
+        after the first window's checkpoint; the resumed solve finishes
+        bit-identically to the uninterrupted one."""
+        path = str(tmp_path / "cg")
+        A, b, x0 = self._cg_problem()
+        base = ht.linalg.cg(A, b, x0)
+        resilience.inject(site="cg_chunk", kind="resource", calls=(2,))
+        with pytest.raises(resilience.HeatTpuRuntimeError):
+            ht.linalg.cg(A, b, x0, checkpoint_every=5, checkpoint_path=path)
+        faults.clear()
+        _, extra = resilience.load_checkpoint(path, with_extra=True)
+        assert extra["algo"] == "cg" and extra["it"] == 5
+        resumed = ht.linalg.cg(
+            A, b, x0, checkpoint_every=5, checkpoint_path=path, resume=True
+        )
+        assert np.array_equal(base.numpy(), resumed.numpy())
+
+    def test_lanczos_checkpointed_equals_uninterrupted(self, tmp_path):
+        A, _, _ = self._cg_problem()
+        Vb, Tb = ht.linalg.lanczos(A, 10)
+        Vc, Tc = ht.linalg.lanczos(
+            A, 10, checkpoint_every=3, checkpoint_path=str(tmp_path / "lz")
+        )
+        assert np.array_equal(Vb.numpy(), Vc.numpy())
+        assert np.array_equal(Tb.numpy(), Tc.numpy())
+
+    def test_checkpoint_kwarg_validation(self, tmp_path):
+        with pytest.raises(ValueError, match="checkpoint_path"):
+            ht.cluster.KMeans(checkpoint_every=5)
+        A, b, x0 = self._cg_problem()
+        with pytest.raises(ValueError, match="positive"):
+            ht.linalg.cg(A, b, x0, checkpoint_every=0, checkpoint_path="x")
+        # resume without the windowed driver would silently restart from
+        # scratch — must refuse instead
+        with pytest.raises(ValueError, match="resume"):
+            ht.cluster.KMeans(checkpoint_path="x", resume=True)
+        with pytest.raises(ValueError, match="resume"):
+            ht.linalg.cg(A, b, x0, checkpoint_path="x", resume=True)
+        with pytest.raises(ValueError, match="resume"):
+            ht.linalg.lanczos(A, 4, checkpoint_path="x", resume=True)
+
+    def test_wrong_algo_checkpoint_rejected(self, tmp_path):
+        path = str(tmp_path / "km")
+        x = ht.random.randn(60, 4, split=0)
+        ht.cluster.KMeans(
+            n_clusters=2, max_iter=4, random_state=0,
+            checkpoint_every=2, checkpoint_path=path,
+        ).fit(x)
+        A, b, x0 = self._cg_problem()
+        with pytest.raises(resilience.CheckpointError, match="kmeans"):
+            ht.linalg.cg(
+                A, b, x0, checkpoint_every=2, checkpoint_path=path, resume=True
+            )
+
+
+class TestDasoCheckpoint:
+    def test_round_trip_restores_params_and_schedule(self, tmp_path):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        path = str(tmp_path / "daso")
+        rng = np.random.default_rng(0)
+        X = jnp.asarray(rng.standard_normal((16, 4)), dtype=jnp.float32)
+        y = jnp.asarray(rng.standard_normal((16, 1)), dtype=jnp.float32)
+        params = {"w": jnp.zeros((4, 1)), "b": jnp.zeros((1,))}
+
+        def loss_fn(p, xb, yb):
+            return jnp.mean((xb @ p["w"] + p["b"] - yb) ** 2)
+
+        daso = ht.optim.DASO(
+            optax.sgd(0.1), total_epochs=4,
+            checkpoint_every=2, checkpoint_path=path,
+        )
+        daso.set_loss(loss_fn)
+        daso.last_batch = 3
+        sp, st = daso.stack_params(params), None
+        st = daso.init(sp)
+        for _ in range(4):
+            sp, st, _loss = daso.step(sp, st, (X, y))
+        assert os.path.isdir(path)
+
+        fresh = ht.optim.DASO(optax.sgd(0.1), total_epochs=4)
+        fresh.set_loss(loss_fn)
+        fresh.last_batch = 3
+        fp = fresh.stack_params(params)
+        fs = fresh.init(fp)
+        rp, rs = fresh.load_checkpoint(path, fp, fs)
+        assert fresh._steps_done == 4
+        assert fresh.epoch == daso.epoch
+        assert fresh.current_batch == daso.current_batch
+        assert jax.tree.all(
+            jax.tree.map(lambda a, b: bool(jnp.array_equal(a, b)), rp, sp)
+        )
+        # the restored state machine keeps stepping
+        rp, rs, loss = fresh.step(rp, rs, (X, y))
+        assert np.isfinite(float(loss))
+
+
+# ------------------------------------------------------------ io hardening
+
+
+class TestIoHardening:
+    def test_save_npy_atomic_on_failure(self, tmp_path, monkeypatch):
+        p = tmp_path / "x.npy"
+        ht.save_npy(ht.arange(10, split=0), str(p))
+        orig = p.read_bytes()
+
+        def boom(f, arr):
+            f.write(b"partial garbage")
+            raise OSError("disk full")
+
+        monkeypatch.setattr(np, "save", boom)
+        with pytest.raises(OSError, match="disk full"):
+            ht.save_npy(ht.arange(5, split=0), str(p))
+        monkeypatch.undo()
+        assert p.read_bytes() == orig  # previous file intact
+        assert not [q.name for q in tmp_path.iterdir() if ".tmp." in q.name]
+
+    def test_save_csv_atomic_on_failure(self, tmp_path, monkeypatch):
+        p = tmp_path / "x.csv"
+        a = ht.array(np.arange(6, dtype=np.float32).reshape(3, 2), split=0)
+        ht.save_csv(a, str(p))
+        orig = p.read_bytes()
+        monkeypatch.setattr(
+            np, "savetxt",
+            lambda *a, **k: (_ for _ in ()).throw(OSError("disk full")),
+        )
+        from heat_tpu import native
+
+        monkeypatch.setattr(native, "write_csv", lambda *a, **k: False)
+        with pytest.raises(OSError, match="disk full"):
+            ht.save_csv(a, str(p))
+        monkeypatch.undo()
+        assert p.read_bytes() == orig
+        assert not [q.name for q in tmp_path.iterdir() if ".tmp." in q.name]
+
+    def test_load_npy_truncated_raises_clean_error(self, tmp_path):
+        p = tmp_path / "t.npy"
+        with open(p, "wb") as f:
+            np.save(f, np.arange(100.0))
+        blob = p.read_bytes()
+        p.write_bytes(blob[: len(blob) // 3])
+        with pytest.raises(ValueError, match="load_npy"):
+            ht.load_npy(str(p))
+
+    def test_load_npy_garbage_raises_clean_error(self, tmp_path):
+        p = tmp_path / "g.npy"
+        p.write_bytes(b"this is not a numpy file at all")
+        with pytest.raises(ValueError, match="load_npy"):
+            ht.load_npy(str(p))
+
+    def test_load_npy_object_dtype_rejected(self, tmp_path):
+        p = tmp_path / "o.npy"
+        with open(p, "wb") as f:
+            np.save(f, np.array([{"a": 1}, None], dtype=object), allow_pickle=True)
+        with pytest.raises(ValueError, match="load_npy|object"):
+            ht.load_npy(str(p))
+
+    @pytest.mark.skipif(not ht.supports_hdf5(), reason="h5py not available")
+    def test_save_hdf5_atomic_on_failure(self, tmp_path, monkeypatch):
+        import h5py
+
+        p = tmp_path / "x.h5"
+        a = ht.arange(8, split=0)
+        ht.save_hdf5(a, str(p), "d")
+        orig = p.read_bytes()
+        real_file = h5py.File
+
+        def boom(path, mode, *args, **kwargs):
+            h = real_file(path, mode, *args, **kwargs)
+            raise OSError("disk full")
+
+        monkeypatch.setattr(h5py, "File", boom)
+        with pytest.raises(OSError, match="disk full"):
+            ht.save_hdf5(a, str(p), "d")
+        monkeypatch.undo()
+        assert p.read_bytes() == orig
+        assert not [q.name for q in tmp_path.iterdir() if ".tmp." in q.name]
+
+
+# --------------------------------------------------------- telemetry flush
+
+
+class TestTelemetryFlush:
+    def test_flush_writes_counter_snapshot_to_sink(self, tmp_path):
+        sink = str(tmp_path / "events.jsonl")
+        reg = ht.telemetry.enable(sink)
+        reg.clear()
+        reg.add("demo.counter", 3)
+        ht.telemetry.flush("unit")
+        ht.telemetry.disable()
+        records = [json.loads(l) for l in open(sink) if l.strip()]
+        finals = [r for r in records if r.get("kind") == "final"]
+        assert finals and finals[-1]["name"] == "unit"
+        assert finals[-1]["counters"]["demo.counter"] == 3
+
+    def test_escalation_flushes_before_raising(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("HEAT_TPU_RETRIES", "0")
+        resilience.refresh()
+        sink = str(tmp_path / "events.jsonl")
+        reg = ht.telemetry.enable(sink)
+        reg.clear()
+        resilience.inject(site="relayout", kind="resource", p=1.0)
+        a = ht.random.randn(8, 4, split=0)
+        with pytest.raises(resilience.HeatTpuRuntimeError):
+            a.resplit(1)
+        ht.telemetry.disable()
+        records = [json.loads(l) for l in open(sink) if l.strip()]
+        finals = [r for r in records if r.get("kind") == "final"]
+        assert finals and finals[-1]["name"] == "escalation"
+        assert finals[-1]["counters"].get("resilience.gave_up", 0) >= 1
+
+    def test_atexit_flush_in_subprocess(self, tmp_path):
+        """A process that exits without cleanup still lands its counters
+        in the sink (the atexit hook)."""
+        import subprocess
+        import sys
+
+        sink = str(tmp_path / "events.jsonl")
+        code = (
+            "import os\n"
+            f"os.environ['HEAT_TPU_TELEMETRY'] = '1'\n"
+            f"os.environ['HEAT_TPU_TELEMETRY_SINK'] = {sink!r}\n"
+            "os.environ.setdefault('XLA_FLAGS', "
+            "'--xla_force_host_platform_device_count=2')\n"
+            "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+            "import heat_tpu as ht\n"
+            "ht.telemetry.get_registry().add('sub.counter', 7)\n"
+        )
+        subprocess.run(
+            [sys.executable, "-c", code], check=True, timeout=120,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        records = [json.loads(l) for l in open(sink) if l.strip()]
+        finals = [r for r in records if r.get("kind") == "final"]
+        assert finals and finals[-1]["name"] == "atexit"
+        assert finals[-1]["counters"]["sub.counter"] == 7
+
+
+# ------------------------------------------------------------ housekeeping
+
+
+class TestApiSurface:
+    def test_public_names(self):
+        assert ht.resilience is resilience
+        for name in (
+            "inject", "clear_faults", "guarded_call", "armed", "refresh",
+            "stats", "save_checkpoint", "load_checkpoint",
+            "HeatTpuRuntimeError", "HeatTpuMemoryError",
+            "CheckpointError", "CheckpointCorruptError",
+        ):
+            assert hasattr(resilience, name), name
+
+    def test_stats_shape(self):
+        s = resilience.stats()
+        assert set(s) == {"armed", "retries", "faults", "hbm_budget"}
+
+    def test_wrapped_programs_forward_lower(self):
+        from heat_tpu.core import program_cache
+
+        import jax.numpy as jnp
+
+        fn = program_cache.cached_program(
+            "resilience_test_site", "k", lambda: (lambda v: v + 1)
+        )
+        assert hasattr(fn, "lower")
+        lowered = fn.lower(jnp.ones(3))
+        assert lowered.compile()(jnp.ones(3)).shape == (3,)
