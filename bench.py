@@ -871,6 +871,18 @@ def main():
             detail["lm_step_mfu"] = round(ours_now["lm_step"] / peak_single, 3)
         if errors:
             detail["errors"] = dict(errors)
+        if final:
+            # relayout-planner policy probe (ISSUE 6, schema in
+            # docs/BENCHMARKS.md): plan kind / stage count / predicted vs
+            # HLO-audited wire bytes for the canonical resplit shape under
+            # the run's env. AOT lower-compile only; must never kill the
+            # summary.
+            try:
+                from heat_tpu.core import relayout_planner as _rp
+
+                detail["relayout_plan"] = _rp.bench_field()
+            except Exception as e:  # noqa: BLE001
+                detail["relayout_plan"] = {"error": repr(e)}
         print(json.dumps(detail), file=sys.stderr, flush=True)
 
         # honesty bit (VERDICT r5 #9, schema in docs/BENCHMARKS.md): the

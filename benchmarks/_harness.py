@@ -37,6 +37,14 @@ def base_parser(description: str) -> argparse.ArgumentParser:
                         "against the analytic cost model; the summary gains "
                         "a telemetry.hlo_collectives section "
                         "(docs/OBSERVABILITY.md)")
+    p.add_argument("--plan", choices=("auto", "monolithic", "chunked",
+                                      "alltoall"),
+                   default=None,
+                   help="relayout planning policy for this run (sets "
+                        "HEAT_TPU_RELAYOUT_PLAN; ISSUE 6, "
+                        "docs/TUNING_RUNBOOK.md §0.8). With telemetry on, "
+                        "the summary gains a telemetry.relayout_plan "
+                        "block of the planner's decisions")
     p.add_argument("--compile-cache", metavar="DIR",
                    default=os.environ.get("HEAT_TPU_COMPILE_CACHE") or None,
                    help="persistent on-disk XLA compilation cache directory "
@@ -50,6 +58,8 @@ def base_parser(description: str) -> argparse.ArgumentParser:
 
 def bootstrap(args):
     """Apply --mesh BEFORE jax initializes a backend, then import heat_tpu."""
+    if getattr(args, "plan", None):
+        os.environ["HEAT_TPU_RELAYOUT_PLAN"] = args.plan
     if getattr(args, "compile_cache", None):
         # FIRST, before anything imports heat_tpu (force_virtual_cpu_mesh
         # below already does): program_cache reads the env at import and

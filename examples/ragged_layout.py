@@ -1,23 +1,30 @@
 #!/usr/bin/env python
-"""Rank-proportional work WITHOUT ragged shards — the TPU substitute for
-the reference's ``redistribute_(target_map)`` (PARITY.md, "redistribute_
-and ragged target maps").
+"""Rank-proportional work WITHOUT ragged shards — ``ht.ragged``, the
+first-class substitute for the reference's ``redistribute_(target_map)``
+(PARITY.md, "redistribute_ and ragged target maps").
 
 The reference framework lets MPI rank ``r`` own an arbitrary number of
 split-dim rows ("rank 0 holds 7, rank 1 holds 2") because Alltoallv makes
 ragged layouts first-class. The XLA layout model has exactly ONE physical
 layout per ``(gshape, split, mesh)`` — equal ceil-rule shards with a tail
-pad — so that design point is formally closed here. This demo shows the
-two substitutes the design argument names, as runnable code:
+pad — so that design point is formally closed here. What the reference
+*uses* ragged maps for survives as :class:`heat_tpu.Ragged`
+(heat_tpu/core/ragged.py), toured below:
 
-1. **Masked proportional work** — keep the canonical layout and express
-   "position ``i`` processes ``w_i`` rows" as a weight mask built from the
-   desired ragged counts. The mask rides the same sharding as the data, so
-   each device touches only its assigned rows; everything stays one
-   compiled program on the canonical layout. Numerically identical to the
-   ragged-layout computation it substitutes (asserted below).
+1. **Masked proportional work** — the data stays canonical; the ragged
+   intent ("position ``i`` processes ``counts[i]`` rows") is metadata:
+   ``r.owner`` / ``r.mask(i)`` ride the same sharding as the data, so
+   each device touches only its assigned rows inside one compiled
+   program. Numerically identical to the ragged-layout computation it
+   substitutes (asserted below).
 
-2. **Mesh reshape** — when the imbalance is *structural* (a fast group of
+2. **Free redistribution** — ``r.redistribute(new_counts)`` rewrites the
+   intent without moving a byte (the reference pays an Alltoallv);
+   ``r.resplit(axis)`` changes the physical layout through the
+   communication-aware relayout planner, which decomposes the move into
+   bounded-memory chunks near the HBM ceiling instead of raising.
+
+3. **Mesh reshape** — when the imbalance is *structural* (a fast group of
    devices should take more of the batch than a slow group), factor the
    flat mesh into a 2-D ``(group, worker)`` mesh and shard the big axis
    over only one of the factors; the other factor carries the skew.
@@ -71,18 +78,19 @@ def main():
     except NotImplementedError as e:
         print(f"redistribute_(ragged map) raises as documented:\n  {e}\n")
 
-    # Substitute: a GLOBAL row->owner map on the canonical layout. Row j
-    # belongs to position owner[j] per the ragged intent; the mask
-    # owner==i is what "position i's work" means — no ragged shards.
-    owner = np.repeat(np.arange(p), counts)  # (n,) ragged assignment
-    owner_ht = ht.array(owner.astype(np.int64), split=0)
+    # First-class substitute: ht.ragged carries the intent as metadata on
+    # the canonical layout. Row j belongs to position r.owner[j]; the
+    # mask r.mask(i) is what "position i's work" means — no ragged shards.
+    r = ht.ragged(x, counts)
+    print(f"first-class layout: {r}")
+    print("owner map:", r.owner.numpy().tolist())
 
     # Example workload: per-position partial sums of x's rows — computed
     # (a) with the masked canonical layout, (b) with the ragged slices the
     # reference would hold. The two must agree exactly.
     masked = []
     for i in range(p):
-        mask = (owner_ht == i).astype(ht.float32).reshape((n, 1))
+        mask = r.mask(i).astype(ht.float32).reshape((n, 1))
         masked.append((x * mask).sum(axis=0).numpy())
     ragged_ref = []
     xs = x.numpy()
@@ -94,6 +102,16 @@ def main():
                                rtol=1e-6)
     print("masked canonical layout == ragged-layout result: OK")
     print("per-position row sums:\n", np.stack(masked))
+
+    # block views are the rows a ragged shard would hold...
+    np.testing.assert_allclose(
+        r.block(0).numpy(), x.numpy()[: int(counts[0])], rtol=0
+    )
+    # ...and redistributing the intent moves ZERO bytes (the reference's
+    # redistribute_ ships the whole array through Alltoallv for this)
+    flipped = r.redistribute(counts[::-1].copy())
+    assert flipped.array is r.array
+    print(f"redistribute({list(map(int, counts[::-1]))}): zero-copy OK")
 
     # ----------------------------------------------------------------- 2
     # Structural skew via mesh reshape: a (group, worker) factorization.

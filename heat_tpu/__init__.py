@@ -34,6 +34,7 @@ from . import resilience
 from .core import *
 from . import core
 from .core import linalg, program_cache, random, version
+from .core.ragged import Ragged, ragged
 from .core.version import version as __version__
 
 # ML subpackages (assembled as they are built; reference heat/__init__.py

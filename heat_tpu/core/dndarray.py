@@ -337,7 +337,7 @@ class DNDarray:
 
     def _relayout(
         self, new_split: Optional[int], *, audit: bool = False,
-        donate: bool = False,
+        donate: bool = False, audit_site: str = "relayout",
     ) -> jax.Array:
         """Physical buffer re-laid-out to the canonical layout of
         ``new_split``: ONE cached compiled program (logical slice, tail
@@ -363,21 +363,56 @@ class DNDarray:
         against that prediction (telemetry/hlo.py). Op-level callers
         (`resplit`) audit at their own site, so the global
         ``HEAT_TPU_HLO_AUDIT`` flag is deliberately NOT consulted here —
-        one relayout must never produce two audit records."""
+        one relayout must never produce two audit records.
+
+        With the relayout planner armed (``HEAT_TPU_RELAYOUT_PLAN`` set
+        non-auto, or an ``HEAT_TPU_HBM_BUDGET``), the layout change may
+        instead execute as a decomposed plan — an explicit all-to-all
+        kernel or a bounded-memory chain of chunk programs
+        (core/relayout_planner.py). ``auto`` with no budget never plans:
+        this method stays the single-dict-lookup monolithic dispatch."""
+        plan = self._relayout_plan(new_split)
         _cost, fields, do_audit = telemetry.op_cost(
             self.__comm.relayout_cost, self.__gshape,
             self.__dtype.byte_size(), self.__split, new_split,
             audit=audit, use_global=False,
         )
-        if do_audit:
-            self._audit_relayout(new_split, site="relayout")
+        decomposed = plan is not None and plan.kind != "monolithic"
+        if do_audit and not decomposed:
+            self._audit_relayout(new_split, site=audit_site)
         if telemetry.enabled():
+            if decomposed:
+                fields = dict(fields, plan=plan.kind, stages=plan.chunks)
             with telemetry.span(
                 "relayout", old_split=self.__split, new_split=new_split,
                 gshape=list(self.__gshape), **fields,
             ) as sp:
-                return sp.output(self.__relayout_impl(new_split, donate))
-        return self.__relayout_impl(new_split, donate)
+                return sp.output(
+                    self.__relayout_impl(new_split, donate, plan, do_audit)
+                )
+        return self.__relayout_impl(new_split, donate, plan, do_audit)
+
+    def _relayout_plan(self, new_split: Optional[int]):
+        """Consult the relayout planner (None on the unplanned fast
+        path). The measured monolithic (temp+output) bytes are supplied
+        lazily — only a budgeted `auto` decision compiles the monolithic
+        program ahead of time (AOT, memoized in memory_guard)."""
+        from . import relayout_planner
+
+        if not relayout_planner.active():
+            return None
+
+        def measure() -> int:
+            from ..resilience import memory_guard
+
+            return memory_guard.program_bytes(
+                self.__relayout_program(new_split), (self.larray,)
+            )
+
+        return relayout_planner.maybe_plan(
+            self.__gshape, self.__dtype.byte_size(), self.__split,
+            new_split, self.__comm, measure=measure,
+        )
 
     def _audit_relayout(self, new_split: Optional[int], site: str):
         """Ground-truth the relayout: lower-and-compile the equivalent
@@ -431,6 +466,13 @@ class DNDarray:
             self.__gshape, str(self.__array.dtype), self.__split, new_split
         )
 
+    def _relayout_executable(self, new_split: Optional[int], donate: bool = False):
+        """The cached monolithic relayout program (for AOT consumers:
+        memory_guard budgeting, the planner's measured-need decision, the
+        bench `relayout_plan` probe, tests). Building it never traces or
+        executes."""
+        return self.__relayout_program(new_split, donate)
+
     def __relayout_program(self, new_split: Optional[int], donate: bool = False):
         """The cached compiled relayout program for this layout signature:
         logical slice → tail re-pad → canonical ``out_shardings``."""
@@ -467,7 +509,8 @@ class DNDarray:
         )
 
     def __relayout_impl(
-        self, new_split: Optional[int], donate: bool = False
+        self, new_split: Optional[int], donate: bool = False,
+        plan=None, audit: bool = False,
     ) -> jax.Array:
         buf = self.larray
         pshape = self.__comm.padded_shape(self.__gshape, new_split)
@@ -484,6 +527,16 @@ class DNDarray:
             _PERF_STATS["repads"] += 1
         if self.__comm.size > 1:
             _PERF_STATS["device_puts"] += 1
+        if plan is not None and plan.kind != "monolithic":
+            # decomposed plan: chain of cached stage programs (the source
+            # buffer must stay live through every stage, so donation — if
+            # requested — is simply dropped; the chunk accumulator chain
+            # donates internally instead)
+            from . import relayout_planner
+
+            return relayout_planner.run(
+                plan, buf, self.__comm, audit=audit
+            )
         fn = self.__relayout_program(new_split, donate)
         return fn(buf)
 
@@ -700,9 +753,11 @@ class DNDarray:
             "formally closed on the XLA tail-pad layout — every sharded "
             "dim has exactly one physical layout per (gshape, split, "
             "mesh); see PARITY.md 'redistribute_ and ragged target maps'. "
-            "Use resplit_() to change the distribution axis or balance_() "
-            "to canonicalize; deliberate imbalance is expressed via mesh "
-            "shape or masking, not ragged shards"
+            "Use resplit_() to change the distribution axis, balance_() "
+            "to canonicalize, or ht.ragged (core/ragged.py) to carry a "
+            "rank-proportional ownership intent on the canonical layout "
+            "— Ragged.redistribute(new_counts) is the zero-copy form of "
+            "this call"
         )
 
     def create_lshape_map(self, force_check: bool = False) -> np.ndarray:

@@ -602,6 +602,16 @@ def setitem(x: DNDarray, key, value) -> None:
             except (TypeError, IndexError, ValueError):
                 pass  # ragged values etc. — host fallback below
 
+    # bool array inside a tuple key (e.g. ``x[mask, 2] = v``): stays on
+    # device as a combined per-dim mask + rank-among-True value gather —
+    # multi-host safe (the carried ISSUE 6 debt fix; the host fallback
+    # below reads `_logical`, which refuses on multi-host padded arrays)
+    if isinstance(key, tuple) and builtins.any(_is_bool_array(k) for k in key):
+        if _setitem_bool_tuple(x, key, value):
+            return
+        _host_fallback_warning(f"key {key!r} mixes mask/advanced entries")
+        return _setitem_host_fallback(x, key, value)
+
     # basic / integer-array keys: normalize against logical extents and
     # update the physical buffer in place — pads are unreachable. Tuple keys
     # containing boolean arrays consume multiple dims per entry and skip the
@@ -627,10 +637,19 @@ def setitem(x: DNDarray, key, value) -> None:
                 raise
             _host_fallback_warning(f"key {key!r} is not jnp-compatible ({e})")
     else:
-        # un-normalizable keys (e.g. bool arrays inside a tuple) must NOT be
-        # applied to the padded physical buffer — negative/global indices
-        # would resolve against the physical extent and write pads silently
+        # un-normalizable keys (e.g. n-D bool arrays inside a tuple) must
+        # NOT be applied to the padded physical buffer — negative/global
+        # indices would resolve against the physical extent and write pads
+        # silently
         _host_fallback_warning(f"key {key!r} mixes mask/advanced entries")
+    return _setitem_host_fallback(x, key, value)
+
+
+def _setitem_host_fallback(x: DNDarray, key, value) -> None:
+    """Last-resort eager update: numpy on the host-logical view
+    (single-controller only — `_logical` refuses on multi-host padded
+    arrays rather than mis-computing)."""
+    buf = x.larray
 
     def _np_key(k):
         if isinstance(k, tuple):
@@ -642,6 +661,87 @@ def setitem(x: DNDarray, key, value) -> None:
     x.larray = DNDarray.from_logical(
         jnp.asarray(host, dtype=buf.dtype), x.split, x.device, x.comm, x.dtype
     ).larray
+
+
+def _setitem_bool_tuple(x: DNDarray, key, value) -> builtins.bool:
+    """``x[key] = value`` for a tuple key with exactly ONE 1-D boolean
+    array among ints/slices, entirely on device (the carried edge-case
+    debt ISSUE 6 closes; reference dndarray.py:1334-1549 does this
+    shard-side too). Returns False for shapes this path does not cover —
+    the caller falls back to the host.
+
+    Construction: each key entry becomes a per-dim mask over the PHYSICAL
+    buffer (the bool vector is padded with False, int/slice masks are
+    bounded by the logical extent, so pads are never writable), the masks
+    AND together, and the value lands either as a broadcast scalar
+    (`where`) or by rank-among-True gather — the physical row-major rank
+    of a selected position equals its numpy assignment order because with
+    one advanced entry numpy keeps the result dim in place and pads are
+    excluded. One scalar sync validates the value count (numpy parity)."""
+    bool_pos = [i for i, k in enumerate(key) if _is_bool_array(k)]
+    if len(bool_pos) != 1:
+        return False
+    bp = bool_pos[0]
+    kb = np.asarray(key[bp])
+    if kb.ndim != 1 or len(key) > x.ndim or x.ndim == 0:
+        return False
+    for i, k in enumerate(key):
+        if i == bp:
+            continue
+        if not isinstance(k, (builtins.int, np.integer, slice)):
+            return False
+        if isinstance(k, slice) and k.step is not None and k.step < 0:
+            # numpy assigns vector values along the REVERSED traversal of
+            # a negative-step slice; the rank-among-True gather below is
+            # ascending-order only — keep numpy semantics on the fallback
+            return False
+    if kb.shape != (x.shape[bp],):
+        return False
+    buf = x.larray
+    nd = x.ndim
+    sel = None
+    for d in range(nd):
+        n = x.shape[d]
+        k = key[d] if d < len(key) else slice(None)
+        iota = jax.lax.broadcasted_iota(jnp.int32, buf.shape, d)
+        if d == bp:
+            mvec = jnp.asarray(kb, dtype=jnp.bool_)
+            pn = buf.shape[d]
+            if pn != n:
+                mvec = jnp.pad(mvec, (0, pn - n), constant_values=False)
+            shape = [1] * nd
+            shape[d] = pn
+            m = jnp.broadcast_to(jnp.reshape(mvec, shape), buf.shape)
+        elif isinstance(k, (builtins.int, np.integer)):
+            kk = builtins.int(k)
+            if kk < -n or kk >= n:
+                raise IndexError(
+                    f"index {kk} is out of bounds for axis {d} with size {n}"
+                )
+            m = iota == (kk + n if kk < 0 else kk)
+        else:
+            start, stop, step = k.indices(n)
+            m = (iota >= start) & (iota < stop) & (
+                (iota - start) % step == 0
+            )
+        sel = m if sel is None else (sel & m)
+    val = jnp.asarray(value, dtype=buf.dtype)
+    if val.ndim == 0 or val.size == 1:
+        x.larray = jnp.where(sel, jnp.reshape(val, ()), buf)
+        return True
+    nnz = builtins.int(jnp.sum(sel))  # one scalar sync (numpy parity check)
+    val1 = jnp.reshape(val, (-1,))
+    if builtins.int(val1.shape[0]) != nnz:
+        # partially-broadcast value shapes keep numpy's error/broadcast
+        # semantics on the fallback path
+        return False
+    if nnz == 0:
+        return True
+    flat = jnp.reshape(sel, (-1,))
+    ranks = jnp.clip(jnp.cumsum(flat) - 1, 0, val1.shape[0] - 1)
+    taken = jnp.reshape(jnp.take(val1, ranks), buf.shape)
+    x.larray = jnp.where(sel, taken, buf)
+    return True
 
 
 def _scatter_compact(comm: MeshCommunication, out_shape, dtype, dest, vals):

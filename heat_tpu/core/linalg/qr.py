@@ -49,37 +49,58 @@ def _gram_ring(buf: jax.Array, comm, audit_cost=None) -> jax.Array:
     plus the final n² all-gather of row blocks. ``audit_cost`` (an
     analytic CollectiveCost) turns on the HLO collective audit of the
     kernel program (telemetry/hlo.py)."""
+    from .. import relayout_planner
+
     p = comm.size
     axis = comm.axis_name
     n_phys = buf.shape[1]
     c = n_phys // p  # per-device column-block width (used by the tile writes)
+    # double-buffered overlap schedule (ISSUE 6): hop before the tile
+    # GEMM (so the permute rides under the compute) and peel the final
+    # dead hop — p-1 hops, bit-identical tiles/updates; the serial p-hop
+    # kernel is restored by HEAT_TPU_RING_OVERLAP=0
+    overlap = relayout_planner.ring_overlap() and p > 1
 
     xt = buf.T  # (n_phys, m) split=0 — local transpose, no relayout
 
     def kernel(xt_blk):
         rank = jax.lax.axis_index(axis)
 
-        def body(t, carry):
-            circ, acc = carry
+        def tile_into(t, circ, acc):
             origin = (rank - t) % p
             tile = xt_blk @ circ.T  # (c, c)
-            acc = jax.lax.dynamic_update_slice(
+            return jax.lax.dynamic_update_slice(
                 acc, tile, (jnp.int32(0), (origin * c).astype(jnp.int32))
             )
-            # the comm wrapper (not raw lax.ppermute) so the hop is named
-            # in telemetry's trace-time collective record
-            circ = comm.ring_permute(circ)
-            return circ, acc
 
         acc0 = jax.lax.pcast(
             jnp.zeros((xt_blk.shape[0], n_phys), dtype=buf.dtype),
             axis,
             to="varying",
         )
-        _, acc = jax.lax.fori_loop(0, p, body, (xt_blk, acc0))
+        if overlap:
+            def body(t, carry):
+                circ, acc = carry
+                cnext = comm.ring_permute(circ)
+                acc = tile_into(t, circ, acc)
+                return cnext, acc
+
+            circ, acc = jax.lax.fori_loop(0, p - 1, body, (xt_blk, acc0))
+            acc = tile_into(p - 1, circ, acc)
+        else:
+            def body(t, carry):
+                circ, acc = carry
+                acc = tile_into(t, circ, acc)
+                # the comm wrapper (not raw lax.ppermute) so the hop is
+                # named in telemetry's trace-time collective record
+                circ = comm.ring_permute(circ)
+                return circ, acc
+
+            _, acc = jax.lax.fori_loop(0, p, body, (xt_blk, acc0))
         return jax.lax.all_gather(acc, axis, tiled=True)  # replicated G
 
-    key = (tuple(buf.shape), str(buf.dtype))
+    key = (tuple(buf.shape), str(buf.dtype),
+           "overlap" if overlap else "serial")
     smapped = program_cache.cached_program(
         "cholqr_gram_ring", key,
         lambda: jax.shard_map(
@@ -148,12 +169,21 @@ def _cholqr_split1(a: DNDarray, dt, calc_q: bool, audit: bool = False) -> QR:
     passes_left = 2
     shifted = False
     q_buf = buf
+    from .. import relayout_planner
+
+    gram_hops = (
+        comm.size - 1 if relayout_planner.ring_overlap() and comm.size > 1
+        else comm.size
+    )
     while passes_left > 0:
         cost, fields, do_audit = telemetry.op_cost(
             telemetry.collectives.gram_ring_cost, m, n, dt.byte_size(),
-            comm.size, audit=audit,
+            comm.size, gram_hops, audit=audit,
         )
-        with telemetry.span("cholqr_gram_ring", gshape=[m, n], **fields) as sp:
+        with telemetry.span(
+            "cholqr_gram_ring", gshape=[m, n],
+            overlap=gram_hops < comm.size, **fields,
+        ) as sp:
             g = sp.output(
                 _gram_ring(q_buf, comm, audit_cost=cost if do_audit else None)
             )[:n, :n]

@@ -1,0 +1,232 @@
+"""First-class ragged (rank-proportional) layouts — the sanctioned
+substitute for the reference's ``redistribute_(target_map)``.
+
+The reference framework lets MPI rank ``r`` own an arbitrary number of
+split-dim rows because Alltoallv makes ragged shards first-class. The XLA
+layout model admits exactly ONE physical layout per ``(gshape, split,
+mesh)`` — equal ceil-rule shards with a tail pad — so that design point is
+formally closed here (PARITY.md, "redistribute_ and ragged target maps"
+and ``DNDarray.redistribute_``). What the reference actually *uses* ragged
+maps for survives, as this module's :class:`Ragged`:
+
+* the data stays on the **canonical** layout (one compiled-program family,
+  every op works unchanged);
+* the ragged intent — "position ``i`` owns ``counts[i]`` rows" — is
+  carried as metadata: an ``owner`` map plus per-position masks/blocks
+  that ride the same sharding as the data, so "position i's work" is a
+  mask multiply, not a ragged shard;
+* **redistribution of the intent is free**: :meth:`Ragged.redistribute`
+  rewrites ``counts`` without moving a byte (the reference's
+  ``redistribute_`` moves the whole array through Alltoallv for the same
+  outcome);
+* **redistribution of the layout** (changing the split axis) goes through
+  the canonical :meth:`DNDarray.resplit` — which, since ISSUE 6, is
+  planner-managed: near the HBM ceiling the communication-aware relayout
+  planner (:mod:`heat_tpu.core.relayout_planner`) decomposes the move
+  into a bounded-memory chunked program chain instead of raising, so a
+  ragged workload can change layout at sizes where the monolithic
+  relayout cannot.
+
+This promotes the ``examples/ragged_layout.py`` demo (the PR-3-era
+substitute) to API: :func:`ragged` builds a :class:`Ragged` from
+per-position blocks (the reference's construction) or from data plus an
+explicit ``counts`` vector.
+"""
+
+from __future__ import annotations
+
+import builtins
+from typing import Optional, Sequence
+
+import numpy as np
+
+from .dndarray import DNDarray
+
+__all__ = ["Ragged", "ragged"]
+
+
+class Ragged:
+    """A canonical-layout array carrying a ragged ownership intent.
+
+    ``counts[i]`` is the number of logical positions along ``axis`` that
+    mesh position ``i`` owns *logically* — the physical shards stay the
+    canonical ceil-rule chunks. See the module docstring for why this is
+    the TPU-native form of a ragged layout.
+    """
+
+    def __init__(self, array: DNDarray, counts: Sequence[int], axis: int = 0):
+        if not isinstance(array, DNDarray):
+            raise TypeError(f"array must be a DNDarray, got {type(array)}")
+        counts = np.asarray(counts, dtype=np.int64).reshape(-1)
+        p = array.comm.size
+        if counts.shape[0] != p:
+            raise ValueError(
+                f"counts must have one entry per mesh position "
+                f"({p}), got {counts.shape[0]}"
+            )
+        if (counts < 0).any():
+            raise ValueError(f"counts must be non-negative, got {counts.tolist()}")
+        axis = int(axis)
+        if not 0 <= axis < array.ndim:
+            raise ValueError(f"axis {axis} out of range for {array.ndim}-d array")
+        if int(counts.sum()) != array.shape[axis]:
+            raise ValueError(
+                f"counts sum to {int(counts.sum())} but the array has "
+                f"{array.shape[axis]} positions along axis {axis}"
+            )
+        self.__array = array
+        self.__counts = counts
+        self.__axis = axis
+        self.__owner = None
+
+    # -- metadata -------------------------------------------------------------
+
+    @property
+    def array(self) -> DNDarray:
+        """The canonical-layout data."""
+        return self.__array
+
+    @property
+    def axis(self) -> int:
+        return self.__axis
+
+    @property
+    def counts(self) -> np.ndarray:
+        """Per-position logical extents (a copy)."""
+        return self.__counts.copy()
+
+    @property
+    def displs(self) -> np.ndarray:
+        """Per-position logical start offsets along ``axis``."""
+        return np.concatenate([[0], np.cumsum(self.__counts)[:-1]])
+
+    @property
+    def owner(self) -> DNDarray:
+        """``owner[j]`` = mesh position that logically owns index ``j``
+        along ``axis`` — a 1-D int64 DNDarray sharded like the data's
+        ``axis`` (so ``owner == i`` masks are shard-aligned with the
+        rows they gate). Built once, cached."""
+        if self.__owner is None:
+            from . import factories
+
+            arr = self.__array
+            vec = np.repeat(
+                np.arange(self.__counts.shape[0], dtype=np.int64),
+                self.__counts,
+            )
+            split = 0 if arr.split == self.__axis else None
+            self.__owner = factories.array(
+                vec, split=split, device=arr.device, comm=arr.comm
+            )
+        return self.__owner
+
+    def __repr__(self) -> str:
+        return (
+            f"Ragged(counts={self.__counts.tolist()}, axis={self.__axis}, "
+            f"array=<{self.__array.shape} split={self.__array.split}>)"
+        )
+
+    # -- per-position views ---------------------------------------------------
+
+    def mask(self, position: int) -> DNDarray:
+        """Boolean mask selecting position ``position``'s logical indices
+        along ``axis`` — shard-aligned with the data, so ``x * mask``
+        touches only that position's rows on the canonical layout."""
+        from . import relational
+
+        p = self.__counts.shape[0]
+        position = builtins.int(position)
+        if not 0 <= position < p:
+            raise ValueError(f"position {position} out of range for {p}")
+        return relational.eq(self.owner, position)
+
+    def block(self, position: int) -> DNDarray:
+        """Position ``position``'s logical slice along ``axis`` (the rows
+        a ragged shard would hold) — a canonical-layout DNDarray."""
+        p = self.__counts.shape[0]
+        position = builtins.int(position)
+        if not 0 <= position < p:
+            raise ValueError(f"position {position} out of range for {p}")
+        lo = builtins.int(self.displs[position])
+        hi = lo + builtins.int(self.__counts[position])
+        key = tuple(
+            slice(lo, hi) if d == self.__axis else slice(None)
+            for d in range(self.__array.ndim)
+        )
+        return self.__array[key]
+
+    # -- redistribution -------------------------------------------------------
+
+    def redistribute(self, counts: Sequence[int]) -> "Ragged":
+        """A new :class:`Ragged` with the ownership intent rewritten to
+        ``counts`` — ZERO data movement (the canonical layout already
+        holds every row where XLA wants it; only the metadata changes).
+        This is the operation the reference's ``redistribute_`` pays an
+        Alltoallv for."""
+        return Ragged(self.__array, counts, self.__axis)
+
+    def resplit(self, axis: Optional[int] = None) -> "Ragged":
+        """Change the *physical* distribution axis of the canonical data
+        (the intent is unchanged). Planner-managed: under an
+        ``HEAT_TPU_HBM_BUDGET`` the relayout decomposes into a
+        bounded-memory chunked program chain instead of erroring at the
+        ceiling (core/relayout_planner.py)."""
+        return Ragged(self.__array.resplit(axis), self.__counts, self.__axis)
+
+
+def ragged(
+    blocks_or_data,
+    counts: Optional[Sequence[int]] = None,
+    *,
+    axis: int = 0,
+    split: Optional[int] = 0,
+    dtype=None,
+    device=None,
+    comm=None,
+) -> Ragged:
+    """Build a :class:`Ragged` layout.
+
+    Two forms:
+
+    * ``ht.ragged([b0, b1, ...])`` — one array-like block per mesh
+      position, concatenated along ``axis``; ``counts`` are the block
+      extents (the reference's per-rank construction);
+    * ``ht.ragged(data, counts)`` — existing data (array-like or
+      DNDarray) plus an explicit per-position counts vector.
+
+    The data lands on the canonical layout with the given ``split``
+    (DNDarray inputs keep theirs); the ragged intent is metadata. See
+    :class:`Ragged` for the operations it supports and
+    ``examples/ragged_layout.py`` for a worked tour.
+    """
+    from . import factories
+    from .communication import sanitize_comm
+
+    comm = sanitize_comm(
+        comm if comm is not None
+        else (blocks_or_data.comm if isinstance(blocks_or_data, DNDarray) else None)
+    )
+    if counts is None:
+        blocks = list(blocks_or_data)
+        if len(blocks) != comm.size:
+            raise ValueError(
+                f"ragged(blocks) needs one block per mesh position "
+                f"({comm.size}), got {len(blocks)}"
+            )
+        blocks = [np.asarray(b) for b in blocks]
+        counts = [b.shape[axis] for b in blocks]
+        data = np.concatenate(blocks, axis=axis) if blocks else np.empty((0,))
+        arr = factories.array(
+            data, dtype=dtype, split=split, device=device, comm=comm
+        )
+        return Ragged(arr, counts, axis)
+    if isinstance(blocks_or_data, DNDarray):
+        arr = blocks_or_data
+        if dtype is not None:
+            arr = arr.astype(dtype)
+    else:
+        arr = factories.array(
+            np.asarray(blocks_or_data), dtype=dtype, split=split,
+            device=device, comm=comm,
+        )
+    return Ragged(arr, counts, axis)

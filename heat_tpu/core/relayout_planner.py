@@ -1,0 +1,715 @@
+"""Communication-aware relayout planning (ISSUE 6 tentpole).
+
+Every resplit used to lower as ONE monolithic slice→repad→reshard program
+(`DNDarray._relayout`). That is the right call when it fits — one dispatch,
+minimal wire volume — but near the HBM ceiling the monolithic program's
+temporaries are what break first: `memory_guard` could only degrade
+(fusion window-flush, gc) and then **error**. "Memory-efficient array
+redistribution through portable collective communication"
+(arXiv:2112.01075) observes that any resplit decomposes into chains of
+smaller collectives with *bounded peak memory*; this module is that
+observation made operational:
+
+* :func:`plan` enumerates candidate plans for a relayout
+  ``(gshape, itemsize, src split, dst split, mesh)``:
+
+  - **monolithic** — today's single cached program, kept verbatim as the
+    fast path (site ``relayout``; auto mode with no budget never builds
+    anything else, so dispatch stays one dict lookup);
+  - **alltoall** — an explicit `shard_map` kernel (pad the destination
+    axis locally, one ``lax.all_to_all``, slice the source axis locally).
+    Same wire volume as monolithic with a *pinned* collective schedule —
+    the plan to force when XLA's monolithic lowering must not be trusted;
+  - **chunked** — ``k`` destination-shard-aligned column blocks, each
+    moved by its own small cached program into a donated accumulator.
+    Each stage is exactly ONE all-gather of ``~B/k`` bytes (verified by
+    the per-stage HLO audit), so peak temp memory is ``O(B/k)`` instead
+    of ``O(B)`` — the bounded-memory decomposition. The price is wire
+    volume: an aligned chunk lands whole on one destination shard, so a
+    stage all-gathers ``chunk·(p-1)`` bytes and the chunked total is
+    ``~B·(p-1)`` vs the monolithic all-to-all's ``B·(p-1)/p``. The
+    planner therefore picks chunked ONLY when monolithic cannot fit.
+
+* scoring uses the analytic collective cost model
+  (:mod:`heat_tpu.telemetry.collectives`) for wire bytes plus a
+  per-device temp-memory model calibrated against XLA CPU
+  ``memory_analysis()`` (tests pin measured ≤ model); feasibility under
+  ``HEAT_TPU_HBM_BUDGET`` mirrors `memory_guard.preflight` arithmetic
+  (``live + temp + output ≤ budget``), so a plan the planner emits is a
+  plan the pre-flight guard will admit — plan selection *replaces* the
+  error-at-the-ceiling ladder step for relayouts.
+
+* :func:`run` executes a decomposed plan as a chain of
+  :func:`~heat_tpu.core.program_cache.cached_program` stages — each stage
+  carries its own structural signature (site ``relayout_chunk`` /
+  ``relayout_a2a`` / ``relayout_init``), its own HLO audit
+  (``relayout_stage`` records, predicted per-stage cost), and the
+  resilience retry guard every cached program gets. Repeat dispatch of
+  the same plan is pure cache hits (CompileWatcher: zero recompiles).
+
+Knob: ``HEAT_TPU_RELAYOUT_PLAN=auto|monolithic|chunked|alltoall``
+(default ``auto``). ``monolithic`` restores the pre-planner behavior
+bit-for-bit; ``chunked``/``alltoall`` force the decomposition regardless
+of budget (chunk count then sized from
+:func:`heat_tpu.resilience.memory_guard.temp_budget`). docs/TUNING_RUNBOOK.md
+§0.8 discusses when each wins.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Callable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .. import telemetry
+
+__all__ = [
+    "PlanStage",
+    "RelayoutPlan",
+    "mode",
+    "ring_overlap",
+    "plan",
+    "maybe_plan",
+    "run",
+    "plan_memory",
+    "bench_field",
+    "monolithic_need",
+    "chunk_stage_need",
+    "MAX_CHUNKS",
+]
+
+_ENV_MODE = "HEAT_TPU_RELAYOUT_PLAN"
+_MODES = ("auto", "monolithic", "chunked", "alltoall")
+
+# Hard cap on decomposition width: each chunk is its own small cached
+# program, so k bounds both registry entries and per-plan compile count.
+MAX_CHUNKS = 32
+
+# Per-device temp model, calibrated against XLA CPU memory_analysis():
+# a monolithic s->t relayout measures ~1.75x its per-device shard in
+# temporaries; a chunk stage measures ~1.25x its chunk. Both models round
+# UP (2x / 1.5x) so "the model says it fits" stays conservative.
+_MONO_TEMP_FACTOR = 2.0
+_CHUNK_TEMP_FACTOR = 1.5
+
+
+def mode() -> str:
+    """The active ``HEAT_TPU_RELAYOUT_PLAN`` value (malformed -> auto)."""
+    raw = os.environ.get(_ENV_MODE, "").strip().lower()
+    return raw if raw in _MODES else "auto"
+
+
+def ring_overlap() -> bool:
+    """Whether the double-buffered ring schedule is active
+    (``HEAT_TPU_RING_OVERLAP``, default on): the ring kernels
+    (spatial cdist/manhattan/rbf, TSQR gram ring) issue the next hop's
+    ``ppermute`` *before* consuming the current block — the permute is
+    data-independent of the local GEMM, so XLA's latency-hiding
+    scheduler can ride it under the compute — and skip the final hop
+    that only returns each block home (``p-1`` hops instead of ``p``).
+    Tile values and update order are unchanged, so results are
+    bit-identical to the serial schedule; ``HEAT_TPU_RING_OVERLAP=0``
+    restores the serial p-hop kernels verbatim."""
+    return os.environ.get("HEAT_TPU_RING_OVERLAP", "1").strip().lower() not in (
+        "0", "false", "off", "no",
+    )
+
+
+@dataclass(frozen=True)
+class PlanStage:
+    """One chunk stage: destination-axis block ``[lo, hi)`` moved by one
+    cached program, with its analytic collective cost and per-device temp
+    estimate."""
+
+    lo: int
+    hi: int
+    cost: "telemetry.collectives.CollectiveCost"
+    temp_bytes: int
+
+    def summary(self) -> dict:
+        return {
+            "lo": self.lo, "hi": self.hi, "collective": self.cost.kind,
+            "wire_bytes": self.cost.bytes, "temp_bytes": self.temp_bytes,
+        }
+
+
+@dataclass(frozen=True)
+class RelayoutPlan:
+    """The selected relayout schedule for one layout signature."""
+
+    kind: str                       # "monolithic" | "alltoall" | "chunked"
+    gshape: Tuple[int, ...]
+    itemsize: int
+    src_split: Optional[int]
+    dst_split: Optional[int]
+    chunk_axis: Optional[int]       # destination axis the chunks tile
+    stages: Tuple[PlanStage, ...]   # empty for monolithic/alltoall
+    predicted_bytes: int            # total wire bytes over all stages
+    temp_bytes: int                 # analytic peak per-device temp (model)
+    reason: str                     # why this plan won (event/debugging)
+
+    @property
+    def chunks(self) -> int:
+        return len(self.stages)
+
+    def summary(self) -> dict:
+        """The ``relayout_plan`` telemetry-event payload (schema in
+        docs/OBSERVABILITY.md)."""
+        return {
+            "plan": self.kind,
+            "gshape": list(self.gshape),
+            "src_split": self.src_split,
+            "dst_split": self.dst_split,
+            "chunks": self.chunks,
+            "stages": self.chunks if self.kind == "chunked" else 1,
+            "predicted_bytes": self.predicted_bytes,
+            "temp_bytes": self.temp_bytes,
+            "reason": self.reason,
+        }
+
+
+def _phys_numel(gshape: Sequence[int], split: Optional[int], nproc: int) -> int:
+    """Element count of the tail-padded physical buffer."""
+    n = 1
+    for d, s in enumerate(gshape):
+        if d == split:
+            s = -(-int(s) // nproc) * nproc
+        n *= int(s)
+    return n
+
+
+def monolithic_need(
+    gshape: Sequence[int],
+    itemsize: int,
+    src_split: Optional[int],
+    dst_split: Optional[int],
+    nproc: int,
+) -> int:
+    """Analytic per-device (temp + output) bytes of the monolithic
+    relayout program — the quantity `memory_guard.preflight` budgets.
+    Replicated destinations hold the whole output on every device."""
+    if nproc <= 1 or src_split == dst_split:
+        return 0
+    b_src = _phys_numel(gshape, src_split, nproc) * int(itemsize)
+    b_dst = _phys_numel(gshape, dst_split, nproc) * int(itemsize)
+    out = b_dst if dst_split is None else b_dst // nproc
+    if src_split is None:
+        return out  # local slice, no temp
+    if dst_split is None:
+        return out  # all-gather: measured temp ~0, output dominates
+    return int(_MONO_TEMP_FACTOR * b_src / nproc) + out
+
+
+def chunk_stage_need(
+    gshape: Sequence[int],
+    itemsize: int,
+    src_split: int,
+    dst_split: int,
+    width: int,
+    nproc: int,
+) -> Tuple[int, int]:
+    """(per-device temp, per-device output) byte estimates for one chunk
+    stage of ``width`` destination-axis columns."""
+    other = _phys_numel(gshape, src_split, nproc) // max(
+        1, int(gshape[dst_split])
+    )
+    chunk = other * int(width) * int(itemsize)
+    out = _phys_numel(gshape, dst_split, nproc) * int(itemsize) // nproc
+    return int(_CHUNK_TEMP_FACTOR * chunk), out
+
+
+def _monolithic(gshape, itemsize, src, dst, nproc, reason) -> RelayoutPlan:
+    cost = telemetry.collectives.relayout_cost(
+        gshape, itemsize, src, dst, nproc
+    )
+    return RelayoutPlan(
+        kind="monolithic", gshape=tuple(int(s) for s in gshape),
+        itemsize=int(itemsize), src_split=src, dst_split=dst,
+        chunk_axis=None, stages=(),
+        predicted_bytes=int(cost.bytes),
+        temp_bytes=monolithic_need(gshape, itemsize, src, dst, nproc),
+        reason=reason,
+    )
+
+
+def _alltoall(gshape, itemsize, src, dst, nproc, reason) -> RelayoutPlan:
+    cost = telemetry.collectives.relayout_cost(
+        gshape, itemsize, src, dst, nproc
+    )
+    return RelayoutPlan(
+        kind="alltoall", gshape=tuple(int(s) for s in gshape),
+        itemsize=int(itemsize), src_split=src, dst_split=dst,
+        chunk_axis=None, stages=(),
+        predicted_bytes=int(cost.bytes),
+        temp_bytes=monolithic_need(gshape, itemsize, src, dst, nproc),
+        reason=reason,
+    )
+
+
+def _chunked(
+    gshape, itemsize, src, dst, nproc, width: int, reason: str
+) -> RelayoutPlan:
+    """Build the chunked plan: destination-shard-aligned blocks of
+    ``width`` columns along ``dst`` (clipped at shard and logical edges),
+    one stage per block."""
+    gshape = tuple(int(s) for s in gshape)
+    extent = gshape[dst]
+    pad_extent = -(-extent // nproc) * nproc
+    cm = pad_extent // nproc  # destination shard width
+    width = max(1, min(int(width), cm))
+    # Even subdivision keeps the CHUNK SHAPES to at most two (full blocks
+    # + one clipped logical tail) — which is what bounds the per-stage
+    # HLO-audit memo and temp-model variety. Each stage still bakes its
+    # static (lo, hi) into its own small program (k compiles, k registry
+    # entries, capped by MAX_CHUNKS): that is deliberate — a shared
+    # program with a RUNTIME start index was measured to lower with extra
+    # collective-permutes and ~2x the temp bytes on the sharded slice,
+    # defeating the bounded-memory point.
+    per_shard = -(-cm // width)
+    width = -(-cm // per_shard)
+    stages = []
+    for shard in range(nproc):
+        base = shard * cm
+        for q in range(per_shard):
+            lo = base + q * width
+            hi = min(lo + width, min(base + cm, extent))
+            if hi <= lo:
+                continue
+            cshape = list(gshape)
+            cshape[dst] = hi - lo
+            cost = telemetry.collectives.relayout_chunk_cost(
+                gshape, itemsize, src, dst, hi - lo, nproc
+            )
+            temp, _ = chunk_stage_need(
+                gshape, itemsize, src, dst, hi - lo, nproc
+            )
+            stages.append(PlanStage(lo=lo, hi=hi, cost=cost, temp_bytes=temp))
+    return RelayoutPlan(
+        kind="chunked", gshape=gshape, itemsize=int(itemsize),
+        src_split=src, dst_split=dst, chunk_axis=dst,
+        stages=tuple(stages),
+        predicted_bytes=sum(int(s.cost.bytes) for s in stages),
+        temp_bytes=max((s.temp_bytes for s in stages), default=0),
+        reason=reason,
+    )
+
+
+def _chunk_width_for(gshape, itemsize, src, dst, nproc, avail: int) -> int:
+    """Largest chunk width whose stage temp model fits ``avail`` bytes,
+    clamped so the plan stays within :data:`MAX_CHUNKS` stages (best
+    effort beyond that — a too-narrow plan is still better than the
+    guaranteed overflow it replaces)."""
+    extent = int(gshape[dst])
+    pad_extent = -(-extent // nproc) * nproc
+    cm = max(1, pad_extent // nproc)
+    other = _phys_numel(gshape, src, nproc) // max(1, extent)
+    per_col = max(1, int(_CHUNK_TEMP_FACTOR * other * itemsize))
+    width = max(1, min(cm, avail // per_col))
+    # respect the stage-count cap: k = nproc * ceil(cm / width)
+    min_width = -(-cm // max(1, MAX_CHUNKS // nproc))
+    return max(width, min_width)
+
+
+def plan(
+    gshape: Sequence[int],
+    itemsize: int,
+    src_split: Optional[int],
+    dst_split: Optional[int],
+    comm,
+    *,
+    budget: Optional[int] = None,
+    live: int = 0,
+    measured_need: Optional[int] = None,
+    plan_mode: Optional[str] = None,
+) -> RelayoutPlan:
+    """Select the relayout plan for one layout signature.
+
+    Pure given its inputs (the golden tests sweep ``budget`` with
+    ``live=0``): ``budget``/``live`` are bytes in `memory_guard`'s
+    convention, ``measured_need`` optionally replaces the analytic
+    monolithic (temp+output) estimate with the compiled program's
+    ``memory_analysis()`` figure. ``plan_mode`` overrides the env knob.
+
+    Selection in ``auto``: monolithic when it fits (``live + need <=
+    budget``, or no budget at all); otherwise the chunked decomposition
+    with the chunk width sized to the remaining headroom. Decompositions
+    require both splits to be real axes — split→replicated keeps the
+    monolithic program (its memory is dominated by the replicated
+    *output*, which no decomposition shrinks) and replicated→split is a
+    zero-comm local slice.
+    """
+    nproc = getattr(comm, "size", comm if isinstance(comm, int) else 1)
+    m = plan_mode if plan_mode in _MODES else mode()
+    gshape = tuple(int(s) for s in gshape)
+    decomposable = (
+        nproc > 1
+        and src_split is not None
+        and dst_split is not None
+        and src_split != dst_split
+        and gshape[dst_split] > 0
+        and all(s > 0 for s in gshape)
+    )
+    if m == "monolithic" or (not decomposable and m != "auto"):
+        reason = (
+            "forced by HEAT_TPU_RELAYOUT_PLAN=monolithic"
+            if m == "monolithic"
+            else f"{m} forced but relayout is not decomposable; monolithic"
+        )
+        return _monolithic(gshape, itemsize, src_split, dst_split, nproc,
+                           reason)
+    if m == "alltoall":
+        return _alltoall(gshape, itemsize, src_split, dst_split, nproc,
+                         "forced by HEAT_TPU_RELAYOUT_PLAN=alltoall")
+    if m == "chunked":
+        from ..resilience import memory_guard
+
+        width = _chunk_width_for(
+            gshape, itemsize, src_split, dst_split, nproc,
+            memory_guard.temp_budget(),
+        )
+        return _chunked(gshape, itemsize, src_split, dst_split, nproc, width,
+                        "forced by HEAT_TPU_RELAYOUT_PLAN=chunked")
+    # -- auto ---------------------------------------------------------------
+    if budget is None or not decomposable:
+        return _monolithic(gshape, itemsize, src_split, dst_split, nproc,
+                           "auto: no budget" if budget is None
+                           else "auto: not decomposable")
+    need = (
+        int(measured_need)
+        if measured_need is not None and measured_need > 0
+        else monolithic_need(gshape, itemsize, src_split, dst_split, nproc)
+    )
+    if live + need <= budget:
+        return _monolithic(
+            gshape, itemsize, src_split, dst_split, nproc,
+            f"auto: monolithic fits (live {live} + need {need} <= "
+            f"budget {budget})",
+        )
+    temp_min, out = chunk_stage_need(
+        gshape, itemsize, src_split, dst_split, 1, nproc
+    )
+    if live + temp_min + out > budget:
+        # even a single-column chunk cannot fit: decomposing would only
+        # move the failure to a stage site — keep the monolithic program
+        # so memory_guard's ladder raises its classic, actionable error
+        return _monolithic(
+            gshape, itemsize, src_split, dst_split, nproc,
+            f"auto: no feasible decomposition (budget {budget} B below "
+            f"even a width-1 chunk's need, live {live} B)",
+        )
+    avail = max(1, budget - live - out)
+    width = _chunk_width_for(
+        gshape, itemsize, src_split, dst_split, nproc, avail
+    )
+    return _chunked(
+        gshape, itemsize, src_split, dst_split, nproc, width,
+        f"auto: monolithic needs {need} B over budget {budget} B "
+        f"(live {live} B); chunked width {width}",
+    )
+
+
+def active() -> bool:
+    """Whether planning can change anything: a non-auto knob or an armed
+    HBM budget. One env-var check each — the cost `_relayout` pays on the
+    fast path."""
+    if mode() != "auto":
+        return True
+    from ..resilience import memory_guard
+
+    return memory_guard.budget_bytes() is not None
+
+
+def maybe_plan(
+    gshape,
+    itemsize: int,
+    src_split: Optional[int],
+    dst_split: Optional[int],
+    comm,
+    measure: Optional[Callable[[], int]] = None,
+) -> Optional[RelayoutPlan]:
+    """The `_relayout` entry point: returns None on the fast path (auto
+    mode, no budget — the monolithic program dispatches exactly as before
+    planning existed), else the selected plan. ``measure()`` lazily
+    supplies the monolithic program's measured (temp+output) bytes; it is
+    only invoked when a budget decision actually needs it."""
+    if not active():
+        return None
+    if comm.size <= 1 or src_split == dst_split:
+        return None
+    from ..resilience import memory_guard
+
+    budget = memory_guard.budget_bytes()
+    measured = None
+    live = 0
+    # split→replicated / replicated→split can never decompose — skip the
+    # measure + gc + live-array walk entirely (these are the HOT small
+    # relayouts: every `_replicated()` index-vector/centroid read), the
+    # decision is "monolithic" regardless
+    decomposable = (
+        src_split is not None and dst_split is not None
+        and all(int(s) > 0 for s in gshape)
+    )
+    if budget is not None and decomposable:
+        # measure the monolithic program FIRST (the AOT compile can leave
+        # collectable per-shard garbage that would inflate the live-bytes
+        # reading), then gc — the same ordering memory_guard's ladder
+        # uses — so the live figure the decision sees is the real working
+        # set. Budgeted relayouts are rare, heavyweight events; the gc is
+        # noise next to the compile.
+        if measure is not None and mode() == "auto":
+            try:
+                measured = measure()
+            except Exception:
+                measured = None
+        import gc
+
+        gc.collect()
+        live = memory_guard._live_total()
+    p = plan(
+        gshape, itemsize, src_split, dst_split, comm,
+        budget=budget, live=live, measured_need=measured,
+    )
+    if telemetry.enabled():
+        reg = telemetry.get_registry()
+        reg.add(f"relayout_plan.{p.kind}", 1)
+        reg.emit(
+            "relayout_plan", p.kind, budget=budget, live_bytes=live,
+            measured_need=measured, **p.summary(),
+        )
+    return p
+
+
+# -- plan execution -----------------------------------------------------------
+
+
+def _dst_sharding(comm, dst_split: Optional[int], ndim: int):
+    if comm.size <= 1:
+        return None
+    if dst_split is None:
+        return comm.replicated()
+    return comm.sharding(dst_split, ndim)
+
+
+def _init_program(plan_: RelayoutPlan, comm, dtype_str: str):
+    """Zero-filled accumulator in the destination layout (donated through
+    the stage chain, so only one accumulator is ever live)."""
+    from . import program_cache
+
+    pshape = comm.padded_shape(plan_.gshape, plan_.dst_split)
+    tgt = _dst_sharding(comm, plan_.dst_split, len(plan_.gshape))
+    # dst_split is part of the key: two destination splits can share one
+    # padded shape (divisible extents), and program_key does not see
+    # out_shardings — without it they would share a wrongly-sharded
+    # accumulator that every stage then reshards
+    return program_cache.cached_program(
+        "relayout_init", (pshape, dtype_str, plan_.dst_split),
+        lambda: (lambda: jnp.zeros(pshape, dtype_str)),
+        comm=comm, out_shardings=tgt,
+    )
+
+
+def _stage_key(plan_: RelayoutPlan, stage: PlanStage, dtype_str: str):
+    return (
+        plan_.gshape, dtype_str, plan_.src_split, plan_.dst_split,
+        stage.lo, stage.hi,
+    )
+
+
+def _stage_program(plan_: RelayoutPlan, stage: PlanStage, comm, dtype_str):
+    from . import program_cache
+
+    gshape = plan_.gshape
+    nd = len(gshape)
+    ax = plan_.chunk_axis
+    lo, hi = stage.lo, stage.hi
+    tgt = _dst_sharding(comm, plan_.dst_split, nd)
+
+    def build():
+        sl = tuple(
+            slice(lo, hi) if d == ax else slice(0, gshape[d])
+            for d in range(nd)
+        )
+        starts = tuple(
+            jnp.int32(lo if d == ax else 0) for d in range(nd)
+        )
+
+        def stage_fn(src, acc):
+            # logical slice of the source (drops the src tail pad), then
+            # one placed update into the destination-layout accumulator;
+            # the block is destination-shard-aligned, so XLA emits exactly
+            # one all-gather of the chunk (per-stage HLO audit pins this)
+            return jax.lax.dynamic_update_slice(acc, src[sl], starts)
+
+        return stage_fn
+
+    return program_cache.cached_program(
+        "relayout_chunk", _stage_key(plan_, stage, dtype_str), build,
+        comm=comm, out_shardings=tgt, donate=(1,),
+    )
+
+
+def _a2a_program(plan_: RelayoutPlan, comm, dtype_str):
+    from . import program_cache
+
+    gshape = plan_.gshape
+    nd = len(gshape)
+    s, t = plan_.src_split, plan_.dst_split
+    pad_t = -(-gshape[t] // comm.size) * comm.size
+
+    def build():
+        def kernel(b):
+            # local t-pad up to the padded extent, then one all-to-all,
+            # then a local slice back to the logical s extent
+            widths = [(0, 0)] * nd
+            widths[t] = (0, pad_t - b.shape[t])
+            if pad_t != b.shape[t]:
+                b = jnp.pad(b, widths)
+            out = comm.all_to_all(b, split_axis=t, concat_axis=s)
+            sl = [slice(None)] * nd
+            sl[s] = slice(0, gshape[s])
+            return out[tuple(sl)]
+
+        return jax.shard_map(
+            kernel, mesh=comm.mesh,
+            in_specs=comm.spec(s, nd), out_specs=comm.spec(t, nd),
+        )
+
+    return program_cache.cached_program(
+        "relayout_a2a", (gshape, dtype_str, s, t), build, comm=comm,
+    )
+
+
+def run(plan_: RelayoutPlan, buf: jax.Array, comm, *, audit: bool = False):
+    """Execute a decomposed plan on a physical source buffer; returns the
+    destination-layout physical buffer. Each stage is its own cached
+    program (structural signature + resilience guard); ``audit=True``
+    lower-compiles every distinct stage once and diffs the emitted
+    collectives against the per-stage analytic cost (memoized —
+    ``relayout_stage`` records in `telemetry.hlo.recent()`)."""
+    from . import program_cache
+
+    dtype_str = str(buf.dtype)
+    if plan_.kind == "alltoall":
+        fn = _a2a_program(plan_, comm, dtype_str)
+        if audit:
+            phys = list(plan_.gshape)
+            for axx in (plan_.src_split, plan_.dst_split):
+                if axx is not None:
+                    phys[axx] = -(-phys[axx] // comm.size) * comm.size
+            telemetry.hlo.audit_call(
+                "relayout_stage",
+                lambda: (fn, (buf,)),
+                predicted=telemetry.collectives.relayout_cost(
+                    phys, plan_.itemsize, plan_.src_split, plan_.dst_split,
+                    comm.size,
+                ),
+                key=program_cache.program_key(
+                    "relayout_a2a",
+                    (plan_.gshape, dtype_str, plan_.src_split,
+                     plan_.dst_split),
+                    comm=comm,
+                ),
+                fields={"plan": "alltoall"},
+            )
+        return fn(buf)
+    if plan_.kind != "chunked":
+        raise ValueError(
+            f"run() executes decomposed plans; got {plan_.kind!r} "
+            "(monolithic dispatches through DNDarray._relayout directly)"
+        )
+    acc = _init_program(plan_, comm, dtype_str)()
+    for stage in plan_.stages:
+        fn = _stage_program(plan_, stage, comm, dtype_str)
+        if audit:
+            telemetry.hlo.audit_call(
+                "relayout_stage",
+                (lambda fn=fn, acc=acc: (fn, (buf, acc))),
+                predicted=stage.cost,
+                key=program_cache.program_key(
+                    "relayout_chunk", _stage_key(plan_, stage, dtype_str),
+                    comm=comm, donate=(1,),
+                ),
+                fields={"plan": "chunked", "lo": stage.lo, "hi": stage.hi},
+            )
+        acc = fn(buf, acc)
+    return acc
+
+
+def bench_field(gshape: Tuple[int, ...] = (4096, 64), itemsize: int = 4) -> dict:
+    """The ``relayout_plan`` field for BENCH summaries (bench.py /
+    docs/BENCHMARKS.md): what the active policy would do with the
+    canonical resplit-bench shape on the live mesh — plan kind, stage
+    count, predicted wire bytes — plus the HLO-**audited** wire bytes of
+    the very programs that plan dispatches (AOT lower-compile only;
+    nothing executes). ``audited_wire_bytes`` is None when lowering is
+    unavailable."""
+    from .communication import get_comm
+    from ..resilience import memory_guard
+
+    comm = get_comm()
+    budget = memory_guard.budget_bytes()
+    live = memory_guard._live_total() if budget is not None else 0
+    pl = plan(gshape, itemsize, 0, 1, comm, budget=budget, live=live)
+    field = {
+        "plan": pl.kind,
+        "stages": pl.chunks if pl.kind == "chunked" else 1,
+        "mode": mode(),
+        "budget": budget,
+        "ring_overlap": ring_overlap(),
+        "predicted_wire_bytes": pl.predicted_bytes,
+        "audited_wire_bytes": None,
+    }
+    try:
+        from . import factories, types
+
+        x = factories.zeros(gshape, dtype=types.float32, split=0, comm=comm)
+        buf = x.larray
+        dtype_str = str(buf.dtype)
+        audited = 0
+        if pl.kind == "chunked":
+            acc = _init_program(pl, comm, dtype_str)()
+            for stage in pl.stages:
+                fn = _stage_program(pl, stage, comm, dtype_str)
+                audited += telemetry.hlo.audit_computation(
+                    fn, buf, acc
+                ).total_wire()
+        elif pl.kind == "alltoall":
+            fn = _a2a_program(pl, comm, dtype_str)
+            audited = telemetry.hlo.audit_computation(fn, buf).total_wire()
+        else:
+            fn = x._relayout_executable(pl.dst_split)
+            audited = telemetry.hlo.audit_computation(fn, buf).total_wire()
+        field["audited_wire_bytes"] = int(audited)
+    except Exception:  # pragma: no cover — the probe must never kill a bench
+        pass
+    return field
+
+
+def plan_memory(plan_: RelayoutPlan, buf: jax.Array, comm) -> dict:
+    """Ground-truth per-stage memory of a decomposed plan: lower-compile
+    every stage program (AOT — compiles, never executes) and read
+    ``memory_analysis()``. Returns ``{"stage_temp_bytes": [...],
+    "peak_temp_bytes": int, "model_temp_bytes": int}`` — the CI planner
+    gate asserts ``peak_temp_bytes <= HEAT_TPU_HBM_BUDGET``."""
+    dtype_str = str(buf.dtype)
+    temps = []
+    if plan_.kind == "chunked":
+        acc = _init_program(plan_, comm, dtype_str)()
+        for stage in plan_.stages:
+            fn = _stage_program(plan_, stage, comm, dtype_str)
+            try:
+                ma = fn.lower(buf, acc).compile().memory_analysis()
+                temps.append(int(getattr(ma, "temp_size_in_bytes", 0)))
+            except Exception:
+                temps.append(-1)
+    elif plan_.kind in ("monolithic", "alltoall"):
+        temps.append(-1)
+    measured = [t for t in temps if t >= 0]
+    return {
+        "stage_temp_bytes": temps,
+        "peak_temp_bytes": max(measured) if measured else -1,
+        "model_temp_bytes": plan_.temp_bytes,
+    }

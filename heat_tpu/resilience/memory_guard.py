@@ -26,6 +26,14 @@ On predicted overflow the guard degrades before it fails:
 4. otherwise raise :class:`HeatTpuMemoryError` naming the site, the
    predicted/live/budget byte counts, and the remediation ladder.
 
+For **relayouts** the ladder no longer ends in step 4: with a budget
+armed, ``DNDarray._relayout`` consults the communication-aware planner
+(:mod:`heat_tpu.core.relayout_planner`) *before* dispatch, using the
+same ``live + temp + output <= budget`` arithmetic as :func:`preflight`
+— a monolithic program that would overflow is replaced by a
+bounded-memory chunked program chain whose stages fit, so the resplit
+succeeds instead of erroring at the ceiling (ISSUE 6).
+
 The cdist/manhattan row-blocked kernels additionally consult
 :func:`temp_budget` so their broadcast temporaries are chunked along the
 batch axis to fit the budget (spatial/distance.py).
@@ -194,6 +202,9 @@ def preflight(site: str, fn, args: tuple) -> None:
             "live bytes drop",
             "chunk the workload along the batch axis (cdist/manhattan do "
             "this automatically under the budget)",
+            "relayouts decompose automatically under the budget "
+            "(HEAT_TPU_RELAYOUT_PLAN, core/relayout_planner.py) — other "
+            "sites may free buffers and retry",
         ],
     )
 

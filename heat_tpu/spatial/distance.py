@@ -108,7 +108,18 @@ def _ring_dist(
     has filled its (local rows × all columns) slab. ``audit_cost`` (an
     analytic CollectiveCost) turns on the HLO collective audit of the
     kernel program (telemetry/hlo.py).
-    """
+
+    Schedule (ISSUE 6): by default the loop body is **double-buffered** —
+    the next hop's ppermute is issued *before* the current block's tile
+    GEMM, so the permute carries no data dependence on the compute and
+    XLA's latency-hiding scheduler can overlap the two — and the final
+    dead hop (which only returns each block home) is peeled off, so the
+    ring runs ``p-1`` hops instead of ``p``. Tile values and update
+    order are untouched: the result is bit-identical to the serial
+    schedule, which ``HEAT_TPU_RING_OVERLAP=0`` restores verbatim
+    (core/relayout_planner.py `ring_overlap`)."""
+    from ..core import relayout_planner
+
     comm = x.comm
     p = comm.size
     axis = comm.axis_name
@@ -116,6 +127,7 @@ def _ring_dist(
     ym = y.larray
     cy = ym.shape[0] // p
     n_cols = ym.shape[0]
+    overlap = relayout_planner.ring_overlap() and p > 1
 
     def kernel(xb, yb):
         rank = jax.lax.axis_index(axis)
@@ -123,14 +135,31 @@ def _ring_dist(
         # mark the accumulator as device-varying for the scan carry typing
         out = jax.lax.pcast(out, (axis,), to="varying")
 
-        def step(t, carry):
-            yblk, out = carry
+        def tile_into(t, yblk, out):
             # the ring sends i→i+1, so after t hops shard i holds origin
             # (i−t) mod p
             col = ((rank - t) % p) * cy
             tile = block_fn(xb, yblk)
             zero = jnp.zeros((), dtype=col.dtype)
-            out = jax.lax.dynamic_update_slice(out, tile, (zero, col))
+            return jax.lax.dynamic_update_slice(out, tile, (zero, col))
+
+        if overlap:
+            def step(t, carry):
+                yblk, out = carry
+                # hop FIRST (no dependence on the tile GEMM below — the
+                # permute rides under the local compute), consume second
+                ynext = comm.ring_permute(yblk)
+                out = tile_into(t, yblk, out)
+                return (ynext, out)
+
+            yb, out = jax.lax.fori_loop(0, p - 1, step, (yb, out))
+            # last block: compute only — the p-th hop of the serial
+            # schedule moved data nobody consumed
+            return tile_into(p - 1, yb, out)
+
+        def step(t, carry):
+            yblk, out = carry
+            out = tile_into(t, yblk, out)
             # the comm wrapper (not raw lax.ppermute) so the hop is named
             # in telemetry's trace-time collective record
             yblk = comm.ring_permute(yblk)
@@ -142,8 +171,10 @@ def _ring_dist(
     spec = comm.spec(0, 2)
     out_spec = spec
     # block_fn is a module-level function (stable identity), so the ring
-    # program is shared across calls of the same kernel + layout family
-    key = (block_fn, cy, n_cols)
+    # program is shared across calls of the same kernel + layout family;
+    # the schedule is part of the signature — serial and double-buffered
+    # kernels never share a program
+    key = (block_fn, cy, n_cols, "overlap" if overlap else "serial")
     smapped = program_cache.cached_program(
         "ring_cdist", key,
         lambda: jax.shard_map(
@@ -240,13 +271,20 @@ def _dist(
 
     if use_ring:
         # ring kernel works on the padded buffers; x pad rows land in output
-        # pad rows, y pad columns are sliced off below
+        # pad rows, y pad columns are sliced off below. The hop count is
+        # schedule-dependent: the double-buffered kernel skips the final
+        # dead hop (p-1 hops), the serial kernel permutes p times.
+        from ..core import relayout_planner
+
+        p_ring = x.comm.size
+        hops = p_ring - 1 if relayout_planner.ring_overlap() else p_ring
         cost, fields, do_audit = telemetry.op_cost(
             telemetry.collectives.ring_cdist_cost, n, x.shape[1],
-            promoted.byte_size(), x.comm.size, audit=audit,
+            promoted.byte_size(), x.comm.size, hops, audit=audit,
         )
         with telemetry.span(
-            "ring_cdist", gshape=[m, n], mesh=x.comm.size, **fields
+            "ring_cdist", gshape=[m, n], mesh=x.comm.size,
+            overlap=hops < p_ring, **fields
         ) as sp:
             xm = x._masked(0).astype(promoted.jnp_type())
             ym = y._masked(0).astype(promoted.jnp_type())

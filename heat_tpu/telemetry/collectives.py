@@ -34,6 +34,7 @@ from typing import Dict, Optional, Sequence
 __all__ = [
     "CollectiveCost",
     "relayout_cost",
+    "relayout_chunk_cost",
     "ring_cdist_cost",
     "tsqr_cost",
     "gram_ring_cost",
@@ -95,18 +96,55 @@ def relayout_cost(
     return CollectiveCost("all-to-all", (b * (nproc - 1)) // nproc)
 
 
-def ring_cdist_cost(n: int, k: int, itemsize: int, nproc: int) -> CollectiveCost:
-    """Cost of the ppermute ring distance kernel
-    (:func:`heat_tpu.spatial.distance._ring_dist`): the row-split ``y``
-    block circulates one hop per step for ``p`` steps (the kernel's
-    `fori_loop` permutes on every iteration, including the final hop that
-    returns each block home), every device sending its ``ceil(n/p)·k``
-    block each step. Only ``y`` moves — the stationary x rows never touch
-    the wire, so the volume is independent of the x-row count."""
+def relayout_chunk_cost(
+    gshape: Sequence[int],
+    itemsize: int,
+    src_split: int,
+    dst_split: int,
+    width: int,
+    nproc: int,
+) -> CollectiveCost:
+    """Cost of ONE stage of the planner's chunked relayout
+    (:mod:`heat_tpu.core.relayout_planner`): a destination-shard-aligned
+    block of ``width`` columns along ``dst_split`` lands whole on one
+    destination shard, so XLA emits one **all-gather** of the block —
+    every device receives the whole chunk and the owner keeps its part:
+    ``chunk_phys · (p-1)`` wire bytes, where ``chunk_phys`` counts the
+    source buffer's tail pad along ``src_split`` (the bytes the program
+    actually moves). Summed over a plan's stages this is ``~B·(p-1)`` —
+    the wire premium the bounded-memory decomposition pays vs the
+    monolithic all-to-all's ``B·(p-1)/p``."""
     if nproc <= 1:
         return CollectiveCost("none", 0)
+    other = 1
+    for d, s in enumerate(gshape):
+        if d == dst_split:
+            continue
+        s = int(s)
+        if d == src_split:
+            s = math.ceil(s / nproc) * nproc
+        other *= s
+    chunk = other * int(width) * int(itemsize)
+    return CollectiveCost("all-gather", chunk * (nproc - 1))
+
+
+def ring_cdist_cost(
+    n: int, k: int, itemsize: int, nproc: int, hops: Optional[int] = None
+) -> CollectiveCost:
+    """Cost of the ppermute ring distance kernel
+    (:func:`heat_tpu.spatial.distance._ring_dist`): the row-split ``y``
+    block circulates one hop per step, every device sending its
+    ``ceil(n/p)·k`` block each hop. Only ``y`` moves — the stationary x
+    rows never touch the wire, so the volume is independent of the x-row
+    count. ``hops`` defaults to ``p`` (the serial kernel's `fori_loop`
+    permutes on every iteration, including the final hop that returns
+    each block home); the double-buffered overlap kernel skips that dead
+    hop and passes ``hops = p - 1``."""
+    if nproc <= 1:
+        return CollectiveCost("none", 0)
+    hops = nproc if hops is None else int(hops)
     block = math.ceil(n / nproc) * int(k) * int(itemsize)
-    return CollectiveCost("ppermute-ring", nproc * nproc * block, steps=nproc)
+    return CollectiveCost("ppermute-ring", nproc * hops * block, steps=hops)
 
 
 def tsqr_cost(m: int, n: int, itemsize: int, nproc: int) -> CollectiveCost:
@@ -123,16 +161,22 @@ def tsqr_cost(m: int, n: int, itemsize: int, nproc: int) -> CollectiveCost:
     )
 
 
-def gram_ring_cost(m: int, n: int, itemsize: int, nproc: int) -> CollectiveCost:
+def gram_ring_cost(
+    m: int, n: int, itemsize: int, nproc: int, hops: Optional[int] = None
+) -> CollectiveCost:
     """Cost of the CholeskyQR2 ring Gram kernel
-    (:func:`heat_tpu.core.linalg.qr._gram_ring`): ``p`` ring hops of the
-    stationary-transpose schedule (each device circulates its
-    ``(ceil(n/p), m)`` block every step) plus the final tiled all-gather
-    of the ``(ceil(n/p), n_phys)`` row blocks of G."""
+    (:func:`heat_tpu.core.linalg.qr._gram_ring`): ``hops`` ring hops of
+    the stationary-transpose schedule (each device circulates its
+    ``(ceil(n/p), m)`` block every hop — ``p`` hops for the serial
+    kernel, ``p - 1`` for the double-buffered overlap kernel, which
+    skips the final hop that only returns each block home) plus the
+    final tiled all-gather of the ``(ceil(n/p), n_phys)`` row blocks of
+    G."""
     if nproc <= 1:
         return CollectiveCost("none", 0)
+    hops = nproc if hops is None else int(hops)
     c = math.ceil(n / nproc)
     n_phys = c * nproc
-    ring = nproc * nproc * c * int(m) * int(itemsize)
+    ring = nproc * hops * c * int(m) * int(itemsize)
     gather = nproc * (nproc - 1) * c * n_phys * int(itemsize)
-    return CollectiveCost("ppermute-ring+all-gather", ring + gather, steps=nproc)
+    return CollectiveCost("ppermute-ring+all-gather", ring + gather, steps=hops)

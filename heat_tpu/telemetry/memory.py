@@ -25,14 +25,34 @@ __all__ = ["live_bytes", "device_memory_stats", "watermark"]
 def live_bytes() -> dict:
     """Framework-level live-array accounting: ``{"total": bytes,
     "per_device": {device: bytes}, "arrays": count}`` over
-    ``jax.live_arrays()`` (addressable shards only)."""
+    ``jax.live_arrays()`` (addressable shards only).
+
+    Buffers are de-duplicated by ``(device, buffer pointer)``: reading a
+    sharded array's ``addressable_shards`` materializes per-shard view
+    Arrays that jax caches on the parent AND reports in
+    ``live_arrays()`` — without the dedup, the first ``live_bytes()``
+    call would permanently double every sharded array in all later
+    calls (ISSUE 6 found this via the relayout planner's
+    before/after-decision comparisons). Aliased views of one buffer
+    therefore count once — which is also the physically correct figure.
+    """
     per_device: Dict[str, int] = defaultdict(int)
     count = 0
+    seen = set()
     for arr in jax.live_arrays():
         count += 1
         try:
             for shard in arr.addressable_shards:
-                per_device[str(shard.device)] += shard.data.nbytes
+                data = shard.data
+                dev = str(shard.device)
+                try:
+                    key = (dev, data.unsafe_buffer_pointer())
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                except Exception:
+                    pass  # no pointer API on this backend: count as-is
+                per_device[dev] += data.nbytes
         except Exception:
             # deleted/donated buffers raise on access mid-iteration
             continue

@@ -68,6 +68,9 @@ def summarize(
     phases: dict = {}
     pc_retraces: dict = {}
     res_events: dict = {}
+    plan_counts: dict = {}
+    plan_last: Optional[dict] = None
+    plan_wire = 0
     pc_evictions = 0
     compile_seconds = 0.0
     compile_events = 0
@@ -106,6 +109,17 @@ def summarize(
         elif kind == "resilience":
             what = ev.get("event") or "event"
             res_events[what] = res_events.get(what, 0) + 1
+        elif kind == "relayout_plan":
+            p = ev.get("plan") or ev.get("name")
+            plan_counts[p] = plan_counts.get(p, 0) + 1
+            plan_wire += int(ev.get("predicted_bytes", 0) or 0)
+            plan_last = {
+                k: ev.get(k)
+                for k in ("plan", "gshape", "src_split", "dst_split",
+                          "chunks", "stages", "predicted_bytes",
+                          "temp_bytes", "budget", "reason")
+                if k in ev
+            }
         elif kind == "hlo_audit":
             hlo_audits += 1
             drift = int(ev.get("drift", 0) or 0)
@@ -133,6 +147,16 @@ def summarize(
         "traced_collectives": traced,
         "events": n,
     }
+    if plan_counts:
+        # relayout-planner decisions (core/relayout_planner.py): how many
+        # relayouts planned per plan kind, the summed predicted wire
+        # bytes, and the last full decision payload. Absent when the
+        # planner never armed, so unplanned summaries keep their shape.
+        out["relayout_plan"] = {
+            "plans": plan_counts,
+            "predicted_bytes": plan_wire,
+            "last": plan_last,
+        }
     if hlo_audits:
         # ground-truth emitted collectives (telemetry/hlo.py) next to the
         # analytic phases — only present when the auditor actually ran, so

@@ -346,6 +346,91 @@ EOF
     fi
 fi
 
+# Planner step (ISSUE 6): the resplit whose monolithic program exceeds a
+# tight HEAT_TPU_HBM_BUDGET must succeed through the planner's chunked
+# program chain with (a) every stage's memory_analysis() temp bytes within
+# the budget and (b) a result sha256 BIT-IDENTICAL to the unconstrained
+# monolithic run. The budget is computed IN-PROCESS (live bytes + half the
+# monolithic program's measured temp+output need) because the flip point
+# depends on live bytes at decision time — a fixed env value would race
+# allocator state. HEAT_TPU_CI_SKIP_PLANNER=1 opts out.
+if [ -z "${HEAT_TPU_CI_SKIP_PLANNER:-}" ]; then
+    echo "=== planner step: budget-constrained resplit via chunked plan (4-device mesh) ==="
+    planner_rc=0
+    planner_out=$(mktemp)
+    XLA_FLAGS="--xla_force_host_platform_device_count=4" JAX_PLATFORMS=cpu \
+        HEAT_TPU_TELEMETRY=1 python - <<'EOF' > "$planner_out" 2>&1 || planner_rc=$?
+import hashlib
+import json
+import os
+
+import numpy as np
+
+import heat_tpu as ht
+from heat_tpu import telemetry
+from heat_tpu.core import relayout_planner as rp
+from heat_tpu.resilience import memory_guard
+
+comm = ht.get_comm()
+assert comm.size == 4, f"expected a 4-device mesh, got {comm.size}"
+n, m = 4096, 256
+xn = np.arange(n * m, dtype=np.float32).reshape(n, m)
+x = ht.array(xn, split=0)
+
+# unconstrained run: auto with no budget stays monolithic
+ref = x.resplit(1)
+sha_ref = hashlib.sha256(
+    np.ascontiguousarray(ref.numpy()).tobytes()
+).hexdigest()
+del ref
+
+# measure the program FIRST, then gc, then read live — the ordering
+# maybe_plan itself uses, so the flip arithmetic is deterministic
+need = memory_guard.program_bytes(x._relayout_executable(1), (x.larray,))
+assert need > 0, "memory_analysis unavailable — cannot gate the planner"
+import gc
+
+gc.collect()
+live = memory_guard._live_total()
+budget = live + need // 2  # the monolithic program can no longer fit
+os.environ["HEAT_TPU_HBM_BUDGET"] = str(budget)
+
+reg = telemetry.get_registry()
+reg.clear()
+y = x.resplit(1)
+sha = hashlib.sha256(np.ascontiguousarray(y.numpy()).tobytes()).hexdigest()
+events = [e for e in reg.events if e["kind"] == "relayout_plan"]
+assert events, "budgeted resplit recorded no relayout_plan event"
+ev = events[0]
+assert ev["plan"] == "chunked", f"expected a chunked plan, got {ev}"
+
+plan = rp.plan(
+    (n, m), 4, 0, 1, comm, budget=budget, live=live, measured_need=need
+)
+mem = rp.plan_memory(plan, x.larray, comm)
+assert 0 <= mem["peak_temp_bytes"] <= budget, (mem, budget)
+assert mem["peak_temp_bytes"] < need, (mem, need)
+assert sha == sha_ref, (
+    f"chunked plan diverged from monolithic result ({sha} != {sha_ref})"
+)
+print(json.dumps({
+    "planner": "ok", "budget": budget, "live": live,
+    "monolithic_need": need, "chunks": ev["chunks"],
+    "peak_stage_temp_bytes": mem["peak_temp_bytes"],
+    "digest": sha[:12],
+}))
+EOF
+    cat "$planner_out"
+    if [ -n "$REPORT" ]; then
+        cp "$planner_out" "${REPORT}/planner_gate.log" || true
+    fi
+    rm -f "$planner_out"
+    if [ "$planner_rc" != 0 ]; then
+        echo "=== planner step FAILED (rc=$planner_rc) ==="
+        FAILED_SIZES="$FAILED_SIZES planner"
+    fi
+fi
+
 # Chaos step (ISSUE 5): run the resplit microbenchmark twice — fault-free,
 # then under deterministic fault injection (one synthetic transient per
 # matched site: the relayout dispatch and every collective wrapper) with

@@ -398,6 +398,144 @@ class TestUniqueDeep(TestCase):
         got = ht.unique(ht.array(a, split=0), sorted=True)
         assert got.split == 0
 
+    @staticmethod
+    def _row_multiset(rows):
+        """Order-independent row comparison (the packed-key sort's output
+        order for NaN/complex rows is a valid total order but not
+        necessarily numpy's byte order)."""
+        a = np.asarray(rows)
+        a = a.reshape(len(a), -1)
+        if np.iscomplexobj(a):
+            a = np.concatenate([a.real, a.imag], axis=1)
+        return sorted(map(tuple, a.tolist()))
+
+    def test_row_unique_mode_dispatch_boundary(self):
+        """The dispatch table of the ISSUE 6 packed-key path (pure
+        function — the expensive wide compiles live in the slow-marked
+        sweep below and the run_ci full sweeps)."""
+        from heat_tpu.core.manipulations import _row_unique_mode
+
+        assert _row_unique_mode(ht.float32, 256) == "direct"
+        assert _row_unique_mode(ht.float32, 300) == "packed"   # 150 lanes
+        assert _row_unique_mode(ht.float32, 512) == "packed"   # 256 lanes
+        assert _row_unique_mode(ht.float32, 513) is None
+        assert _row_unique_mode(ht.int8, 2048) == "packed"     # 8 per lane
+        assert _row_unique_mode(ht.int8, 2049) is None
+        assert _row_unique_mode(ht.float64, 256) == "direct"
+        assert _row_unique_mode(ht.float64, 300) is None       # no packing
+        assert _row_unique_mode(ht.complex64, 2) == "packed"   # always keyed
+        assert _row_unique_mode(ht.complex64, 256) == "packed"
+        assert _row_unique_mode(ht.complex128, 129) is None
+
+    @pytest.mark.slow
+    def test_unique_axis_wide_rows_distributed(self):
+        """Rows wider than the direct-operand cap (carried >256-wide debt,
+        closed by ISSUE 6's packed-key path) stay distributed and agree
+        with numpy. Slow-marked: the 151-operand sort network is a long
+        XLA CPU compile; the fast packed-path semantics run in the
+        cap-monkeypatched tests below."""
+        rng = np.random.default_rng(17)
+        base = rng.integers(0, 3, size=(9, 300)).astype(np.float32)
+        m = np.concatenate([base, base[:4]], axis=0)
+        got = ht.unique(ht.array(m, split=0), sorted=True, axis=0)
+        ref = np.unique(m, axis=0)
+        assert got.shape == ref.shape
+        assert self._row_multiset(got.numpy()) == self._row_multiset(ref)
+        # inverse reconstructs the input exactly
+        got2, inv = ht.unique(
+            ht.array(m, split=0), sorted=True, return_inverse=True, axis=0
+        )
+        np.testing.assert_array_equal(got2.numpy()[inv.numpy()], m)
+
+    @staticmethod
+    def _forced_packed_cap(cap):
+        """Temporarily lower the direct-path width cap so the packed-key
+        path runs at cheap widths (unittest-style; these tests cannot
+        take pytest fixtures)."""
+        import contextlib
+
+        from heat_tpu.core import manipulations as manip
+
+        @contextlib.contextmanager
+        def ctx():
+            old = manip._ROW_UNIQUE_MAX_WIDTH
+            manip._ROW_UNIQUE_MAX_WIDTH = cap
+            try:
+                yield manip
+            finally:
+                manip._ROW_UNIQUE_MAX_WIDTH = old
+
+        return ctx()
+
+    def test_unique_axis_packed_int8_multilane(self):
+        # force the packed path at a narrow width that still exercises
+        # MULTI-LANE packing (20 int8 cols -> 3 uint64 lanes, 8 per lane)
+        rng = np.random.default_rng(18)
+        m = np.concatenate(
+            [rng.integers(-5, 5, size=(7, 20)).astype(np.int8)] * 2, axis=0
+        )
+        with self._forced_packed_cap(3) as manip:
+            assert manip._row_unique_mode(ht.int8, 20) == "packed"
+            got = ht.unique(ht.array(m, split=0), sorted=True, axis=0)
+            got2, inv = ht.unique(
+                ht.array(m, split=0), sorted=True, return_inverse=True,
+                axis=0,
+            )
+        ref = np.unique(m, axis=0)
+        assert got.shape == ref.shape
+        assert self._row_multiset(got.numpy()) == self._row_multiset(ref)
+        np.testing.assert_array_equal(got2.numpy()[inv.numpy()], m)
+
+    def test_unique_axis_complex_distributed(self):
+        """Complex dtypes (carried debt, ISSUE 6): distributed via
+        (real, imag) key pairs — numpy's complex sort order."""
+        m = np.asarray(
+            [[1 + 2j, 3 - 1j], [0 + 1j, 2 + 2j], [1 + 2j, 3 - 1j],
+             [1 - 2j, 3 - 1j]],
+            dtype=np.complex64,
+        )
+        got = ht.unique(ht.array(m, split=0), sorted=True, axis=0)
+        ref = np.unique(m, axis=0)
+        assert got.shape == ref.shape
+        assert self._row_multiset(got.numpy()) == self._row_multiset(ref)
+        got2, inv = ht.unique(
+            ht.array(m, split=0), sorted=True, return_inverse=True, axis=0
+        )
+        np.testing.assert_array_equal(got2.numpy()[inv.numpy()], m)
+        # 1-D complex axis=0 takes the same rows path
+        c1 = np.asarray([1 + 1j, 2 + 0j, 1 + 1j, 3 - 1j], dtype=np.complex64)
+        got1 = ht.unique(ht.array(c1, split=0), sorted=True, axis=0)
+        assert got1.shape == np.unique(c1, axis=0).shape
+
+    def test_unique_axis_packed_nan_rows_stay_distinct(self):
+        # numpy's axis-unique keeps NaN-bearing duplicate rows DISTINCT;
+        # the packed keys only order rows — equality still uses plain !=
+        # (cap lowered so the packed path runs at a cheap width)
+        m = np.asarray(
+            [[1.0, np.nan], [1.0, np.nan], [2.0, 3.0]], dtype=np.float32
+        )
+        with self._forced_packed_cap(1) as manip:
+            assert manip._row_unique_mode(ht.float32, 2) == "packed"
+            got = ht.unique(ht.array(m, split=0), sorted=True, axis=0)
+        assert got.shape == np.unique(m, axis=0).shape == (3, 2)
+
+    def test_unique_axis_packed_negative_zero_collapses(self):
+        # -0.0 == 0.0 rows must collapse (key canonicalization)
+        m = np.asarray([[0.0, 1.0], [-0.0, 1.0], [2.0, 2.0]], dtype=np.float32)
+        with self._forced_packed_cap(1):
+            got = ht.unique(ht.array(m, split=0), sorted=True, axis=0)
+        assert got.shape[0] == 2
+
+    def test_unique_axis_wide_f64_eager_fallback(self):
+        # float64 keys cannot pack (8 bytes each): >256-wide f64 rows keep
+        # the eager path and must still be correct
+        rng = np.random.default_rng(19)
+        m = np.concatenate([rng.standard_normal((3, 300))] * 2, axis=0)
+        got = ht.unique(ht.array(m, split=0), sorted=True, axis=0)
+        ref = np.unique(m, axis=0)
+        assert got.shape == ref.shape
+        assert self._row_multiset(got.numpy()) == self._row_multiset(ref)
+
     def test_unique_replicated_routes_distributed(self):
         """Replicated inputs on a multi-device mesh run the SAME distributed
         algorithm as split inputs (VERDICT r5 Missing #3) — device-side
