@@ -31,6 +31,9 @@ Workloads (BASELINE.json configs):
   * moments     — mean/var over split rows (statistical_moments bench)
   * elementwise — chained normalize/scale/clip pipeline; the fusion-engine
                   guard (7 ops defer into ONE cached program, core/fusion.py)
+  * reduction   — normalize/scale/sum map+reduce chain; the Fusion 2.0
+                  guard (chain + reduction + collective tail absorbed into
+                  ONE cached program, core/fusion.py absorb_reduce)
   * lasso       — coordinate-descent sweeps (lasso bench; incremental-residual
                   epochs, one jit per sweep)
   * lm_step     — flagship TransformerLM training step (fwd+bwd+AdamW in one
@@ -272,6 +275,28 @@ def bench_heat_tpu(errors, profile_dir=None, small=False, only=None,
             return _sync(out)
 
         return run, reps * 7.0 * ne * de
+
+    def make_reduction():
+        # normalize -> scale -> sum map+reduce chain (the committed
+        # microbenchmark benchmarks/reduction/): Fusion 2.0
+        # (core/fusion.py absorb_reduce) compiles the 4 elementwise ops
+        # AND the reduction — collective tail included — as ONE cached
+        # program per rep; the PR 4 flush-at-reduction dispatch paid a
+        # chain flush plus an eager reduce each time. ~5 counted flops
+        # per element, bandwidth-bound.
+        nr, dr, reps = (1_000_000, 64, 3) if small else (8_000_000, 64, 10)
+        xr = ht.random.randn(nr, dr, dtype=ht.float32, split=0)
+        mean_r = ht.array(np.float32(0.1))
+        std_r = ht.array(np.float32(1.3))
+
+        def run():
+            out = None
+            for _ in range(reps):  # async dispatch queues all reps
+                z = (xr - mean_r) / (std_r + 1e-6) * 0.125
+                out = ht.sum(z, axis=0).larray  # ONE absorbed program
+            return _sync(out)
+
+        return run, reps * 5.0 * nr * dr
 
     def make_lasso():
         # coordinate-descent sweeps (lasso bench). The whole fit is ONE
@@ -522,6 +547,7 @@ def bench_heat_tpu(errors, profile_dir=None, small=False, only=None,
         ("kmeans", make_kmeans),
         ("moments", make_moments),
         ("elementwise", make_elementwise),
+        ("reduction", make_reduction),
         ("attention", make_attention),
         ("matmul_f32", make_matmul_f32),
         ("matmul_int8", make_matmul_int8),
@@ -673,6 +699,17 @@ def _torch_cpu_workloads(results, only=None):
         t = _best_time(moments, repeats=2)
         results["moments"] = (4.0 * nm * dm) / t / 1e9
 
+    if want("reduction"):
+        nr, dr = 1_000_000, 64
+        xr = torch.randn(nr, dr)
+
+        def mapreduce():
+            return ((xr - 0.1) / (1.3 + 1e-6) * 0.125).sum(dim=0)
+
+        mapreduce()
+        t = _best_time(mapreduce, repeats=2)
+        results["reduction"] = (5.0 * nr * dr) / t / 1e9
+
     if want("lasso"):
         nl, dl, sweeps = 100_000, 64, 2
         xl = torch.randn(nl, dl)
@@ -773,8 +810,9 @@ def main():
         only = {s.strip() for s in args.only.split(",") if s.strip()}
         known = {
             "matmul", "matmul_f32", "matmul_bf16", "cdist", "kmeans",
-            "moments", "elementwise", "lasso", "attention", "attention_bwd",
-            "matmul_int8", "lm_step", "matmul_1b", "spectral", "kmeans_1b",
+            "moments", "elementwise", "reduction", "lasso", "attention",
+            "attention_bwd", "matmul_int8", "lm_step", "matmul_1b",
+            "spectral", "kmeans_1b",
         }
         unknown = only - known
         if unknown:

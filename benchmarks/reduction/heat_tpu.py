@@ -1,0 +1,238 @@
+#!/usr/bin/env python
+"""Through-reduction fusion microbenchmark — eager vs fused dispatch of
+normalize→scale→sum and mean/var moment chains (ISSUE 7, core/fusion.py
+``absorb_reduce`` / ``defer_matmul``).
+
+PR 4's elementwise bench stops at the reduction: every chain ending in a
+``sum``/``mean``/``var`` still paid one flush program PLUS one eager
+reduce dispatch. Fusion 2.0 absorbs the chain into the reduction's
+program, so the whole normalize-then-reduce pipeline is ONE cached
+program whose collective tail rides in the same trace. This runner
+measures THREE modes in one process:
+
+* ``eager``  — ``HEAT_TPU_FUSION=0``: one XLA dispatch per op (PR 3).
+* ``flush``  — fusion on, ``HEAT_TPU_FUSION_REDUCE=0``: the chain fuses
+  but flushes at the reduction (PR 4 behavior, the knob-off baseline).
+* ``fused``  — both on: chain+reduction absorbed (this PR).
+
+and prints a comparison line::
+
+    {"reduction_compare": {"eager": {...}, "flush": {...}, "fused": {...},
+     "fused_programs": 1, "dense_programs": 1, "digest_match": true, ...}}
+
+``programs_compiled`` counts backend compiles on the cold first call (the
+dispatch-count oracle scripts/run_ci.sh asserts on: fused must compile
+>= 3x fewer programs than eager for the normalize→scale→sum chain and
+exactly ONE program for the chain; the DP-forward ``dense`` —
+matmul+bias+relu — must also be ONE program).
+
+Digest semantics (what is and is not bit-pinned): ``digest_chain`` hashes
+the map+reduce result — bit-identical between ``fused`` and ``flush``
+(the absorbed program computes the same masked chain + sum). The moment
+chain's ``var`` re-derives the shared centered chain INSIDE the absorbed
+program, which legally re-tiles the f32 reduction — so ``digest_moments``
+is bit-pinned only within a mode (knob-off == PR 6 by code-path identity)
+and fused-vs-flush is checked via ``moments_allclose`` instead.
+"""
+
+import hashlib
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+from benchmarks._harness import base_parser, bootstrap, load_or_make
+
+
+CHAIN_OPS = 5  # sub, add, div, mul + sum — see chain_reduce()
+MOMENT_OPS = 4  # sub, mul + mean, var over the shared centered chain
+
+
+def chain_reduce(ht, data, mean, std):
+    """normalize → scale → sum along the split axis: the canonical
+    map+reduce shape (4 elementwise ops + 1 reduction)."""
+    z = (data - mean) / (std + 1e-6) * 0.125
+    return ht.sum(z, axis=0)
+
+
+def moment_chain(ht, data, mean):
+    """Centered second-moment pipeline: the statistical-moments bench
+    pattern (chain → mean AND chain → var share the sub-DAG)."""
+    d = (data - mean) * 2.0
+    return ht.mean(d, axis=0), ht.var(d, axis=0)
+
+
+def dense_forward(ht, x, w, b):
+    """The DP-forward building block: matmul + bias + relu as ONE cached
+    program via the deferred matmul kernel node (nn/functional.dense)."""
+    from heat_tpu.nn import functional as F
+
+    return F.dense(x, w, bias=b, activation="relu")
+
+
+def _digest(*arrays):
+    h = hashlib.sha256()
+    for a in arrays:
+        import numpy as np
+
+        h.update(np.ascontiguousarray(np.asarray(a)).tobytes())
+    return h.hexdigest()
+
+
+def _time_mode(ht, data, mean, std, trials):
+    import numpy as np
+
+    from heat_tpu.core import fusion, program_cache
+
+    def run_once():
+        s = chain_reduce(ht, data, mean, std)
+        mu, var = moment_chain(ht, data, mean)
+        return s.numpy(), mu.numpy(), var.numpy()
+
+    def fusion_sites():
+        return {
+            k: dict(v)
+            for k, v in program_cache.stats()["sites"].items()
+            if k.startswith("fusion")
+        }
+
+    f0 = fusion.stats()
+    sites0 = fusion_sites()
+    with ht.telemetry.CompileWatcher() as cw:
+        t0 = time.perf_counter()
+        out = run_once()
+        first_call = time.perf_counter() - t0
+    times = []
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        run_once()
+        times.append(time.perf_counter() - t0)
+    f1 = fusion.stats()
+
+    # per-MODE site deltas (the process-cumulative totals would leak the
+    # fused mode's fusion_reduce entries into whichever mode runs later —
+    # the CI disarm assert reads these per row)
+    sites1 = fusion_sites()
+    site_delta = {}
+    for k, row1 in sites1.items():
+        base = sites0.get(k, {"hits": 0, "misses": 0})
+        d = {f: row1[f] - base.get(f, 0) for f in ("hits", "misses")}
+        if d["hits"] or d["misses"]:
+            site_delta[k] = d
+    row = {
+        "compile_seconds": round(cw.seconds, 4),
+        "first_call_seconds": round(first_call, 4),
+        "programs_compiled": cw.backend_compiles,
+        "best_seconds": round(min(times), 6),
+        "mean_seconds": round(sum(times) / len(times), 6),
+        "reductions_absorbed": f1["reductions_absorbed"] - f0["reductions_absorbed"],
+        "fallbacks": f1["fallbacks"] - f0["fallbacks"],
+        "digest_chain": _digest(out[0]),
+        "digest_moments": _digest(out[1], out[2]),
+        "site_misses": site_delta,
+    }
+    return row, out
+
+
+def _count_chain_programs(ht, data, mean, std):
+    """Cold-compile count for the 5-op normalize→scale→sum chain alone."""
+    with ht.telemetry.CompileWatcher() as cw:
+        chain_reduce(ht, data, mean, std).numpy()
+    return cw.backend_compiles
+
+
+def _count_dense_programs(ht, args):
+    import numpy as np
+
+    rng = np.random.default_rng(7)
+    x = ht.array(
+        rng.standard_normal((4096, args.features)).astype(np.float32),
+        split=0,
+    )
+    w = ht.array(rng.standard_normal((args.features, 32)).astype(np.float32))
+    b = ht.array(rng.standard_normal(32).astype(np.float32))
+    with ht.telemetry.CompileWatcher() as cw:
+        dense_forward(ht, x, w, b).numpy()
+    return cw.backend_compiles
+
+
+def main():
+    parser = base_parser(
+        "heat_tpu through-reduction fusion microbenchmark")
+    parser.add_argument(
+        "--split", type=int, default=0,
+        help="distribution axis of the operand (default 0)")
+    args = parser.parse_args()
+    ht = bootstrap(args)
+    import numpy as np
+
+    data = load_or_make(ht, args, split=args.split)
+    mean = ht.array(np.float32(0.1))
+    std = ht.array(np.float32(1.3))
+
+    modes = (
+        ("eager", {"HEAT_TPU_FUSION": "0"}),
+        ("flush", {"HEAT_TPU_FUSION": "1", "HEAT_TPU_FUSION_REDUCE": "0"}),
+        ("fused", {"HEAT_TPU_FUSION": "1", "HEAT_TPU_FUSION_REDUCE": "1"}),
+    )
+    rows = {}
+    outs = {}
+    chain_programs = {}
+    for mode, env in modes:
+        os.environ.update(env)
+        # distinct leading extent per mode → every mode cold-compiles its
+        # own programs (jax caches by shape, so reusing the shape would
+        # credit later modes with the first mode's compiles)
+        d = data[: data.shape[0] - {"eager": 0, "flush": 1, "fused": 2}[mode]]
+        chain_programs[mode] = _count_chain_programs(ht, d, mean, std)
+        rows[mode], outs[mode] = _time_mode(ht, data, mean, std, args.trials)
+        rows[mode]["chain_programs_compiled"] = chain_programs[mode]
+        print(json.dumps({"mode": mode, **rows[mode]}), flush=True)
+    dense_programs = _count_dense_programs(ht, args)
+    for k in ("HEAT_TPU_FUSION", "HEAT_TPU_FUSION_REDUCE"):
+        os.environ.pop(k, None)
+
+    moments_close = bool(
+        np.allclose(outs["fused"][1], outs["flush"][1], rtol=1e-5, atol=1e-7)
+        and np.allclose(outs["fused"][2], outs["flush"][2], rtol=1e-5, atol=1e-7)
+    )
+    compare = {
+        "chain_ops": CHAIN_OPS,
+        "moment_ops": MOMENT_OPS,
+        "eager": rows["eager"],
+        "flush": rows["flush"],
+        "fused": rows["fused"],
+        "chain_programs": chain_programs,
+        "fused_programs": chain_programs["fused"],
+        "dense_programs": dense_programs,
+        # the map+reduce bit-identity pin: fused chain+sum == knob-off
+        # flush-then-sum, bit for bit (run_ci.sh asserts this)
+        "digest_chain_match": (
+            rows["fused"]["digest_chain"] == rows["flush"]["digest_chain"]
+        ),
+        # moment chain: fused var legally re-tiles the f32 reduction →
+        # tolerance check, not a bit pin (see module docstring)
+        "moments_allclose": moments_close,
+        "speedup_vs_eager": round(
+            rows["eager"]["best_seconds"]
+            / max(rows["fused"]["best_seconds"], 1e-9), 3),
+    }
+    import jax
+
+    from heat_tpu import telemetry
+
+    # bench honesty (ROADMAP standing weakness): record whether this run
+    # actually measured an accelerator — CPU-mesh numbers validate dispatch
+    # counts and scaling shape, not chip throughput
+    compare["on_chip"] = jax.default_backend() == "tpu"
+    summary = {"reduction_compare": compare}
+    if telemetry.enabled():
+        summary.update(telemetry.report.bench_fields())
+    print(json.dumps(summary), flush=True)
+
+
+if __name__ == "__main__":
+    main()

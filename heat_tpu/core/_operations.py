@@ -188,7 +188,12 @@ def reduce_op(
     """Generic reduction (reference _operations.py:355-478: local partial
     reduce + Allreduce over the split axis, neutral elements for empty
     shards). Here: neutralize the pad when the reduction crosses the split
-    axis, then one jnp reduction — XLA inserts the cross-shard combine."""
+    axis, then one jnp reduction — XLA inserts the cross-shard combine.
+
+    A pending fused elementwise chain on ``x`` is not flushed first: with
+    Fusion 2.0 on (``HEAT_TPU_FUSION_REDUCE``, default) the chain is
+    *absorbed* — chain, masked-neutral pad fill, reduction and collective
+    tail compile as ONE cached program (core/fusion.py `absorb_reduce`)."""
     sanitation.sanitize_in(x)
     axes = sanitize_axis(x.shape, axis)
     if axes is None:
@@ -201,10 +206,8 @@ def reduce_op(
     split = x.split
     crosses_split = split is not None and split in red_axes
 
-    buf = x._masked(neutral) if (crosses_split and x.pad_count) else x.larray
-    result = operation(buf, axis=red_axes if axis is not None else None, keepdims=keepdims, **kwargs)
-
-    # output metadata
+    # output metadata (before dispatch: the absorbing path pins the result
+    # sharding from it)
     if split is None or crosses_split:
         out_split = None
     else:
@@ -216,10 +219,23 @@ def reduce_op(
         out_gshape = tuple(1 if d in red_axes else s for d, s in enumerate(x.shape))
     else:
         out_gshape = tuple(s for d, s in enumerate(x.shape) if d not in red_axes)
-
     if dtype is not None:
         dtype = types.canonical_heat_type(dtype)
-        result = result.astype(dtype.jnp_type())
+
+    result = None
+    from . import fusion
+
+    if fusion.active():
+        result = fusion.absorb_reduce(
+            operation, x, red_axes, axis, neutral, keepdims, kwargs,
+            out_gshape, out_split, crosses_split,
+            dtype.jnp_type() if dtype is not None else None,
+        )
+    if result is None:
+        buf = x._masked(neutral) if (crosses_split and x.pad_count) else x.larray
+        result = operation(buf, axis=red_axes if axis is not None else None, keepdims=keepdims, **kwargs)
+        if dtype is not None:
+            result = result.astype(dtype.jnp_type())
 
     res = DNDarray(
         result,

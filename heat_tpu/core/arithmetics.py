@@ -39,6 +39,8 @@ __all__ = [
     "mod",
     "mul",
     "multiply",
+    "nanprod",
+    "nansum",
     "neg",
     "negative",
     "pos",
@@ -219,6 +221,23 @@ def prod(a: DNDarray, axis=None, out=None, keepdims: bool = False) -> DNDarray:
     """Product of elements over axis (reference arithmetics.py `prod` via
     __reduce_op + MPI.PROD)."""
     return reduce_op(jnp.prod, a, axis, neutral=1, out=out, keepdims=keepdims)
+
+
+def nanprod(a: DNDarray, axis=None, out=None, keepdims: bool = False) -> DNDarray:
+    """Product treating NaN as 1 (reference arithmetics.py `nanprod`).
+    Rides the same ``reduce_op`` machinery as :func:`prod` — including
+    Fusion 2.0 chain absorption. Exact ints cannot hold NaN, so they
+    route to :func:`prod` (identical numpy semantics)."""
+    if not jnp.issubdtype(a.dtype.jnp_type(), jnp.inexact):
+        return prod(a, axis, out=out, keepdims=keepdims)
+    return reduce_op(jnp.nanprod, a, axis, neutral=1, out=out, keepdims=keepdims)
+
+
+def nansum(a: DNDarray, axis=None, out=None, keepdims: bool = False) -> DNDarray:
+    """Sum treating NaN as 0 (reference arithmetics.py `nansum`)."""
+    if not jnp.issubdtype(a.dtype.jnp_type(), jnp.inexact):
+        return sum(a, axis, out=out, keepdims=keepdims)
+    return reduce_op(jnp.nansum, a, axis, neutral=0, out=out, keepdims=keepdims)
 
 
 def right_shift(t1, t2, out=None) -> DNDarray:

@@ -15,16 +15,40 @@ executes — the first time any consumer touches the physical buffer.
 Flush (materialization) boundaries
 ----------------------------------
 Every read of ``DNDarray.larray`` flushes a pending chain, which makes the
-boundary set *emergent* rather than enumerated: reductions and scans
-(``_masked``), resplit/relayout, indexing, comm wrappers, ``.numpy()`` /
-``__repr__`` / I/O, halo exchanges, ``out=`` aliasing (the ``larray`` setter
-force-flushes a pending destination) — anything that is not itself a
-deferrable elementwise op materializes the chain first. Deferral additionally
-stops at the depth/node caps (``HEAT_TPU_FUSION_DEPTH``, default 16; node cap
-is 4x the depth cap), at non-allowlisted callables (lambdas, partials), at
-non-static kwargs, and whenever the abstract result would not obey the
-tail-pad invariant — those fall back to the exact eager path and count as
-``fusion.fallbacks``.
+boundary set *emergent* rather than enumerated: scans (``_masked``),
+resplit/relayout, indexing, comm wrappers, ``.numpy()`` / ``__repr__`` /
+I/O, halo exchanges, ``out=`` aliasing (the ``larray`` setter force-flushes
+a pending destination) — anything that is not itself a deferrable op
+materializes the chain first. Deferral additionally stops at the depth/node
+caps (``HEAT_TPU_FUSION_DEPTH``, default 16; node cap is 4x the depth cap),
+at non-allowlisted callables (lambdas, partials), at non-static kwargs, and
+whenever the abstract result would not obey the tail-pad invariant — those
+fall back to the exact eager path and count as ``fusion.fallbacks``.
+
+Fusion 2.0 — through-reduction fusion and epilogue grafting (ISSUE 7)
+---------------------------------------------------------------------
+Reductions are no longer hard flush boundaries: a ``__reduce_op``-family
+call (sum/mean/prod/min/max/any/all/var/std and the nan-variants, any
+axis form, keepdims or not) whose operand carries a pending chain *absorbs*
+the chain — :func:`absorb_reduce` compiles ONE map+reduce program through
+``program_cache.cached_program`` under site ``fusion_reduce`` (structural
+signature = chain signature + reduce op + axis/neutral/keepdims). The
+cross-split case keeps the exact masked-neutral pad semantics *inside* the
+fused program (an explicit ``__mask__`` node), and the ``psum``-style
+collective tail XLA derives from the pinned ``out_shardings`` rides in the
+same trace (HLO-auditable against
+:func:`heat_tpu.telemetry.collectives.fusion_reduce_cost`).
+
+Symmetrically, :func:`defer_matmul` makes ``matmul`` a lazy *kernel node*:
+pending operand chains are grafted in as a pre-map, and downstream
+elementwise ops (bias add, activation, Lasso's soft-threshold tail) graft
+onto the kernel's output as an *epilogue* — ``matmul + bias + activation``
+flushes as one cached program. ``HEAT_TPU_FUSION_REDUCE=0`` disables both
+absorption paths, restoring the flush-at-reduction dispatch bit for bit;
+unsupported ops / non-static kwargs count as ``fusion.fallbacks`` and
+flush exactly as before. Counters ``fusion.reductions_absorbed`` /
+``fusion.epilogues_grafted`` feed ``report.summarize()`` and the Chrome
+trace.
 
 Pad semantics
 -------------
@@ -81,12 +105,14 @@ __all__ = [
     "fuse",
     "fusing",
     "active",
+    "reduce_active",
     "depth_cap",
     "node_cap",
     "set_pressure_cap",
     "pressure_cap",
     "stats",
     "reset_stats",
+    "register_elementwise",
     "DEFAULT_DEPTH",
 ]
 
@@ -106,7 +132,10 @@ _TLS = threading.local()
 _LOCK = threading.Lock()
 # Always-on lightweight counters (ints behind one lock) — the bench and the
 # tests read dispatch counts here without enabling full telemetry.
-_STATS = {"deferred": 0, "flushes": 0, "nodes_flushed": 0, "fallbacks": 0}
+_STATS = {
+    "deferred": 0, "flushes": 0, "nodes_flushed": 0, "fallbacks": 0,
+    "reductions_absorbed": 0, "epilogues_grafted": 0,
+}
 
 
 # -- enablement ---------------------------------------------------------------
@@ -126,6 +155,19 @@ def active() -> bool:
     if ov is not None:
         return ov
     return _env_enabled()
+
+
+def reduce_active() -> bool:
+    """Whether Fusion 2.0 absorption (through-reduction fusion and
+    matmul/moments epilogue grafting) is on: requires :func:`active` AND
+    ``HEAT_TPU_FUSION_REDUCE`` (default on). ``HEAT_TPU_FUSION_REDUCE=0``
+    restores the flush-at-reduction dispatch bit for bit while plain
+    elementwise fusion keeps running."""
+    if not active():
+        return False
+    return os.environ.get("HEAT_TPU_FUSION_REDUCE", "1").strip().lower() not in (
+        "0", "false", "off",
+    )
 
 
 def depth_cap() -> int:
@@ -273,12 +315,16 @@ class FusedNode:
     by two DAGs (``t = log(a); u = t+1; v = t*2`` with ``t`` never read)
     is re-traced inside each consumer's program — duplicated elementwise
     device work bounded by the depth cap, never duplicated buffers.
+    *Kernel* nodes (deferred matmul) are the exception: a second consumer
+    materializes them once via ``_entry_of`` — duplicating a contraction
+    is not "bounded elementwise work".
     ``split`` is the result's logical split (set on root wrap — it pins
     the program's ``out_shardings``)."""
 
     __slots__ = (
         "op_id", "fn", "kwargs", "operands",
         "pshape", "dtype", "split", "depth", "nnodes", "buffer", "shared",
+        "kernel",
     )
 
     def __init__(self, op_id, fn, kwargs, operands, pshape, dtype):
@@ -289,6 +335,10 @@ class FusedNode:
         self.pshape = tuple(int(s) for s in pshape)
         self.dtype = dtype  # jnp dtype of the (strong-typed) result
         self.split = None
+        # True for a deferred *kernel* node (matmul): elementwise consumers
+        # deferring onto it are epilogue grafts (counted in
+        # _commit_captures), and the kernel+tail flush as one program.
+        self.kernel = False
         # True once another DAG consumed this node as an operand: the
         # owner's eventual flush result may then be referenced by other
         # pending chains, so its buffer must never be donated to XLA
@@ -463,17 +513,254 @@ def _plan_program(plan_tuple):
     return fused_program
 
 
+# -- absorption building blocks (Fusion 2.0, ISSUE 7) -------------------------
+
+
+def _mask_fill(x, *, dim, extent, fill):
+    """Pad neutralization INSIDE a fused program — the traced twin of
+    ``DNDarray._masked``: positions at global index >= ``extent`` along
+    ``dim`` are replaced with ``fill`` (a static constant baked into the
+    program)."""
+    idx = jax.lax.broadcasted_iota(jnp.int32, x.shape, dim)
+    return jnp.where(idx < extent, x, jnp.asarray(fill, dtype=x.dtype))
+
+
+def _cast_fn(x, *, dtype):
+    """Static dtype cast as a fusable node (matmul's operand promotion)."""
+    return x.astype(dtype)
+
+
+def _masked_node(entry, dim: int, extent: int, fill) -> FusedNode:
+    """Wrap ``entry`` in a ``__mask__`` node (see :func:`_mask_fill`)."""
+    sds = _entry_sds(entry)
+    return FusedNode(
+        "__mask__", _mask_fill,
+        {"dim": int(dim), "extent": int(extent), "fill": fill},
+        (entry,), sds.shape, sds.dtype,
+    )
+
+
+def _cast_node(entry, dtype) -> FusedNode:
+    sds = _entry_sds(entry)
+    return FusedNode(
+        "__cast__", _cast_fn, {"dtype": str(np.dtype(dtype))},
+        (entry,), sds.shape, dtype,
+    )
+
+
+def _padded_node(entry, widths) -> FusedNode:
+    sds = _entry_sds(entry)
+    pshape = tuple(s + w0 + w1 for s, (w0, w1) in zip(sds.shape, widths))
+    return FusedNode(
+        "__pad__", None, {"pad": tuple(tuple(w) for w in widths)},
+        (entry,), pshape, sds.dtype,
+    )
+
+
+def pending_plan(x):
+    """``(signature, plan_tuple, args)`` for ``x``'s pending fused chain —
+    the raw material an absorbing consumer (reduction, pallas moments)
+    composes its own program from — or None when nothing is pending.
+    ``plan_program(plan_tuple)`` rebuilds the chain callable; ``args`` is
+    the positional argument list (leaf buffers then runtime scalars) the
+    composed program must be called with, in signature order."""
+    node = x._fused_node()
+    if node is None or node.buffer is not None:
+        return None
+    if node.kernel and node.shared:
+        return None  # materialize-once rule — see _entry_of
+    sig, plan, leaf_bufs, scalar_vals = _compile_plan(node)
+    return sig, plan, list(leaf_bufs) + list(scalar_vals)
+
+
+# rebuilds the chain callable from a pending_plan plan tuple (public alias
+# for absorbing consumers outside this module, e.g. statistics' fused
+# pallas-moments program)
+plan_program = _plan_program
+
+
+def _note_absorbed(x, site: str, **fields) -> None:
+    """Count one chain absorbed into a consumer's program: the chain DID
+    materialize (inside the consumer's trace), so the flush counters keep
+    their meaning, plus the Fusion 2.0 absorption counter and one instant
+    event for the Chrome trace."""
+    node = x._fused_node()
+    nodes = node.nnodes if node is not None else 0
+    _count("flushes")
+    _count("nodes_flushed", nodes)
+    _count("reductions_absorbed")
+    if telemetry.enabled():
+        reg = telemetry.get_registry()
+        reg.add("fusion.flushes", 1)
+        reg.add("fusion.nodes_flushed", nodes)
+        reg.add("fusion.reductions_absorbed", 1)
+        reg.emit("fusion", site, nodes=nodes, **fields)
+
+
+def absorb_reduce(
+    operation: Callable,
+    x,
+    red_axes: Tuple[int, ...],
+    axis_arg,
+    neutral,
+    keepdims: bool,
+    fn_kwargs: dict,
+    out_gshape: Tuple[int, ...],
+    out_split: Optional[int],
+    crosses_split: bool,
+    dtype_jnp,
+):
+    """Through-reduction fusion: compile ``x``'s pending elementwise chain
+    PLUS the reduction as ONE cached program (site ``fusion_reduce``) and
+    execute it, returning the result buffer — or None to fall back to the
+    flush-then-eager-reduce path.
+
+    The program replays the eager pipeline exactly: chain → masked-neutral
+    pad fill (only when the reduction crosses the split axis of a padded
+    operand) → ``operation(..., axis=, keepdims=)`` → optional static dtype
+    cast. ``out_shardings`` pins the result layout, so the cross-shard
+    combine (an all-reduce for split-crossing reductions) is part of the
+    same trace — one program, one dispatch. Declined absorptions with a
+    pending chain count as ``fusion.fallbacks`` and flush exactly as
+    before."""
+    node = x._fused_node()
+    if node is None or node.buffer is not None:
+        return None
+    if node.kernel and node.shared:
+        # a kernel node another chain already consumed: absorbing would
+        # re-run the contraction inside the reduce program too — flush
+        # once instead (the larray read below reuses the cached buffer)
+        return None
+    if not reduce_active():
+        return None
+    op_id = _op_id(operation)
+    if op_id is None or not _static_kwargs(fn_kwargs):
+        return _fallback()
+    if not isinstance(neutral, _STATIC_KW):
+        return _fallback()
+    sig, plan, leaf_bufs, scalar_vals = _compile_plan(node)
+    mask = None
+    mask_key = None
+    if crosses_split and x.pad_count:
+        mask = (int(x.split), int(x.shape[x.split]), neutral)
+        # key on repr, never the raw value: float('nan') hashes by object
+        # identity, so a raw-NaN neutral (every nan-variant) would miss
+        # the program registry on EVERY call and recompile per dispatch
+        # (same rule as _compile_plan's scalar dedup)
+        mask_key = (mask[0], mask[1], repr(neutral))
+    axes = tuple(red_axes) if axis_arg is not None else None
+    kw_key = tuple(
+        (k, repr(v) if isinstance(v, float) else v)
+        for k, v in sorted(fn_kwargs.items())
+    )
+    dt_key = None if dtype_jnp is None else str(np.dtype(dtype_jnp))
+    rsig = sig + (
+        ("reduce", op_id, axes, bool(keepdims), kw_key, mask_key, out_split,
+         dt_key),
+    )
+    comm = x.comm
+    if comm is not None and comm.size > 1:
+        tgt = (
+            comm.sharding(out_split, len(out_gshape))
+            if out_split is not None
+            else comm.replicated()
+        )
+    else:
+        tgt = None
+
+    def build():
+        inner = _plan_program(plan)
+
+        def fused_reduce(*args):
+            val = inner(*args)
+            if mask is not None:
+                val = _mask_fill(
+                    val, dim=mask[0], extent=mask[1], fill=mask[2]
+                )
+            r = operation(val, axis=axes, keepdims=keepdims, **fn_kwargs)
+            if dtype_jnp is not None:
+                r = r.astype(dtype_jnp)
+            return r
+
+        return fused_reduce
+
+    from . import program_cache
+
+    fn = program_cache.cached_program(
+        "fusion_reduce", rsig, build, comm=comm, out_shardings=tgt
+    )
+    buf = fn(*leaf_bufs, *scalar_vals)
+    _note_absorbed(
+        x, "reduce_absorb", op=op_id, axes=list(red_axes),
+        crosses_split=bool(crosses_split),
+    )
+    _maybe_audit_reduce(
+        fn, rsig, comm, buf, out_gshape, crosses_split,
+        (leaf_bufs, scalar_vals), op_id,
+    )
+    return buf
+
+
+def _maybe_audit_reduce(
+    fn, rsig, comm, buf, out_gshape, crosses_split, args, op_id
+) -> None:
+    """Ground-truth the fused collective tail: with the global HLO audit
+    armed, lower the very cached program that just executed and diff its
+    emitted collectives against the analytic all-reduce prediction
+    (telemetry/collectives.fusion_reduce_cost). Memoized on the shared
+    program signature; never raises; no-op when no collective is expected
+    (1-position mesh or a reduction that keeps the split)."""
+    if comm is None or comm.size <= 1 or not crosses_split:
+        return
+    from ..telemetry import hlo
+
+    if not hlo.audit_enabled():
+        return
+    from . import program_cache
+
+    leaf_bufs, scalar_vals = args
+    predicted = telemetry.collectives.fusion_reduce_cost(
+        out_gshape, buf.dtype.itemsize, comm.size
+    )
+    hlo.audit_call(
+        "fusion_reduce",
+        lambda: (fn, (*leaf_bufs, *scalar_vals)),
+        predicted=predicted,
+        key=program_cache.program_key("fusion_reduce", rsig, comm=comm),
+        fields={"op": op_id, "out_gshape": list(out_gshape)},
+    )
+
+
 # -- deferral entry points (called by _operations) ----------------------------
+
+
+# Framework-owned module-level elementwise helpers allowlisted for deferral
+# by OBJECT identity (never by name): a module-level ``def`` has one stable
+# identity per process, so — unlike lambdas/partials, which stay refused —
+# keying the process-global program cache on its registered id is safe.
+_REGISTERED_OPS: Dict[Callable, str] = {}
+
+
+def register_elementwise(fn: Callable) -> Callable:
+    """Allowlist a module-level framework helper for fusion (decorator).
+    The registered op id is ``module.qualname`` — stable per process and
+    unique per function object."""
+    _REGISTERED_OPS[fn] = f"{fn.__module__}.{fn.__qualname__}"
+    return fn
 
 
 def _op_id(fn: Callable) -> Optional[str]:
     """Stable identity for an allowlisted elementwise callable, or None.
 
-    Only module-level ``jax.numpy`` functions qualify: their
-    (module, name) uniquely identifies the computation. Lambdas and
+    Only module-level ``jax.numpy`` functions — plus framework helpers
+    explicitly allowlisted via :func:`register_elementwise` — qualify:
+    their (module, name) uniquely identifies the computation. Lambdas and
     partials are refused — two closures over different constants share a
     qualname, and keying a process-global program cache on one would
     silently reuse the wrong program."""
+    reg = _REGISTERED_OPS.get(fn)
+    if reg is not None:
+        return reg
     name = getattr(fn, "__qualname__", None) or getattr(fn, "__name__", None)
     mod = getattr(fn, "__module__", None)
     if not name or not mod or "<" in name:
@@ -489,13 +776,24 @@ def _static_kwargs(kwargs: dict) -> bool:
 
 
 def _entry_of(a):
-    """DNDarray -> DAG entry: its pending node (never flushed here!) or a
-    by-value leaf of its physical buffer. Side-effect free — capture
-    marks are applied by :func:`_commit_captures` only once the op has
-    actually deferred, so a fallback to eager dispatch leaves no stale
-    non-donatable flags behind."""
+    """DNDarray -> DAG entry: its pending node (elementwise chains are
+    never flushed here!) or a by-value leaf of its physical buffer.
+    Capture marks are applied by :func:`_commit_captures` only once the
+    op has actually deferred, so a fallback to eager dispatch leaves no
+    stale non-donatable flags behind.
+
+    One exception to no-side-effects: a *kernel* node (deferred matmul)
+    that a previous chain already consumed (``shared``) materializes
+    here and enters as a leaf — re-tracing it per consumer would
+    duplicate a full O(n·k·m) contraction in every consumer's program,
+    which the depth-cap rationale that bounds duplicated *elementwise*
+    work cannot excuse. The single-consumer path (bias+activation
+    epilogues) keeps full kernel fusion."""
     node = a._fused_node()
     if node is not None and node.buffer is None:
+        if node.kernel and node.shared:
+            node.materialize(a.comm)
+            return _Leaf(node.buffer)
         return node
     if node is not None:
         return _Leaf(node.buffer)
@@ -509,10 +807,19 @@ def _commit_captures(pairs):
     ``resplit_`` donating one to XLA would hand a later flush a deleted
     array (eager dispatch computed consumers immediately, so this
     ordering could never fail there). ``pairs`` holds ``(entry, source
-    DNDarray)`` for the pre-pad operand entries."""
+    DNDarray)`` for the pre-pad operand entries. Consuming a pending
+    *kernel* node (a deferred matmul) is an epilogue graft — the
+    elementwise tail rides into the kernel's program — and counts as
+    ``fusion.epilogues_grafted``."""
     for entry, src in pairs:
         if isinstance(entry, FusedNode) and entry.buffer is None:
             entry.shared = True
+            if entry.kernel:
+                _count("epilogues_grafted")
+                if telemetry.enabled():
+                    reg = telemetry.get_registry()
+                    reg.add("fusion.epilogues_grafted", 1)
+                    reg.emit("fusion", "epilogue_graft", kernel=entry.op_id)
         else:
             src._mark_leaf_captured()
 
@@ -654,3 +961,77 @@ def defer_binary(
         op_id, operation, dict(fn_kwargs), entries, out.shape, out.dtype
     )
     return _wrap_deferred(node, out_shape, out_split, device, comm)
+
+
+def defer_matmul(a, b, out_dtype_jnp, out_gshape, out_split, device, comm):
+    """Lazy kernel node for ``linalg.matmul`` (epilogue grafting, ISSUE 7):
+    instead of dispatching, wrap mask → cast → pad-align → ``jnp.matmul``
+    as a *kernel* FusedNode. Pending operand chains graft in as the
+    kernel's pre-map; downstream elementwise ops (bias add, activation,
+    soft-threshold tails) defer onto the node as its epilogue — the whole
+    ``matmul + tail`` flushes as ONE cached program with the result split
+    pinning ``out_shardings`` (XLA derives the contraction collective
+    inside the same trace). Returns a deferred DNDarray, or None to run
+    today's eager kernel (counted as a fallback only when a pending chain
+    would have been flushed by it).
+
+    Mirrors the eager path op for op: operands are pad-masked to 0, cast
+    to the promoted dtype, and contraction-side pads are aligned with
+    explicit pad nodes — bit-equal semantics, one program."""
+    if not reduce_active():
+        return None
+    ea0, eb0 = _entry_of(a), _entry_of(b)
+    captures = [(ea0, a), (eb0, b)]
+    had_pending = any(
+        isinstance(e, FusedNode) and e.buffer is None for e in (ea0, eb0)
+    )
+
+    def decline():
+        return _fallback() if had_pending else None
+
+    def prep(entry, arr):
+        if arr.pad_count:
+            entry = _masked_node(
+                entry, arr.split, arr.shape[arr.split], 0
+            )
+        if _entry_sds(entry).dtype != out_dtype_jnp:
+            entry = _cast_node(entry, out_dtype_jnp)
+        return entry
+
+    ea, eb = prep(ea0, a), prep(eb0, b)
+    ash, bsh = _entry_pshape(ea), _entry_pshape(eb)
+
+    # contraction-side pad alignment (the eager branch structure verbatim:
+    # when one operand's contraction dim is physically padded, the other
+    # operand pads its matching dim so the contraction extents agree; the
+    # masked zeros contribute nothing)
+    def pad_entry(entry, ndim, dim, delta):
+        if delta < 0:
+            return None  # shapes the eager path would reject — let it
+        widths = [(0, 0)] * ndim
+        widths[dim] = (0, delta)
+        return _padded_node(entry, widths)
+
+    if a.ndim >= 2 and a.split == a.ndim - 1 and a.pad_count:
+        dim = -2 if b.ndim > 1 else 0
+        eb = pad_entry(eb, b.ndim, dim, ash[-1] - bsh[dim])
+    elif b.ndim >= 2 and b.split == b.ndim - 2 and b.pad_count:
+        ea = pad_entry(ea, a.ndim, -1, bsh[-2] - ash[-1])
+    elif b.ndim == 1 and b.split == 0 and b.pad_count:
+        ea = pad_entry(ea, a.ndim, -1, bsh[0] - ash[-1])
+    elif a.ndim == 1 and a.split == 0 and a.pad_count and b.ndim > 1:
+        eb = pad_entry(eb, b.ndim, -2, ash[0] - bsh[-2])
+    if ea is None or eb is None:
+        return decline()
+    try:
+        out = jax.eval_shape(jnp.matmul, _entry_sds(ea), _entry_sds(eb))
+    except Exception:
+        return decline()
+    expected = comm.padded_shape(out_gshape, out_split)
+    if tuple(out.shape) != tuple(expected):
+        # result needs the eager path's slice/reshape repair — run it there
+        return decline()
+    _commit_captures(captures)
+    node = FusedNode("__matmul__", jnp.matmul, {}, (ea, eb), out.shape, out.dtype)
+    node.kernel = True
+    return _wrap_deferred(node, out_gshape, out_split, device, comm)

@@ -88,7 +88,15 @@ def matmul(a: DNDarray, b: DNDarray, allow_resplit: bool = False) -> DNDarray:
 
     Pads along contraction dims are zero-masked, so they contribute nothing;
     pads along carried dims stay pad. N-D batched matmul is an extension over
-    the reference (which supports up to 2-D)."""
+    the reference (which supports up to 2-D).
+
+    With Fusion 2.0 on (``HEAT_TPU_FUSION_REDUCE``, default) the matmul is
+    a lazy *kernel node* (core/fusion.py `defer_matmul`): pending operand
+    chains graft in as its pre-map, trailing elementwise ops (bias add,
+    activation) graft on as its epilogue, and the whole thing flushes as
+    ONE cached program. Shapes the kernel path cannot express (vector
+    promotions needing repair slices) run the eager dispatch below,
+    unchanged."""
     from .. import factories
 
     if not isinstance(a, DNDarray) or not isinstance(b, DNDarray):
@@ -97,10 +105,6 @@ def matmul(a: DNDarray, b: DNDarray, allow_resplit: bool = False) -> DNDarray:
         return dot(a, b)
 
     out_dtype = types.promote_types(a.dtype, b.dtype)
-    am = a._masked(0) if a.pad_count else a.larray
-    bm = b._masked(0) if b.pad_count else b.larray
-    am = am.astype(out_dtype.jnp_type())
-    bm = bm.astype(out_dtype.jnp_type())
 
     # vector promotions (numpy semantics)
     a_vec = a.ndim == 1
@@ -115,27 +119,7 @@ def matmul(a: DNDarray, b: DNDarray, allow_resplit: bool = False) -> DNDarray:
             f"as the second-to-last dimension of b ({b.shape[-2 if b.ndim > 1 else -1]})."
         )
 
-    # physical operands: when a contraction-side pad exists on one operand,
-    # the other operand's matching dim must be padded too
     comm = a.comm
-    if a.ndim >= 2 and a.split == a.ndim - 1 and a.pad_count:
-        pad = [(0, 0)] * b.ndim
-        pad[-2 if b.ndim > 1 else 0] = (0, am.shape[-1] - bm.shape[-2 if b.ndim > 1 else 0])
-        bm = jnp.pad(bm, pad)
-    elif b.ndim >= 2 and b.split == b.ndim - 2 and b.pad_count:
-        pad = [(0, 0)] * a.ndim
-        pad[-1] = (0, bm.shape[-2] - am.shape[-1])
-        am = jnp.pad(am, pad)
-    elif b.ndim == 1 and b.split == 0 and b.pad_count:
-        pad = [(0, 0)] * a.ndim
-        pad[-1] = (0, bm.shape[0] - am.shape[-1])
-        am = jnp.pad(am, pad)
-    elif a.ndim == 1 and a.split == 0 and a.pad_count and b.ndim > 1:
-        pad = [(0, 0)] * b.ndim
-        pad[-2] = (0, am.shape[0] - bm.shape[-2])
-        bm = jnp.pad(bm, pad)
-
-    result = jnp.matmul(am, bm)
 
     # logical output shape
     batch = tuple(np.broadcast_shapes(a_shape[:-2], b_shape[:-2])) if (len(a_shape) > 2 or len(b_shape) > 2) else ()
@@ -164,6 +148,42 @@ def matmul(a: DNDarray, b: DNDarray, allow_resplit: bool = False) -> DNDarray:
             out_split = ndim_out - 2
     if out_split is not None and out_split >= ndim_out:
         out_split = None
+
+    from .. import fusion
+
+    if fusion.active():
+        deferred = fusion.defer_matmul(
+            a, b, out_dtype.jnp_type(), out_gshape, out_split,
+            a.device, comm,
+        )
+        if deferred is not None:
+            return deferred
+
+    am = a._masked(0) if a.pad_count else a.larray
+    bm = b._masked(0) if b.pad_count else b.larray
+    am = am.astype(out_dtype.jnp_type())
+    bm = bm.astype(out_dtype.jnp_type())
+
+    # physical operands: when a contraction-side pad exists on one operand,
+    # the other operand's matching dim must be padded too
+    if a.ndim >= 2 and a.split == a.ndim - 1 and a.pad_count:
+        pad = [(0, 0)] * b.ndim
+        pad[-2 if b.ndim > 1 else 0] = (0, am.shape[-1] - bm.shape[-2 if b.ndim > 1 else 0])
+        bm = jnp.pad(bm, pad)
+    elif b.ndim >= 2 and b.split == b.ndim - 2 and b.pad_count:
+        pad = [(0, 0)] * a.ndim
+        pad[-1] = (0, bm.shape[-2] - am.shape[-1])
+        am = jnp.pad(am, pad)
+    elif b.ndim == 1 and b.split == 0 and b.pad_count:
+        pad = [(0, 0)] * a.ndim
+        pad[-1] = (0, bm.shape[0] - am.shape[-1])
+        am = jnp.pad(am, pad)
+    elif a.ndim == 1 and a.split == 0 and a.pad_count and b.ndim > 1:
+        pad = [(0, 0)] * b.ndim
+        pad[-2] = (0, am.shape[0] - bm.shape[-2])
+        bm = jnp.pad(bm, pad)
+
+    result = jnp.matmul(am, bm)
 
     # restore the invariant: physical == padded_shape(out_gshape, out_split)
     expected = comm.padded_shape(out_gshape, out_split)

@@ -30,15 +30,10 @@ __all__ = [
 
 def all(x: DNDarray, axis=None, out=None, keepdims: bool = False) -> DNDarray:
     """True where all elements (along axis) are truthy (reference
-    logical.py `all`: local all + Allreduce(LAND))."""
-    return reduce_op(
-        lambda a, axis, keepdims: jnp.all(a, axis=axis, keepdims=keepdims),
-        x,
-        axis,
-        neutral=True,
-        out=out,
-        keepdims=keepdims,
-    )
+    logical.py `all`: local all + Allreduce(LAND)). Passed as the bare
+    ``jnp.all`` — a lambda wrapper would decline Fusion 2.0 absorption on
+    every pending chain (ISSUE 7 fallback audit)."""
+    return reduce_op(jnp.all, x, axis, neutral=True, out=out, keepdims=keepdims)
 
 
 def allclose(x: DNDarray, y: DNDarray, rtol: float = 1e-05, atol: float = 1e-08, equal_nan: bool = False) -> bool:
@@ -50,15 +45,8 @@ def allclose(x: DNDarray, y: DNDarray, rtol: float = 1e-05, atol: float = 1e-08,
 
 def any(x: DNDarray, axis=None, out=None, keepdims: bool = False) -> DNDarray:
     """True where any element (along axis) is truthy (reference logical.py
-    `any`)."""
-    return reduce_op(
-        lambda a, axis, keepdims: jnp.any(a, axis=axis, keepdims=keepdims),
-        x,
-        axis,
-        neutral=False,
-        out=out,
-        keepdims=keepdims,
-    )
+    `any`; bare ``jnp.any`` so pending chains absorb — see :func:`all`)."""
+    return reduce_op(jnp.any, x, axis, neutral=False, out=out, keepdims=keepdims)
 
 
 def isclose(x, y, rtol: float = 1e-05, atol: float = 1e-08, equal_nan: bool = False) -> DNDarray:

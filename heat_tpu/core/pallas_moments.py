@@ -76,13 +76,30 @@ def _moments_kernel(lim_ref, x_ref, mean_ref, m2_ref, mean_s, m2_s, cnt_s, *, bm
         m2_ref[:] = m2_s[:]
 
 
-@functools.partial(jax.jit, static_argnames=("n", "block_m", "interpret"))
+@functools.partial(
+    jax.jit, static_argnames=("n", "block_m", "interpret", "pre_map")
+)
 def column_moments(
     x: jax.Array, n: int, block_m: int = 1024, interpret: bool = False,
-    lim=None,
+    lim=None, pre_map=None,
 ):
     """(mean (d,), M2 (d,)) over the first axis of an (m, d) f32 array,
-    counting only the first ``n`` rows (tail-pad aware). One HBM read."""
+    counting only the first ``n`` rows (tail-pad aware). One HBM read.
+
+    ``pre_map`` (static) grafts a single-array elementwise prologue into
+    the same program — the moments of ``pre_map(x)`` from one read of
+    ``x``. This is the DIRECT-caller graft slot; the statistics layer's
+    chain grafting (``statistics._pallas_moments_fused``) instead
+    composes the pending chain around this kernel at the program level
+    (site ``fusion_moments``): chain scalars are *runtime* arguments
+    there (programs shared across scalar values — baking them into a
+    static ``pre_map`` closure would fork one executable per value), and
+    the pad mask must apply to GLOBAL row indices, which a per-shard
+    ``pre_map`` inside ``shard_map`` cannot express. ``pre_map`` output
+    must be finite on rows past ``n`` (the validity multiply would turn
+    ``0·inf`` into NaN)."""
+    if pre_map is not None:
+        x = pre_map(x)
     m, d = x.shape
     dp = _round_up(d, 64)  # 64-lane granularity: d=64 stays unpadded
     bm = min(block_m, _round_up(m, 8))
@@ -125,15 +142,18 @@ def column_moments(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("comm", "n", "block_m", "interpret")
+    jax.jit, static_argnames=("comm", "n", "block_m", "interpret", "pre_map")
 )
 def sharded_column_moments(
-    comm, x: jax.Array, n: int, block_m: int = 1024, interpret: bool = False
+    comm, x: jax.Array, n: int, block_m: int = 1024, interpret: bool = False,
+    pre_map=None,
 ):
     """Multi-device variant: per-shard (count, mean, M2) from the fused
     kernel, then the closed-form Welford merge across shards with two
     psums — mean_g = psum(n_s mean_s)/n; M2_g = psum(M2_s) +
-    psum(n_s (mean_s - mean_g)^2). X is still read exactly once."""
+    psum(n_s (mean_s - mean_g)^2). X is still read exactly once.
+    ``pre_map`` applies per shard before the kernel (elementwise, so
+    shard-local) — see :func:`column_moments`."""
     p = comm.size
     m, _d = x.shape
     c_rows = m // p
@@ -143,7 +163,7 @@ def sharded_column_moments(
         lim = jnp.clip(n - rank * c_rows, 0, c_rows).astype(jnp.int32)
         mean_s, m2_s = column_moments(
             xs, n, block_m=block_m, interpret=interpret,
-            lim=lim.reshape((1,)),
+            lim=lim.reshape((1,)), pre_map=pre_map,
         )
         ns = lim.astype(jnp.float32)
         mean_g = jax.lax.psum(ns * mean_s, comm.axis_name) / jnp.float32(n)

@@ -29,6 +29,12 @@ recompiled an identical program. Now:
   ``resplit_``, ``out=`` paths) let XLA reuse the input memory instead of
   holding source + destination live. Donation is part of the cache key: a
   donating and a non-donating caller never share an executable.
+* The fusion engine routes every flushed elementwise chain through site
+  ``fusion``; Fusion 2.0 (ISSUE 7) adds ``fusion_reduce`` (chain+reduction
+  map+reduce programs, keyed on chain signature + reduce op/axis/neutral)
+  and ``fusion_moments`` (chain grafted into the pallas column-moments
+  kernel) — absorption reuses this registry, so a repeated fused reduction
+  is the same dict-lookup dispatch as any cached program.
 * The site/key signature is shared with the HLO collective auditor
   (:func:`heat_tpu.telemetry.hlo.audit_call` sites build their memo key via
   :func:`program_key`), so an audited program and the cached program that

@@ -6,11 +6,25 @@ from typing import Optional
 
 import jax.numpy as jnp
 
-from . import types
+from . import fusion, types
 from ._operations import binary_op, local_op
 from .dndarray import DNDarray
 
 __all__ = ["abs", "absolute", "ceil", "clip", "fabs", "floor", "modf", "round", "sign", "trunc"]
+
+
+@fusion.register_elementwise
+def _modf_frac(a):
+    """Fractional part of ``jnp.modf`` as a module-level registered op —
+    a lambda here would trip ``fusion.fallbacks`` on every modf call
+    (closures are refused by the program-cache keying rules)."""
+    return jnp.modf(a)[0]
+
+
+@fusion.register_elementwise
+def _modf_int(a):
+    """Integral part of ``jnp.modf`` (see :func:`_modf_frac`)."""
+    return jnp.modf(a)[1]
 
 
 def abs(x, out=None, dtype=None) -> DNDarray:
@@ -61,9 +75,11 @@ def floor(x, out=None) -> DNDarray:
 
 
 def modf(x: DNDarray, out=None):
-    """Fractional and integral parts (reference rounding.py `modf`)."""
-    frac = local_op(lambda a: jnp.modf(a)[0], x)
-    intg = local_op(lambda a: jnp.modf(a)[1], x)
+    """Fractional and integral parts (reference rounding.py `modf`). Both
+    parts are registered fusable ops, so they join pending chains instead
+    of flushing them (PR 4 left these as lambda fallbacks)."""
+    frac = local_op(_modf_frac, x)
+    intg = local_op(_modf_int, x)
     if out is not None:
         if not isinstance(out, tuple) or len(out) != 2:
             raise TypeError("expected out to be None or a tuple of two DNDarrays")

@@ -37,6 +37,11 @@ __all__ = [
     "median",
     "min",
     "minimum",
+    "nanmax",
+    "nanmean",
+    "nanmin",
+    "nanstd",
+    "nanvar",
     "percentile",
     "skew",
     "std",
@@ -415,6 +420,63 @@ def histogram(a: DNDarray, bins: int = 10, range=None, normed=None, weights=None
     )
 
 
+def _pallas_moments_fused(
+    x: DNDarray, want: str, ddof: int = 0, interpret: bool = False
+):
+    """Graft ``x``'s pending fused elementwise chain into the pallas
+    column-moments kernel (Fusion 2.0 pre-map): ONE cached program (site
+    ``fusion_moments``) computing chain → pad-zero mask → single-read
+    Welford moments — the chain never flushes into its own dispatch.
+    Returns the replicated result buffer (mean for ``want='mean'``,
+    ``M2/(n-ddof)`` for ``want='var'``) or None when nothing is pending /
+    Fusion 2.0 is off."""
+    from . import fusion, program_cache
+    from .pallas_moments import column_moments, sharded_column_moments
+
+    if not fusion.reduce_active():
+        return None
+    plan = fusion.pending_plan(x)
+    if plan is None:
+        return None
+    sig, plan_t, args = plan
+    comm = x.comm
+    n = int(x.shape[0])
+    sharded = comm.size > 1
+    need_mask = bool(sharded and x.pad_count)
+    key = sig + (
+        ("moments", want, int(ddof), n, sharded, need_mask, interpret),
+    )
+
+    def build():
+        chain = fusion.plan_program(plan_t)
+
+        def prog(*bufs):
+            val = chain(*bufs)
+            if need_mask:
+                # mask AFTER the chain: pad rows must enter the kernel
+                # finite (0·inf inside the Welford combine would poison)
+                val = fusion._mask_fill(val, dim=0, extent=n, fill=0.0)
+            if sharded:
+                mu, m2 = sharded_column_moments(
+                    comm, val, n, interpret=interpret
+                )
+            else:
+                mu, m2 = column_moments(val, n, interpret=interpret)
+            if want == "mean":
+                return mu
+            return m2 / (n - ddof)
+
+        return prog
+
+    fn = program_cache.cached_program(
+        "fusion_moments", key, build, comm=comm,
+        out_shardings=comm.replicated() if sharded else None,
+    )
+    buf = fn(*args)
+    fusion._note_absorbed(x, "moments_absorb", want=want)
+    return buf
+
+
 def _central_moment(x: DNDarray, axis, k: int):
     """E[(x-μ)^k] with pad-safe masking."""
     from . import arithmetics
@@ -467,8 +529,9 @@ def mean(x: DNDarray, axis=None, keepdims_internal: bool = False, keepdims: bool
         axis == 0
         and not keepdims
         and not keepdims_internal
-        and x.split in (None, 0)
         and isinstance(x, DNDarray)
+        and x.ndim == 2  # gate BEFORE x.shape[1] — 1-D axis=0 is legal
+        and x.split in (None, 0)
     ):
         from .pallas_moments import (
             column_moments,
@@ -477,15 +540,18 @@ def mean(x: DNDarray, axis=None, keepdims_internal: bool = False, keepdims: bool
         )
 
         if pallas_moments_applicable(
-            x.comm.size, x.split, x.ndim, 0, x.shape[1], x.larray.dtype
+            x.comm.size, x.split, x.ndim, 0, x.shape[1],
+            x.dtype.jnp_type(),  # metadata, so a pending chain stays pending
         ):
             try:
-                if x.comm.size > 1:
-                    mu, _m2 = sharded_column_moments(
-                        x.comm, x._masked(0), x.shape[0]
-                    )
-                else:
-                    mu, _m2 = column_moments(x.larray, x.shape[0])
+                mu = _pallas_moments_fused(x, "mean")
+                if mu is None:
+                    if x.comm.size > 1:
+                        mu, _m2 = sharded_column_moments(
+                            x.comm, x._masked(0), x.shape[0]
+                        )
+                    else:
+                        mu, _m2 = column_moments(x.larray, x.shape[0])
                 import jax
 
                 jax.block_until_ready(mu)  # surface Mosaic faults HERE
@@ -511,6 +577,73 @@ def median(x: DNDarray, axis=None, keepdims: bool = False) -> DNDarray:
 
 def min(x: DNDarray, axis=None, out=None, keepdims: bool = False) -> DNDarray:
     return reduce_op(jnp.min, x, axis, neutral=_neutral_extreme(x, False), out=out, keepdims=keepdims)
+
+
+def _is_inexact(x: DNDarray) -> bool:
+    return jnp.issubdtype(x.dtype.jnp_type(), jnp.inexact)
+
+
+def _with_out(res: DNDarray, out: Optional[DNDarray]) -> DNDarray:
+    """numpy ``out=`` contract for the exact-int nan-variant routes, with
+    the SAME shape/split/device validation the inexact routes get from
+    ``reduce_op`` (a mismatched ``out`` must raise the sanitation error,
+    not a low-level physical-shape one)."""
+    if out is None:
+        return res
+    from . import sanitation
+
+    sanitation.sanitize_out(out, tuple(res.shape), res.split, res.device)
+    out.larray = res.larray.astype(out.dtype.jnp_type())
+    return out
+
+
+def nanmax(x: DNDarray, axis=None, out=None, keepdims: bool = False) -> DNDarray:
+    """Maximum ignoring NaN (reference statistics.py nan-family). Tail
+    pads are filled with NaN inside the reduction — a value nanmax
+    *ignores* — so pad rows can never win AND an all-NaN lane still
+    yields NaN exactly as numpy does. Rides ``reduce_op``: a pending
+    fused chain is absorbed into one map+reduce program (Fusion 2.0).
+    Exact ints cannot hold NaN and route to :func:`max`."""
+    if not _is_inexact(x):
+        return max(x, axis, out=out, keepdims=keepdims)
+    return reduce_op(jnp.nanmax, x, axis, neutral=float("nan"), out=out, keepdims=keepdims)
+
+
+def nanmin(x: DNDarray, axis=None, out=None, keepdims: bool = False) -> DNDarray:
+    """Minimum ignoring NaN (see :func:`nanmax` for pad semantics)."""
+    if not _is_inexact(x):
+        return min(x, axis, out=out, keepdims=keepdims)
+    return reduce_op(jnp.nanmin, x, axis, neutral=float("nan"), out=out, keepdims=keepdims)
+
+
+def nanmean(x: DNDarray, axis=None, out=None, keepdims: bool = False) -> DNDarray:
+    """Arithmetic mean ignoring NaN. The NaN pad fill keeps tail pads out
+    of BOTH the numerator and the divisor (a 0 fill would silently count
+    them)."""
+    if not _is_inexact(x):
+        return _with_out(mean(x, axis, keepdims=keepdims), out)
+    return reduce_op(jnp.nanmean, x, axis, neutral=float("nan"), out=out, keepdims=keepdims)
+
+
+def nanvar(x: DNDarray, axis=None, ddof: int = 0, out=None, keepdims: bool = False) -> DNDarray:
+    """Variance ignoring NaN (``ddof`` rides as a static kwarg, so the
+    call still fuses with a pending chain)."""
+    if not _is_inexact(x):
+        return _with_out(var(x, axis, ddof=ddof, keepdims=keepdims), out)
+    return reduce_op(
+        jnp.nanvar, x, axis, neutral=float("nan"), out=out,
+        keepdims=keepdims, ddof=builtins.int(ddof),
+    )
+
+
+def nanstd(x: DNDarray, axis=None, ddof: int = 0, out=None, keepdims: bool = False) -> DNDarray:
+    """Standard deviation ignoring NaN."""
+    if not _is_inexact(x):
+        return _with_out(std(x, axis, ddof=ddof, keepdims=keepdims), out)
+    return reduce_op(
+        jnp.nanstd, x, axis, neutral=float("nan"), out=out,
+        keepdims=keepdims, ddof=builtins.int(ddof),
+    )
 
 
 def minimum(x1, x2, out=None) -> DNDarray:
@@ -692,8 +825,9 @@ def var(x: DNDarray, axis=None, ddof: int = 0, keepdims: bool = False) -> DNDarr
     if (
         axis == 0
         and not keepdims
-        and x.split in (None, 0)
         and isinstance(x, DNDarray)
+        and x.ndim == 2  # gate BEFORE x.shape[1] — 1-D axis=0 is legal
+        and x.split in (None, 0)
     ):
         from .pallas_moments import (
             column_moments,
@@ -702,19 +836,22 @@ def var(x: DNDarray, axis=None, ddof: int = 0, keepdims: bool = False) -> DNDarr
         )
 
         if pallas_moments_applicable(
-            x.comm.size, x.split, x.ndim, 0, x.shape[1], x.larray.dtype
+            x.comm.size, x.split, x.ndim, 0, x.shape[1],
+            x.dtype.jnp_type(),  # metadata, so a pending chain stays pending
         ):
             try:
-                if x.comm.size > 1:
-                    _mu, m2 = sharded_column_moments(
-                        x.comm, x._masked(0), x.shape[0]
-                    )
-                else:
-                    _mu, m2 = column_moments(x.larray, x.shape[0])
+                out = _pallas_moments_fused(x, "var", ddof=ddof)
+                if out is None:
+                    if x.comm.size > 1:
+                        _mu, m2 = sharded_column_moments(
+                            x.comm, x._masked(0), x.shape[0]
+                        )
+                    else:
+                        _mu, m2 = column_moments(x.larray, x.shape[0])
+                    out = m2 / (x.shape[0] - ddof)
                 import jax
 
-                jax.block_until_ready(m2)  # surface Mosaic faults HERE
-                out = m2 / (x.shape[0] - ddof)
+                jax.block_until_ready(out)  # surface Mosaic faults HERE
                 return DNDarray.from_logical(
                     out, None, x.device, x.comm,
                     types.canonical_heat_type(out.dtype),

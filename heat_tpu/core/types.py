@@ -414,28 +414,30 @@ def can_cast(from_: Any, to: Any, casting: str = "intuitive") -> builtins.bool:
 
 
 def iscomplex(x):
-    """Elementwise test for non-zero imaginary part (reference types.py)."""
-    from . import factories
-    from ._operations import local_op
+    """Elementwise test for non-zero imaginary part (reference types.py).
+    Composed from fusable framework ops (``imag`` then ``!= 0``) instead
+    of a lambda, so it joins pending fused chains (PR 4 left this as a
+    per-call fallback)."""
+    from . import complex_math, factories, relational
     from .dndarray import DNDarray
 
     if not isinstance(x, DNDarray):
         x = factories.array(x)
     if issubclass(x.dtype, complexfloating):
-        return local_op(lambda a: jnp.imag(a) != 0, x, out=None)
+        return relational.ne(complex_math.imag(x), 0)
     return factories.zeros(x.shape, dtype=bool, split=x.split, device=x.device, comm=x.comm)
 
 
 def isreal(x):
-    """Elementwise test for zero imaginary part (reference types.py)."""
-    from . import factories
-    from ._operations import local_op
+    """Elementwise test for zero imaginary part (reference types.py); see
+    :func:`iscomplex` for the fusable composition."""
+    from . import complex_math, factories, relational
     from .dndarray import DNDarray
 
     if not isinstance(x, DNDarray):
         x = factories.array(x)
     if issubclass(x.dtype, complexfloating):
-        return local_op(lambda a: jnp.imag(a) == 0, x, out=None)
+        return relational.eq(complex_math.imag(x), 0)
     return factories.ones(x.shape, dtype=bool, split=x.split, device=x.device, comm=x.comm)
 
 

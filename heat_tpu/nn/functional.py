@@ -16,7 +16,46 @@ import jax.numpy as jnp
 from ..core.dndarray import DNDarray
 from ..parallel import local_attention, ring_attention, ulysses_attention
 
-__all__ = ["scaled_dot_product_attention"]
+__all__ = ["dense", "scaled_dot_product_attention"]
+
+
+def dense(x, w, bias=None, activation=None):
+    """Affine layer ``activation(x @ w + bias)`` on DNDarrays — the DP
+    forward building block, expressed entirely in framework ops so the
+    Fusion 2.0 engine compiles it as ONE cached program: the matmul is a
+    lazy kernel node (core/fusion.py ``defer_matmul``) and the bias add +
+    activation graft onto it as the kernel's epilogue. With
+    ``HEAT_TPU_FUSION_REDUCE=0`` the same expression dispatches op by op,
+    bit for bit.
+
+    ``activation`` is ``None``, one of ``"relu"`` / ``"tanh"`` /
+    ``"sigmoid"`` (compositions of fusable framework ops), or any callable
+    taking and returning a DNDarray (a callable built from non-framework
+    ops will flush the kernel first — still correct, just not one
+    program)."""
+    from ..core import arithmetics, exponential, statistics, trigonometrics
+    from ..core.linalg import matmul
+
+    y = matmul(x, w)
+    if bias is not None:
+        y = arithmetics.add(y, bias)
+    if activation is None:
+        return y
+    if callable(activation):
+        return activation(y)
+    if activation == "relu":
+        return statistics.maximum(y, 0.0)
+    if activation == "tanh":
+        return trigonometrics.tanh(y)
+    if activation == "sigmoid":
+        # 1 / (1 + exp(-y)) as fusable framework ops
+        return arithmetics.div(
+            1.0, arithmetics.add(exponential.exp(arithmetics.mul(y, -1.0)), 1.0)
+        )
+    raise ValueError(
+        f"activation must be None, 'relu', 'tanh', 'sigmoid' or a callable, "
+        f"got {activation!r}"
+    )
 
 
 def scaled_dot_product_attention(

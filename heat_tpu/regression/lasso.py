@@ -110,13 +110,18 @@ class Lasso(BaseEstimator, RegressionMixin):
         return self.__theta
 
     def soft_threshold(self, rho: DNDarray):
-        """Soft-thresholding operator (reference lasso.py:90)."""
+        """Soft-thresholding operator (reference lasso.py:90),
+        ``sign(ρ)·max(|ρ|−λ, 0)`` expressed in framework ops: the 4-op
+        elementwise tail defers into ONE fused program — and when ``rho``
+        is itself a pending chain or kernel result (the coordinate
+        update's residual), the whole residual+threshold expression
+        grafts into a single dispatch (Fusion 2.0 epilogue)."""
+        from ..core import arithmetics, rounding, statistics
 
-        import jax.numpy as _jnp
-
-        r = rho.larray
-        out = _jnp.sign(r) * _jnp.maximum(_jnp.abs(r) - self.lam, 0.0)
-        return DNDarray(out, rho.shape, rho.dtype, rho.split, rho.device, rho.comm, True)
+        mag = arithmetics.sub(rounding.abs(rho), float(self.lam))
+        return arithmetics.mul(
+            rounding.sign(rho), statistics.maximum(mag, 0.0)
+        )
 
     def rmse(self, gt: DNDarray, yest: DNDarray) -> float:
         """Root mean squared error (reference lasso.py:103)."""
@@ -149,10 +154,13 @@ class Lasso(BaseEstimator, RegressionMixin):
         return self
 
     def predict(self, x: DNDarray) -> DNDarray:
-        """ŷ = X θ + intercept (reference lasso.py `predict`)."""
+        """ŷ = X θ + intercept (reference lasso.py `predict`), in
+        framework ops: the matvec is a lazy kernel node and the intercept
+        add grafts onto it — one cached program per input layout
+        (Fusion 2.0 epilogue)."""
         if self.__theta is None:
             raise RuntimeError("fit needs to be called before predict")
-        th = self.__theta._logical()
-        xb = x.larray.astype(th.dtype)
-        yhat = xb @ th[1:] + th[0]
-        return DNDarray(yhat, (x.shape[0],), types.canonical_heat_type(yhat.dtype), x.split, x.device, x.comm, True)
+        from ..core import arithmetics
+        from ..core.linalg import matmul
+
+        return arithmetics.add(matmul(x, self.__theta[1:]), self.__theta[0])

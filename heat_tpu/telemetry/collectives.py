@@ -38,6 +38,7 @@ __all__ = [
     "ring_cdist_cost",
     "tsqr_cost",
     "gram_ring_cost",
+    "fusion_reduce_cost",
 ]
 
 
@@ -180,3 +181,20 @@ def gram_ring_cost(
     ring = nproc * hops * c * int(m) * int(itemsize)
     gather = nproc * (nproc - 1) * c * n_phys * int(itemsize)
     return CollectiveCost("ppermute-ring+all-gather", ring + gather, steps=hops)
+
+
+def fusion_reduce_cost(
+    out_gshape: Sequence[int], itemsize: int, nproc: int
+) -> CollectiveCost:
+    """Cost of the collective tail of a fused chain+reduction program
+    (core/fusion.py ``absorb_reduce``, site ``fusion_reduce``): a
+    reduction crossing the split axis leaves each device holding a full
+    partial result of the OUTPUT shape, combined by one all-reduce —
+    ``2·B·(p-1)`` wire bytes for the reduce-scatter+broadcast lowering,
+    where ``B`` is the replicated result's byte size. Reductions that keep
+    the split (and 1-position meshes) move nothing."""
+    if nproc <= 1:
+        return CollectiveCost("none", 0)
+    return CollectiveCost(
+        "all-reduce", 2 * _numel(out_gshape) * int(itemsize) * (nproc - 1)
+    )

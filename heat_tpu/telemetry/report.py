@@ -178,13 +178,19 @@ def summarize(
         if pc["hits"] or pc["misses"]:
             out["program_cache"] = pc
         # fusion-engine counters (core/fusion.py): deferred elementwise
-        # ops, chain flushes, mean nodes per flushed program, and eager
-        # fallbacks. Absent when no elementwise op ran deferred, so
-        # fusion-off summaries keep their exact shape.
+        # ops, chain flushes, mean nodes per flushed program, eager
+        # fallbacks, plus the Fusion 2.0 absorption counters —
+        # reductions_absorbed (chains consumed by a reduce/moments
+        # program) and epilogues_grafted (elementwise tails grafted onto
+        # kernel nodes). Absent when no op ran deferred, so fusion-off
+        # summaries keep their exact shape.
         from ..core import fusion as _fz
 
         fz = _fz.stats()
-        if fz["deferred"] or fz["flushes"] or fz["fallbacks"]:
+        if (
+            fz["deferred"] or fz["flushes"] or fz["fallbacks"]
+            or fz["reductions_absorbed"] or fz["epilogues_grafted"]
+        ):
             out["fusion"] = fz
     elif pc_retraces or pc_evictions:
         out["program_cache"] = {

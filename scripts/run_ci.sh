@@ -346,6 +346,109 @@ EOF
     fi
 fi
 
+# Fusion 2.0 step (ISSUE 7): run the reduction microbenchmark (normalize→
+# scale→sum + mean/var moment chains) in eager / flush-at-reduction /
+# fully-fused modes and assert (a) the fused moment chain dispatches FEWER
+# programs than eager and the map+reduce chain compiles as exactly ONE
+# program, (b) the DP-forward dense (matmul+bias+relu) is ONE program,
+# (c) the fused chain+sum digests bit-identical to the knob-off baseline,
+# and (d) HEAT_TPU_FUSION_REDUCE=0 really disarms absorption (zero
+# reductions_absorbed, no fusion_reduce registry entries).
+# HEAT_TPU_CI_SKIP_FUSION_REDUCE=1 opts out.
+if [ -z "${HEAT_TPU_CI_SKIP_FUSION_REDUCE:-}" ]; then
+    echo "=== fusion-reduce dispatch check (reduction microbenchmark, 4-device mesh) ==="
+    fr_out=$(mktemp)
+    fr_rc=0
+    # compile-cache-free: the program-count comparison must see real
+    # backend compiles, not deserializations from the sweep's cache
+    if env -u HEAT_TPU_COMPILE_CACHE python benchmarks/reduction/heat_tpu.py \
+            --n 100000 --features 64 --trials 2 --mesh 4 > "$fr_out"; then
+        python - "$fr_out" <<'EOF' || fr_rc=$?
+import json, sys
+
+cmp = None
+for line in open(sys.argv[1]):
+    line = line.strip()
+    if not line:
+        continue
+    try:
+        obj = json.loads(line)
+    except json.JSONDecodeError:
+        continue
+    if "reduction_compare" in obj:
+        cmp = obj["reduction_compare"]
+if cmp is None:
+    raise SystemExit("fusion-reduce: no reduction_compare summary line")
+eager, flush, fused = cmp["eager"], cmp["flush"], cmp["fused"]
+cp = cmp["chain_programs"]
+print(
+    f"fusion-reduce: chain programs eager={cp['eager']} flush={cp['flush']} "
+    f"fused={cp['fused']} | moment programs eager={eager['programs_compiled']} "
+    f"fused={fused['programs_compiled']} | dense={cmp['dense_programs']} "
+    f"| absorbed={fused['reductions_absorbed']}"
+)
+if cp["fused"] != 1:
+    raise SystemExit(
+        f"fusion-reduce: the map+reduce chain should compile as exactly ONE "
+        f"program, got {cp['fused']}"
+    )
+if cp["eager"] < 3 * cp["fused"]:
+    raise SystemExit(
+        f"fusion-reduce: fused chain must compile >=3x fewer programs than "
+        f"eager (eager={cp['eager']}, fused={cp['fused']})"
+    )
+if not fused["programs_compiled"] < eager["programs_compiled"]:
+    raise SystemExit(
+        f"fusion-reduce: fused moment chain did not dispatch fewer programs "
+        f"than eager (fused={fused['programs_compiled']}, "
+        f"eager={eager['programs_compiled']})"
+    )
+if cmp["dense_programs"] != 1:
+    raise SystemExit(
+        f"fusion-reduce: matmul+bias+relu (dense) should be ONE cached "
+        f"program, got {cmp['dense_programs']}"
+    )
+if not cmp["digest_chain_match"]:
+    raise SystemExit(
+        "fusion-reduce: fused chain+sum digest differs from the knob-off "
+        "flush-then-reduce baseline (bit-identity pin)"
+    )
+if not cmp["moments_allclose"]:
+    raise SystemExit(
+        "fusion-reduce: fused moment chain drifted beyond tolerance vs the "
+        "knob-off baseline"
+    )
+if fused["reductions_absorbed"] == 0:
+    raise SystemExit("fusion-reduce: nothing absorbed — engine disabled?")
+if flush["reductions_absorbed"] != 0 or "fusion_reduce" in flush["site_misses"]:
+    raise SystemExit(
+        "fusion-reduce: HEAT_TPU_FUSION_REDUCE=0 did not disarm absorption"
+    )
+print("fusion-reduce ok")
+EOF
+    else
+        fr_rc=$?
+    fi
+    if [ -n "$REPORT" ]; then
+        cp "$fr_out" "${REPORT}/fusion_reduction.jsonl" || true
+    fi
+    rm -f "$fr_out"
+    if [ "$fr_rc" != 0 ]; then
+        echo "=== fusion-reduce dispatch check FAILED (rc=$fr_rc) ==="
+        FAILED_SIZES="$FAILED_SIZES fusion-reduce"
+    fi
+    # Knob-off parity spot check: the fusion-reduce numeric oracles re-run
+    # with absorption forced OFF, pinning HEAT_TPU_FUSION_REDUCE=0 ==
+    # flush-at-reduction dispatch.
+    echo "=== fusion-reduce knob-off parity spot check (tests/test_fusion_reduce.py) ==="
+    if ! HEAT_TPU_FUSION_REDUCE=0 python -m pytest tests/test_fusion_reduce.py \
+            -q -p no:cacheprovider \
+            -k "NumpyParity or (NanVariants and not nan_chain_absorbs)"; then
+        echo "=== fusion-reduce knob-off parity check FAILED ==="
+        FAILED_SIZES="$FAILED_SIZES fusion-reduce-off"
+    fi
+fi
+
 # Planner step (ISSUE 6): the resplit whose monolithic program exceeds a
 # tight HEAT_TPU_HBM_BUDGET must succeed through the planner's chunked
 # program chain with (a) every stage's memory_analysis() temp bytes within

@@ -293,10 +293,29 @@ class TestOutAliasing:
 
 class TestFallbacks:
     def test_lambda_ops_fall_back_eager(self):
+        import jax.numpy as jnp
+
+        from heat_tpu.core import _operations
+
         before = fusion.stats()["fallbacks"]
         an = np.arange(5.0) + 0.25
-        frac, intg = ht.modf(ht.array(an, split=0))  # lambda-wrapped jnp.modf
+        # an unregistered lambda must refuse deferral and dispatch eagerly
+        # (modf used to be the in-tree example until ISSUE 7 converted it
+        # to registered fusable helpers)
+        r = _operations.local_op(lambda a: jnp.sin(a), ht.array(an, split=0))
         assert fusion.stats()["fallbacks"] > before
+        assert r._fused_node() is None
+        np.testing.assert_allclose(r.numpy(), np.sin(an))
+
+    def test_modf_fuses(self):
+        """PR 7 satellite: modf's parts are registered fusable ops — no
+        fallback, and both parts defer."""
+        before = fusion.stats()["fallbacks"]
+        an = np.arange(5.0) + 0.25
+        frac, intg = ht.modf(ht.array(an, split=0))
+        assert fusion.stats()["fallbacks"] == before
+        if fusion.active():
+            assert frac._fused_node() is not None
         np.testing.assert_allclose(frac.numpy(), np.modf(an)[0])
         np.testing.assert_allclose(intg.numpy(), np.modf(an)[1])
 
@@ -411,10 +430,14 @@ class TestDonationGuard:
     def test_fallback_leaves_no_stale_capture_marks(self):
         """An op that falls back to eager dispatch must not leave its
         operands marked non-donatable."""
+        import jax.numpy as jnp
+
+        from heat_tpu.core import _operations
+
         an = np.arange(5.0) + 0.25
         a = ht.array(an, split=0)
         assert a._buffer_donatable()
-        ht.modf(a)  # lambda-wrapped jnp.modf -> eager fallback
+        _operations.local_op(lambda v: jnp.cos(v), a)  # eager fallback
         assert a._buffer_donatable(), "fallback left a stale capture mark"
 
     def test_astype_copy_is_a_real_copy_same_dtype(self):

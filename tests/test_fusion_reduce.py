@@ -1,0 +1,660 @@
+"""Fusion 2.0 oracles (ISSUE 7, core/fusion.py `absorb_reduce` /
+`defer_matmul`).
+
+The contract under test: a ``__reduce_op``-family call whose operand
+carries a pending fused elementwise chain ABSORBS the chain — the whole
+normalize→reduce pipeline compiles as exactly ONE cached program (site
+``fusion_reduce``), with masked-neutral pad semantics preserved inside the
+program and the collective tail in the same trace (HLO-audited); ``matmul``
+is a lazy kernel node whose elementwise epilogue (bias add, activation)
+grafts into one program (site ``fusion``); pallas column-moments accept a
+grafted pre-map; ``HEAT_TPU_FUSION_REDUCE=0`` restores the PR 4
+flush-at-reduction dispatch bit for bit; results are numpy-exact across
+splits 0/1/None, padded shapes, dtypes, keepdims and the nan-variants.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import heat_tpu as ht
+from heat_tpu import telemetry as tm
+from heat_tpu.core import _operations, fusion, statistics
+from heat_tpu.core import program_cache as pc
+
+
+def _site(name):
+    return dict(pc.stats()["sites"].get(name, {"hits": 0, "misses": 0}))
+
+
+def _chain(a, b):
+    """normalize-then-scale: 3 elementwise ops feeding a reduction."""
+    return (ht.exp(a) - b) * 0.5
+
+
+def _chain_np(an, bn):
+    return (np.exp(an) - bn) * 0.5
+
+
+class TestOneProgramReduce:
+    """The dispatch oracle: chain + reduction is ONE cached program."""
+
+    def test_chain_sum_is_one_program(self):
+        rng = np.random.default_rng(0)
+        an = rng.standard_normal((13, 3))
+        bn = rng.standard_normal((13, 3))
+        a, b = ht.array(an, split=0), ht.array(bn, split=0)
+        before = fusion.stats()
+        sf0, sr0 = _site("fusion"), _site("fusion_reduce")
+        r = ht.sum(_chain(a, b), axis=0)
+        got = r.numpy()
+        after = fusion.stats()
+        assert after["reductions_absorbed"] - before["reductions_absorbed"] == 1
+        # the chain flushed INSIDE the reduce program: no standalone
+        # `fusion`-site program, exactly one `fusion_reduce` entry
+        assert _site("fusion")["misses"] == sf0["misses"]
+        assert _site("fusion_reduce")["misses"] == sr0["misses"] + 1
+        np.testing.assert_allclose(
+            got, _chain_np(an, bn).sum(axis=0), rtol=1e-12
+        )
+
+    def test_repeat_is_zero_compile_registry_hit(self):
+        rng = np.random.default_rng(1)
+        an = rng.standard_normal((24, 5))
+        bn = rng.standard_normal((24, 5))
+        first = ht.sum(_chain(ht.array(an, split=0), ht.array(bn, split=0)))
+        _ = first.numpy()
+        hits0 = _site("fusion_reduce")["hits"]
+        misses0 = _site("fusion_reduce")["misses"]
+        with tm.CompileWatcher() as w:
+            second = ht.sum(
+                _chain(ht.array(an, split=0), ht.array(bn, split=0))
+            ).numpy()
+        assert w.backend_seconds == 0.0, (
+            f"repeat fused reduction recompiled: {dict(w.stages)}"
+        )
+        assert _site("fusion_reduce")["misses"] == misses0
+        assert _site("fusion_reduce")["hits"] > hits0
+        np.testing.assert_array_equal(np.asarray(first.numpy()), second)
+
+    def test_float_scalars_share_one_reduce_program(self):
+        an = np.arange(17.0)
+        _ = ht.sum(ht.array(an, split=0) * 2.0).numpy()
+        misses0 = _site("fusion_reduce")["misses"]
+        got = ht.sum(ht.array(an, split=0) * 3.0).numpy()
+        assert _site("fusion_reduce")["misses"] == misses0, (
+            "sum(x*2) and sum(x*3) must share one executable"
+        )
+        np.testing.assert_allclose(got, (an * 3.0).sum(), rtol=1e-12)
+
+
+class TestNumpyParity:
+    """Absorbed reductions are numpy-exact across splits, padded tails,
+    axis forms and keepdims."""
+
+    OPS = [
+        (ht.sum, np.sum),
+        (ht.prod, np.prod),
+        (ht.max, np.max),
+        (ht.min, np.min),
+    ]
+
+    @pytest.mark.parametrize("split", [None, 0, 1])
+    @pytest.mark.parametrize("axis", [None, 0, 1, (0, 1)])
+    @pytest.mark.parametrize("keepdims", [False, True])
+    def test_reduce_family_padded(self, split, axis, keepdims):
+        rng = np.random.default_rng(42)
+        an = rng.standard_normal((7, 5))  # pads on both axes of an 8-mesh
+        bn = rng.standard_normal((7, 5))
+        for f_ht, f_np in self.OPS:
+            a, b = ht.array(an, split=split), ht.array(bn, split=split)
+            r = f_ht(_chain(a, b), axis=axis, keepdims=keepdims)
+            np.testing.assert_allclose(
+                r.numpy(),
+                f_np(_chain_np(an, bn), axis=axis, keepdims=keepdims),
+                rtol=1e-10,
+                err_msg=f"{f_np.__name__} split={split} axis={axis}",
+            )
+
+    @pytest.mark.parametrize("split", [None, 0, 1])
+    def test_any_all_on_bool_chain(self, split):
+        an = np.arange(-6, 15).reshape(7, 3)
+        a = ht.array(an, split=split)
+        mask = (a % 2 == 0) & (a > 0)
+        np.testing.assert_array_equal(
+            ht.any(mask, axis=0).numpy(),
+            np.any((an % 2 == 0) & (an > 0), axis=0),
+        )
+        a2 = ht.array(an, split=split)
+        mask2 = (a2 % 2 == 0) | (a2 > -10)
+        np.testing.assert_array_equal(
+            ht.all(mask2, axis=1).numpy(),
+            np.all((an % 2 == 0) | (an > -10), axis=1),
+        )
+
+    @pytest.mark.parametrize("split", [None, 0, 1])
+    def test_moment_chain_mean_var_std(self, split):
+        rng = np.random.default_rng(7)
+        an = rng.standard_normal((11, 6))
+        for axis in (None, 0, 1):
+            a = ht.array(an, split=split)
+            z = (a - 0.25) * 2.0
+            zn = (an - 0.25) * 2.0
+            np.testing.assert_allclose(
+                ht.mean(z, axis=axis).numpy(), zn.mean(axis=axis), rtol=1e-10
+            )
+            a2 = ht.array(an, split=split)
+            z2 = (a2 - 0.25) * 2.0
+            np.testing.assert_allclose(
+                ht.var(z2, axis=axis).numpy(), zn.var(axis=axis),
+                rtol=1e-9, atol=1e-12,
+            )
+            a3 = ht.array(an, split=split)
+            z3 = (a3 - 0.25) * 2.0
+            np.testing.assert_allclose(
+                ht.std(z3, axis=axis).numpy(), zn.std(axis=axis),
+                rtol=1e-9, atol=1e-12,
+            )
+
+    @pytest.mark.parametrize("dtype", [np.float32, np.float64, np.int32])
+    def test_dtypes(self, dtype):
+        rng = np.random.default_rng(3)
+        an = (rng.standard_normal((9, 4)) * 10).astype(dtype)
+        a = ht.array(an, split=0)
+        # f32 tolerance: the sharded local-reduce + all-reduce legally
+        # sums in a different order than numpy's single pass
+        np.testing.assert_allclose(
+            ht.sum(a + a, axis=0).numpy(), (an + an).sum(axis=0),
+            rtol=3e-5 if dtype == np.float32 else 1e-10,
+        )
+
+    def test_reduce_op_dtype_param_is_in_program(self):
+        """The optional dtype cast is part of the fused program (and its
+        signature), not a separate dispatch."""
+        an = np.arange(12.0).reshape(4, 3)
+        a = ht.array(an, split=0)
+        r = _operations.reduce_op(
+            jnp.sum, a * 2.0, 0, neutral=0, dtype=ht.float32
+        )
+        assert r.dtype == ht.float32
+        np.testing.assert_allclose(
+            r.numpy(), (an * 2.0).sum(axis=0).astype(np.float32), rtol=1e-6
+        )
+
+    def test_out_param_with_pending_chain(self):
+        an = np.arange(10.0).reshape(5, 2)
+        a = ht.array(an, split=0)
+        out = ht.zeros((2,), dtype=ht.float64)
+        ht.sum(a * 3.0, axis=0, out=out)
+        np.testing.assert_allclose(out.numpy(), (an * 3.0).sum(axis=0))
+
+    def test_absorbed_source_stays_reusable(self):
+        """Absorption leaves the source chain pending: reading it later
+        re-materializes it correctly (documented recompute semantics —
+        same contract as interior shared nodes)."""
+        an = np.arange(8.0)
+        a = ht.array(an, split=0)
+        r = a * 2.0 + 1.0
+        s = ht.sum(r)
+        np.testing.assert_allclose(s.numpy(), (an * 2 + 1).sum())
+        np.testing.assert_array_equal(r.numpy(), an * 2 + 1)
+
+
+class TestNanVariants:
+    NAN_OPS = [
+        (ht.nansum, np.nansum),
+        (ht.nanprod, np.nanprod),
+        (ht.nanmax, np.nanmax),
+        (ht.nanmin, np.nanmin),
+        (ht.nanmean, np.nanmean),
+        (ht.nanvar, np.nanvar),
+        (ht.nanstd, np.nanstd),
+    ]
+
+    @pytest.mark.parametrize("split", [None, 0, 1])
+    @pytest.mark.parametrize("axis", [None, 0, 1])
+    def test_nan_family_parity_padded(self, split, axis):
+        rng = np.random.default_rng(5)
+        an = rng.standard_normal((7, 5))
+        an[rng.random((7, 5)) < 0.3] = np.nan
+        for f_ht, f_np in self.NAN_OPS:
+            a = ht.array(an, split=split)
+            got = f_ht(a * 2.0, axis=axis).numpy()
+            np.testing.assert_allclose(
+                got, f_np(an * 2.0, axis=axis), rtol=1e-10,
+                err_msg=f"{f_np.__name__} split={split} axis={axis}",
+            )
+
+    def test_nan_chain_absorbs(self):
+        an = np.arange(14.0)
+        an[3] = np.nan
+        before = fusion.stats()["reductions_absorbed"]
+        got = ht.nansum(ht.array(an, split=0) * 0.5).numpy()
+        assert fusion.stats()["reductions_absorbed"] - before == 1
+        np.testing.assert_allclose(got, np.nansum(an * 0.5))
+
+    def test_nan_variants_keepdims_and_ddof(self):
+        rng = np.random.default_rng(9)
+        an = rng.standard_normal((6, 4))
+        an[0, 1] = np.nan
+        a = ht.array(an, split=0)
+        np.testing.assert_allclose(
+            ht.nanmean(a * 1.0, axis=0, keepdims=True).numpy(),
+            np.nanmean(an, axis=0, keepdims=True), rtol=1e-12,
+        )
+        a2 = ht.array(an, split=0)
+        np.testing.assert_allclose(
+            ht.nanvar(a2 * 1.0, axis=0, ddof=1).numpy(),
+            np.nanvar(an, axis=0, ddof=1), rtol=1e-12,
+        )
+
+    def test_nan_neutral_hits_program_cache_on_repeat(self):
+        """The NaN pad-fill neutral must be keyed by repr, not by value:
+        a raw float('nan') in the registry key hashes by object identity,
+        so every padded cross-split nan-reduction would recompile (and
+        LRU-flood) on each call."""
+        comm = ht.get_comm()
+        if comm.size <= 1:
+            pytest.skip("needs pads, hence a multi-device mesh")
+        rng = np.random.default_rng(23)
+        an = rng.standard_normal((8 * comm.size + 5, 3))  # padded tail
+        an[1, 1] = np.nan
+        first = ht.nanmean(ht.array(an, split=0) * 2.0, axis=0).numpy()
+        misses0 = _site("fusion_reduce")["misses"]
+        hits0 = _site("fusion_reduce")["hits"]
+        with tm.CompileWatcher() as w:
+            second = ht.nanmean(ht.array(an, split=0) * 2.0, axis=0).numpy()
+        assert _site("fusion_reduce")["misses"] == misses0, (
+            "repeat nan-reduction missed the program registry (NaN in key?)"
+        )
+        assert _site("fusion_reduce")["hits"] > hits0
+        assert w.backend_seconds == 0.0
+        np.testing.assert_array_equal(np.asarray(first), second)
+
+    def test_mismatched_out_raises_sanitation_error_int_route(self):
+        """The exact-int nan routes validate out= exactly like the
+        inexact routes (sanitize_out), not via the low-level larray
+        setter."""
+        a = ht.array(np.arange(12, dtype=np.int64).reshape(3, 4), split=0)
+        bad = ht.zeros((7,), dtype=ht.float64)
+        with pytest.raises(ValueError, match="[Ee]xpecting|shape"):
+            ht.nanmean(a, axis=0, out=bad)
+
+    def test_exact_int_routes_to_plain_reduction(self):
+        an = np.arange(12, dtype=np.int64).reshape(3, 4)
+        a = ht.array(an, split=0)
+        np.testing.assert_array_equal(
+            ht.nansum(a, axis=0).numpy(), an.sum(axis=0)
+        )
+        np.testing.assert_allclose(ht.nanmean(a).numpy(), an.mean())
+
+    def test_all_nan_lane_matches_numpy(self):
+        an = np.full((5, 3), np.nan)
+        an[:, 0] = 1.0
+        a = ht.array(an, split=0)
+        import warnings
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")  # numpy's all-NaN warnings
+            want = np.nanmax(an * 1.0, axis=0)
+        got = ht.nanmax(a * 1.0, axis=0).numpy()
+        np.testing.assert_array_equal(got, want)
+
+
+class TestKnobOff:
+    """HEAT_TPU_FUSION_REDUCE=0 restores flush-at-reduction + eager
+    matmul, bit for bit."""
+
+    def test_knob_off_flushes_and_matches_bitwise(self, monkeypatch):
+        rng = np.random.default_rng(11)
+        an = rng.standard_normal((103, 7))
+        bn = rng.standard_normal((103, 7))
+        for split in (None, 0, 1):
+            a, b = ht.array(an, split=split), ht.array(bn, split=split)
+            fused = ht.sum(_chain(a, b), axis=0).numpy()
+            monkeypatch.setenv("HEAT_TPU_FUSION_REDUCE", "0")
+            before = fusion.stats()["reductions_absorbed"]
+            a2, b2 = ht.array(an, split=split), ht.array(bn, split=split)
+            eager = ht.sum(_chain(a2, b2), axis=0).numpy()
+            assert fusion.stats()["reductions_absorbed"] == before
+            monkeypatch.delenv("HEAT_TPU_FUSION_REDUCE")
+            np.testing.assert_array_equal(np.asarray(fused), np.asarray(eager))
+
+    def test_knob_off_matmul_is_eager(self, monkeypatch):
+        monkeypatch.setenv("HEAT_TPU_FUSION_REDUCE", "0")
+        x = ht.array(np.arange(12.0).reshape(4, 3), split=0)
+        w = ht.array(np.arange(6.0).reshape(3, 2))
+        y = ht.matmul(x, w)
+        assert y._fused_node() is None, "knob off must not defer matmul"
+        monkeypatch.delenv("HEAT_TPU_FUSION_REDUCE")
+        y2 = ht.matmul(
+            ht.array(np.arange(12.0).reshape(4, 3), split=0),
+            ht.array(np.arange(6.0).reshape(3, 2)),
+        )
+        assert y2._fused_node() is not None
+        np.testing.assert_array_equal(y.numpy(), y2.numpy())
+
+    def test_fusion_off_implies_reduce_off(self, monkeypatch):
+        monkeypatch.setenv("HEAT_TPU_FUSION", "0")
+        assert not fusion.reduce_active()
+        x = ht.array(np.arange(6.0), split=0)
+        assert ht.matmul(
+            ht.array(np.arange(12.0).reshape(4, 3), split=0),
+            ht.array(np.arange(6.0).reshape(3, 2)),
+        )._fused_node() is None
+        np.testing.assert_allclose(ht.sum(x * 2.0).numpy(), np.arange(6.0).sum() * 2)
+
+
+class TestMatmulEpilogue:
+    """matmul is a lazy kernel node; bias+activation graft into ONE
+    program (the DP forward path)."""
+
+    def test_dense_is_one_program(self):
+        rng = np.random.default_rng(2)
+        xn = rng.standard_normal((16, 8)).astype(np.float32)
+        wn = rng.standard_normal((8, 4)).astype(np.float32)
+        bn = rng.standard_normal(4).astype(np.float32)
+        from heat_tpu.nn import functional as F
+
+        x, w, b = ht.array(xn, split=0), ht.array(wn), ht.array(bn)
+        before = fusion.stats()
+        sf0 = _site("fusion")
+        with tm.CompileWatcher() as cw:
+            got = F.dense(x, w, bias=b, activation="relu").numpy()
+        after = fusion.stats()
+        assert after["epilogues_grafted"] - before["epilogues_grafted"] >= 1
+        assert _site("fusion")["misses"] - sf0["misses"] == 1, (
+            "matmul+bias+relu must flush as ONE cached program"
+        )
+        assert cw.backend_compiles <= 1
+        np.testing.assert_allclose(
+            got, np.maximum(xn @ wn + bn, 0.0), rtol=1e-5
+        )
+
+    @pytest.mark.parametrize("act", [None, "relu", "tanh", "sigmoid"])
+    def test_dense_activations_parity(self, act):
+        rng = np.random.default_rng(4)
+        xn = rng.standard_normal((12, 5))
+        wn = rng.standard_normal((5, 3))
+        bn = rng.standard_normal(3)
+        from heat_tpu.nn import functional as F
+
+        got = F.dense(
+            ht.array(xn, split=0), ht.array(wn), bias=ht.array(bn),
+            activation=act,
+        ).numpy()
+        ref = xn @ wn + bn
+        if act == "relu":
+            ref = np.maximum(ref, 0.0)
+        elif act == "tanh":
+            ref = np.tanh(ref)
+        elif act == "sigmoid":
+            ref = 1.0 / (1.0 + np.exp(-ref))
+        np.testing.assert_allclose(got, ref, rtol=1e-9, atol=1e-12)
+
+    @pytest.mark.parametrize("sx", [None, 0, 1])
+    @pytest.mark.parametrize("sw", [None, 0, 1])
+    def test_matmul_parity_padded_all_splits(self, sx, sw):
+        rng = np.random.default_rng(6)
+        xn = rng.standard_normal((7, 5))
+        wn = rng.standard_normal((5, 3))
+        got = (ht.matmul(ht.array(xn, split=sx), ht.array(wn, split=sw)) * 2.0).numpy()
+        np.testing.assert_allclose(got, (xn @ wn) * 2.0, rtol=1e-10)
+
+    def test_pending_chain_grafts_into_matmul_premap(self):
+        """A pending elementwise chain on a matmul operand rides INTO the
+        kernel program instead of flushing first."""
+        rng = np.random.default_rng(8)
+        xn = rng.standard_normal((8, 4))
+        wn = rng.standard_normal((4, 2))
+        x = ht.array(xn, split=0)
+        w = ht.array(wn)
+        sf0 = _site("fusion")
+        z = ht.exp(x) * 0.5        # pending chain
+        y = ht.matmul(z, w)        # kernel consumes the chain
+        assert y._fused_node() is not None
+        got = y.numpy()
+        assert _site("fusion")["misses"] - sf0["misses"] == 1
+        np.testing.assert_allclose(got, (np.exp(xn) * 0.5) @ wn, rtol=1e-10)
+
+    def test_sum_of_matmul_absorbs_kernel(self):
+        rng = np.random.default_rng(10)
+        xn = rng.standard_normal((8, 4))
+        wn = rng.standard_normal((4, 2))
+        before = fusion.stats()["reductions_absorbed"]
+        got = ht.sum(
+            ht.matmul(ht.array(xn, split=0), ht.array(wn)), axis=0
+        ).numpy()
+        assert fusion.stats()["reductions_absorbed"] - before == 1
+        np.testing.assert_allclose(got, (xn @ wn).sum(axis=0), rtol=1e-10)
+
+    def test_matmul_batched_and_vector_forms(self):
+        rng = np.random.default_rng(12)
+        an = rng.standard_normal((3, 4, 5))
+        bn = rng.standard_normal((3, 5, 2))
+        got = ht.matmul(ht.array(an, split=0), ht.array(bn, split=0)).numpy()
+        np.testing.assert_allclose(got, an @ bn, rtol=1e-10)
+        vn = rng.standard_normal(5)
+        m = rng.standard_normal((6, 5))
+        got2 = ht.matmul(ht.array(m, split=0), ht.array(vn)).numpy()
+        np.testing.assert_allclose(got2, m @ vn, rtol=1e-10)
+
+    def test_lasso_predict_is_fused(self):
+        from heat_tpu.regression import Lasso
+
+        rng = np.random.default_rng(13)
+        X = rng.standard_normal((24, 4))
+        yv = X @ np.array([1.0, -2.0, 0.0, 0.5]) + 0.3
+        las = Lasso(lam=0.01, max_iter=60).fit(
+            ht.array(X, split=0), ht.array(yv, split=0)
+        )
+        pred = las.predict(ht.array(X, split=0))
+        assert pred._fused_node() is not None, "predict must defer"
+        theta = np.asarray(las.theta.numpy())
+        np.testing.assert_allclose(
+            pred.numpy(), X @ theta[1:] + theta[0], rtol=1e-9
+        )
+
+    def test_lasso_soft_threshold_fuses(self):
+        from heat_tpu.regression import Lasso
+
+        las = Lasso(lam=0.1)
+        rho = ht.array(np.array([0.5, -0.05, -2.0, 0.0]), split=0)
+        r = las.soft_threshold(rho)
+        assert r._fused_node() is not None
+        rn = np.array([0.5, -0.05, -2.0, 0.0])
+        np.testing.assert_allclose(
+            r.numpy(), np.sign(rn) * np.maximum(np.abs(rn) - 0.1, 0.0)
+        )
+
+    def test_shared_kernel_node_materializes_once(self):
+        """A matmul result consumed by a SECOND chain materializes once
+        and re-enters every consumer as a leaf — re-tracing a contraction
+        per consumer program is not 'bounded elementwise work'."""
+        rng = np.random.default_rng(30)
+        xn = rng.standard_normal((8, 4))
+        wn = rng.standard_normal((4, 2))
+        y = ht.matmul(ht.array(xn, split=0), ht.array(wn))
+        node = y._fused_node()
+        assert node is not None and node.buffer is None
+        a = y * 2.0            # first consumer: grafts the pending kernel
+        b = y + 1.0            # second consumer: forces materialize-once
+        assert node.buffer is not None, (
+            "second consumption must materialize the kernel node"
+        )
+        np.testing.assert_allclose(a.numpy(), (xn @ wn) * 2.0, rtol=1e-10)
+        np.testing.assert_allclose(b.numpy(), (xn @ wn) + 1.0, rtol=1e-10)
+        np.testing.assert_allclose(y.numpy(), xn @ wn, rtol=1e-10)
+
+    def test_sum_of_shared_kernel_flushes_once(self):
+        rng = np.random.default_rng(31)
+        xn = rng.standard_normal((8, 4))
+        wn = rng.standard_normal((4, 2))
+        y = ht.matmul(ht.array(xn, split=0), ht.array(wn))
+        _ = y * 3.0            # shares the kernel node
+        before = fusion.stats()["fallbacks"]
+        s = ht.sum(y)          # must flush-and-reuse, not re-trace the GEMM
+        assert fusion.stats()["fallbacks"] == before  # decline ≠ fallback
+        np.testing.assert_allclose(s.numpy(), (xn @ wn).sum(), rtol=1e-10)
+
+    def test_mean_var_1d_axis0(self):
+        """The pallas gate must reject 1-D input BEFORE reading
+        x.shape[1] (used to IndexError on ht.mean(1-D, axis=0))."""
+        an = np.arange(11.0)
+        for f_ht, f_np in ((ht.mean, np.mean), (ht.var, np.var)):
+            got = f_ht(ht.array(an, split=0), axis=0)
+            np.testing.assert_allclose(got.numpy(), f_np(an), rtol=1e-12)
+        ai = ht.array(np.arange(11), split=0)
+        np.testing.assert_allclose(ht.nanmean(ai, axis=0).numpy(), 5.0)
+        np.testing.assert_allclose(
+            ht.nanvar(ai, axis=0).numpy(), np.arange(11).var(), rtol=1e-12
+        )
+
+    def test_kernel_capture_blocks_operand_donation(self):
+        """A deferred matmul captures its operand buffers by value: a
+        later in-place resplit_ must copy, not donate."""
+        an = np.arange(12.0).reshape(6, 2)
+        a = ht.array(an, split=0)
+        w = ht.array(np.arange(4.0).reshape(2, 2))
+        y = ht.matmul(a, w)
+        assert not a._buffer_donatable()
+        a.resplit_(1)
+        np.testing.assert_allclose(y.numpy(), an @ np.arange(4.0).reshape(2, 2))
+
+
+class TestHLOAuditFusedTail:
+    """The fused collective tail is ground-truthed: zero drift between the
+    analytic all-reduce prediction and the emitted HLO."""
+
+    def test_cross_split_sum_audits_clean(self):
+        from heat_tpu.telemetry import hlo
+
+        comm = ht.get_comm()
+        if comm.size <= 1:
+            pytest.skip("needs a multi-device mesh")
+        hlo.enable_audit()
+        try:
+            rng = np.random.default_rng(21)
+            an = rng.standard_normal((19, 3))  # unique shape → fresh audit
+            a = ht.array(an, split=0)
+            got = ht.sum(a * 2.0, axis=0).numpy()
+            rec = hlo.last_audit("fusion_reduce")
+            assert rec is not None, "no fusion_reduce audit recorded"
+            assert rec.report is not None
+            assert rec.report.ok, (
+                f"fused collective tail drifted: "
+                f"{[d.summary() for d in rec.report.drifts]}"
+            )
+            assert rec.report.emitted_bytes == rec.report.predicted_bytes
+            np.testing.assert_allclose(got, (an * 2.0).sum(axis=0), rtol=1e-12)
+        finally:
+            hlo.disable_audit()
+
+    def test_split_preserving_reduce_does_not_audit(self):
+        from heat_tpu.telemetry import hlo
+
+        comm = ht.get_comm()
+        if comm.size <= 1:
+            pytest.skip("needs a multi-device mesh")
+        hlo.enable_audit()
+        try:
+            hlo.clear()
+            an = np.arange(34.0).reshape(17, 2)
+            a = ht.array(an, split=0)
+            _ = ht.sum(a * 1.5, axis=1).numpy()  # keeps split → no collective
+            assert hlo.last_audit("fusion_reduce") is None
+        finally:
+            hlo.disable_audit()
+
+
+class TestMomentsGraft:
+    """The pallas column-moments kernel accepts a grafted pre-map, and the
+    statistics layer composes a pending chain + kernel into one program
+    (interpreter-mode on the CPU mesh)."""
+
+    def test_pre_map_param(self):
+        from heat_tpu.core.pallas_moments import column_moments
+
+        rng = np.random.default_rng(14)
+        xn = rng.standard_normal((96, 5)).astype(np.float32)
+        mean, m2 = column_moments(
+            jnp.asarray(xn), 96, block_m=32, interpret=True,
+            pre_map=lambda v: v * 2.0 + 1.0,
+        )
+        zn = xn * 2.0 + 1.0
+        np.testing.assert_allclose(np.asarray(mean), zn.mean(axis=0), rtol=1e-4)
+        np.testing.assert_allclose(
+            np.asarray(m2) / 96, zn.var(axis=0), rtol=1e-4, atol=1e-5
+        )
+
+    def test_fused_chain_into_moments_program(self):
+        comm = ht.get_comm()
+        rng = np.random.default_rng(15)
+        n = 13 * comm.size + 3  # forces a padded tail
+        xn = rng.standard_normal((n, 6)).astype(np.float32)
+        x = ht.array(xn, split=0)
+        z = x * 2.0 + 1.0
+        assert z._fused_node() is not None
+        before = fusion.stats()["reductions_absorbed"]
+        mu = statistics._pallas_moments_fused(z, "mean", interpret=True)
+        assert mu is not None
+        zn = xn * 2.0 + 1.0
+        np.testing.assert_allclose(
+            np.asarray(mu), zn.mean(axis=0), rtol=1e-4, atol=1e-5
+        )
+        assert fusion.stats()["reductions_absorbed"] - before == 1
+        z2 = ht.array(xn, split=0) * 2.0 + 1.0
+        v = statistics._pallas_moments_fused(z2, "var", ddof=0, interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(v), zn.var(axis=0), rtol=1e-3, atol=1e-5
+        )
+
+    def test_no_pending_chain_returns_none(self):
+        xn = np.ones((8, 3), dtype=np.float32)
+        x = ht.array(xn, split=0)
+        assert statistics._pallas_moments_fused(x, "mean", interpret=True) is None
+
+
+class TestTelemetry:
+    def test_counters_events_and_summarize_block(self):
+        reg = tm.enable()
+        reg.clear()
+        try:
+            an = np.arange(18.0).reshape(6, 3)
+            a = ht.array(an, split=0)
+            _ = ht.sum(a * 2.0 + 1.0, axis=0).numpy()
+            _ = (ht.matmul(
+                ht.array(an, split=0), ht.array(np.ones((3, 2)))
+            ) + 1.0).numpy()
+            snap = reg.snapshot()["counters"]
+            assert snap.get("fusion.reductions_absorbed", 0) >= 1
+            assert snap.get("fusion.epilogues_grafted", 0) >= 1
+            summary = tm.report.summarize()
+            assert summary["fusion"]["reductions_absorbed"] >= 1
+            assert summary["fusion"]["epilogues_grafted"] >= 1
+            kinds = {
+                (e.get("kind"), e.get("name"))
+                for e in reg.events
+                if e.get("kind") == "fusion"
+            }
+            assert ("fusion", "reduce_absorb") in kinds
+            assert ("fusion", "epilogue_graft") in kinds
+        finally:
+            tm.disable()
+            reg.clear()
+
+    def test_unsupported_reduce_counts_fallback(self):
+        """A pending chain hitting a non-absorbable reduction counts one
+        fallback and flushes exactly as before."""
+        an = np.arange(10.0)
+        a = ht.array(an, split=0)
+        z = a * 2.0
+        before = fusion.stats()["fallbacks"]
+        r = _operations.reduce_op(
+            lambda v, axis, keepdims: jnp.sum(v, axis=axis, keepdims=keepdims),
+            z, 0, neutral=0,
+        )
+        assert fusion.stats()["fallbacks"] - before == 1
+        np.testing.assert_allclose(r.numpy(), (an * 2.0).sum())
