@@ -1,0 +1,210 @@
+#!/usr/bin/env python
+"""Serving benchmark: open-loop Poisson load against a live heat_tpu.serve
+Server (ISSUE 8).
+
+No reference analog (the reference framework has no serving front end).
+The runner fits small estimators, mounts them as endpoints, pre-traces the
+batch ladder with ``server.warmup()``, then drives a seeded open-loop
+Poisson arrival stream at ``--rate`` requests/s across ``--streams``
+concurrent submitter threads. It prints JSONL:
+
+* ``{"warmup": ...}`` — ladder size and backend compiles paid up front;
+* ``{"serving_compare": ...}`` — the CI gate's oracle: program-registry
+  misses and backend compiles **during the load window** (steady state
+  must be 0/0), achieved QPS vs offered rate, latency percentiles,
+  failed/shed counts, the response digest (bit-identity across fault
+  injection), and ``post_ok`` (a post-load probe per endpoint matching a
+  direct single-dispatch answer bit-for-bit — the recover check);
+* a final summary carrying ``on_chip`` + ``cpu_fallback`` (bench-honesty
+  contract: a CPU-mesh number must say so in-band) and, with
+  ``HEAT_TPU_TELEMETRY=1``, the ``telemetry.serving`` block
+  (docs/OBSERVABILITY.md schema).
+
+``--artifact PATH`` appends the emitted lines to a JSONL artifact (the
+committed ``artifacts/bench_serving_r08.jsonl``).
+
+Fault interplay: inject with ``HEAT_TPU_FAULTS='serve.*:...'`` and arm
+``HEAT_TPU_RETRIES`` — dispatch-level retries happen per *batch* inside
+the server, so a clean and an injected run must produce identical
+digests (scripts/run_ci.sh serving gate pins exactly that).
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+import numpy as np
+
+from benchmarks._harness import base_parser, bootstrap
+
+ENDPOINTS = ("kmeans", "lasso", "gnb", "dense", "knn", "rbf")
+
+
+def add_args(p):
+    p.add_argument("--requests", type=int, default=400,
+                   help="total requests in the open-loop schedule")
+    p.add_argument("--rate", type=float, default=400.0,
+                   help="offered Poisson arrival rate, requests/second")
+    p.add_argument("--streams", type=int, default=2,
+                   help="concurrent submitter threads")
+    p.add_argument("--endpoints", default="kmeans,lasso,gnb,dense",
+                   help=f"comma-separated subset of {ENDPOINTS}")
+    p.add_argument("--max-batch", type=int, default=16,
+                   help="micro-batch ladder top (HEAT_TPU_SERVE_MAX_BATCH)")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--digest", action="store_true",
+                   help="include the response sha256 in serving_compare "
+                        "(the CI fault-injection bit-identity oracle)")
+    p.add_argument("--artifact", default=None,
+                   help="append the emitted JSONL lines to this file")
+
+
+def _emit(lines, obj):
+    print(json.dumps(obj), flush=True)
+    lines.append(obj)
+
+
+def build_endpoints(ht, args, names):
+    """Fit the small estimators and return {name: (endpoint, features,
+    dtype)} — seeded, so every process builds identical endpoints."""
+    rng = np.random.default_rng(args.seed)
+    n, d = args.n, args.features
+    xn = rng.standard_normal((n, d)).astype(np.float32)
+    x = ht.array(xn, split=0)
+    out = {}
+    if "kmeans" in names:
+        km = ht.cluster.KMeans(
+            n_clusters=8, max_iter=20, random_state=args.seed
+        ).fit(x)
+        out["kmeans"] = ht.serve.kmeans_predict(km)
+    if "lasso" in names:
+        y = ht.array(
+            (xn @ rng.standard_normal(d) + 0.1).astype(np.float32), split=0
+        )
+        out["lasso"] = ht.serve.lasso_predict(
+            ht.regression.Lasso(lam=0.05, max_iter=10).fit(x, y)
+        )
+    if "gnb" in names:
+        labels = ht.array((xn[:, 0] > 0).astype(np.int64), split=0)
+        out["gnb"] = ht.serve.gaussian_nb_predict(
+            ht.naive_bayes.GaussianNB().fit(x, labels)
+        )
+    if "dense" in names:
+        w = rng.standard_normal((d, 8)).astype(np.float32)
+        b = rng.standard_normal(8).astype(np.float32)
+        out["dense"] = ht.serve.dense_forward(w, b, activation="relu")
+    if "knn" in names:
+        labels = ht.array((xn[:, 0] > 0).astype(np.int64), split=0)
+        knn = ht.classification.KNeighborsClassifier(n_neighbors=5).fit(
+            x[: min(n, 512)], labels[: min(n, 512)]
+        )
+        out["knn"] = ht.serve.knn_classify(knn)
+    if "rbf" in names:
+        out["rbf"] = ht.serve.rbf_query(xn[:64], sigma=1.0)
+    return out
+
+
+def main():
+    p = base_parser("heat_tpu serving benchmark (open-loop Poisson load)")
+    add_args(p)
+    args = p.parse_args()
+    ht = bootstrap(args)
+    import jax
+
+    from benchmarks.serving import loadgen
+    from heat_tpu.core import program_cache
+    from heat_tpu import telemetry
+
+    devs = jax.devices()
+    on_chip = devs[0].platform != "cpu"
+    cpu_fallback = (
+        None if on_chip else
+        ("forced virtual cpu mesh (--mesh)" if args.mesh
+         else "default backend is cpu (no accelerator attached)")
+    )
+    lines = []
+    names = [s.strip() for s in args.endpoints.split(",") if s.strip()]
+    unknown = set(names) - set(ENDPOINTS)
+    if unknown:
+        raise SystemExit(f"unknown endpoints {sorted(unknown)}")
+
+    eps = build_endpoints(ht, args, names)
+    server = ht.serve.Server(max_batch=args.max_batch)
+    for name, ep in eps.items():
+        server.register(name, ep)
+    warm = server.warmup()
+    _emit(lines, {"warmup": warm})
+
+    reqs = loadgen.make_requests(
+        {n: eps[n].features for n in eps},
+        args.requests, args.seed,
+        dtypes={n: eps[n].dtype for n in eps},
+    )
+    before = program_cache.site_stats("serve.")
+    with telemetry.CompileWatcher() as cw:
+        report = loadgen.run_open_loop(
+            server, reqs, args.rate, seed=args.seed, streams=args.streams,
+        )
+    after = program_cache.site_stats("serve.")
+
+    # shed-and-recover probe: after the load window (faults, sheds and all)
+    # every endpoint must still answer — and answer bit-identically to a
+    # direct single dispatch of the same program outside the server
+    import jax.numpy as jnp
+
+    post_ok = True
+    probe_rng = np.random.default_rng(args.seed + 1)
+    for name, ep in eps.items():
+        probe = probe_rng.standard_normal((2, ep.features)).astype(ep.dtype)
+        try:
+            got = server.predict(name, probe, timeout=30.0)
+        except Exception:  # noqa: BLE001 — a dead server is the finding
+            post_ok = False
+            continue
+        # a FRESH jit of the same pure function: identical HLO, compiled
+        # independently of the server's cached program (eager dispatch
+        # would re-associate reductions op-by-op and break bit-equality)
+        ref = np.asarray(jax.jit(ep.build())(jnp.asarray(probe), *ep.params))
+        if got.tobytes() != ref.tobytes():
+            post_ok = False
+
+    compare = {
+        "misses_during_load": after["misses"] - before["misses"],
+        "backend_compiles_during_load": cw.backend_compiles,
+        "post_ok": post_ok,
+        **{k: v for k, v in report.items()
+           if k not in ("digest",) or args.digest},
+    }
+    _emit(lines, {"serving_compare": compare})
+
+    summary = {
+        "bench": "serving",
+        "requests": args.requests,
+        "offered_rate": args.rate,
+        "streams": args.streams,
+        "endpoints": sorted(eps),
+        "max_batch": args.max_batch,
+        "achieved_qps": report["achieved_qps"],
+        "p99_s": report["latency"].get("p99_s"),
+        "on_chip": on_chip,
+        "cpu_fallback": cpu_fallback,
+        "devices": {"count": len(devs), "kind": devs[0].device_kind},
+        "server": server.stats(),
+    }
+    if telemetry.enabled():
+        telemetry.memory.watermark("post_load")
+        summary.update(telemetry.report.bench_fields())
+    _emit(lines, summary)
+    server.close()
+
+    if args.artifact:
+        with open(args.artifact, "a") as f:
+            for obj in lines:
+                f.write(json.dumps(obj) + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,191 @@
+"""Open-loop Poisson-arrival load generator for ``heat_tpu.serve``.
+
+Open loop means the arrival process does **not** wait for completions
+(the schedule is fixed before the run): unlike closed-loop "submit,
+wait, repeat" drivers, latency degradation cannot throttle the offered
+rate, so queueing collapse is *visible* instead of silently self-limited
+— the standard methodology for serving benchmarks. Arrivals are
+exponential inter-arrival times (Poisson process) from a seeded RNG, so
+a run is fully reproducible: same seed → same schedule, same payloads,
+same per-request answers (batching composition may differ run to run,
+but in exact serving mode answers are batch-composition-independent —
+that is what makes the digest below a meaningful bit-identity oracle).
+
+Used by ``benchmarks/serving/heat_tpu.py`` (the committed-artifact
+runner), the CI serving gate (scripts/run_ci.sh), and tests.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["poisson_schedule", "make_requests", "run_open_loop"]
+
+
+def poisson_schedule(n: int, rate: float, seed: int = 0) -> np.ndarray:
+    """``n`` arrival offsets (seconds from start) of a Poisson process
+    with ``rate`` arrivals/second."""
+    rng = np.random.default_rng(seed)
+    gaps = rng.exponential(1.0 / rate, size=n)
+    return np.cumsum(gaps)
+
+
+def make_requests(
+    endpoints: Dict[str, int],
+    n: int,
+    seed: int = 0,
+    *,
+    max_rows: int = 4,
+    dtypes: Optional[Dict[str, np.dtype]] = None,
+) -> List[Tuple[str, np.ndarray]]:
+    """``n`` deterministic (endpoint, payload) pairs round-robined over
+    ``endpoints`` (name → feature count). Row counts cycle 1..max_rows,
+    payloads are seeded standard normals — request ``i`` is identical
+    across runs and processes."""
+    names = sorted(endpoints)
+    rng = np.random.default_rng(seed)
+    out: List[Tuple[str, np.ndarray]] = []
+    for i in range(n):
+        name = names[i % len(names)]
+        rows = 1 + (i // len(names)) % max_rows
+        dt = (dtypes or {}).get(name, np.float32)
+        payload = rng.standard_normal((rows, endpoints[name])).astype(dt)
+        out.append((name, payload))
+    return out
+
+
+def run_open_loop(
+    server,
+    requests: Sequence[Tuple[str, np.ndarray]],
+    rate: float,
+    *,
+    seed: int = 0,
+    streams: int = 2,
+    timeout: float = 60.0,
+) -> dict:
+    """Drive ``requests`` at ``rate``/s total over ``streams`` concurrent
+    submitter threads (each owning an interleaved slice of the one
+    global schedule), then gather every future.
+
+    Returns a report dict::
+
+        {"requests", "failed", "shed", "errors": [repr...],
+         "offered_rate", "achieved_qps", "wall_seconds",
+         "latency": {"p50_s", "p95_s", "p99_s", "mean_s", "max_s"},
+         "per_endpoint": {name: {"requests", "failed", "p99_s", ...}},
+         "digest": sha256-hex over successful responses in request order}
+
+    ``achieved_qps`` counts completed (non-shed, non-failed) requests
+    over the first-submit → last-completion wall window. The digest
+    covers (endpoint, request index, response bytes) for every
+    *successful* request — bit-stable across batching compositions in
+    exact serving mode, which is what the CI chaos comparison pins.
+    """
+    from heat_tpu.serve import ServerOverloadedError
+
+    n = len(requests)
+    sched = poisson_schedule(n, rate, seed)
+    futures: List[Optional[object]] = [None] * n
+    shed_errors: List[Optional[str]] = [None] * n
+    submit_errors: List[Optional[str]] = [None] * n
+    t0 = time.perf_counter()
+
+    def submitter(stream: int) -> None:
+        for i in range(stream, n, streams):
+            name, payload = requests[i]
+            delay = t0 + sched[i] - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            try:
+                futures[i] = server.submit(name, payload)
+            except ServerOverloadedError as e:
+                shed_errors[i] = repr(e)
+            except Exception as e:  # noqa: BLE001 — a dead submitter
+                # stream must surface as FAILED requests, never as
+                # silent sheds (the CI clean gate checks failed==0)
+                submit_errors[i] = repr(e)
+
+    threads = [
+        threading.Thread(target=submitter, args=(s,), daemon=True)
+        for s in range(streams)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    per_ep: Dict[str, dict] = {}
+    errors: List[str] = []
+    digest = hashlib.sha256()
+    shed = failed = 0
+    deadline = time.monotonic() + timeout
+    for i, (name, _payload) in enumerate(requests):
+        row = per_ep.setdefault(
+            name, {"requests": 0, "failed": 0, "shed": 0}
+        )
+        row["requests"] += 1
+        if futures[i] is None:
+            if submit_errors[i] is not None:
+                failed += 1
+                row["failed"] += 1
+                errors.append(f"request {i} ({name}): {submit_errors[i]}")
+            else:
+                shed += 1
+                row["shed"] += 1
+            continue
+        try:
+            out = futures[i].result(max(0.001, deadline - time.monotonic()))
+        except Exception as e:  # noqa: BLE001 — a failed request is data
+            failed += 1
+            row["failed"] += 1
+            errors.append(f"request {i} ({name}): {e!r}")
+            continue
+        digest.update(name.encode())
+        digest.update(str(i).encode())
+        digest.update(np.ascontiguousarray(out).tobytes())
+    wall = time.perf_counter() - t0
+
+    # latency from the server's own per-endpoint histograms (submit →
+    # future resolution, recorded by the batcher thread); the loadgen
+    # adds the offered-vs-achieved arithmetic on top. The overall row is
+    # conservative: worst per-endpoint percentile, count-weighted mean.
+    stats = server.stats()["endpoints"]
+    counts = 0
+    worst = {"p50_s": 0.0, "p95_s": 0.0, "p99_s": 0.0, "mean_s": 0.0,
+             "max_s": 0.0}
+    for name, srow in stats.items():
+        lat = srow.get("latency", {})
+        c = lat.get("count", 0)
+        if name in per_ep:
+            for k in ("p50_s", "p95_s", "p99_s", "mean_s", "max_s"):
+                if k in lat:
+                    per_ep[name][k] = round(lat[k], 6)
+        if not c:
+            continue
+        counts += c
+        worst["mean_s"] += lat.get("mean_s", 0.0) * c
+        for k in ("p50_s", "p95_s", "p99_s", "max_s"):
+            worst[k] = max(worst[k], lat.get(k, 0.0) or 0.0)
+    if counts:
+        worst["mean_s"] = round(worst["mean_s"] / counts, 6)
+        for k in ("p50_s", "p95_s", "p99_s", "max_s"):
+            worst[k] = round(worst[k], 6)
+    ok = n - shed - failed
+    return {
+        "requests": n,
+        "completed": ok,
+        "failed": failed,
+        "shed": shed,
+        "errors": errors[:8],
+        "offered_rate": rate,
+        "achieved_qps": round(ok / wall, 2) if wall > 0 else 0.0,
+        "wall_seconds": round(wall, 4),
+        "latency": worst if counts else {},
+        "per_endpoint": per_ep,
+        "digest": digest.hexdigest(),
+    }

@@ -51,3 +51,4 @@ from . import parallel
 from . import datasets
 from . import nn
 from . import optim
+from . import serve

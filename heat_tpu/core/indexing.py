@@ -609,6 +609,21 @@ def setitem(x: DNDarray, key, value) -> None:
     if isinstance(key, tuple) and builtins.any(_is_bool_array(k) for k in key):
         if _setitem_bool_tuple(x, key, value):
             return
+        if jax.process_count() > 1:
+            # the forms the device path declines (negative-step slices,
+            # n-D masks in tuples, broadcast-mismatched values) fall back
+            # to numpy on the host-logical view, which a multi-host
+            # topology cannot materialize — raise the contract clearly
+            # HERE instead of surfacing _logical's generic padded-view
+            # error (or a non-addressable fetch) from halfway down the
+            # fallback (carried ISSUE 6 debt, closed ISSUE 8)
+            raise NotImplementedError(
+                f"setitem with a boolean array inside a tuple key is "
+                f"multi-host only for 1-D masks combined with ints and "
+                f"non-negative-step slices (shard-side rank-gather path); "
+                f"key {key!r} needs the single-controller host fallback — "
+                f"reformulate with a full-shape mask or ascending slices"
+            )
         _host_fallback_warning(f"key {key!r} mixes mask/advanced entries")
         return _setitem_host_fallback(x, key, value)
 

@@ -68,6 +68,7 @@ from .. import resilience, telemetry
 __all__ = [
     "cached_program",
     "program_key",
+    "site_stats",
     "stats",
     "reset",
     "clear",
@@ -230,6 +231,20 @@ def stats() -> dict:
             "maxsize": _maxsize(),
             "sites": {s: dict(row) for s, row in _SITE_STATS.items()},
         }
+
+
+def site_stats(prefix: str) -> dict:
+    """Aggregated ``{"hits", "misses"}`` over every site whose name
+    starts with ``prefix`` — e.g. ``site_stats("serve.")`` is the
+    serving front end's zero-recompile-after-warmup oracle (a steady
+    state shows only the hit counter moving)."""
+    with _LOCK:
+        out = {"hits": 0, "misses": 0}
+        for s, row in _SITE_STATS.items():
+            if s.startswith(prefix):
+                out["hits"] += row["hits"]
+                out["misses"] += row["misses"]
+        return out
 
 
 def reset() -> None:

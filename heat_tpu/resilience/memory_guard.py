@@ -56,6 +56,7 @@ from .. import telemetry
 __all__ = [
     "HeatTpuMemoryError",
     "budget_bytes",
+    "headroom",
     "preflight",
     "program_bytes",
     "temp_budget",
@@ -148,6 +149,19 @@ def _live_total() -> int:
         return int(telemetry.memory.live_bytes()["total"])
     except Exception:
         return 0
+
+
+def headroom() -> Tuple[Optional[int], int]:
+    """``(budget_bytes, live_bytes)`` — the two sides of the budget
+    arithmetic in one call, shared by :func:`preflight`, the relayout
+    planner's plan selection, and the serving admission controller
+    (ISSUE 8), so every consumer compares the SAME quantities. Budget is
+    None when the guard is unarmed (live bytes are then not measured:
+    the disabled path stays one env read)."""
+    budget = budget_bytes()
+    if budget is None:
+        return None, 0
+    return budget, _live_total()
 
 
 def _set_pressure(on: bool) -> None:

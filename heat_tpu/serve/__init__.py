@@ -1,0 +1,71 @@
+"""heat_tpu.serve — multi-tenant micro-batched inference front end (ISSUE 8).
+
+The first subsystem that *uses* the PR 1-7 substrate under concurrent
+load, and the ROADMAP's "millions of users" story:
+
+* fitted estimators mount as named **endpoints** (:mod:`.endpoints`):
+  KMeans ``predict``, KNN classify, cdist/rbf queries, Lasso and
+  GaussianNB inference, ``nn.functional.dense`` forward;
+* a thread-safe :class:`~.server.Server` accepts concurrent request
+  streams and a **micro-batcher** coalesces compatible requests into
+  single dispatches through :func:`heat_tpu.core.program_cache
+  .cached_program` — after :meth:`~.server.Server.warmup` pre-traces the
+  batch-size ladder, the steady state compiles **nothing** (pad-to-bucket
+  keeps the program registry finite, and the zero pad rows are
+  masked-neutral: in exact mode batched answers are bit-identical to
+  solo dispatch);
+* **admission control** (:mod:`.admission`) sheds with 503-style
+  :class:`~.admission.ServerOverloadedError` before OOM — queue-depth
+  bound plus the :mod:`~heat_tpu.resilience.memory_guard` budget
+  arithmetic, degrading the batch ladder under pressure before shedding;
+* every dispatch already runs under :func:`heat_tpu.resilience
+  .wrap_program` retry semantics (transient faults cost one batch retry,
+  never the process), and :meth:`~.server.Server.save` /
+  :meth:`~.server.Server.restore` checkpoint the fitted endpoints
+  through the CRC-verified resilience checkpoint format;
+* the telemetry **serving view**: per-endpoint QPS, queue depth, batch
+  occupancy, and p50/p95/p99 latency through
+  :func:`heat_tpu.telemetry.report.summarize` (``serving`` block) and
+  :meth:`~.server.Server.stats`.
+
+See docs/SERVING.md for architecture, knobs (``HEAT_TPU_SERVE_*``) and
+the SLO metrics schema; ``benchmarks/serving/`` for the open-loop
+Poisson load generator.
+"""
+
+from __future__ import annotations
+
+from .admission import (
+    AdmissionController,
+    ServeError,
+    ServerClosedError,
+    ServerOverloadedError,
+)
+from .endpoints import (
+    Endpoint,
+    cdist_query,
+    dense_forward,
+    gaussian_nb_predict,
+    kmeans_predict,
+    knn_classify,
+    lasso_predict,
+    rbf_query,
+)
+from .server import Server
+from . import admission, endpoints, metrics, server  # noqa: F401
+
+__all__ = [
+    "Server",
+    "Endpoint",
+    "AdmissionController",
+    "ServeError",
+    "ServerOverloadedError",
+    "ServerClosedError",
+    "kmeans_predict",
+    "knn_classify",
+    "gaussian_nb_predict",
+    "lasso_predict",
+    "cdist_query",
+    "rbf_query",
+    "dense_forward",
+]

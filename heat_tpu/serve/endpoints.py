@@ -1,0 +1,398 @@
+"""Endpoint adapters: fitted estimators → pure batched inference programs.
+
+An :class:`Endpoint` is the unit the server dispatches: a *pure* function
+``fn(batch, *params)`` over a ``(bucket, features)`` request batch plus
+the fitted parameters, compiled once per ladder bucket through
+:func:`heat_tpu.core.program_cache.cached_program` (site
+``serve.<name>``) and reused for every later batch of that shape — the
+zero-compile steady state the warm-up pre-traces.
+
+Two kernel families per endpoint, selected by ``HEAT_TPU_SERVE_EXACT``
+(default on):
+
+* **exact** — broadcast-then-reduce forms whose per-row reduction order
+  is independent of the batch dimension, so a request served inside a
+  padded 64-row bucket returns *bit-identical* results to the same
+  request dispatched alone (the pad rows are zeros and every kernel is
+  row-independent — the serving analog of the fusion engine's
+  masked-neutral pad fill). This is the contract the batched/sequential
+  bit-identity CI oracle pins, and the default because a cache hit on a
+  different bucket must never change an answer.
+* **fast** (``HEAT_TPU_SERVE_EXACT=0``) — the MXU GEMM forms the
+  estimators themselves use (``x² + c² − 2xcᵀ`` etc.). On TPU these are
+  several times faster for large reference sets, but XLA is free to
+  re-tile the contraction per batch shape, so cross-bucket bit-identity
+  is NOT guaranteed (still allclose at f32 ulp scale).
+
+Parameters are passed as *arguments* to the jitted program, not closed
+over: a checkpoint-restored estimator with identical shapes re-enters the
+very same cached executable (the re-warm after ``Server.restore`` is all
+registry hits), and two endpoints of one kind share programs when their
+static config matches.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "Endpoint",
+    "kmeans_predict",
+    "knn_classify",
+    "gaussian_nb_predict",
+    "lasso_predict",
+    "cdist_query",
+    "rbf_query",
+    "dense_forward",
+    "rebuild",
+]
+
+
+def exact_mode() -> bool:
+    """Whether the bit-stable serving kernels are active (default). Off
+    (``HEAT_TPU_SERVE_EXACT=0``) selects the GEMM forms — faster on the
+    MXU, but batched-vs-solo results are only allclose, not bit-equal."""
+    return os.environ.get("HEAT_TPU_SERVE_EXACT", "").strip().lower() not in (
+        "0", "false", "no", "off",
+    )
+
+
+# -- shape-stable math helpers -------------------------------------------------
+# Reduction order per output element must not depend on the batch dim:
+# broadcast+reduce lowers to one fused elementwise+reduce loop per row,
+# which XLA keeps row-independent, while a GEMM may re-tile (and hence
+# re-associate) the contraction when the batch dimension changes —
+# measured on this backend: (1,64)@(64,8) and (16,64)@(64,8) disagree in
+# the last ulp.
+
+
+def _d2_exact(xb: jax.Array, c: jax.Array) -> jax.Array:
+    diff = xb[:, None, :] - c[None, :, :]
+    return jnp.sum(diff * diff, axis=-1)
+
+
+def _d2_fast(xb: jax.Array, c: jax.Array) -> jax.Array:
+    x2 = jnp.sum(xb * xb, axis=1, keepdims=True)
+    c2 = jnp.sum(c * c, axis=1)[None, :]
+    prod = jnp.matmul(xb, c.T, precision=jax.lax.Precision.HIGH)
+    return jnp.maximum(x2 + c2 - 2.0 * prod, 0.0)
+
+
+def _d2(xb, c, exact: bool):
+    return _d2_exact(xb, c) if exact else _d2_fast(xb, c)
+
+
+def _matmul_exact(a: jax.Array, b: jax.Array) -> jax.Array:
+    return jnp.sum(a[:, :, None] * b[None, :, :], axis=1)
+
+
+def _matvec_exact(a: jax.Array, v: jax.Array) -> jax.Array:
+    return jnp.sum(a * v[None, :], axis=1)
+
+
+# -- kernel functions (module-level: stable identities for the registry) -------
+
+
+def _kmeans_fn(xb, params, cfg):
+    (centers,) = params
+    d2 = _d2(xb.astype(centers.dtype), centers, cfg["exact"])
+    return jnp.argmin(d2, axis=1).astype(jnp.int64)
+
+
+def _knn_fn(xb, params, cfg):
+    xt, yt, classes = params
+    d2 = _d2(xb.astype(xt.dtype), xt, cfg["exact"])
+    _, idx = jax.lax.top_k(-d2, cfg["k"])
+    neigh = jnp.take(yt, idx)  # (m, k) labels
+    votes = jnp.sum(
+        (neigh[:, :, None] == classes[None, None, :]).astype(jnp.int32),
+        axis=1,
+    )
+    return jnp.take(classes, jnp.argmax(votes, axis=1))
+
+
+def _gnb_fn(xb, params, cfg):
+    theta, var, prior, classes = params
+    xl = xb.astype(jnp.float64)
+    log_prior = jnp.log(prior)[None, :]
+    n_ij = -0.5 * jnp.sum(jnp.log(2.0 * jnp.pi * var), axis=1)[None, :]
+    diff = xl[:, None, :] - theta[None, :, :]  # (m, k, d)
+    quad = -0.5 * jnp.sum(diff * diff / var[None, :, :], axis=2)
+    jll = log_prior + n_ij + quad
+    return jnp.take(classes, jnp.argmax(jll, axis=1))
+
+
+def _lasso_fn(xb, params, cfg):
+    coef, intercept = params
+    xc = xb.astype(coef.dtype)
+    if cfg["exact"]:
+        return _matvec_exact(xc, coef) + intercept
+    return jnp.matmul(xc, coef) + intercept
+
+
+def _cdist_fn(xb, params, cfg):
+    (y,) = params
+    d2 = _d2(xb.astype(y.dtype), y, cfg["exact"])
+    d2 = jnp.maximum(d2, 0.0)
+    gamma = cfg.get("gamma")
+    if gamma is not None:
+        return jnp.exp(-gamma * d2)
+    return jnp.sqrt(d2)
+
+
+def _dense_fn(xb, params, cfg):
+    w = params[0]
+    xc = xb.astype(w.dtype)
+    y = _matmul_exact(xc, w) if cfg["exact"] else jnp.matmul(xc, w)
+    if cfg["bias"]:
+        y = y + params[1]
+    act = cfg.get("activation")
+    if act == "relu":
+        y = jnp.maximum(y, 0.0)
+    elif act == "tanh":
+        y = jnp.tanh(y)
+    elif act == "sigmoid":
+        y = 1.0 / (1.0 + jnp.exp(-y))
+    return y
+
+
+_KIND_FNS: Dict[str, Callable] = {
+    "kmeans_predict": _kmeans_fn,
+    "knn_classify": _knn_fn,
+    "gaussian_nb_predict": _gnb_fn,
+    "lasso_predict": _lasso_fn,
+    "cdist_query": _cdist_fn,
+    "dense_forward": _dense_fn,
+}
+
+
+class Endpoint:
+    """One named inference program family: ``kind`` selects the kernel,
+    ``params`` are the fitted arrays (passed as program arguments),
+    ``config`` the static knobs baked into the trace (and the registry
+    key). ``features`` / ``dtype`` define the request contract the server
+    validates against."""
+
+    __slots__ = ("kind", "params", "config", "features", "dtype")
+
+    def __init__(
+        self,
+        kind: str,
+        params: Sequence[jax.Array],
+        config: Optional[Dict[str, Any]] = None,
+        *,
+        features: int,
+        dtype,
+    ):
+        if kind not in _KIND_FNS:
+            raise ValueError(
+                f"unknown endpoint kind {kind!r}; known: {sorted(_KIND_FNS)}"
+            )
+        self.kind = kind
+        # canonical placement: a freshly-fitted param arrives with the
+        # estimator's replicated MESH sharding, a checkpoint-restored one
+        # as a plain default-device array — jit compiles a distinct
+        # executable per input sharding, which would break the
+        # "restore-then-rewarm compiles nothing" contract. The host
+        # round-trip pins every param to the default single-device layout
+        # (they are small: centers, coefficients, class stats).
+        self.params = tuple(jnp.asarray(np.asarray(p)) for p in params)
+        self.config = dict(config or {})
+        self.config.setdefault("exact", exact_mode())
+        self.features = int(features)
+        self.dtype = np.dtype(dtype)
+
+    # -- program plumbing ----------------------------------------------------
+
+    def cfg_key(self) -> Tuple:
+        return tuple(sorted(self.config.items()))
+
+    def program_key(self, bucket: int) -> Tuple:
+        """The program-cache static key for one ladder bucket. Parameter
+        *avals* ride in the key so two same-kind endpoints with different
+        reference-set sizes never collide, while a restored estimator
+        with identical shapes re-hits the warm entry."""
+        psig = tuple((tuple(p.shape), str(p.dtype)) for p in self.params)
+        return (
+            self.kind, self.cfg_key(), int(bucket), self.features,
+            str(self.dtype), psig,
+        )
+
+    def build(self) -> Callable:
+        """The pure callable to jit — runs only on a registry miss."""
+        fn = _KIND_FNS[self.kind]
+        cfg = dict(self.config)
+
+        def call(xb, *params):
+            return fn(xb, params, cfg)
+
+        return call
+
+    def cost_bytes(self, bucket: int) -> int:
+        """Analytic temp+output byte estimate for one ``bucket``-row
+        dispatch — the admission controller's fallback when the bucket
+        was never warmed (measured ``memory_analysis`` bytes win once
+        available). Counts the request buffer, the (bucket, n_ref)
+        intermediate the distance/likelihood kernels materialize, and
+        the output."""
+        item = max(self.dtype.itemsize, 4)
+        n_ref = self.params[0].shape[0] if self.params[0].ndim else 1
+        inp = bucket * self.features * item
+        mid = bucket * max(n_ref, 1) * item
+        out = bucket * max(n_ref, 1) * item
+        return int(inp + mid + out)
+
+    def describe(self) -> dict:
+        """JSON-serializable manifest record (checkpoint/restore)."""
+        return {
+            "kind": self.kind,
+            "config": dict(self.config),
+            "features": self.features,
+            "dtype": str(self.dtype),
+            "n_params": len(self.params),
+        }
+
+
+def rebuild(record: dict, params: Sequence) -> Endpoint:
+    """Inverse of :meth:`Endpoint.describe` + saved params — the
+    checkpoint-restore constructor (``Server.restore``)."""
+    return Endpoint(
+        record["kind"],
+        [jnp.asarray(p) for p in params],
+        config=record.get("config"),
+        features=record["features"],
+        dtype=np.dtype(record["dtype"]),
+    )
+
+
+# -- estimator adapters --------------------------------------------------------
+
+
+def _replicated(x) -> jax.Array:
+    """Fitted parameters are small (centers, coefficients, class stats):
+    replicate DNDarrays onto the host process, accept plain arrays as-is."""
+    from ..core.dndarray import DNDarray
+
+    if isinstance(x, DNDarray):
+        return x._replicated()
+    return jnp.asarray(x)
+
+
+def kmeans_predict(est) -> Endpoint:
+    """Serve ``est.predict`` for a fitted K-family clusterer (KMeans,
+    KMedians with euclidean assignment): nearest-centroid labels
+    (int64), bit-matching :meth:`heat_tpu.cluster.KMeans.predict` in
+    exact mode."""
+    if est.cluster_centers_ is None:
+        raise ValueError("estimator is not fitted (no cluster_centers_)")
+    centers = _replicated(est.cluster_centers_)
+    return Endpoint(
+        "kmeans_predict", [centers],
+        features=int(centers.shape[1]), dtype=np.dtype(centers.dtype),
+    )
+
+
+def knn_classify(est) -> Endpoint:
+    """Serve a fitted :class:`~heat_tpu.classification.KNeighborsClassifier`:
+    distance + top-k + one-hot vote, like ``est.predict``."""
+    if est.x is None:
+        raise ValueError("estimator is not fitted (call fit first)")
+    xt = _replicated(est.x).astype(jnp.float32)
+    yt = _replicated(est.y).ravel()
+    classes = jnp.asarray(est._classes)
+    k = min(int(est.n_neighbors), int(xt.shape[0]))
+    return Endpoint(
+        "knn_classify", [xt, yt, classes], {"k": k},
+        features=int(xt.shape[1]), dtype=np.float32,
+    )
+
+
+def gaussian_nb_predict(est) -> Endpoint:
+    """Serve a fitted :class:`~heat_tpu.naive_bayes.GaussianNB`: max joint
+    log-likelihood class per row (float64 internally, like the
+    estimator)."""
+    if est.theta_ is None:
+        raise ValueError("estimator is not fitted (call fit first)")
+    theta = _replicated(est.theta_)
+    var = _replicated(est.var_)
+    prior = _replicated(est.class_prior_)
+    classes = _replicated(est.classes_)
+    return Endpoint(
+        "gaussian_nb_predict", [theta, var, prior, classes],
+        features=int(theta.shape[1]), dtype=np.float64,
+    )
+
+
+def lasso_predict(est) -> Endpoint:
+    """Serve a fitted :class:`~heat_tpu.regression.Lasso`:
+    ``x @ coef + intercept``."""
+    if est.theta is None:
+        raise ValueError("estimator is not fitted (call fit first)")
+    theta = _replicated(est.theta).ravel()
+    coef, intercept = theta[1:], theta[0]
+    return Endpoint(
+        "lasso_predict", [coef, intercept],
+        features=int(coef.shape[0]), dtype=np.dtype(coef.dtype),
+    )
+
+
+def cdist_query(y) -> Endpoint:
+    """Serve euclidean distance rows against a fixed reference matrix
+    ``y`` ((n_ref, d) DNDarray or array): each request row yields its
+    distance vector to every reference row."""
+    yb = _replicated(y)
+    if yb.ndim != 2:
+        raise ValueError(f"reference matrix must be 2-D, got {yb.ndim}-D")
+    if not jnp.issubdtype(yb.dtype, jnp.floating):
+        yb = yb.astype(jnp.float32)
+    return Endpoint(
+        "cdist_query", [yb],
+        features=int(yb.shape[1]), dtype=np.dtype(yb.dtype),
+    )
+
+
+def rbf_query(y, sigma: float = 1.0) -> Endpoint:
+    """Gaussian-kernel rows ``exp(−‖x−y‖²/2σ²)`` against a fixed
+    reference matrix — the serving form of :func:`heat_tpu.spatial.rbf`."""
+    yb = _replicated(y)
+    if yb.ndim != 2:
+        raise ValueError(f"reference matrix must be 2-D, got {yb.ndim}-D")
+    if not jnp.issubdtype(yb.dtype, jnp.floating):
+        yb = yb.astype(jnp.float32)
+    gamma = 1.0 / (2.0 * float(sigma) * float(sigma))
+    return Endpoint(
+        "cdist_query", [yb], {"gamma": gamma},
+        features=int(yb.shape[1]), dtype=np.dtype(yb.dtype),
+    )
+
+
+def dense_forward(w, bias=None, activation: Optional[str] = None) -> Endpoint:
+    """Serve an affine layer ``activation(x @ w + bias)`` — the
+    :func:`heat_tpu.nn.functional.dense` forward as an endpoint.
+    ``activation`` ∈ {None, 'relu', 'tanh', 'sigmoid'}."""
+    wb = _replicated(w)
+    if wb.ndim != 2:
+        raise ValueError(f"weight must be 2-D (d_in, d_out), got {wb.ndim}-D")
+    if activation not in (None, "relu", "tanh", "sigmoid"):
+        raise ValueError(
+            f"activation must be None/'relu'/'tanh'/'sigmoid', "
+            f"got {activation!r}"
+        )
+    params = [wb]
+    if bias is not None:
+        bb = _replicated(bias).ravel().astype(wb.dtype)
+        if bb.shape[0] != wb.shape[1]:
+            raise ValueError(
+                f"bias length {bb.shape[0]} != output width {wb.shape[1]}"
+            )
+        params.append(bb)
+    return Endpoint(
+        "dense_forward", params,
+        {"bias": bias is not None, "activation": activation},
+        features=int(wb.shape[0]), dtype=np.dtype(wb.dtype),
+    )

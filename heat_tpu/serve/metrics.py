@@ -1,0 +1,153 @@
+"""Serving-side latency/occupancy accounting (ISSUE 8).
+
+The telemetry registry records *events*; a serving front end additionally
+needs cheap online aggregates it can report while the event stream is
+disabled — per-endpoint request counts, shed/error tallies, batch
+occupancy, and latency percentiles. :class:`LatencyHistogram` is a
+fixed-size log-bucketed histogram (10 µs … ~300 s, 1.25× growth): O(1)
+record, O(buckets) quantile, no per-request allocation, thread-safe under
+the owning :class:`EndpointStats` lock. Percentile estimates interpolate
+inside the winning bucket, so the p50/p95/p99 the server reports are
+within one bucket width (≤25%) of exact — the honest resolution for an
+SLO dashboard, at zero memory growth under sustained load.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import List, Optional
+
+__all__ = ["LatencyHistogram", "EndpointStats"]
+
+# bucket i covers (BASE*GROWTH^(i-1), BASE*GROWTH^i]; bucket 0 covers
+# [0, BASE]. 80 buckets reach BASE*1.25^79 ≈ 459 s — beyond any sane SLO.
+_BASE = 1e-5
+_GROWTH = 1.25
+_NBUCKETS = 80
+_LOG_GROWTH = math.log(_GROWTH)
+
+
+class LatencyHistogram:
+    """Log-bucketed latency histogram with quantile estimation."""
+
+    __slots__ = ("counts", "count", "total", "min", "max")
+
+    def __init__(self):
+        self.counts: List[int] = [0] * _NBUCKETS
+        self.count = 0
+        self.total = 0.0
+        self.min = float("inf")
+        self.max = 0.0
+
+    def record(self, seconds: float) -> None:
+        if seconds < 0:
+            seconds = 0.0
+        if seconds <= _BASE:
+            i = 0
+        else:
+            i = min(
+                _NBUCKETS - 1,
+                1 + int(math.log(seconds / _BASE) / _LOG_GROWTH),
+            )
+        self.counts[i] += 1
+        self.count += 1
+        self.total += seconds
+        if seconds < self.min:
+            self.min = seconds
+        if seconds > self.max:
+            self.max = seconds
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Estimated ``q``-quantile in seconds (linear interpolation inside
+        the winning bucket, clamped to the observed min/max). None when
+        empty."""
+        if self.count == 0:
+            return None
+        target = q * self.count
+        seen = 0
+        for i, c in enumerate(self.counts):
+            if c == 0:
+                continue
+            if seen + c >= target:
+                lo = 0.0 if i == 0 else _BASE * _GROWTH ** (i - 1)
+                hi = _BASE * _GROWTH ** i
+                frac = (target - seen) / c
+                est = lo + (hi - lo) * frac
+                return max(self.min, min(self.max, est))
+            seen += c
+        return self.max
+
+    def snapshot(self) -> dict:
+        if self.count == 0:
+            return {"count": 0}
+        return {
+            "count": self.count,
+            "mean_s": self.total / self.count,
+            "min_s": self.min,
+            "max_s": self.max,
+            "p50_s": self.quantile(0.50),
+            "p95_s": self.quantile(0.95),
+            "p99_s": self.quantile(0.99),
+        }
+
+
+class EndpointStats:
+    """Per-endpoint serving aggregates: request/row/batch tallies, shed
+    and error counts, pad overhead, and the latency histogram. All
+    mutation goes through the instance lock — the submit path and the
+    batcher thread both write here."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+        self.requests = 0
+        self.rows = 0
+        self.batches = 0
+        self.dispatched_rows = 0
+        self.padded_rows = 0
+        self.shed = 0
+        self.errors = 0
+        self.latency = LatencyHistogram()
+
+    def record_request(self, rows: int) -> None:
+        with self._lock:
+            self.requests += 1
+            self.rows += rows
+
+    def record_shed(self) -> None:
+        with self._lock:
+            self.shed += 1
+
+    def record_batch(self, rows: int, padded: int) -> None:
+        with self._lock:
+            self.batches += 1
+            self.dispatched_rows += rows
+            self.padded_rows += padded
+
+    def record_done(self, seconds: float) -> None:
+        with self._lock:
+            self.latency.record(seconds)
+
+    def record_error(self, n: int = 1) -> None:
+        with self._lock:
+            self.errors += n
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            out = {
+                "requests": self.requests,
+                "rows": self.rows,
+                "batches": self.batches,
+                "shed": self.shed,
+                "errors": self.errors,
+                "padded_rows": self.padded_rows,
+                "latency": self.latency.snapshot(),
+            }
+            if self.batches:
+                out["mean_batch_rows"] = self.dispatched_rows / self.batches
+                denom = self.dispatched_rows + self.padded_rows
+                out["occupancy"] = (
+                    self.dispatched_rows / denom if denom else 1.0
+                )
+            return out

@@ -1,0 +1,559 @@
+"""The multi-tenant micro-batched inference server (ISSUE 8 tentpole).
+
+Request lifecycle::
+
+    submit(name, payload)
+      └─ admission gate (queue depth, memory budget → 503-style shed,
+         or ladder degradation)                       [caller thread]
+      └─ FIFO queue (thread-safe)
+    batcher thread
+      └─ coalesce consecutive same-endpoint requests up to the ladder cap
+         within a short gather window (micro-batch)
+      └─ pad the coalesced rows up to the smallest ladder bucket
+         (masked-neutral zero rows; row-independent kernels → pad rows
+         cannot perturb real rows, and in exact mode results are
+         bit-identical to solo dispatch)
+      └─ ONE dispatch through program_cache.cached_program
+         (site ``serve.<name>``) — which is already wrapped in
+         resilience.wrap_program, so the fault injector, the HBM
+         preflight, and the transient-retry guard run per *batch*
+         (a transient fault costs one batch retry, never the process)
+      └─ slice results back per request, resolve futures, record
+         latency/occupancy metrics + telemetry events
+
+``warmup()`` pre-traces every endpoint's whole batch-size ladder (the
+pad-to-bucket discipline keeps the program registry finite: one program
+per (endpoint, bucket)), so the steady state is **zero compiles** — every
+later dispatch is a registry dict hit, pinned by the CI serving gate via
+:func:`heat_tpu.core.program_cache.site_stats`.
+
+Knobs (all overridable per-``Server`` constructor argument):
+
+* ``HEAT_TPU_SERVE_MAX_BATCH`` — ladder top (default 64);
+* ``HEAT_TPU_SERVE_LADDER`` — explicit comma-separated bucket list
+  (default: powers of two up to max_batch);
+* ``HEAT_TPU_SERVE_MAX_WAIT_MS`` — micro-batch gather window (default 2);
+* ``HEAT_TPU_SERVE_QUEUE_MAX`` — admission queue bound (default 1024);
+* ``HEAT_TPU_SERVE_EXACT`` — bit-stable kernels (default on; see
+  :mod:`.endpoints`).
+
+Checkpoint story: ``server.save(path)`` writes every endpoint's fitted
+parameters + static config through :mod:`heat_tpu.resilience.checkpoint`
+(CRC-verified, atomically swapped); ``Server.restore(path)`` rebuilds the
+endpoints without refitting — and because parameters are program
+*arguments*, the re-warm after restore re-enters the same cached
+executables bit-for-bit.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Dict, List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import telemetry
+from ..core import program_cache
+from ..resilience import memory_guard
+from .admission import (
+    AdmissionController,
+    ServerClosedError,
+    ServerOverloadedError,
+)
+from .endpoints import Endpoint, rebuild
+from .metrics import EndpointStats
+
+__all__ = ["Server"]
+
+DEFAULT_MAX_BATCH = 64
+DEFAULT_WAIT_MS = 2.0
+
+_SHUTDOWN = object()
+
+
+def _resolve(fut: Future, value=None, exc=None) -> None:
+    """Resolve a future exactly once. A close() racing a live batcher can
+    reach the same request from both sides (drain vs in-flight batch);
+    the second resolution must be a no-op, not an InvalidStateError that
+    kills the batcher thread mid-batch."""
+    try:
+        if exc is not None:
+            fut.set_exception(exc)
+        else:
+            fut.set_result(value)
+    except Exception:  # concurrent.futures.InvalidStateError
+        pass
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name, "").strip()
+    if raw:
+        try:
+            v = float(raw)
+            if v >= 0:
+                return v
+        except ValueError:
+            pass
+    return default
+
+
+def _default_ladder(max_batch: int) -> List[int]:
+    ladder = []
+    b = 1
+    while b < max_batch:
+        ladder.append(b)
+        b *= 2
+    ladder.append(max_batch)
+    return ladder
+
+
+def _env_ladder(max_batch: int) -> List[int]:
+    raw = os.environ.get("HEAT_TPU_SERVE_LADDER", "").strip()
+    if raw:
+        try:
+            vals = sorted({int(v) for v in raw.split(",") if v.strip()})
+            if vals and all(v > 0 for v in vals):
+                return vals
+        except ValueError:
+            pass
+    return _default_ladder(max_batch)
+
+
+class _Request:
+    __slots__ = ("endpoint", "array", "rows", "squeeze", "future", "t_submit")
+
+    def __init__(self, endpoint: str, array: np.ndarray, squeeze: bool):
+        self.endpoint = endpoint
+        self.array = array
+        self.rows = int(array.shape[0])
+        self.squeeze = squeeze
+        self.future: Future = Future()
+        self.t_submit = time.perf_counter()
+
+
+class Server:
+    """Multi-tenant micro-batched inference front end over fitted
+    estimators (module docstring has the architecture; docs/SERVING.md
+    the operator guide)."""
+
+    def __init__(
+        self,
+        *,
+        max_batch: Optional[int] = None,
+        ladder: Optional[Sequence[int]] = None,
+        max_wait_ms: Optional[float] = None,
+        queue_max: Optional[int] = None,
+    ):
+        if max_batch is None:
+            raw = os.environ.get("HEAT_TPU_SERVE_MAX_BATCH", "").strip()
+            max_batch = DEFAULT_MAX_BATCH
+            if raw:
+                try:
+                    max_batch = max(1, int(raw))
+                except ValueError:
+                    pass
+        self.max_batch = int(max_batch)
+        if ladder is not None:
+            ladder = sorted({int(b) for b in ladder})
+            if not ladder or ladder[0] < 1:
+                raise ValueError(f"invalid bucket ladder {ladder!r}")
+        else:
+            ladder = _env_ladder(self.max_batch)
+        self.ladder = list(ladder)
+        self.max_wait = (
+            max_wait_ms if max_wait_ms is not None
+            else _env_float("HEAT_TPU_SERVE_MAX_WAIT_MS", DEFAULT_WAIT_MS)
+        ) / 1e3
+        self._endpoints: Dict[str, Endpoint] = {}
+        self._stats: Dict[str, EndpointStats] = {}
+        self._measured: Dict[tuple, int] = {}  # (name, bucket) -> bytes
+        self.admission = AdmissionController(
+            queue_max,
+            measured_cost=lambda name, bucket: self._measured.get(
+                (name, bucket)
+            ),
+        )
+        self._queue: "queue.Queue" = queue.Queue()
+        self._carry: Optional[_Request] = None
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._closed = False
+
+    # -- registration --------------------------------------------------------
+
+    def register(self, name: str, endpoint: Endpoint) -> "Server":
+        """Mount ``endpoint`` under ``name`` (the dispatch site becomes
+        ``serve.<name>``). Re-registering a name replaces the endpoint
+        (and drops its warmed-cost memo — the programs themselves stay
+        in the registry for the next endpoint with identical shapes)."""
+        if not isinstance(endpoint, Endpoint):
+            raise TypeError(
+                f"endpoint must be a serve.Endpoint, got {type(endpoint)}"
+            )
+        if not name or "/" in name or ":" in name:
+            raise ValueError(f"invalid endpoint name {name!r}")
+        with self._lock:
+            if self._closed:
+                raise ServerClosedError("server is closed")
+            self._endpoints[name] = endpoint
+            self._stats[name] = EndpointStats(name)
+            for key in [k for k in self._measured if k[0] == name]:
+                del self._measured[key]
+        return self
+
+    def endpoints(self) -> Dict[str, Endpoint]:
+        return dict(self._endpoints)
+
+    # -- warm-up -------------------------------------------------------------
+
+    def warmup(self, names: Optional[Sequence[str]] = None) -> dict:
+        """Pre-trace (and execute once, on zeros) every registered
+        endpoint's whole batch-size ladder so serving hits only warm
+        programs. With an HBM budget armed, also pre-measures each
+        bucket's compiled temp+output bytes for the admission
+        controller. Returns ``{"endpoints", "programs",
+        "backend_compiles", "seconds"}`` — ``backend_compiles`` counts
+        real XLA builds in the window (0 on a re-warm)."""
+        t0 = time.perf_counter()
+        targets = list(names) if names is not None else list(self._endpoints)
+        programs = 0
+        budget_armed = memory_guard.budget_bytes() is not None
+        with telemetry.CompileWatcher() as cw:
+            for name in targets:
+                ep = self._endpoints[name]  # KeyError = caller bug, loud
+                for bucket in self.ladder:
+                    prog = self._program(name, ep, bucket)
+                    zeros = jnp.zeros((bucket, ep.features), dtype=ep.dtype)
+                    out = prog(zeros, *ep.params)
+                    np.asarray(out)  # block: warm-up owns the compile wait
+                    programs += 1
+                    if budget_armed:
+                        self._measured[(name, bucket)] = (
+                            memory_guard.program_bytes(
+                                prog, (zeros,) + tuple(ep.params)
+                            )
+                        )
+        dt = time.perf_counter() - t0
+        report = {
+            "endpoints": len(targets),
+            "programs": programs,
+            "backend_compiles": cw.backend_compiles,
+            "seconds": round(dt, 4),
+        }
+        if telemetry.enabled():
+            telemetry.get_registry().emit(
+                "serve", "warmup", event="warmup", **report
+            )
+        return report
+
+    # -- request path --------------------------------------------------------
+
+    def submit(self, name: str, payload) -> Future:
+        """Admit + enqueue one request; returns a
+        :class:`concurrent.futures.Future` resolving to the result rows
+        (1-D payloads resolve to a single row). Sheds with
+        :class:`ServerOverloadedError` (status 503) at the admission
+        gate; a failed dispatch (after per-batch retries) resolves the
+        future with the error."""
+        with self._lock:
+            if self._closed:
+                raise ServerClosedError("server is closed")
+            ep = self._endpoints.get(name)
+        if ep is None:
+            raise ValueError(
+                f"unknown endpoint {name!r}; registered: "
+                f"{sorted(self._endpoints)}"
+            )
+        arr = np.asarray(payload, dtype=ep.dtype)
+        squeeze = arr.ndim == 1
+        if squeeze:
+            arr = arr[None, :]
+        if arr.ndim != 2 or arr.shape[1] != ep.features:
+            raise ValueError(
+                f"endpoint {name!r} expects (rows, {ep.features}) payloads, "
+                f"got shape {np.asarray(payload).shape}"
+            )
+        st = self._stats[name]
+        try:
+            self.admission.admit(
+                name, ep, arr.shape[0], self._queue.qsize(), self.ladder
+            )
+        except ServerOverloadedError:
+            st.record_shed()
+            raise
+        req = _Request(name, arr, squeeze)
+        st.record_request(req.rows)
+        if telemetry.enabled():
+            reg = telemetry.get_registry()
+            reg.add("serve.requests", 1)
+            reg.high_water("serve.queue_depth", self._queue.qsize() + 1)
+        self._ensure_thread()
+        self._queue.put(req)
+        if self._closed:
+            # close() may have drained the queue between our admission
+            # check and the put — never strand a future
+            self._drain_pending()
+        return req.future
+
+    def predict(self, name: str, payload, timeout: Optional[float] = 30.0):
+        """Synchronous convenience: ``submit(...).result(timeout)``."""
+        return self.submit(name, payload).result(timeout)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def close(self, timeout: float = 10.0) -> None:
+        """Stop accepting requests, drain the batcher, fail whatever is
+        still pending with :class:`ServerClosedError`. Idempotent."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            thread = self._thread
+        self._queue.put(_SHUTDOWN)
+        if thread is not None:
+            thread.join(timeout)
+        self._drain_pending()
+
+    def _drain_pending(self) -> None:
+        """Fail every still-queued request with ServerClosedError (only
+        called once the batcher is no longer consuming)."""
+        leftovers = []
+        if self._carry is not None:
+            leftovers.append(self._carry)
+            self._carry = None
+        while True:
+            try:
+                item = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if item is not _SHUTDOWN:
+                leftovers.append(item)
+        for req in leftovers:
+            _resolve(
+                req.future,
+                exc=ServerClosedError("server closed with request pending"),
+            )
+
+    def __enter__(self) -> "Server":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
+
+    # -- checkpoint/restore --------------------------------------------------
+
+    def save(self, path: str) -> str:
+        """Checkpoint every endpoint's fitted parameters + static config
+        (CRC-verified blobs, atomic directory swap —
+        :mod:`heat_tpu.resilience.checkpoint`). The server keeps
+        serving; restore with :meth:`Server.restore`."""
+        from .. import resilience
+
+        leaves: List[np.ndarray] = []
+        records = []
+        with self._lock:
+            for name in sorted(self._endpoints):
+                ep = self._endpoints[name]
+                rec = ep.describe()
+                rec["name"] = name
+                records.append(rec)
+                leaves.extend(np.asarray(p) for p in ep.params)
+        return resilience.save_checkpoint(
+            leaves, path,
+            extra={"serve": {"version": 1, "endpoints": records},
+                   "algo": "serve"},
+        )
+
+    @classmethod
+    def restore(cls, path: str, **server_kwargs) -> "Server":
+        """Rebuild a server (endpoints + fitted parameters) from a
+        :meth:`save` checkpoint — no refit. Call :meth:`warmup` after;
+        identical parameter shapes re-enter the already-cached programs,
+        so a restore-then-warm on a live process compiles nothing."""
+        from .. import resilience
+
+        leaves, extra = resilience.load_checkpoint(path, with_extra=True)
+        meta = (extra or {}).get("serve")
+        if not meta or "endpoints" not in meta:
+            raise resilience.CheckpointError(
+                f"{path!r} is not a serve checkpoint (algo="
+                f"{(extra or {}).get('algo')!r})"
+            )
+        server = cls(**server_kwargs)
+        off = 0
+        for rec in meta["endpoints"]:
+            n = int(rec["n_params"])
+            server.register(rec["name"], rebuild(rec, leaves[off:off + n]))
+            off += n
+        if off != len(leaves):
+            raise resilience.CheckpointError(
+                f"serve checkpoint {path!r} holds {len(leaves)} parameter "
+                f"blobs but the manifest accounts for {off}"
+            )
+        return server
+
+    # -- introspection -------------------------------------------------------
+
+    def stats(self) -> dict:
+        """Live serving stats: per-endpoint request/batch/latency
+        aggregates, queue depth, ladder state, shed/degrade counts, and
+        the ``serve.*`` program-registry counters (the zero-recompile
+        oracle)."""
+        return {
+            "endpoints": {
+                name: s.snapshot() for name, s in self._stats.items()
+            },
+            "queue_depth": self._queue.qsize(),
+            "ladder": list(self.ladder),
+            "bucket_cap": self.admission.bucket_cap(self.ladder),
+            "shed": self.admission.sheds,
+            "degrades": self.admission.degrades,
+            "programs": program_cache.site_stats("serve."),
+            "closed": self._closed,
+        }
+
+    # -- internals -----------------------------------------------------------
+
+    def _ensure_thread(self) -> None:
+        with self._lock:
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._loop, name="heat_tpu.serve.batcher",
+                    daemon=True,
+                )
+                self._thread.start()
+
+    def _bucket_for(self, rows: int) -> int:
+        for b in self.ladder:
+            if b >= rows:
+                return b
+        return self.ladder[-1]
+
+    def _program(self, name: str, ep: Endpoint, bucket: int):
+        return program_cache.cached_program(
+            f"serve.{name}", ep.program_key(bucket), ep.build
+        )
+
+    def _loop(self) -> None:
+        while True:
+            if self._carry is not None:
+                item, self._carry = self._carry, None
+            else:
+                try:
+                    item = self._queue.get(timeout=0.1)
+                except queue.Empty:
+                    if self._closed:
+                        return
+                    continue
+            if item is _SHUTDOWN:
+                return
+            batch = [item]
+            rows = item.rows
+            cap = self.admission.bucket_cap(self.ladder)
+            deadline = time.perf_counter() + self.max_wait
+            while rows < cap:
+                try:
+                    nxt = self._queue.get_nowait()
+                except queue.Empty:
+                    rem = deadline - time.perf_counter()
+                    if rem <= 0:
+                        break
+                    try:
+                        nxt = self._queue.get(timeout=rem)
+                    except queue.Empty:
+                        break
+                if nxt is _SHUTDOWN:
+                    self._run_batch(batch)
+                    return
+                if nxt.endpoint != item.endpoint:
+                    # FIFO segments: a different endpoint closes this
+                    # micro-batch and opens the next — no reordering
+                    self._carry = nxt
+                    break
+                batch.append(nxt)
+                rows += nxt.rows
+            self._run_batch(batch)
+
+    def _run_batch(self, reqs: List[_Request]) -> None:
+        name = reqs[0].endpoint
+        ep = self._endpoints[name]
+        st = self._stats[name]
+        rows = sum(r.rows for r in reqs)
+        x = (
+            reqs[0].array if len(reqs) == 1
+            else np.concatenate([r.array for r in reqs], axis=0)
+        )
+        cap = self.admission.bucket_cap(self.ladder)
+        t0 = time.perf_counter()
+        try:
+            pieces = []
+            padded_total = 0
+            # rows == 0 (a valid empty query) still dispatches one
+            # all-pad bucket so the result carries the endpoint's real
+            # output shape/dtype with zero rows
+            starts = range(0, rows, cap) if rows else (0,)
+            for start in starts:
+                chunk = x[start:start + cap]
+                crows = chunk.shape[0]
+                bucket = self._bucket_for(crows)
+                pad = bucket - crows
+                if pad:
+                    chunk = np.concatenate(
+                        [chunk, np.zeros((pad, ep.features), dtype=ep.dtype)],
+                        axis=0,
+                    )
+                padded_total += pad
+                prog = self._program(name, ep, bucket)
+                out = prog(jnp.asarray(chunk), *ep.params)
+                pieces.append(np.asarray(out)[:crows])
+            result = pieces[0] if len(pieces) == 1 else np.concatenate(
+                pieces, axis=0
+            )
+        except Exception as e:  # noqa: BLE001 — per-batch failure isolation
+            # the guard already retried transients per batch; whatever
+            # reaches here is terminal for THESE requests only — the
+            # batcher thread (and every other queued request) lives on
+            st.record_error(len(reqs))
+            if telemetry.enabled():
+                reg = telemetry.get_registry()
+                reg.add("serve.failed_requests", len(reqs))
+                reg.emit(
+                    "serve", name, event="batch_failed",
+                    requests=len(reqs), rows=rows, error=repr(e),
+                )
+            for r in reqs:
+                _resolve(r.future, exc=e)
+            return
+        dt = time.perf_counter() - t0
+        st.record_batch(rows, padded_total)
+        now = time.perf_counter()
+        tel = telemetry.enabled()
+        reg = telemetry.get_registry() if tel else None
+        if tel:
+            reg.add("serve.batches", 1)
+            reg.add("serve.batch_rows", rows)
+            reg.add("serve.padded_rows", padded_total)
+            reg.emit(
+                "serve_batch", name, rows=rows, requests=len(reqs),
+                padded_rows=padded_total, seconds=dt,
+                queue_depth=self._queue.qsize(),
+                occupancy=rows / max(rows + padded_total, 1),
+            )
+        off = 0
+        for r in reqs:
+            piece = result[off:off + r.rows]
+            off += r.rows
+            latency = now - r.t_submit
+            st.record_done(latency)
+            if tel:
+                reg.emit(
+                    "serve_request", name, seconds=latency, rows=r.rows,
+                    ok=True,
+                )
+            _resolve(r.future, piece[0] if r.squeeze else piece)

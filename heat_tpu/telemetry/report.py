@@ -66,6 +66,8 @@ def summarize(
             watermarks = dict(reg.watermarks)
 
     phases: dict = {}
+    serve_rows: dict = {}
+    serve_span: list = [None, None]  # [first ts, last ts] of serve traffic
     pc_retraces: dict = {}
     res_events: dict = {}
     plan_counts: dict = {}
@@ -120,6 +122,39 @@ def summarize(
                           "temp_bytes", "budget", "reason")
                 if k in ev
             }
+        elif kind in ("serve_request", "serve_batch", "serve"):
+            what = ev.get("event")
+            if kind == "serve" and what not in ("shed", "batch_failed"):
+                continue  # warmup/degrade events are not per-endpoint rows
+            row = serve_rows.setdefault(
+                ev.get("name"),
+                {"requests": 0, "errors": 0, "shed": 0, "batches": 0,
+                 "rows": 0, "padded_rows": 0, "latencies": []},
+            )
+            ts = ev.get("ts")
+            if ts is not None:
+                if serve_span[0] is None or ts < serve_span[0]:
+                    serve_span[0] = ts
+                if serve_span[1] is None or ts > serve_span[1]:
+                    serve_span[1] = ts
+                t0, t1 = row.get("_ts0"), row.get("_ts1")
+                if t0 is None or ts < t0:
+                    row["_ts0"] = ts
+                if t1 is None or ts > t1:
+                    row["_ts1"] = ts
+            if kind == "serve_request":
+                row["requests"] += 1
+                if not ev.get("ok", True):
+                    row["errors"] += 1
+                row["latencies"].append(float(ev.get("seconds", 0.0)))
+            elif kind == "serve_batch":
+                row["batches"] += 1
+                row["rows"] += int(ev.get("rows", 0) or 0)
+                row["padded_rows"] += int(ev.get("padded_rows", 0) or 0)
+            elif what == "shed":
+                row["shed"] += 1
+            else:  # batch_failed
+                row["errors"] += int(ev.get("requests", 1) or 1)
         elif kind == "hlo_audit":
             hlo_audits += 1
             drift = int(ev.get("drift", 0) or 0)
@@ -157,6 +192,58 @@ def summarize(
             "predicted_bytes": plan_wire,
             "last": plan_last,
         }
+    if serve_rows:
+        # serving view (heat_tpu/serve, ISSUE 8): per-endpoint QPS and
+        # latency percentiles over the event window, batch occupancy,
+        # shed/error tallies. QPS spans the endpoint's own first→last
+        # event; exact percentiles here (the offline aggregate holds the
+        # full latency list — the server's live histogram quantizes).
+        # Absent when no serve event was recorded, so non-serving
+        # summaries keep their exact shape.
+        window = (
+            (serve_span[1] - serve_span[0])
+            if serve_span[0] is not None else 0.0
+        )
+        eps = {}
+        for name, row in serve_rows.items():
+            lats = sorted(row.pop("latencies"))
+            # per-endpoint QPS over the ENDPOINT'S own first→last event
+            # span (two tenants active at different times must not dilute
+            # each other's rate)
+            ep_window = (
+                (row.pop("_ts1") - row.pop("_ts0"))
+                if "_ts0" in row else 0.0
+            )
+
+            def q(p, _l=lats):
+                return _l[min(len(_l) - 1, int(p * len(_l)))] if _l else None
+
+            out_row = dict(row)
+            if lats:
+                out_row["p50_s"] = round(q(0.50), 6)
+                out_row["p95_s"] = round(q(0.95), 6)
+                out_row["p99_s"] = round(q(0.99), 6)
+                out_row["mean_s"] = round(sum(lats) / len(lats), 6)
+            if row["requests"] and ep_window > 0:
+                out_row["qps"] = round(row["requests"] / ep_window, 2)
+            if row["batches"]:
+                denom = row["rows"] + row["padded_rows"]
+                out_row["mean_batch_rows"] = round(
+                    row["rows"] / row["batches"], 3
+                )
+                out_row["occupancy"] = round(
+                    row["rows"] / denom if denom else 1.0, 4
+                )
+            eps[name] = out_row
+        out["serving"] = {
+            "endpoints": eps,
+            "requests": sum(r["requests"] for r in serve_rows.values()),
+            "window_seconds": round(window, 4),
+        }
+        if watermarks and "serve.queue_depth" in watermarks:
+            out["serving"]["peak_queue_depth"] = int(
+                watermarks["serve.queue_depth"]
+            )
     if hlo_audits:
         # ground-truth emitted collectives (telemetry/hlo.py) next to the
         # analytic phases — only present when the auditor actually ran, so

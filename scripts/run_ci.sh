@@ -619,6 +619,117 @@ EOF
     fi
 fi
 
+# Serving gate (ISSUE 8): a short open-loop Poisson run against a live
+# heat_tpu.serve server, three phases —
+#   clean:  ZERO program-registry misses and ZERO backend compiles after
+#           warmup() (the zero-compile steady-state acceptance oracle),
+#           no failures, p99 under a generous bound, post-load probe ok;
+#   retry:  one injected transient per serve site with retries armed —
+#           the guarded per-batch retry must absorb every fault
+#           (retries>=1, no gave_up, zero failed requests) and the
+#           response digest must be BIT-IDENTICAL to the clean run;
+#   shed:   the same faults with retries DISARMED — the affected batches
+#           shed cleanly (failed>=1, futures resolve with the error, no
+#           hang) and the server recovers (post_ok). calls=6 lands the
+#           injection past the 5 warmup executions of the --max-batch 16
+#           ladder (buckets 1,2,4,8,16), i.e. on the first load batches.
+# HEAT_TPU_CI_SKIP_SERVING=1 opts out.
+if [ -z "${HEAT_TPU_CI_SKIP_SERVING:-}" ]; then
+    echo "=== serving gate: open-loop load vs live server (4-device mesh) ==="
+    serve_rc=0
+    serve_clean=$(mktemp); serve_retry=$(mktemp); serve_shed=$(mktemp)
+    SERVE_ARGS="--n 2048 --features 32 --mesh 4 --requests 240 --rate 400 --max-batch 16 --digest"
+    if env -u HEAT_TPU_FAULTS -u HEAT_TPU_RETRIES HEAT_TPU_TELEMETRY=1 \
+            python benchmarks/serving/heat_tpu.py $SERVE_ARGS > "$serve_clean" \
+       && HEAT_TPU_TELEMETRY=1 HEAT_TPU_RETRIES=3 HEAT_TPU_RETRY_BASE=0.01 \
+            HEAT_TPU_FAULTS='serve.*:kind=reset:calls=6' \
+            python benchmarks/serving/heat_tpu.py $SERVE_ARGS > "$serve_retry" \
+       && env -u HEAT_TPU_RETRIES HEAT_TPU_TELEMETRY=1 \
+            HEAT_TPU_FAULTS='serve.*:kind=resource:calls=6' \
+            python benchmarks/serving/heat_tpu.py $SERVE_ARGS > "$serve_shed"; then
+        python - "$serve_clean" "$serve_retry" "$serve_shed" <<'EOF' || serve_rc=$?
+import json, sys
+
+def parse(path):
+    cmp_, summary = None, None
+    for line in open(path):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            obj = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if "serving_compare" in obj:
+            cmp_ = obj["serving_compare"]
+        if obj.get("bench") == "serving":
+            summary = obj
+    if cmp_ is None or summary is None:
+        raise SystemExit(f"serving: missing serving_compare/summary in {path}")
+    return cmp_, summary
+
+clean, clean_sum = parse(sys.argv[1])
+retry, retry_sum = parse(sys.argv[2])
+shed, _ = parse(sys.argv[3])
+
+# clean phase: zero-compile steady state + SLO
+if clean["misses_during_load"] != 0 or clean["backend_compiles_during_load"] != 0:
+    raise SystemExit(
+        f"serving: steady state recompiled after warmup "
+        f"(misses={clean['misses_during_load']}, "
+        f"backend_compiles={clean['backend_compiles_during_load']})"
+    )
+if clean["failed"] or not clean["post_ok"]:
+    raise SystemExit(f"serving: clean run failed requests: {clean}")
+p99 = clean["latency"].get("p99_s")
+if p99 is None or p99 > 2.0:
+    raise SystemExit(f"serving: clean p99 {p99}s exceeds the 2s CI bound")
+res = clean_sum.get("telemetry", {}).get("resilience")
+if res:
+    raise SystemExit(f"serving: fault-free run carries resilience counters {res}")
+
+# retry phase: per-batch retries absorb the faults, answers bit-identical
+rres = retry_sum.get("telemetry", {}).get("resilience") or {}
+if rres.get("retries", 0) < 1:
+    raise SystemExit(f"serving: injected faults produced no retries: {rres}")
+if rres.get("gave_up", 0) or retry["failed"]:
+    raise SystemExit(f"serving: retry phase lost requests: {retry} {rres}")
+if retry["digest"] != clean["digest"]:
+    raise SystemExit(
+        f"serving: fault-injected digest diverged from clean "
+        f"({retry['digest']} != {clean['digest']}) — retries not transparent"
+    )
+
+# shed phase: retries disarmed -> affected batches shed, server recovers
+if shed["failed"] < 1:
+    raise SystemExit(f"serving: shed phase absorbed faults with no retries armed? {shed}")
+if not shed["post_ok"]:
+    raise SystemExit(f"serving: server did not recover after shedding: {shed}")
+print(
+    f"serving ok: 0 recompiles, p99={p99}s, qps={clean['achieved_qps']} "
+    f"(offered {clean['offered_rate']}), retry digest bit-identical "
+    f"(retries={rres.get('retries')}), shed-and-recover "
+    f"(failed={shed['failed']}, post_ok)"
+)
+EOF
+    else
+        serve_rc=$?
+    fi
+    if [ -n "$REPORT" ]; then
+        cp "$serve_clean" "${REPORT}/serving_clean.jsonl" || true
+        cp "$serve_retry" "${REPORT}/serving_retry.jsonl" || true
+        cp "$serve_shed" "${REPORT}/serving_shed.jsonl" || true
+    fi
+    rm -f "$serve_clean" "$serve_retry" "$serve_shed"
+    if [ "$serve_rc" != 0 ]; then
+        log_resilience "kind=serving verdict=FAIL rc=${serve_rc}"
+        echo "=== serving gate FAILED (rc=$serve_rc) ==="
+        FAILED_SIZES="$FAILED_SIZES serving"
+    else
+        log_resilience "kind=serving verdict=ok phases='clean retry shed' sites='serve.*'"
+    fi
+fi
+
 if [ "$have_coverage" = 1 ]; then
     # merge the per-size coverage files, as the reference CI merges its
     # 8 mpirun passes (Jenkinsfile:33-44 / codecov)

@@ -64,3 +64,29 @@ def test_runner_smoke(runner, extra):
     summary = lines[-1]
     assert summary["trials"] == 2 and summary["best_seconds"] > 0
     assert summary["devices"]["count"] == 2
+
+
+@pytest.mark.slow
+def test_serving_runner_smoke():
+    """The serving loadgen runner (ISSUE 8): zero registry misses during
+    the load window, no failures, the bench-honesty pair on the summary.
+    Slow-marked (fresh-process jax import + fit + load, ~12s); the CI
+    serving gate exercises the same runner end to end every sweep."""
+    r = _run([
+        sys.executable, "benchmarks/serving/heat_tpu.py",
+        "--n", "512", "--features", "8", "--mesh", "2",
+        "--requests", "40", "--rate", "400", "--max-batch", "4",
+        "--endpoints", "kmeans,dense", "--digest",
+    ])
+    assert r.returncode == 0, r.stderr[-800:]
+    lines = [json.loads(l) for l in r.stdout.strip().splitlines()]
+    cmp_ = next(l["serving_compare"] for l in lines
+                if "serving_compare" in l)
+    assert cmp_["misses_during_load"] == 0
+    assert cmp_["failed"] == 0 and cmp_["shed"] == 0
+    assert cmp_["post_ok"] is True
+    assert len(cmp_["digest"]) == 64
+    summary = next(l for l in lines if l.get("bench") == "serving")
+    assert summary["on_chip"] is False
+    assert isinstance(summary["cpu_fallback"], str)
+    assert summary["achieved_qps"] > 0
