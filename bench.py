@@ -77,18 +77,23 @@ _PEAK_BF16_TFLOPS = {
 }
 
 
-def _probe_platform(retries=2, timeout=100):
+def _probe_platform(retries=2, timeout=45, budget=120):
     """Probe backend init via the shared hang-safe subprocess helper.
 
     Returns (platform_or_None, diagnostics): the platform name when init
-    succeeds, None after exhausting retries. Budget contract (round-5): the
-    WHOLE probe phase (both rounds + cooldown) stays under ~6.5 min worst
-    case — round 4 burned ~25 min of the driver's budget on probes alone
-    (BENCH_r04 rc=124) before any benching started.
+    succeeds, None after exhausting retries. Budget contract (ISSUE 9
+    satellite, tightening round-5): the WHOLE probe phase is wall-capped
+    at ``budget`` seconds per round — BENCH_r04 burned ~25 min on 10 x
+    150 s probe timeouts + a 180 s cooldown before any benching started
+    (rc=124). Two 45 s attempts answer the only question that matters
+    ("does a backend come up at all") fast enough that the CPU fallback
+    engages with the driver budget intact.
     """
     from heat_tpu.utils.backend_probe import probe_default_platform
 
-    plat, _n, diags = probe_default_platform(retries=retries, timeout=timeout)
+    plat, _n, diags = probe_default_platform(
+        retries=retries, timeout=timeout, budget=budget
+    )
     return plat, diags
 
 
@@ -793,7 +798,10 @@ def main():
                     default=float(os.environ.get("HEAT_TPU_BENCH_COOLDOWN", "60")),
                     help="seconds to sleep before the second probe round when "
                          "the first exhausts its retries (a wedged accelerator "
-                         "tunnel can need minutes to recycle)")
+                         "tunnel can need minutes to recycle). Applied only "
+                         "when round 1 saw a TIMEOUT-class failure — a probe "
+                         "that fails fast means no backend is there at all, "
+                         "and sleeping on it was the r4 budget burn")
     ap.add_argument("--budget", type=float,
                     default=float(os.environ.get("HEAT_TPU_BENCH_BUDGET", "1500")),
                     help="total wall-clock budget in seconds (probe included); "
@@ -810,7 +818,12 @@ def main():
     platform = None
     if not args.no_probe:
         platform, diags = _probe_platform()
-        if platform is None and args.cooldown > 0:
+        # only a TIMEOUT-class round-1 failure suggests a wedged-but-present
+        # accelerator worth waiting out; a probe that fails FAST (rc!=0 —
+        # "no backend here") gains nothing from a cooldown and the r4 run
+        # burned its budget sleeping on exactly that (ISSUE 9 satellite)
+        hang_like = any("TimeoutExpired" in d for d in diags)
+        if platform is None and args.cooldown > 0 and hang_like:
             # round 2 after a cool-down: a wedged tunnel often recovers once
             # the stale endpoint is recycled (r3's probe gave up too early).
             # Flush round-1 diagnostics BEFORE sleeping so a driver watching
@@ -822,12 +835,24 @@ def main():
             time.sleep(args.cooldown)
             platform, diags2 = _probe_platform(retries=1)
             diags += diags2
+        elif platform is None and not hang_like:
+            diags.append(
+                "no cooldown: round-1 failures were fast (no backend "
+                "present), not hangs — falling back to cpu immediately"
+            )
         for d in diags:
             print(json.dumps({"probe": d}), file=sys.stderr, flush=True)
         if platform is None:
             os.environ["JAX_PLATFORMS"] = "cpu"
             fallback = small = True
-            errors["backend"] = "default platform init failed; fell back to cpu"
+            # the LAST probe diagnostic rides in the reason string so the
+            # headline's cpu_fallback field says WHY the probe failed, not
+            # just that it did (ISSUE 9 satellite)
+            last_diag = diags[-1] if diags else "no probe attempts ran"
+            errors["backend"] = (
+                "default platform init failed "
+                f"(probe: {last_diag}); fell back to cpu"
+            )
         elif platform == "cpu":
             small = True  # healthy CPU-only host: shrink, but not an error
 
@@ -957,6 +982,17 @@ def main():
                 detail["relayout_plan"] = _rp.bench_field()
             except Exception as e:  # noqa: BLE001
                 detail["relayout_plan"] = {"error": repr(e)}
+            # wire-bytes-vs-accuracy frontier (ISSUE 9, schema in
+            # docs/BENCHMARKS.md): per HEAT_TPU_COLLECTIVE_PREC mode, the
+            # analytic + HLO-audited wire bytes of the canonical resplit
+            # and the executed max relative error vs the exact program.
+            # The honest on_chip bit above governs this field too.
+            try:
+                from heat_tpu.core import collective_prec as _cp
+
+                detail["collective_prec"] = _cp.bench_field()
+            except Exception as e:  # noqa: BLE001
+                detail["collective_prec"] = {"error": repr(e)}
         print(json.dumps(detail), file=sys.stderr, flush=True)
 
         # honesty bit (VERDICT r5 #9, schema in docs/BENCHMARKS.md): the

@@ -156,8 +156,21 @@ def main():
     import jax.numpy as jnp
 
     post_ok = True
+    # GEMM-mode sweep check (ISSUE 9 satellite / ROADMAP PR 8 remaining):
+    # when the run serves the fast GEMM kernels (HEAT_TPU_SERVE_EXACT=0),
+    # every endpoint's probe answer must still be allclose to the
+    # bit-stable exact-mode kernel's answer for the same inputs — the
+    # digest of the exact-mode references is recorded so two sweeps can
+    # be compared across processes.
+    import hashlib
+
+    gemm_mode = not ht.serve.endpoints.exact_mode()
+    exact_check = {
+        "gemm_mode": gemm_mode, "checked": 0, "allclose": True,
+        "max_abs_diff": 0.0, "exact_digest": hashlib.sha256(),
+    }
     probe_rng = np.random.default_rng(args.seed + 1)
-    for name, ep in eps.items():
+    for name, ep in sorted(eps.items()):
         probe = probe_rng.standard_normal((2, ep.features)).astype(ep.dtype)
         try:
             got = server.predict(name, probe, timeout=30.0)
@@ -170,11 +183,36 @@ def main():
         ref = np.asarray(jax.jit(ep.build())(jnp.asarray(probe), *ep.params))
         if got.tobytes() != ref.tobytes():
             post_ok = False
+        # exact-kernel twin of the same endpoint (same params, exact=True)
+        exact_ep = ht.serve.Endpoint(
+            ep.kind, ep.params, {**ep.config, "exact": True},
+            features=ep.features, dtype=ep.dtype,
+        )
+        exact_ref = np.asarray(
+            jax.jit(exact_ep.build())(jnp.asarray(probe), *exact_ep.params)
+        )
+        exact_check["checked"] += 1
+        exact_check["exact_digest"].update(exact_ref.tobytes())
+        if exact_ref.dtype.kind in "fc":
+            diff = float(np.max(np.abs(got.astype(np.float64)
+                                       - exact_ref.astype(np.float64))))
+            exact_check["max_abs_diff"] = max(
+                exact_check["max_abs_diff"], diff
+            )
+            if not np.allclose(got, exact_ref, rtol=1e-4, atol=1e-5):
+                exact_check["allclose"] = False
+        elif got.tobytes() != exact_ref.tobytes():
+            # label-valued endpoints: GEMM-vs-exact may legally flip a
+            # tie-break only at exactly-equidistant probes; random probes
+            # are never equidistant, so labels must match outright
+            exact_check["allclose"] = False
+    exact_check["exact_digest"] = exact_check["exact_digest"].hexdigest()[:16]
 
     compare = {
         "misses_during_load": after["misses"] - before["misses"],
         "backend_compiles_during_load": cw.backend_compiles,
         "post_ok": post_ok,
+        "exact_check": exact_check,
         **{k: v for k, v in report.items()
            if k not in ("digest",) or args.digest},
     }
@@ -189,6 +227,8 @@ def main():
         "max_batch": args.max_batch,
         "achieved_qps": report["achieved_qps"],
         "p99_s": report["latency"].get("p99_s"),
+        "serve_exact_mode": not gemm_mode,
+        "exact_check": {k: v for k, v in exact_check.items()},
         "on_chip": on_chip,
         "cpu_fallback": cpu_fallback,
         "devices": {"count": len(devs), "kind": devs[0].device_kind},

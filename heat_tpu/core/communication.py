@@ -244,12 +244,18 @@ class MeshCommunication(Communication):
         itemsize: int,
         old_split: Optional[int],
         new_split: Optional[int],
+        precision: str = "off",
     ) -> "telemetry.collectives.CollectiveCost":
         """Analytic collective kind + wire bytes of a relayout on this mesh
         (telemetry/collectives.py — the observability analog of the
-        reference's explicit Alltoallv volume)."""
+        reference's explicit Alltoallv volume). ``precision`` prices the
+        compressed-wire program (ISSUE 9); callers pass the *effective*
+        wire mode they resolved for the payload's dtype."""
+        from . import collective_prec
+
         return telemetry.collectives.relayout_cost(
-            gshape, itemsize, old_split, new_split, self.size
+            gshape, itemsize, old_split, new_split, self.size,
+            precision=precision, block=collective_prec.block_size(),
         )
 
     # -- explicit collectives (for hand-written shard_map kernels) -----------
@@ -261,6 +267,15 @@ class MeshCommunication(Communication):
     # traced closure per invocation (the ring kernels) misses the cache
     # and re-emits on every call, so trace-event counts are per-trace,
     # not per-program.
+    #
+    # ``precision`` (ISSUE 9, HEAT_TPU_COLLECTIVE_PREC): every payload-
+    # moving wrapper compresses its wire payload under the resolved mode
+    # (global knob, or the per-call override). Float payloads only —
+    # integer/bool payloads (indices, counts, sort keys) always move
+    # exact — and exactness-critical kernels pin ``precision="off"`` at
+    # their call site. The wire mode is part of the traced program, so
+    # callers caching programs built over these wrappers must key on
+    # ``collective_prec.effective(dtype)``.
 
     def _coll(self, name: str, fn, *args, **kwargs):
         """One collective wrapper body: with the resilience subsystem armed
@@ -273,11 +288,26 @@ class MeshCommunication(Communication):
             return resilience.guarded_call(f"collective.{name}", fn, args, kwargs)
         return fn(*args, **kwargs)
 
-    def psum(self, x):
-        telemetry.trace_event("psum", axis=self.__axis)
+    def _wire(self, x, precision: Optional[str]) -> str:
+        """The effective wire mode for one payload (off for non-floats)."""
+        from . import collective_prec
+
+        return collective_prec.effective(x.dtype, precision)
+
+    def psum(self, x, precision: Optional[str] = None):
+        from . import collective_prec
+
+        wire = self._wire(x, precision)
+        telemetry.trace_event("psum", axis=self.__axis, wire=wire)
+        if wire != "off":
+            return self._coll(
+                "psum", collective_prec.psum, x, self.__axis, self.size, wire,
+            )
         return self._coll("psum", jax.lax.psum, x, self.__axis)
 
     def pmax(self, x):
+        # extremes are exactness-critical (argmin/argmax tie-breaking,
+        # guard thresholds) — never compressed
         telemetry.trace_event("pmax", axis=self.__axis)
         return self._coll("pmax", jax.lax.pmax, x, self.__axis)
 
@@ -288,23 +318,60 @@ class MeshCommunication(Communication):
     def axis_index(self):
         return jax.lax.axis_index(self.__axis)
 
-    def all_gather(self, x, tiled: bool = True):
-        telemetry.trace_event("all_gather", axis=self.__axis)
+    def all_gather(self, x, tiled: bool = True,
+                   precision: Optional[str] = None):
+        from . import collective_prec
+
+        wire = self._wire(x, precision)
+        telemetry.trace_event("all_gather", axis=self.__axis, wire=wire)
+        if wire != "off":
+            return self._coll(
+                "all_gather", collective_prec.all_gather, x, self.__axis,
+                wire, tiled=tiled,
+            )
         return self._coll("all_gather", jax.lax.all_gather, x, self.__axis, tiled=tiled)
 
-    def ppermute(self, x, perm):
-        telemetry.trace_event("ppermute", axis=self.__axis)
+    def ppermute(self, x, perm, precision: Optional[str] = None):
+        from . import collective_prec
+
+        wire = self._wire(x, precision)
+        telemetry.trace_event("ppermute", axis=self.__axis, wire=wire)
+        if wire != "off":
+            return self._coll(
+                "ppermute", collective_prec.ppermute, x, self.__axis, perm,
+                wire,
+            )
         return self._coll("ppermute", jax.lax.ppermute, x, self.__axis, perm=perm)
 
-    def ring_permute(self, x, shift: int = 1):
+    def ring_permute(self, x, shift: int = 1,
+                     precision: Optional[str] = None):
         """Circulate shards around the ring: position i sends to i+shift."""
         n = self.size
         perm = [(i, (i + shift) % n) for i in range(n)]
-        telemetry.trace_event("ppermute", axis=self.__axis, ring_shift=shift)
+        from . import collective_prec
+
+        wire = self._wire(x, precision)
+        telemetry.trace_event(
+            "ppermute", axis=self.__axis, ring_shift=shift, wire=wire
+        )
+        if wire != "off":
+            return self._coll(
+                "ppermute", collective_prec.ppermute, x, self.__axis, perm,
+                wire,
+            )
         return self._coll("ppermute", jax.lax.ppermute, x, self.__axis, perm=perm)
 
-    def all_to_all(self, x, split_axis: int, concat_axis: int):
-        telemetry.trace_event("all_to_all", axis=self.__axis)
+    def all_to_all(self, x, split_axis: int, concat_axis: int,
+                   precision: Optional[str] = None):
+        from . import collective_prec
+
+        wire = self._wire(x, precision)
+        telemetry.trace_event("all_to_all", axis=self.__axis, wire=wire)
+        if wire != "off":
+            return self._coll(
+                "all_to_all", collective_prec.all_to_all, x, self.__axis,
+                self.size, split_axis, concat_axis, wire,
+            )
         return self._coll(
             "all_to_all", jax.lax.all_to_all, x, self.__axis,
             split_axis=split_axis, concat_axis=concat_axis, tiled=True,

@@ -338,6 +338,7 @@ class DNDarray:
     def _relayout(
         self, new_split: Optional[int], *, audit: bool = False,
         donate: bool = False, audit_site: str = "relayout",
+        precision: Optional[str] = None,
     ) -> jax.Array:
         """Physical buffer re-laid-out to the canonical layout of
         ``new_split``: ONE cached compiled program (logical slice, tail
@@ -370,16 +371,25 @@ class DNDarray:
         instead execute as a decomposed plan — an explicit all-to-all
         kernel or a bounded-memory chain of chunk programs
         (core/relayout_planner.py). ``auto`` with no budget never plans:
-        this method stays the single-dict-lookup monolithic dispatch."""
+        this method stays the single-dict-lookup monolithic dispatch.
+
+        ``precision`` (ISSUE 9, ``HEAT_TPU_COLLECTIVE_PREC``): the wire
+        payload of the relayout collective is compressed under the
+        resolved mode — quantize, reshard the compressed tensor, dequant
+        in the destination layout, all in the same cached program. The
+        effective wire mode is part of the program signature (and of the
+        HLO-audit prediction), so modes key separate cache entries and
+        ``off`` dispatches the exact pre-knob program."""
+        wire = self._wire_mode(new_split, precision)
         plan = self._relayout_plan(new_split)
         _cost, fields, do_audit = telemetry.op_cost(
             self.__comm.relayout_cost, self.__gshape,
-            self.__dtype.byte_size(), self.__split, new_split,
+            self.__dtype.byte_size(), self.__split, new_split, wire,
             audit=audit, use_global=False,
         )
         decomposed = plan is not None and plan.kind != "monolithic"
         if do_audit and not decomposed:
-            self._audit_relayout(new_split, site=audit_site)
+            self._audit_relayout(new_split, site=audit_site, wire=wire)
         if telemetry.enabled():
             if decomposed:
                 fields = dict(fields, plan=plan.kind, stages=plan.chunks)
@@ -388,9 +398,34 @@ class DNDarray:
                 gshape=list(self.__gshape), **fields,
             ) as sp:
                 return sp.output(
-                    self.__relayout_impl(new_split, donate, plan, do_audit)
+                    self.__relayout_impl(
+                        new_split, donate, plan, do_audit, wire
+                    )
                 )
-        return self.__relayout_impl(new_split, donate, plan, do_audit)
+        return self.__relayout_impl(new_split, donate, plan, do_audit, wire)
+
+    def _wire_mode(
+        self, new_split: Optional[int], precision: Optional[str] = None
+    ) -> str:
+        """The effective collective-compression mode for this relayout:
+        the resolved knob/override, demoted to ``off`` for non-float
+        dtypes and for layouts that move no payload over the wire
+        (1-position meshes, same-split, replicated sources — a local
+        slice)."""
+        from . import collective_prec
+
+        if (
+            self.__comm.size <= 1
+            or new_split == self.__split
+            or self.__split is None
+        ):
+            # still VALIDATE an explicit override (typos must not pass
+            # silently just because this layout happens to be local)
+            collective_prec.resolve(precision)
+            return "off"
+        return collective_prec.effective(
+            self.__dtype.jnp_type(), precision
+        )
 
     def _relayout_plan(self, new_split: Optional[int]):
         """Consult the relayout planner (None on the unplanned fast
@@ -414,7 +449,9 @@ class DNDarray:
             new_split, self.__comm, measure=measure,
         )
 
-    def _audit_relayout(self, new_split: Optional[int], site: str):
+    def _audit_relayout(
+        self, new_split: Optional[int], site: str, wire: str = "off"
+    ):
         """Ground-truth the relayout: lower-and-compile the equivalent
         single XLA program (slice → re-pad → re-shard, the same steps as
         :meth:`__relayout_impl`) and record the emitted collectives diffed
@@ -441,9 +478,11 @@ class DNDarray:
         for ax in (self.__split, new_split):
             if ax is not None:
                 phys_shape[ax] = comm.padded_size(gshape[ax])
+        from . import collective_prec
+
         phys_cost = telemetry.collectives.relayout_cost(
             phys_shape, self.__dtype.byte_size(), self.__split, new_split,
-            comm.size,
+            comm.size, precision=wire, block=collective_prec.block_size(),
         )
         from . import program_cache
 
@@ -451,37 +490,55 @@ class DNDarray:
         # executes, under the same registry signature — one program, one key
         return hlo.audit_call(
             site,
-            lambda: (self.__relayout_program(new_split), (buf,)),
+            lambda: (self.__relayout_program(new_split, wire=wire), (buf,)),
             predicted=phys_cost,
             key=program_cache.program_key(
-                "relayout", self._relayout_key(new_split), comm=comm
+                "relayout", self._relayout_key(new_split, wire), comm=comm
             ),
             fields={"old_split": self.__split, "new_split": new_split,
-                    "gshape": list(gshape)},
+                    "gshape": list(gshape), "wire": wire},
         )
 
-    def _relayout_key(self, new_split: Optional[int]) -> tuple:
-        """Static-config portion of the relayout program signature."""
+    def _relayout_key(
+        self, new_split: Optional[int], wire: str = "off"
+    ) -> tuple:
+        """Static-config portion of the relayout program signature. The
+        effective collective-compression mode is part of it — a bf16-wire
+        and an exact relayout are different programs (ISSUE 9)."""
         return (
-            self.__gshape, str(self.__array.dtype), self.__split, new_split
+            self.__gshape, str(self.__array.dtype), self.__split, new_split,
+            wire,
         )
 
-    def _relayout_executable(self, new_split: Optional[int], donate: bool = False):
+    def _relayout_executable(
+        self, new_split: Optional[int], donate: bool = False,
+        precision: Optional[str] = None,
+    ):
         """The cached monolithic relayout program (for AOT consumers:
         memory_guard budgeting, the planner's measured-need decision, the
-        bench `relayout_plan` probe, tests). Building it never traces or
-        executes."""
-        return self.__relayout_program(new_split, donate)
+        bench `relayout_plan` / `collective_prec` probes, tests). Building
+        it never traces or executes."""
+        return self.__relayout_program(
+            new_split, donate, wire=self._wire_mode(new_split, precision)
+        )
 
-    def __relayout_program(self, new_split: Optional[int], donate: bool = False):
+    def __relayout_program(
+        self, new_split: Optional[int], donate: bool = False,
+        wire: str = "off",
+    ):
         """The cached compiled relayout program for this layout signature:
-        logical slice → tail re-pad → canonical ``out_shardings``."""
+        logical slice → tail re-pad → canonical ``out_shardings``. With a
+        compressed wire mode the re-shard happens on the quantized tensor
+        (collective_prec.gspmd_reshard): the emitted collective moves the
+        compressed dtype, and dequantization lands in the destination
+        layout inside the same program."""
         from . import program_cache
 
         comm = self.__comm
         gshape = self.__gshape
         pshape = comm.padded_shape(gshape, new_split)
         pad_count = self.pad_count
+        src_split = self.__split
         if comm.size > 1:
             tgt = (
                 comm.sharding(new_split, len(gshape))
@@ -492,6 +549,24 @@ class DNDarray:
             tgt = None
 
         def build():
+            if wire != "off":
+                from . import collective_prec
+
+                blk = collective_prec.block_size()
+
+                def compressed_relayout(b):
+                    if pad_count != 0:
+                        b = b[tuple(slice(0, g) for g in gshape)]
+                    if tuple(b.shape) != pshape:
+                        b = jnp.pad(
+                            b, [(0, p - s) for p, s in zip(pshape, b.shape)]
+                        )
+                    return collective_prec.gspmd_reshard(
+                        b, comm, src_split, new_split, wire, blk
+                    )
+
+                return compressed_relayout
+
             def relayout_program(b):
                 if pad_count != 0:
                     b = b[tuple(slice(0, g) for g in gshape)]
@@ -504,13 +579,13 @@ class DNDarray:
             return relayout_program
 
         return program_cache.cached_program(
-            "relayout", self._relayout_key(new_split), build, comm=comm,
-            out_shardings=tgt, donate=(0,) if donate else (),
+            "relayout", self._relayout_key(new_split, wire), build,
+            comm=comm, out_shardings=tgt, donate=(0,) if donate else (),
         )
 
     def __relayout_impl(
         self, new_split: Optional[int], donate: bool = False,
-        plan=None, audit: bool = False,
+        plan=None, audit: bool = False, wire: str = "off",
     ) -> jax.Array:
         buf = self.larray
         pshape = self.__comm.padded_shape(self.__gshape, new_split)
@@ -535,9 +610,9 @@ class DNDarray:
             from . import relayout_planner
 
             return relayout_planner.run(
-                plan, buf, self.__comm, audit=audit
+                plan, buf, self.__comm, audit=audit, wire=wire
             )
-        fn = self.__relayout_program(new_split, donate)
+        fn = self.__relayout_program(new_split, donate, wire)
         return fn(buf)
 
     def _replicated(self) -> jax.Array:
@@ -717,10 +792,15 @@ class DNDarray:
         self.__lshape_map = None
         return self
 
-    def resplit(self, axis: Optional[int] = None, *, audit: bool = False) -> "DNDarray":
+    def resplit(
+        self, axis: Optional[int] = None, *, audit: bool = False,
+        precision: Optional[str] = None,
+    ) -> "DNDarray":
         from . import manipulations
 
-        return manipulations.resplit(self, axis, audit=audit)
+        return manipulations.resplit(
+            self, axis, audit=audit, precision=precision
+        )
 
     def redistribute_(self, lshape_map=None, target_map=None) -> None:
         """API-parity shim (reference dndarray.py:1007 reshuffles to an

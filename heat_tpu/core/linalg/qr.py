@@ -81,7 +81,9 @@ def _gram_ring(buf: jax.Array, comm, audit_cost=None) -> jax.Array:
         if overlap:
             def body(t, carry):
                 circ, acc = carry
-                cnext = comm.ring_permute(circ)
+                # the Gram/Cholesky factorization amplifies wire error
+                # quadratically — the QR rings never compress
+                cnext = comm.ring_permute(circ, precision="off")
                 acc = tile_into(t, circ, acc)
                 return cnext, acc
 
@@ -93,7 +95,7 @@ def _gram_ring(buf: jax.Array, comm, audit_cost=None) -> jax.Array:
                 acc = tile_into(t, circ, acc)
                 # the comm wrapper (not raw lax.ppermute) so the hop is
                 # named in telemetry's trace-time collective record
-                circ = comm.ring_permute(circ)
+                circ = comm.ring_permute(circ, precision="off")
                 return circ, acc
 
             _, acc = jax.lax.fori_loop(0, p, body, (xt_blk, acc0))

@@ -534,7 +534,8 @@ def reshape(a: DNDarray, *shape, new_split: Optional[int] = None) -> DNDarray:
 
 
 def resplit(
-    arr: DNDarray, axis: Optional[int] = None, *, audit: bool = False
+    arr: DNDarray, axis: Optional[int] = None, *, audit: bool = False,
+    precision: Optional[str] = None,
 ) -> DNDarray:
     """Out-of-place redistribution to a new split axis (reference
     manipulations.py:3351). One compiled relayout — multi-host safe.
@@ -544,11 +545,19 @@ def resplit(
     (the primitive) nests under it. With ``audit=True`` (or the global
     ``HEAT_TPU_HLO_AUDIT=1`` opt-in) the equivalent program is also
     lower-compiled and the collectives XLA actually emitted are diffed
-    against the analytic prediction — docs/OBSERVABILITY.md."""
+    against the analytic prediction — docs/OBSERVABILITY.md.
+
+    ``precision`` (ISSUE 9): per-call collective-compression override —
+    ``"off"``/``"bf16"``/``"int8"``/``"blockwise"`` — defaulting to the
+    global ``HEAT_TPU_COLLECTIVE_PREC`` knob. Compressed modes move the
+    relayout payload at the reduced wire dtype (docs/TUNING_RUNBOOK.md
+    §0.11 has the accuracy contract); float dtypes only, ``off`` is
+    bit-identical to the unknobbed op."""
     axis = sanitize_axis(arr.shape, axis)
+    wire = arr._wire_mode(axis, precision)
     _cost, fields, do_audit = telemetry.op_cost(
         arr.comm.relayout_cost, arr.shape, arr.dtype.byte_size(),
-        arr.split, axis, audit=audit,
+        arr.split, axis, wire, audit=audit,
     )
     # the audit site rides down into the primitive: a monolithic plan is
     # audited once as "resplit", a planner-decomposed plan once per stage
@@ -559,10 +568,15 @@ def resplit(
             gshape=list(arr.shape), **fields,
         ) as sp:
             buf = sp.output(
-                arr._relayout(axis, audit=do_audit, audit_site="resplit")
+                arr._relayout(
+                    axis, audit=do_audit, audit_site="resplit",
+                    precision=precision,
+                )
             )
     else:
-        buf = arr._relayout(axis, audit=do_audit, audit_site="resplit")
+        buf = arr._relayout(
+            axis, audit=do_audit, audit_site="resplit", precision=precision
+        )
     return DNDarray(buf, arr.shape, arr.dtype, axis, arr.device, arr.comm, True)
 
 
@@ -738,8 +752,10 @@ def _oddeven_sort_physical(a: DNDarray, axis: int, descending: bool):
         me = comm.axis_index()
 
         def exchange(perm, vv, ii):
-            ov = comm.ppermute(vv, perm)
-            oi = comm.ppermute(ii, perm)
+            # sort circulates the VALUES being ordered — a lossy wire
+            # (HEAT_TPU_COLLECTIVE_PREC) would corrupt them, so pin exact
+            ov = comm.ppermute(vv, perm, precision="off")
+            oi = comm.ppermute(ii, perm, precision="off")
             mv = jnp.concatenate([vv, ov], axis=axis)
             mi = jnp.concatenate([ii, oi], axis=axis)
             return jax.lax.sort((mv, mi), dimension=axis, num_keys=2, is_stable=False)
@@ -1381,8 +1397,9 @@ def _distributed_unique_rows(a: DNDarray, return_inverse: bool):
         v, i = lexsort_block(v, i)
 
         def exchange(perm, vv, ii):
-            ov = comm.ppermute(vv, perm)
-            oi = comm.ppermute(ii, perm)
+            # exact-value circulation (see the sort-network note above)
+            ov = comm.ppermute(vv, perm, precision="off")
+            oi = comm.ppermute(ii, perm, precision="off")
             return lexsort_block(
                 jnp.concatenate([vv, ov], axis=0),
                 jnp.concatenate([ii, oi], axis=0),
@@ -1450,8 +1467,9 @@ def _distributed_unique_rows_packed(a: DNDarray, return_inverse: bool):
         k, i = lexsort_block(k, i)
 
         def exchange(perm, kk, ii):
-            ov = comm.ppermute(kk, perm)
-            oi = comm.ppermute(ii, perm)
+            # exact-value circulation (see the sort-network note above)
+            ov = comm.ppermute(kk, perm, precision="off")
+            oi = comm.ppermute(ii, perm, precision="off")
             return lexsort_block(
                 jnp.concatenate([kk, ov], axis=0),
                 jnp.concatenate([ii, oi], axis=0),
@@ -1575,8 +1593,8 @@ DNDarray.expand_dims = lambda self, axis: expand_dims(self, axis)
 DNDarray.flatten = lambda self: flatten(self)
 DNDarray.ravel = lambda self: ravel(self)
 DNDarray.reshape = lambda self, *shape, new_split=None: reshape(self, *shape, new_split=new_split)
-DNDarray.resplit = lambda self, axis=None, audit=False: resplit(
-    self, axis, audit=audit
+DNDarray.resplit = lambda self, axis=None, audit=False, precision=None: resplit(
+    self, axis, audit=audit, precision=precision
 )
 DNDarray.squeeze = lambda self, axis=None: squeeze(self, axis)
 DNDarray.unique = lambda self, sorted=False, return_inverse=False, axis=None: unique(

@@ -511,14 +511,29 @@ def _init_program(plan_: RelayoutPlan, comm, dtype_str: str):
     )
 
 
-def _stage_key(plan_: RelayoutPlan, stage: PlanStage, dtype_str: str):
+def _wire_for(dtype_str: str, wire: Optional[str]) -> str:
+    """Effective collective-compression mode for a stage payload
+    (ISSUE 9): the caller-resolved wire mode, demoted to off for
+    non-float dtypes."""
+    from . import collective_prec
+
+    if not wire or wire == "off":
+        return "off"
+    return collective_prec.effective(dtype_str, wire)
+
+
+def _stage_key(
+    plan_: RelayoutPlan, stage: PlanStage, dtype_str: str, wire: str = "off"
+):
     return (
         plan_.gshape, dtype_str, plan_.src_split, plan_.dst_split,
-        stage.lo, stage.hi,
+        stage.lo, stage.hi, wire,
     )
 
 
-def _stage_program(plan_: RelayoutPlan, stage: PlanStage, comm, dtype_str):
+def _stage_program(
+    plan_: RelayoutPlan, stage: PlanStage, comm, dtype_str, wire: str = "off"
+):
     from . import program_cache
 
     gshape = plan_.gshape
@@ -526,6 +541,7 @@ def _stage_program(plan_: RelayoutPlan, stage: PlanStage, comm, dtype_str):
     ax = plan_.chunk_axis
     lo, hi = stage.lo, stage.hi
     tgt = _dst_sharding(comm, plan_.dst_split, nd)
+    src_split = plan_.src_split
 
     def build():
         sl = tuple(
@@ -540,18 +556,29 @@ def _stage_program(plan_: RelayoutPlan, stage: PlanStage, comm, dtype_str):
             # logical slice of the source (drops the src tail pad), then
             # one placed update into the destination-layout accumulator;
             # the block is destination-shard-aligned, so XLA emits exactly
-            # one all-gather of the chunk (per-stage HLO audit pins this)
-            return jax.lax.dynamic_update_slice(acc, src[sl], starts)
+            # one all-gather of the chunk (per-stage HLO audit pins this).
+            # Under a compressed wire mode the chunk is quantized with ONE
+            # per-chunk scale (narrow chunks make blockwise scale overhead
+            # comparable to the payload) and the gather moves int8/bf16.
+            chunk = src[sl]
+            if wire != "off":
+                from . import collective_prec
+
+                chunk = collective_prec.gspmd_reshard(
+                    chunk, comm, src_split, None,
+                    "bf16" if wire == "bf16" else "int8",
+                )
+            return jax.lax.dynamic_update_slice(acc, chunk, starts)
 
         return stage_fn
 
     return program_cache.cached_program(
-        "relayout_chunk", _stage_key(plan_, stage, dtype_str), build,
+        "relayout_chunk", _stage_key(plan_, stage, dtype_str, wire), build,
         comm=comm, out_shardings=tgt, donate=(1,),
     )
 
 
-def _a2a_program(plan_: RelayoutPlan, comm, dtype_str):
+def _a2a_program(plan_: RelayoutPlan, comm, dtype_str, wire: str = "off"):
     from . import program_cache
 
     gshape = plan_.gshape
@@ -562,12 +589,15 @@ def _a2a_program(plan_: RelayoutPlan, comm, dtype_str):
     def build():
         def kernel(b):
             # local t-pad up to the padded extent, then one all-to-all,
-            # then a local slice back to the logical s extent
+            # then a local slice back to the logical s extent; the comm
+            # wrapper compresses the payload under the stage's wire mode
             widths = [(0, 0)] * nd
             widths[t] = (0, pad_t - b.shape[t])
             if pad_t != b.shape[t]:
                 b = jnp.pad(b, widths)
-            out = comm.all_to_all(b, split_axis=t, concat_axis=s)
+            out = comm.all_to_all(
+                b, split_axis=t, concat_axis=s, precision=wire
+            )
             sl = [slice(None)] * nd
             sl[s] = slice(0, gshape[s])
             return out[tuple(sl)]
@@ -578,41 +608,54 @@ def _a2a_program(plan_: RelayoutPlan, comm, dtype_str):
         )
 
     return program_cache.cached_program(
-        "relayout_a2a", (gshape, dtype_str, s, t), build, comm=comm,
+        "relayout_a2a", (gshape, dtype_str, s, t, wire), build, comm=comm,
     )
 
 
-def run(plan_: RelayoutPlan, buf: jax.Array, comm, *, audit: bool = False):
+def run(
+    plan_: RelayoutPlan, buf: jax.Array, comm, *, audit: bool = False,
+    wire: str = "off",
+):
     """Execute a decomposed plan on a physical source buffer; returns the
     destination-layout physical buffer. Each stage is its own cached
     program (structural signature + resilience guard); ``audit=True``
     lower-compiles every distinct stage once and diffs the emitted
     collectives against the per-stage analytic cost (memoized —
-    ``relayout_stage`` records in `telemetry.hlo.recent()`)."""
+    ``relayout_stage`` records in `telemetry.hlo.recent()`). ``wire`` is
+    the caller-resolved collective-compression mode (ISSUE 9): stage
+    payloads move compressed, stage keys and the per-stage audit
+    predictions carry the mode."""
     from . import program_cache
 
     dtype_str = str(buf.dtype)
+    wire = _wire_for(dtype_str, wire)
     if plan_.kind == "alltoall":
-        fn = _a2a_program(plan_, comm, dtype_str)
+        fn = _a2a_program(plan_, comm, dtype_str, wire)
         if audit:
             phys = list(plan_.gshape)
             for axx in (plan_.src_split, plan_.dst_split):
                 if axx is not None:
                     phys[axx] = -(-phys[axx] // comm.size) * comm.size
+            from . import collective_prec
+
+            # the shard_map a2a kernel quantizes per outgoing slab —
+            # scales ride their own all-to-all, the per-slab max-abs is
+            # local — a2a_kernel_cost mirrors the wrapper byte-for-byte
+            a2a_cost = telemetry.collectives.a2a_kernel_cost(
+                phys, plan_.itemsize, comm.size, precision=wire,
+                block=collective_prec.block_size(),
+            )
             telemetry.hlo.audit_call(
                 "relayout_stage",
                 lambda: (fn, (buf,)),
-                predicted=telemetry.collectives.relayout_cost(
-                    phys, plan_.itemsize, plan_.src_split, plan_.dst_split,
-                    comm.size,
-                ),
+                predicted=a2a_cost,
                 key=program_cache.program_key(
                     "relayout_a2a",
                     (plan_.gshape, dtype_str, plan_.src_split,
-                     plan_.dst_split),
+                     plan_.dst_split, wire),
                     comm=comm,
                 ),
-                fields={"plan": "alltoall"},
+                fields={"plan": "alltoall", "wire": wire},
             )
         return fn(buf)
     if plan_.kind != "chunked":
@@ -620,19 +663,33 @@ def run(plan_: RelayoutPlan, buf: jax.Array, comm, *, audit: bool = False):
             f"run() executes decomposed plans; got {plan_.kind!r} "
             "(monolithic dispatches through DNDarray._relayout directly)"
         )
+    # chunk stages always use per-chunk (per-tensor) scales, so blockwise
+    # and int8 build IDENTICAL programs — demote before keying so a mode
+    # sweep shares one cache entry per stage instead of recompiling
+    if wire == "blockwise":
+        wire = "int8"
     acc = _init_program(plan_, comm, dtype_str)()
     for stage in plan_.stages:
-        fn = _stage_program(plan_, stage, comm, dtype_str)
+        fn = _stage_program(plan_, stage, comm, dtype_str, wire)
         if audit:
+            predicted = stage.cost
+            if wire != "off":
+                predicted = telemetry.collectives.relayout_chunk_cost(
+                    plan_.gshape, plan_.itemsize, plan_.src_split,
+                    plan_.dst_split, stage.hi - stage.lo, comm.size,
+                    precision=wire,
+                )
             telemetry.hlo.audit_call(
                 "relayout_stage",
                 (lambda fn=fn, acc=acc: (fn, (buf, acc))),
-                predicted=stage.cost,
+                predicted=predicted,
                 key=program_cache.program_key(
-                    "relayout_chunk", _stage_key(plan_, stage, dtype_str),
+                    "relayout_chunk",
+                    _stage_key(plan_, stage, dtype_str, wire),
                     comm=comm, donate=(1,),
                 ),
-                fields={"plan": "chunked", "lo": stage.lo, "hi": stage.hi},
+                fields={"plan": "chunked", "lo": stage.lo, "hi": stage.hi,
+                        "wire": wire},
             )
         acc = fn(buf, acc)
     return acc
@@ -668,16 +725,24 @@ def bench_field(gshape: Tuple[int, ...] = (4096, 64), itemsize: int = 4) -> dict
         x = factories.zeros(gshape, dtype=types.float32, split=0, comm=comm)
         buf = x.larray
         dtype_str = str(buf.dtype)
+        # the probe audits the very programs the active policy would
+        # dispatch — collective-compression wire mode included (ISSUE 9)
+        from . import collective_prec
+
+        wire = collective_prec.effective(dtype_str)
+        field["wire"] = wire
         audited = 0
         if pl.kind == "chunked":
+            # same demotion as run(): chunk stages key blockwise as int8
+            stage_wire = "int8" if wire == "blockwise" else wire
             acc = _init_program(pl, comm, dtype_str)()
             for stage in pl.stages:
-                fn = _stage_program(pl, stage, comm, dtype_str)
+                fn = _stage_program(pl, stage, comm, dtype_str, stage_wire)
                 audited += telemetry.hlo.audit_computation(
                     fn, buf, acc
                 ).total_wire()
         elif pl.kind == "alltoall":
-            fn = _a2a_program(pl, comm, dtype_str)
+            fn = _a2a_program(pl, comm, dtype_str, wire)
             audited = telemetry.hlo.audit_computation(fn, buf).total_wire()
         else:
             fn = x._relayout_executable(pl.dst_split)

@@ -272,7 +272,8 @@ def bincount(x: DNDarray, weights: Optional[DNDarray] = None, minlength: int = 0
 
         def kernel(vals, w):
             h = jnp.zeros((nbins,), dtype=acc).at[vals].add(w.astype(acc))
-            return comm.psum(h)
+            # histogram counts are exact by contract — never compressed
+            return comm.psum(h, precision="off")
 
         spec = comm.spec(0, 1)
         hist = jax.shard_map(
@@ -353,7 +354,7 @@ def _hist_distributed(x: DNDarray, edges: np.ndarray, weights):
         h, _ = jnp.histogram(
             vals.ravel().astype(jnp.float64), bins=edges, weights=w.ravel()
         )
-        return comm.psum(h)
+        return comm.psum(h, precision="off")  # exact counts
 
     spec = comm.spec(x.split, x.ndim)
     return jax.shard_map(

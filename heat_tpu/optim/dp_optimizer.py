@@ -126,6 +126,13 @@ class DASO:
         dropped payload by construction).
     checkpoint_path : str, optional
         Checkpoint directory for the auto-hook (atomically swapped).
+    collective_precision : str, optional
+        Per-instance override of the ``HEAT_TPU_COLLECTIVE_PREC``
+        collective-compression knob (ISSUE 9) for the cross-node
+        parameter average: ``off`` keeps the historic ``downcast_type``
+        wire cast (bf16 by default); ``bf16`` is that exact program;
+        ``int8``/``blockwise`` run the EQuARX two-phase quantized node
+        psum instead (docs/TUNING_RUNBOOK.md §0.11).
     """
 
     def __init__(
@@ -146,6 +153,7 @@ class DASO:
         verbose: bool = False,
         checkpoint_every: Optional[int] = None,
         checkpoint_path: Optional[str] = None,
+        collective_precision: Optional[str] = None,
     ):
         if checkpoint_every is not None:
             if checkpoint_every <= 0:
@@ -208,6 +216,11 @@ class DASO:
             np.asarray(devices).reshape(n_nodes, self.n_local), ("node", "local")
         )
         self.cast_dtype = downcast_type
+        if collective_precision is not None:
+            from ..core import collective_prec
+
+            collective_prec.resolve(collective_precision)  # validate early
+        self._collective_precision = collective_precision
         self.scheduler = scheduler
         self.verbose = verbose
         self.total_epochs = total_epochs
@@ -334,17 +347,39 @@ class DASO:
     def _get_global_send(self):
         if "send" in self._compiled:
             return self._compiled["send"]
+        from ..core import collective_prec
+
         mesh = self.mesh
         cast = self.cast_dtype
+        n_nodes = self.n_nodes
+        # ISSUE 9: the cross-node wire rides the collective-precision layer.
+        # off        -> the historic path: downcast_type on the wire (bf16
+        #               by default — the reference's custom MPI bf16 sum).
+        # bf16       -> IDENTICAL program to off-with-bf16-downcast (the
+        #               DASO equivalence test pins this): pmean over the
+        #               ICI axis, cast, psum over the DCN axis, payload
+        #               left in bf16 for the merge to upcast.
+        # int8/blockwise -> EQuARX two-phase quantized node psum
+        #               (collective_prec.psum); payload returns in f32.
+        wire = collective_prec.resolve(self._collective_precision)
+        block = collective_prec.block_size()
 
         def kernel(params):
             params = jax.tree.map(lambda x: x[0], params)
-            # node representative: mean over the ICI axis, bf16 on the wire,
-            # summed (not averaged) across nodes — the reference transmits
-            # the raw sum and folds n_nodes into the merge denominator
+            # node representative: mean over the ICI axis, reduced
+            # precision on the wire, summed (not averaged) across nodes —
+            # the reference transmits the raw sum and folds n_nodes into
+            # the merge denominator
             def one(x):
-                rep = jax.lax.pmean(x, "local").astype(cast)
-                return jax.lax.psum(rep, "node")[None]
+                rep = jax.lax.pmean(x, "local")
+                if wire in ("int8", "blockwise") and (
+                    collective_prec.compressible(x.dtype)
+                ):
+                    return collective_prec.psum(
+                        rep, "node", n_nodes, wire, block
+                    )[None]
+                wire_cast = jnp.bfloat16 if wire == "bf16" else cast
+                return jax.lax.psum(rep.astype(wire_cast), "node")[None]
 
             return jax.tree.map(one, params)
 
@@ -357,7 +392,7 @@ class DASO:
             )(params)
 
         compiled = program_cache.cached_program(
-            "daso_send", (mesh, str(cast)), lambda: send
+            "daso_send", (mesh, str(cast), wire), lambda: send
         )
         self._compiled["send"] = compiled
         return compiled

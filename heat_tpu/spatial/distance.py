@@ -173,8 +173,14 @@ def _ring_dist(
     # block_fn is a module-level function (stable identity), so the ring
     # program is shared across calls of the same kernel + layout family;
     # the schedule is part of the signature — serial and double-buffered
-    # kernels never share a program
-    key = (block_fn, cy, n_cols, "overlap" if overlap else "serial")
+    # kernels never share a program. The collective-compression wire mode
+    # (ISSUE 9 — the circulating y-block is re-quantized per hop under
+    # HEAT_TPU_COLLECTIVE_PREC) is part of it too: modes key separate
+    # programs and repeat dispatch per mode stays zero-recompile.
+    from ..core import collective_prec
+
+    wire = collective_prec.effective(ym.dtype)
+    key = (block_fn, cy, n_cols, "overlap" if overlap else "serial", wire)
     smapped = program_cache.cached_program(
         "ring_cdist", key,
         lambda: jax.shard_map(
@@ -278,9 +284,13 @@ def _dist(
 
         p_ring = x.comm.size
         hops = p_ring - 1 if relayout_planner.ring_overlap() else p_ring
+        from ..core import collective_prec
+
+        ring_wire = collective_prec.effective(promoted.jnp_type())
         cost, fields, do_audit = telemetry.op_cost(
             telemetry.collectives.ring_cdist_cost, n, x.shape[1],
-            promoted.byte_size(), x.comm.size, hops, audit=audit,
+            promoted.byte_size(), x.comm.size, hops, ring_wire,
+            collective_prec.block_size(), audit=audit,
         )
         with telemetry.span(
             "ring_cdist", gshape=[m, n], mesh=x.comm.size,

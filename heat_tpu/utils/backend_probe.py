@@ -47,25 +47,57 @@ _PROBE_CODE = "import jax; d = jax.devices(); print('PROBE', d[0].platform, len(
 
 
 def probe_default_platform(
-    retries: int = 1, timeout: float = 150.0
+    retries: int = 1, timeout: float = 150.0, budget: Optional[float] = None
 ) -> Tuple[Optional[str], int, List[str]]:
     """Probe the default JAX platform in a subprocess.
 
     Returns ``(platform, device_count, diagnostics)`` — ``platform`` is None
     when every attempt failed (crash, timeout, unparseable output).
+
+    ``budget`` caps the WHOLE probe phase's wall time (timeouts, backoffs
+    and all): once it is exhausted, remaining attempts are skipped and the
+    skip is named in the diagnostics. BENCH_r04 burned ~25 min of driver
+    budget on 10 x 150 s probe timeouts before any benching started — the
+    budget makes that class of run impossible by construction.
     """
     diags: List[str] = []
+    t0 = time.monotonic()
+
+    def left() -> Optional[float]:
+        return None if budget is None else budget - (time.monotonic() - t0)
+
     for attempt in range(retries):
+        # budget check BEFORE any backoff sleep: a sleep must never burn
+        # the remaining budget for an attempt that would then be skipped
+        if budget is not None and left() < 1.0:
+            diags.append(
+                f"attempt {attempt}: skipped (probe budget {budget:.0f}s "
+                "exhausted)"
+            )
+            break
         if attempt:
             # a wedged accelerator tunnel can take minutes to recycle —
-            # back off hard rather than burning the attempts in 10s
-            time.sleep(min(30 * attempt, 120))
+            # back off rather than burning the attempts in 10s (but never
+            # past the phase budget: leave time for the attempt itself)
+            backoff = min(30 * attempt, 120)
+            if budget is not None:
+                backoff = min(backoff, max(0.0, left() - 1.0))
+            time.sleep(backoff)
+            if budget is not None and left() < 1.0:
+                diags.append(
+                    f"attempt {attempt}: skipped (probe budget "
+                    f"{budget:.0f}s exhausted)"
+                )
+                break
+        t = timeout
+        if budget is not None:
+            t = min(timeout, max(1.0, left()))
         try:
             r = subprocess.run(
                 [sys.executable, "-c", _PROBE_CODE],
                 capture_output=True,
                 text=True,
-                timeout=timeout,
+                timeout=t,
             )
             toks = r.stdout.split()
             if r.returncode == 0 and "PROBE" in toks:

@@ -534,6 +534,150 @@ EOF
     fi
 fi
 
+# Collective-precision step (ISSUE 9): resplit + DP-step microbench under
+# every HEAT_TPU_COLLECTIVE_PREC mode on the 4-device mesh. Gates:
+#   (a) the HLO-audited emitted wire bytes of each compressed program
+#       match the analytic compressed prediction (zero drift), and the
+#       audited byte REDUCTION clears the acceptance floor — resplit
+#       >=1.9x under bf16 and >=3.5x under int8/blockwise, DP gradient
+#       all-reduce >=3.5x under int8/blockwise (the CPU backend
+#       legalizes a bf16 all-reduce payload to f32, so bf16-DP only
+#       gates "not worse"; the true 2x is the resplit's, whose bf16
+#       payload travels as its u16 bit pattern);
+#   (b) HEAT_TPU_COLLECTIVE_PREC=off (the default) stays BIT-identical
+#       to the unknobbed baseline;
+#   (c) each mode's executed error stays within the pinned bound.
+# HEAT_TPU_CI_SKIP_COLLPREC=1 opts out.
+if [ -z "${HEAT_TPU_CI_SKIP_COLLPREC:-}" ]; then
+    echo "=== collective-precision step: quantized wire audit (4-device mesh) ==="
+    collprec_rc=0
+    collprec_out=$(mktemp)
+    XLA_FLAGS="--xla_force_host_platform_device_count=4" JAX_PLATFORMS=cpu \
+        python - <<'EOF' > "$collprec_out" 2>&1 || collprec_rc=$?
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import heat_tpu as ht
+from heat_tpu.telemetry import collectives, hlo
+
+comm = ht.get_comm()
+p = comm.size
+assert p == 4, f"expected a 4-device mesh, got {p}"
+MODES = ("off", "bf16", "int8", "blockwise")
+rng = np.random.default_rng(0)
+report = {"mesh": p}
+
+# -- resplit microbench ------------------------------------------------------
+shape = (4096, 256)
+xn = rng.standard_normal(shape).astype(np.float32)
+x = ht.array(xn, split=0)
+baseline = x.resplit(1).numpy()
+assert baseline.tobytes() == xn.tobytes(), "exact resplit corrupted data"
+wires, errs = {}, {}
+for m in MODES:
+    fn = x._relayout_executable(1, precision=m)
+    aud = hlo.audit_computation(fn, x.larray)
+    phys = [comm.padded_size(shape[0]), comm.padded_size(shape[1])]
+    pred = collectives.relayout_cost(phys, 4, 0, 1, p, precision=m)
+    rep = hlo.compare(aud, pred)
+    if not rep.ok:
+        raise SystemExit(
+            f"collective-prec: {m} resplit audit drifted: "
+            f"{json.dumps(rep.summary())}"
+        )
+    wires[m] = aud.total_wire()
+    out = np.asarray(fn(x.larray))
+    errs[m] = float(np.abs(out - baseline).max() / np.abs(xn).max())
+if baseline.tobytes() != np.asarray(
+    x._relayout_executable(1, precision="off")(x.larray)
+).tobytes():
+    raise SystemExit("collective-prec: off mode is not bit-identical")
+for m, floor in (("bf16", 1.9), ("int8", 3.5), ("blockwise", 3.5)):
+    got = wires["off"] / wires[m]
+    if got < floor:
+        raise SystemExit(
+            f"collective-prec: resplit {m} audited reduction {got:.2f}x "
+            f"below the {floor}x floor ({wires})"
+        )
+bounds = {"off": 0.0, "bf16": 2.0 ** -7, "int8": 1.05 / 127,
+          "blockwise": 1.05 / 127}
+for m in MODES:
+    if errs[m] > bounds[m]:
+        raise SystemExit(
+            f"collective-prec: resplit {m} error {errs[m]:.5f} over the "
+            f"pinned bound {bounds[m]:.5f}"
+        )
+report["resplit"] = {"wire_bytes": wires, "max_rel_err": errs}
+
+# -- DP-step microbench ------------------------------------------------------
+D = 512
+xb = rng.standard_normal((128, D)).astype(np.float32)
+yb = rng.standard_normal((128, 1)).astype(np.float32)
+
+def loss_fn(params, bx, by):
+    return jnp.mean((bx @ params["w"] - by) ** 2)
+
+dp_wires, dp_final = {}, {}
+for m in MODES:
+    dp = ht.nn.DataParallel(
+        lambda pr, bx: bx @ pr["w"], optimizer=optax.sgd(0.05),
+        blocking_parameter_updates=True,
+    )
+    params = {"w": jnp.zeros((D, 1))}
+    opt_state = optax.sgd(0.05).init(params)
+    step = dp.make_train_step(loss_fn, optax.sgd(0.05), precision=m)
+    batch = dp.shard_batch(xb, yb)
+    aud = hlo.audit_computation(step, params, opt_state, *batch)
+    dp_wires[m] = aud.total_wire()
+    if m in ("int8", "blockwise"):
+        pred = collectives.allreduce_cost(D, 4, p, precision=m)
+        loss_ar = collectives.allreduce_cost(1, 4, p)
+        rep = hlo.compare(aud, collectives.CollectiveCost(
+            pred.kind + "+all-reduce", pred.bytes + loss_ar.bytes
+        ))
+        if not rep.ok:
+            raise SystemExit(
+                f"collective-prec: {m} DP-step audit drifted: "
+                f"{json.dumps(rep.summary())}"
+            )
+    for _ in range(8):
+        params, opt_state, loss = step(params, opt_state, *batch)
+    dp_final[m] = np.asarray(params["w"])
+for m, floor in (("int8", 3.5), ("blockwise", 3.5)):
+    got = dp_wires["off"] / dp_wires[m]
+    if got < floor:
+        raise SystemExit(
+            f"collective-prec: DP {m} audited reduction {got:.2f}x below "
+            f"the {floor}x floor ({dp_wires})"
+        )
+if dp_wires["bf16"] > dp_wires["off"]:
+    raise SystemExit(
+        f"collective-prec: bf16 DP wire not smaller than off ({dp_wires})"
+    )
+for m in ("bf16", "int8", "blockwise"):
+    drift = float(np.abs(dp_final[m] - dp_final["off"]).max())
+    if drift > 5e-2:
+        raise SystemExit(
+            f"collective-prec: {m} DP trajectory drifted {drift} from "
+            "exact after 8 steps"
+        )
+report["dp_step"] = {"wire_bytes": dp_wires}
+print(json.dumps({"collective_prec": "ok", **report}))
+EOF
+    cat "$collprec_out"
+    if [ -n "$REPORT" ]; then
+        cp "$collprec_out" "${REPORT}/collective_prec_gate.log" || true
+    fi
+    rm -f "$collprec_out"
+    if [ "$collprec_rc" != 0 ]; then
+        echo "=== collective-precision step FAILED (rc=$collprec_rc) ==="
+        FAILED_SIZES="$FAILED_SIZES collective-prec"
+    fi
+fi
+
 # Chaos step (ISSUE 5): run the resplit microbenchmark twice — fault-free,
 # then under deterministic fault injection (one synthetic transient per
 # matched site: the relayout dispatch and every collective wrapper) with
