@@ -33,13 +33,23 @@ from typing import Dict, Optional, Sequence
 
 __all__ = [
     "CollectiveCost",
+    "DEFAULT_WIRE_BLOCK",
+    "compression_factor",
     "relayout_cost",
     "relayout_chunk_cost",
+    "a2a_kernel_cost",
     "ring_cdist_cost",
     "tsqr_cost",
     "gram_ring_cost",
     "fusion_reduce_cost",
+    "allreduce_cost",
 ]
+
+# Blockwise collective-compression scale granularity (ISSUE 9): one f32
+# scale per this many payload elements. Kept here (the import-light leaf
+# module) so the cost model and heat_tpu.core.collective_prec share one
+# default without a dependency cycle.
+DEFAULT_WIRE_BLOCK = 128
 
 
 @dataclass(frozen=True)
@@ -69,12 +79,51 @@ def _numel(gshape: Sequence[int]) -> int:
     return n
 
 
+def compression_factor(
+    itemsize: int, precision: str, block: int = DEFAULT_WIRE_BLOCK
+) -> float:
+    """Bytes-on-wire per logical byte for one compressed payload
+    (``HEAT_TPU_COLLECTIVE_PREC``, ISSUE 9): ``off`` 1.0; ``bf16`` a
+    2-byte wire element; ``int8`` a 1-byte wire element; ``blockwise``
+    int8 plus one bf16 scale per ``block`` elements. Never above 1.0 —
+    a payload narrower than the wire dtype moves as-is."""
+    itemsize = int(itemsize)
+    if precision == "bf16":
+        return min(1.0, 2.0 / itemsize)
+    if precision == "int8":
+        return min(1.0, 1.0 / itemsize)
+    if precision == "blockwise":
+        return min(1.0, (1.0 + 2.0 / int(block)) / itemsize)
+    return 1.0
+
+
+# The scalar max all-reduce a per-tensor GSPMD quantization pays to learn
+# the global max-abs: one f32 scalar, ring all-reduce model.
+def _amax_allreduce_bytes(nproc: int) -> int:
+    return 2 * 4 * (nproc - 1)
+
+
+def _gspmd_blockwise(gshape: Sequence[int], old_split, block: int):
+    """Mirror of collective_prec's GSPMD blockwise applicability + segment
+    rule: blocks along the last axis (must exist and be unsharded), even
+    ``block``-sized segments only when they divide the axis, else one
+    whole-row segment. Returns (applicable, n_scale_elements)."""
+    ndim = len(gshape)
+    if ndim < 2 or old_split == ndim - 1 or int(gshape[-1]) <= 0:
+        return False, 0
+    last = int(gshape[-1])
+    nb = last // block if (last >= block and last % block == 0) else 1
+    return True, (_numel(gshape) // last) * nb
+
+
 def relayout_cost(
     gshape: Sequence[int],
     itemsize: int,
     old_split: Optional[int],
     new_split: Optional[int],
     nproc: int,
+    precision: str = "off",
+    block: int = DEFAULT_WIRE_BLOCK,
 ) -> CollectiveCost:
     """Cost of the canonical relayout (`DNDarray._relayout` /
     `manipulations.resplit`) from ``old_split`` to ``new_split``.
@@ -86,15 +135,47 @@ def relayout_cost(
     * split s → split t (s ≠ t): **all-to-all** — each device keeps the
       1/p of its shard destined for itself and sends the rest:
       ``B · (p-1)/p`` total (the analytic all-to-all volume).
+
+    ``precision`` (ISSUE 9, ``HEAT_TPU_COLLECTIVE_PREC``) prices the
+    compressed-wire program instead: the payload moves at the compressed
+    dtype, and the scale machinery's own (small) collectives are named in
+    the compound ``kind`` — ``+all-reduce`` for the per-tensor max-abs
+    scalar (``int8``, and ``blockwise`` degraded on shapes whose block
+    axis is the sharded one), ``+all-gather`` for the replicated
+    blockwise scales. Mirrors ``collective_prec.gspmd_reshard`` exactly
+    so the HLO audit of a compressed relayout stays zero-drift.
     """
     b = _numel(gshape) * int(itemsize)
     if nproc <= 1 or old_split == new_split:
         return CollectiveCost("none", 0)
     if old_split is None:
         return CollectiveCost("local-slice", 0)
-    if new_split is None:
-        return CollectiveCost("all-gather", b * (nproc - 1))
-    return CollectiveCost("all-to-all", (b * (nproc - 1)) // nproc)
+    kind = "all-gather" if new_split is None else "all-to-all"
+
+    def payload(nbytes: int) -> int:
+        if kind == "all-gather":
+            return nbytes * (nproc - 1)
+        return (nbytes * (nproc - 1)) // nproc
+
+    if precision == "off" or int(itemsize) <= 1:
+        return CollectiveCost(kind, payload(b))
+    if precision == "bf16":
+        wire = min(int(itemsize), 2)
+        return CollectiveCost(kind, payload(_numel(gshape) * wire))
+    if precision == "blockwise":
+        ok, n_scales = _gspmd_blockwise(gshape, old_split, block)
+        if ok:
+            # blockwise scales are shard-local, replicated by one small
+            # all-gather (same op as the payload when the payload gathers)
+            scale_bytes = n_scales * 2 * (nproc - 1)
+            pk = kind if kind == "all-gather" else kind + "+all-gather"
+            return CollectiveCost(pk, payload(_numel(gshape)) + scale_bytes)
+        precision = "int8"  # degraded: per-tensor scale
+    # int8 per-tensor: scalar max all-reduce for the global scale
+    return CollectiveCost(
+        kind + "+all-reduce",
+        payload(_numel(gshape)) + _amax_allreduce_bytes(nproc),
+    )
 
 
 def relayout_chunk_cost(
@@ -104,6 +185,8 @@ def relayout_chunk_cost(
     dst_split: int,
     width: int,
     nproc: int,
+    precision: str = "off",
+    block: int = DEFAULT_WIRE_BLOCK,
 ) -> CollectiveCost:
     """Cost of ONE stage of the planner's chunked relayout
     (:mod:`heat_tpu.core.relayout_planner`): a destination-shard-aligned
@@ -114,7 +197,13 @@ def relayout_chunk_cost(
     source buffer's tail pad along ``src_split`` (the bytes the program
     actually moves). Summed over a plan's stages this is ``~B·(p-1)`` —
     the wire premium the bounded-memory decomposition pays vs the
-    monolithic all-to-all's ``B·(p-1)/p``."""
+    monolithic all-to-all's ``B·(p-1)/p``.
+
+    ``precision`` (ISSUE 9): chunk stages always use per-chunk
+    (per-tensor) scales — a narrow chunk's last axis would make blockwise
+    scale overhead comparable to the payload — so ``int8`` and
+    ``blockwise`` price identically: int8 payload plus the scalar max
+    all-reduce."""
     if nproc <= 1:
         return CollectiveCost("none", 0)
     other = 1
@@ -125,12 +214,59 @@ def relayout_chunk_cost(
         if d == src_split:
             s = math.ceil(s / nproc) * nproc
         other *= s
-    chunk = other * int(width) * int(itemsize)
-    return CollectiveCost("all-gather", chunk * (nproc - 1))
+    elems = other * int(width)
+    if precision == "bf16" and int(itemsize) > 2:
+        return CollectiveCost("all-gather", elems * 2 * (nproc - 1))
+    if precision in ("int8", "blockwise") and int(itemsize) > 1:
+        return CollectiveCost(
+            "all-gather+all-reduce",
+            elems * (nproc - 1) + _amax_allreduce_bytes(nproc),
+        )
+    return CollectiveCost("all-gather", elems * int(itemsize) * (nproc - 1))
+
+
+def a2a_kernel_cost(
+    phys_gshape: Sequence[int],
+    itemsize: int,
+    nproc: int,
+    precision: str = "off",
+    block: int = DEFAULT_WIRE_BLOCK,
+) -> CollectiveCost:
+    """Cost of the explicit shard_map all-to-all kernel
+    (core/relayout_planner ``alltoall`` plans, via the
+    ``MeshCommunication.all_to_all`` wrapper) on the PHYSICAL
+    (pad-inclusive) shape. Uncompressed it is the plain all-to-all
+    volume; compressed, each of the ``p`` outgoing slabs per device
+    (``m = numel/p²`` elements) is quantized independently — per-slab
+    scale for ``int8``, flat blocks of ``min(block, m)`` elements
+    zero-padded to whole blocks for ``blockwise`` — and the bf16 scales
+    ride their own (tiny) all-to-all. Mirrors
+    ``collective_prec.all_to_all`` byte-for-byte."""
+    numel = _numel(phys_gshape)
+    if nproc <= 1:
+        return CollectiveCost("none", 0)
+    if precision == "off" or int(itemsize) <= 1:
+        return CollectiveCost(
+            "all-to-all", (numel * int(itemsize) * (nproc - 1)) // nproc
+        )
+    if precision == "bf16":
+        wire = min(int(itemsize), 2)
+        return CollectiveCost(
+            "all-to-all", (numel * wire * (nproc - 1)) // nproc
+        )
+    m = numel // (nproc * nproc)
+    if precision == "int8":
+        nb, seg = 1, m
+    else:
+        seg = max(1, min(int(block), m))
+        nb = max(1, -(-m // seg))
+    per_dev = nproc * (nb * seg + nb * 2)  # padded int8 slabs + bf16 scales
+    return CollectiveCost("all-to-all", per_dev * (nproc - 1))
 
 
 def ring_cdist_cost(
-    n: int, k: int, itemsize: int, nproc: int, hops: Optional[int] = None
+    n: int, k: int, itemsize: int, nproc: int, hops: Optional[int] = None,
+    precision: str = "off", block: int = DEFAULT_WIRE_BLOCK,
 ) -> CollectiveCost:
     """Cost of the ppermute ring distance kernel
     (:func:`heat_tpu.spatial.distance._ring_dist`): the row-split ``y``
@@ -140,12 +276,28 @@ def ring_cdist_cost(
     count. ``hops`` defaults to ``p`` (the serial kernel's `fori_loop`
     permutes on every iteration, including the final hop that returns
     each block home); the double-buffered overlap kernel skips that dead
-    hop and passes ``hops = p - 1``."""
+    hop and passes ``hops = p - 1``.
+
+    ``precision`` (ISSUE 9): the circulating y-block is re-quantized
+    every hop, so each hop's permute moves the compressed payload plus
+    its scales — per-tensor (one f32 scalar, ``int8``) or flat blocks of
+    ``block`` elements zero-padded to a whole number of blocks
+    (``blockwise``). Both permutes are collective-permute instructions,
+    so the kind is unchanged."""
     if nproc <= 1:
         return CollectiveCost("none", 0)
     hops = nproc if hops is None else int(hops)
-    block = math.ceil(n / nproc) * int(k) * int(itemsize)
-    return CollectiveCost("ppermute-ring", nproc * hops * block, steps=hops)
+    elems = math.ceil(n / nproc) * int(k)
+    per_hop = elems * int(itemsize)
+    if precision == "bf16" and int(itemsize) > 2:
+        per_hop = elems * 2
+    elif precision == "int8" and int(itemsize) > 1:
+        per_hop = elems + 2  # int8 payload + one bf16 scale per hop
+    elif precision == "blockwise" and int(itemsize) > 1:
+        seg = max(1, min(int(block), elems))  # implementation clamps too
+        nb = max(1, -(-elems // seg))
+        per_hop = nb * seg + nb * 2  # padded int8 blocks + bf16 scales
+    return CollectiveCost("ppermute-ring", nproc * hops * per_hop, steps=hops)
 
 
 def tsqr_cost(m: int, n: int, itemsize: int, nproc: int) -> CollectiveCost:
@@ -198,3 +350,47 @@ def fusion_reduce_cost(
     return CollectiveCost(
         "all-reduce", 2 * _numel(out_gshape) * int(itemsize) * (nproc - 1)
     )
+
+
+def allreduce_cost(
+    numel: int,
+    itemsize: int,
+    nproc: int,
+    precision: str = "off",
+    block: int = DEFAULT_WIRE_BLOCK,
+) -> CollectiveCost:
+    """Cost of one all-reduce of a ``numel``-element payload under
+    ``HEAT_TPU_COLLECTIVE_PREC`` (ISSUE 9) — the DP gradient / DASO
+    node-sync primitive:
+
+    * ``off`` — XLA ring all-reduce, ``2·B·(p-1)``;
+    * ``bf16`` — the same all-reduce on a bf16 payload;
+    * ``int8``/``blockwise`` — the EQuARX two-phase form
+      (``collective_prec.psum``): an all-to-all of each device's
+      quantized partial (zero-padded to ``p`` chunks, blockwise also to
+      whole blocks) plus an all-gather of the requantized reduced
+      chunks, scales riding each phase. Mirrors the implementation
+      byte-for-byte so the HLO audit stays zero-drift.
+    """
+    numel, itemsize = int(numel), int(itemsize)
+    if nproc <= 1:
+        return CollectiveCost("none", 0)
+    if precision == "off" or itemsize <= 1 or (
+        precision == "bf16" and itemsize <= 2
+    ):
+        return CollectiveCost(
+            "all-reduce", 2 * numel * itemsize * (nproc - 1)
+        )
+    if precision == "bf16":
+        return CollectiveCost("all-reduce", 2 * numel * 2 * (nproc - 1))
+    chunk = -(-numel // nproc)
+    if precision == "blockwise":
+        blk = max(1, min(int(block), chunk))  # implementation clamps too
+        chunk = -(-chunk // blk) * blk
+        nb = chunk // blk
+    else:
+        nb = 1
+    numel_p = chunk * nproc
+    payload = 2 * numel_p * (nproc - 1)          # a2a phase + gather phase
+    scales = 2 * 2 * nproc * nb * (nproc - 1)    # bf16 scales, both phases
+    return CollectiveCost("all-to-all+all-gather", payload + scales)
