@@ -625,7 +625,9 @@ def bench_heat_tpu(errors, profile_dir=None, small=False, only=None,
         if on_row is not None:
             on_row(dict(results))
 
-    if sweep_attn or os.environ.get("HEAT_TPU_SWEEP_ATTN"):
+    from heat_tpu.core import knobs as _knobs
+
+    if sweep_attn or _knobs.get("HEAT_TPU_SWEEP_ATTN"):
         # block-size sweep of the flash kernel (VERDICT r3 item 5): per-combo
         # GFLOP/s on stderr; the winner should be baked into make_attention.
         # Blocks clamp to the sequence length, so combos that resolve to the
@@ -795,6 +797,9 @@ def main():
                          "early failure instead of a silently-labeled CPU "
                          "fallback")
     ap.add_argument("--cooldown", type=float,
+                    # heatlint: disable=HL005 -- argparse defaults resolve before
+                    # heat_tpu (and with it the knob registry) may be imported:
+                    # the backend probe must pick JAX_PLATFORMS first
                     default=float(os.environ.get("HEAT_TPU_BENCH_COOLDOWN", "60")),
                     help="seconds to sleep before the second probe round when "
                          "the first exhausts its retries (a wedged accelerator "
@@ -803,6 +808,8 @@ def main():
                          "that fails fast means no backend is there at all, "
                          "and sleeping on it was the r4 budget burn")
     ap.add_argument("--budget", type=float,
+                    # heatlint: disable=HL005 -- pre-import read; same constraint
+                    # as --cooldown above
                     default=float(os.environ.get("HEAT_TPU_BENCH_BUDGET", "1500")),
                     help="total wall-clock budget in seconds (probe included); "
                          "rows that would start past the budget are skipped "
@@ -993,6 +1000,16 @@ def main():
                 detail["collective_prec"] = _cp.bench_field()
             except Exception as e:  # noqa: BLE001
                 detail["collective_prec"] = {"error": repr(e)}
+            # heatlint debt trajectory (ISSUE 10, schema in
+            # docs/BENCHMARKS.md): static-analysis finding counts — `new`
+            # must stay 0 (the CI gate), `baselined` is the grandfathered
+            # debt that should only shrink run over run.
+            try:
+                from heat_tpu import analysis as _heatlint
+
+                detail["heatlint"] = _heatlint.bench_field()
+            except Exception as e:  # noqa: BLE001
+                detail["heatlint"] = {"error": repr(e)}
         print(json.dumps(detail), file=sys.stderr, flush=True)
 
         # honesty bit (VERDICT r5 #9, schema in docs/BENCHMARKS.md): the

@@ -46,6 +46,9 @@ def base_parser(description: str) -> argparse.ArgumentParser:
                         "the summary gains a telemetry.relayout_plan "
                         "block of the planner's decisions")
     p.add_argument("--compile-cache", metavar="DIR",
+                   # heatlint: disable=HL005 -- read before `import heat_tpu`:
+                   # bootstrap() must set the cache dir env BEFORE the package
+                   # (which reads it at import) loads
                    default=os.environ.get("HEAT_TPU_COMPILE_CACHE") or None,
                    help="persistent on-disk XLA compilation cache directory "
                         "(default: $HEAT_TPU_COMPILE_CACHE). Repeated sweep "

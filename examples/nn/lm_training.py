@@ -26,6 +26,7 @@ import numpy as np
 import optax
 
 import heat_tpu as ht
+from heat_tpu.core import program_cache
 from heat_tpu.nn import TransformerLM
 
 VOCAB = 32
@@ -76,13 +77,22 @@ def main():
             logits, toks[:, 1:]
         ).mean()
 
-    @jax.jit
-    def step(p, s, toks):
+    # dispatch through the program registry — the sanctioned jit site
+    # (heatlint HL001): the demo's step/eval programs get the same cache
+    # keying, HLO-audit visibility, and retrace telemetry as the framework
+    def _step_fn(p, s, toks):
         l, g = jax.value_and_grad(loss_fn)(p, toks)
         u, s = opt.update(g, s, p)
         return optax.apply_updates(p, u), s, l
 
-    eval_loss = jax.jit(loss_fn)
+    step = program_cache.cached_program(
+        "example.lm_train_step", (impl, D_MODEL, LAYERS), lambda: _step_fn,
+        comm=comm,
+    )
+    eval_loss = program_cache.cached_program(
+        "example.lm_eval_loss", (impl, D_MODEL, LAYERS), lambda: loss_fn,
+        comm=comm,
+    )
 
     # batches sharded over the mesh's data axis — the DP layout
     shard = comm.sharding(0, 2)

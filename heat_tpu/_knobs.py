@@ -1,0 +1,373 @@
+"""Central registry of every ``HEAT_TPU_*`` environment knob (ISSUE 10).
+
+Before this module, ~20 ``os.environ`` reads were scattered across the
+package — each with its own parse convention, its own default, and its own
+(often missing) documentation. The static analyzer's HL005 rule now rejects
+any direct ``HEAT_TPU_*`` environ read outside this file, so every knob is
+declared exactly once, carrying its type, default, and docstring. The
+``docs/API.md`` knob table is generated from :func:`markdown_table` and a
+test pins the two in sync, so the env-var docs can never drift again.
+
+This module is deliberately a **leaf**: stdlib imports only, no package
+imports. ``heat_tpu.telemetry`` and ``heat_tpu.resilience`` load *before*
+``heat_tpu.core`` during ``import heat_tpu``, so the registry must be
+importable from anywhere in the package graph without touching
+``heat_tpu.core.__init__``. The public face is
+:mod:`heat_tpu.core.knobs`, a re-export of this module.
+
+Usage inside the package::
+
+    from heat_tpu import _knobs as knobs       # safe at any import depth
+    raw = knobs.raw("HEAT_TPU_FUSION", "1")    # registered-name-checked
+    on = knobs.get("HEAT_TPU_FUSION")          # typed parse
+
+Modules with bespoke parse rules (byte-suffix budgets, fault specs,
+comma ladders) call :func:`raw` and keep their local parser; simple
+bool/int/float/enum knobs can use :func:`get` directly. Either way the
+read is registered, typed, and documented here.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Tuple, Union
+
+__all__ = [
+    "Knob",
+    "REGISTRY",
+    "raw",
+    "get",
+    "names",
+    "markdown_table",
+    "FALSY",
+    "TRUTHY",
+]
+
+# Shared string-to-bool conventions. Default-ON knobs ("is the feature
+# still enabled?") treat anything outside FALSY as on; default-OFF
+# activation knobs ("did the user opt in?") require an explicit TRUTHY.
+FALSY = ("0", "false", "off", "no")
+TRUTHY = ("1", "true", "yes", "on")
+
+
+@dataclass(frozen=True)
+class Knob:
+    """One declared environment knob.
+
+    ``type`` is one of ``bool`` / ``int`` / ``float`` / ``str`` / ``enum``
+    / ``bytes`` (byte count with K/M/G/T suffixes) / ``spec`` (structured
+    mini-language parsed by its owning module). ``default`` is the
+    effective value when the variable is unset or malformed (None = the
+    feature is simply off / derived elsewhere). ``scope`` groups the docs
+    table: ``runtime`` knobs are read by the package itself, ``bench`` by
+    the benchmark harnesses, ``ci`` by ``scripts/run_ci.sh``, ``tests`` by
+    the pytest conftest.
+    """
+
+    name: str
+    type: str
+    default: Union[bool, int, float, str, None]
+    doc: str
+    choices: Tuple[str, ...] = field(default=())
+    scope: str = "runtime"
+
+
+REGISTRY: Dict[str, Knob] = {}
+
+
+def _register(
+    name: str,
+    type: str,
+    default,
+    doc: str,
+    *,
+    choices: Tuple[str, ...] = (),
+    scope: str = "runtime",
+) -> None:
+    if name in REGISTRY:
+        raise ValueError(f"knob {name!r} registered twice")
+    if not name.startswith("HEAT_TPU_"):
+        raise ValueError(f"knob {name!r} must be namespaced HEAT_TPU_*")
+    REGISTRY[name] = Knob(name, type, default, doc, choices=choices, scope=scope)
+
+
+# -- runtime knobs ------------------------------------------------------------
+
+_register(
+    "HEAT_TPU_TELEMETRY", "bool", False,
+    "Turn telemetry recording on at `import heat_tpu` "
+    "(docs/OBSERVABILITY.md). Counters, spans, collective cost events and "
+    "compile accounting; one flag check per call site when off.",
+)
+_register(
+    "HEAT_TPU_TELEMETRY_SINK", "str", None,
+    "JSONL file that telemetry events stream to; unset records in memory "
+    "only.",
+)
+_register(
+    "HEAT_TPU_HLO_AUDIT", "bool", False,
+    "Lower-compile every cached program and fail on predicted-vs-emitted "
+    "collective drift (telemetry/hlo.py; the ground-truth auditor).",
+)
+_register(
+    "HEAT_TPU_HLO_TOLERANCE", "float", 0.1,
+    "Relative wire-byte drift tolerated by the HLO auditor before an "
+    "audit fails.",
+)
+_register(
+    "HEAT_TPU_PROGRAM_CACHE", "int", 512,
+    "Max entries in the process-global compiled-program registry "
+    "(core/program_cache.py); LRU eviction beyond it.",
+)
+_register(
+    "HEAT_TPU_COMPILE_CACHE", "str", None,
+    "Directory for the persistent on-disk XLA compilation cache; read at "
+    "`import heat_tpu`. A second process deserializes instead of "
+    "recompiling (docs/TUNING_RUNBOOK.md).",
+)
+_register(
+    "HEAT_TPU_FUSION", "bool", True,
+    "Elementwise defer-and-fuse dispatch (core/fusion.py). `0` restores "
+    "pure-eager dispatch bit-for-bit.",
+)
+_register(
+    "HEAT_TPU_FUSION_REDUCE", "bool", True,
+    "Fusion 2.0 through-reduction absorption and matmul/moments epilogue "
+    "grafting. `0` restores flush-at-reduction dispatch.",
+)
+_register(
+    "HEAT_TPU_FUSION_DEPTH", "int", 16,
+    "Max fused-chain depth before a forced flush (node cap is 4x this).",
+)
+_register(
+    "HEAT_TPU_RELAYOUT_PLAN", "enum", "auto",
+    "Relayout planning policy (core/relayout_planner.py): `auto` picks "
+    "from tensor size vs the HBM budget; the rest force one decomposition.",
+    choices=("auto", "monolithic", "chunked", "alltoall"),
+)
+_register(
+    "HEAT_TPU_RING_OVERLAP", "bool", True,
+    "Double-buffered ring schedules (cdist/manhattan/rbf, TSQR gram "
+    "ring): issue the next hop's ppermute under the local GEMM. `0` "
+    "restores the serial p-hop kernels verbatim.",
+)
+_register(
+    "HEAT_TPU_COLLECTIVE_PREC", "enum", "off",
+    "Wire precision of payload-moving collectives "
+    "(core/collective_prec.py, ISSUE 9): bf16 cast-move-upcast, int8 / "
+    "blockwise EQuARX max-abs quantization. Exact-semantics sites pin "
+    "`off` per call.",
+    choices=("off", "bf16", "int8", "blockwise"),
+)
+_register(
+    "HEAT_TPU_COLLECTIVE_PREC_BLOCK", "int", 128,
+    "Blockwise-quantization scale granularity in elements.",
+)
+_register(
+    "HEAT_TPU_CDIST_PREC", "enum", "bf16x3",
+    "In-kernel dot strategy of the fused pallas cdist kernel; the "
+    "one-line revert knob while bf16x3 is unmeasured on chip "
+    "(docs/TUNING_RUNBOOK.md).",
+    choices=("bf16x3", "default", "high", "highest"),
+)
+_register(
+    "HEAT_TPU_RETRIES", "int", 0,
+    "Transient-failure retry budget of the guarded dispatch sites "
+    "(resilience/guard.py); 0 = retries off.",
+)
+_register(
+    "HEAT_TPU_RETRY_BASE", "float", 0.05,
+    "First retry backoff in seconds (doubles per attempt, jittered).",
+)
+_register(
+    "HEAT_TPU_RETRY_CAP", "float", 2.0,
+    "Retry backoff ceiling in seconds.",
+)
+_register(
+    "HEAT_TPU_HBM_BUDGET", "bytes", None,
+    "Per-device memory budget for pre-flight admission (plain bytes or "
+    "K/M/G/T suffixes, e.g. `8G`). Unset disables the guard; malformed "
+    "values disable it too (resilience/memory_guard.py).",
+)
+_register(
+    "HEAT_TPU_FAULTS", "spec", None,
+    "Deterministic fault-injection spec installed at `import heat_tpu` "
+    "(resilience/faults.py), e.g. `relayout:kind=resource:calls=1`.",
+)
+_register(
+    "HEAT_TPU_SERVE_MAX_BATCH", "int", 64,
+    "Top bucket of the serving micro-batch ladder (serve/server.py).",
+)
+_register(
+    "HEAT_TPU_SERVE_LADDER", "str", None,
+    "Explicit comma-separated bucket ladder; unset derives powers of two "
+    "up to the max batch.",
+)
+_register(
+    "HEAT_TPU_SERVE_MAX_WAIT_MS", "float", 2.0,
+    "Micro-batch gather window in milliseconds.",
+)
+_register(
+    "HEAT_TPU_SERVE_QUEUE_MAX", "int", 1024,
+    "Admission-control bound on pending serving requests (503-style shed "
+    "beyond it).",
+)
+_register(
+    "HEAT_TPU_SERVE_EXACT", "bool", True,
+    "Batch-shape-stable exact serving kernels (batched == solo "
+    "bit-identity); `0` selects the MXU GEMM forms.",
+)
+
+# -- bench harness knobs ------------------------------------------------------
+
+_register(
+    "HEAT_TPU_SWEEP_ATTN", "bool", False,
+    "bench.py: sweep ring/ulysses attention variants in the headline run.",
+    scope="bench",
+)
+_register(
+    "HEAT_TPU_BENCH_COOLDOWN", "float", 60.0,
+    "bench.py: seconds to sleep between heavyweight rows (thermal "
+    "settling on shared hosts).",
+    scope="bench",
+)
+_register(
+    "HEAT_TPU_BENCH_BUDGET", "float", 1500.0,
+    "bench.py: wall-clock budget in seconds; rows past the deadline are "
+    "skipped and marked partial.",
+    scope="bench",
+)
+
+# -- test-suite knobs ---------------------------------------------------------
+
+_register(
+    "HEAT_TPU_TEST_DEVICES", "int", 8,
+    "tests/conftest.py: virtual CPU mesh size the suite runs on "
+    "(deliberately not a power of two by default).",
+    scope="tests",
+)
+
+# -- CI knobs (read by scripts/run_ci.sh, not by Python) ----------------------
+
+for _name, _doc in (
+    ("HEAT_TPU_CI_SIZES", "Space-separated virtual-device sweep list "
+     "(default `1 2 3 5 8`)."),
+    ("HEAT_TPU_CI_CHUNKS", "Run each size's suite in N fresh-process "
+     "chunks of test files (bounds accumulated XLA state)."),
+    ("HEAT_TPU_CI_ALLOW_MISSING_IO", "Skip the loud optional-I/O backend "
+     "presence check."),
+    ("HEAT_TPU_CI_NO_COMPILE_CACHE", "Disable the sweep-wide persistent "
+     "XLA compile cache (measure true cold compiles)."),
+    ("HEAT_TPU_CI_SKIP_AUDIT", "Skip the HLO collective-audit step."),
+    ("HEAT_TPU_CI_SKIP_WARMCACHE", "Skip the warm-compile-cache reuse "
+     "check."),
+    ("HEAT_TPU_CI_SKIP_FUSION", "Skip the fusion dispatch check."),
+    ("HEAT_TPU_CI_SKIP_FUSION_REDUCE", "Skip the fusion-reduce dispatch "
+     "check."),
+    ("HEAT_TPU_CI_SKIP_PLANNER", "Skip the budget-constrained relayout "
+     "planner step."),
+    ("HEAT_TPU_CI_SKIP_COLLPREC", "Skip the quantized-collective wire "
+     "audit step."),
+    ("HEAT_TPU_CI_SKIP_CHAOS", "Skip the fault-injection chaos step."),
+    ("HEAT_TPU_CI_SKIP_SERVING", "Skip the open-loop serving gate."),
+    ("HEAT_TPU_CI_SKIP_HEATLINT", "Skip the heatlint static-analysis "
+     "gate (ISSUE 10)."),
+):
+    _register(_name, "str", None, _doc, scope="ci")
+del _name, _doc
+
+
+# -- reads --------------------------------------------------------------------
+
+
+def names() -> frozenset:
+    """Every registered knob name (the set HL005 validates against)."""
+    return frozenset(REGISTRY)
+
+
+def raw(name: str, default: Optional[str] = None) -> Optional[str]:
+    """The raw environment string for a registered knob.
+
+    This is the ONE sanctioned ``os.environ`` read for ``HEAT_TPU_*``
+    variables (heatlint HL005). Unregistered names raise — a new knob
+    must be declared above, with its type, default, and docstring, before
+    any code can read it.
+    """
+    if name not in REGISTRY:
+        raise KeyError(
+            f"{name!r} is not a registered HEAT_TPU knob — declare it in "
+            "heat_tpu/_knobs.py (type, default, docstring; re-exported via "
+            "heat_tpu.core.knobs) before reading it"
+        )
+    return os.environ.get(name, default)
+
+
+def get(name: str):
+    """Typed live read of a registered knob: parse the raw string by the
+    knob's declared type, falling back to the declared default when unset
+    or malformed. Bool parsing follows the shared conventions: default-on
+    knobs stay on unless the value is in :data:`FALSY`; default-off knobs
+    need an explicit :data:`TRUTHY`."""
+    k = REGISTRY[name]
+    s = (os.environ.get(name) or "").strip()
+    if not s:
+        return k.default
+    if k.type == "bool":
+        low = s.lower()
+        return (low not in FALSY) if k.default else (low in TRUTHY)
+    if k.type == "int":
+        try:
+            return int(s)
+        except ValueError:
+            return k.default
+    if k.type == "float":
+        try:
+            return float(s)
+        except ValueError:
+            return k.default
+    if k.type == "enum":
+        low = s.lower()
+        return low if low in k.choices else k.default
+    return s  # str / bytes / spec: owning module parses further
+
+
+# -- documentation ------------------------------------------------------------
+
+_SCOPE_TITLES = (
+    ("runtime", "Runtime knobs"),
+    ("bench", "Benchmark-harness knobs"),
+    ("tests", "Test-suite knobs"),
+    ("ci", "CI sweep knobs (`scripts/run_ci.sh`)"),
+)
+
+
+def _default_str(k: Knob) -> str:
+    if k.default is None:
+        return "*(unset)*"
+    if k.type == "bool":
+        return "on" if k.default else "off"
+    return f"`{k.default}`"
+
+
+def markdown_table() -> str:
+    """The knob catalog as markdown, grouped by scope — the generated
+    section of docs/API.md (``tests/test_heatlint.py`` pins the committed
+    doc to this output; regenerate with
+    ``python -m heat_tpu.analysis --knob-table``)."""
+    out = []
+    for scope, title in _SCOPE_TITLES:
+        knobs = [k for k in REGISTRY.values() if k.scope == scope]
+        if not knobs:
+            continue
+        out.append(f"### {title}\n")
+        out.append("| Knob | Type | Default | Description |")
+        out.append("|---|---|---|---|")
+        for k in sorted(knobs, key=lambda k: k.name):
+            typ = k.type
+            if k.choices:
+                typ = " \\| ".join(k.choices)
+            doc = " ".join(k.doc.split())
+            out.append(f"| `{k.name}` | {typ} | {_default_str(k)} | {doc} |")
+        out.append("")
+    return "\n".join(out).rstrip() + "\n"

@@ -1,0 +1,119 @@
+"""heatlint — the repo-native static analyzer (ISSUE 10).
+
+Heat's split-tensor model works because every op routes local compute and
+collectives through sanctioned chokepoints; the TPU port re-created them
+(``program_cache.cached_program`` as the single ``jax.jit`` site, the
+``MeshCommunication`` wrappers feeding the HLO auditor,
+``collective_prec`` exact-semantics pinning, the ``knobs`` registry) but
+— before this package — enforced exactly one, via an ad-hoc AST test.
+heatlint turns each chokepoint invariant into a rule plugin:
+
+==== =========================================================
+HL001 no raw ``jax.jit``/``pjit`` outside the program registry
+HL002 no raw ``jax.lax`` collectives outside the comm wrappers
+      and the kernel modules the cost model prices
+HL003 exact-semantics kernels pin ``precision='off'``
+HL004 no host-sync hazards inside traced program bodies
+HL005 every ``HEAT_TPU_*`` env read goes through the knob registry
+HL006 no closed-over numeric literals in ``cached_program`` bodies
+==== =========================================================
+
+CLI::
+
+    python -m heat_tpu.analysis                  # scan the default tree
+    python -m heat_tpu.analysis heat_tpu/ --select HL001 --format json
+    python -m heat_tpu.analysis --write-baseline # re-grandfather
+    python -m heat_tpu.analysis --list-rules
+    python -m heat_tpu.analysis --knob-table     # regen docs/API.md table
+
+Suppress one site with ``# heatlint: disable=HL002 -- reason``; baseline
+semantics and the full rule catalog live in docs/STATIC_ANALYSIS.md.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+from .engine import (  # noqa: F401
+    BASELINE_NAME,
+    Finding,
+    Report,
+    analyze,
+    apply_baseline,
+    load_baseline,
+    load_baseline_entries,
+    scan_source,
+    write_baseline,
+)
+from .rules import RULES, Rule, rule_by_id  # noqa: F401
+
+__all__ = [
+    "BASELINE_NAME",
+    "Finding",
+    "Report",
+    "RULES",
+    "Rule",
+    "analyze",
+    "apply_baseline",
+    "load_baseline",
+    "load_baseline_entries",
+    "rule_by_id",
+    "run",
+    "scan_source",
+    "write_baseline",
+    "bench_field",
+    "DEFAULT_PATHS",
+]
+
+# the tree the CI gate scans; tests/ is deliberately excluded — test code
+# exercises the flagged patterns as fixtures (docs/STATIC_ANALYSIS.md)
+DEFAULT_PATHS = ("heat_tpu", "benchmarks", "examples", "bench.py", "scripts")
+
+
+def repo_root() -> str:
+    """The repository checkout containing this package."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run(
+    paths: Optional[Sequence[str]] = None,
+    root: Optional[str] = None,
+    baseline: Optional[str] = None,
+    select: Optional[Sequence[str]] = None,
+) -> Report:
+    """One-call API: analyze ``paths`` under ``root`` and apply the
+    committed baseline (default ``<root>/.heatlint-baseline.json`` when it
+    exists; pass ``baseline=""`` to skip). Gate on
+    ``report.findings`` — those are the NEW violations."""
+    root = root or repo_root()
+    if paths is None:
+        # only the *defaults* are existence-filtered (a checkout may lack
+        # e.g. benchmarks/); an explicit path that does not exist raises
+        # FileNotFoundError rather than silently scanning nothing
+        paths = [p for p in DEFAULT_PATHS
+                 if os.path.exists(os.path.join(root, p))]
+    else:
+        paths = list(paths)
+    report = analyze(paths, root, select=select)
+    if baseline is None:
+        candidate = os.path.join(root, BASELINE_NAME)
+        baseline = candidate if os.path.exists(candidate) else ""
+    if baseline:
+        report = apply_baseline(report, load_baseline(baseline))
+    return report
+
+
+def bench_field() -> dict:
+    """The trajectory row bench.py records: finding counts per bucket so
+    the debt curve (baseline shrinking, suppressions steady, new always
+    zero) is visible run over run."""
+    try:
+        report = run()
+        return {
+            **report.counts(),
+            "rules": len(RULES),
+            "gate": "clean" if not report.findings else "FAILING",
+        }
+    except Exception as e:  # noqa: BLE001 — bench must never die on lint
+        return {"error": repr(e)}

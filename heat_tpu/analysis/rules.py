@@ -1,0 +1,644 @@
+"""heatlint rules HL001–HL006: the dispatch, collective, precision, and
+knob invariants the codebase relies on but (before ISSUE 10) never
+checked.
+
+Each rule is a plugin: an object with ``id``/``title``/``rationale``, a
+repo-relative ``allowed`` file set where the pattern is sanctioned by
+design, and ``scan(ctx) -> (line, col, message)``. New rules register by
+appending to :data:`RULES`; ``python -m heat_tpu.analysis --list-rules``
+renders the catalog (docs/STATIC_ANALYSIS.md holds the long-form
+rationale per rule).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator, List, Optional, Set, Tuple
+
+from .engine import FileContext
+
+__all__ = ["Rule", "RULES", "rule_by_id"]
+
+Hit = Tuple[int, int, str]
+
+
+class Rule:
+    id: str = "HL000"
+    title: str = ""
+    rationale: str = ""
+    allowed: frozenset = frozenset()
+
+    def scan(self, ctx: FileContext) -> Iterator[Hit]:
+        raise NotImplementedError
+
+
+# -- shared AST helpers -------------------------------------------------------
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _lax_imports(tree: ast.Module) -> Set[str]:
+    """Names imported directly from ``jax.lax`` (``from jax.lax import
+    psum``), so bare-name collective calls are still caught."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "jax.lax":
+            out.update(a.asname or a.name for a in node.names)
+    return out
+
+
+def _kwarg(call: ast.Call, name: str) -> Optional[ast.expr]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _numeric_literal(node: ast.expr):
+    """The int/float value of a literal (incl. unary +/-), else None."""
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, (ast.USub, ast.UAdd)):
+        inner = _numeric_literal(node.operand)
+        if inner is None:
+            return None
+        return -inner if isinstance(node.op, ast.USub) else inner
+    if isinstance(node, ast.Constant) and isinstance(node.value, (int, float)) \
+            and not isinstance(node.value, bool):
+        return node.value
+    return None
+
+
+# -- HL001: single jit dispatch site ------------------------------------------
+
+_JIT_OWNERS = {"jax", "_jax"}
+
+
+def _is_jax_jit(node: ast.AST) -> bool:
+    return (
+        isinstance(node, ast.Attribute)
+        and node.attr == "jit"
+        and isinstance(node.value, ast.Name)
+        and node.value.id in _JIT_OWNERS
+    )
+
+
+def _is_pjit(node: ast.AST) -> bool:
+    if isinstance(node, ast.Name) and node.id == "pjit":
+        return True
+    return isinstance(node, ast.Attribute) and node.attr == "pjit"
+
+
+def _decorator_mentions_jit(dec: ast.AST) -> bool:
+    if _is_jax_jit(dec) or _is_pjit(dec):
+        return True
+    if isinstance(dec, ast.Call):
+        if _is_jax_jit(dec.func) or _is_pjit(dec.func):
+            return True
+        return any(_is_jax_jit(a) or _is_pjit(a) for a in dec.args)
+    return False
+
+
+class NoStrayJit(Rule):
+    """No raw ``jax.jit``/``pjit`` outside the program registry."""
+
+    id = "HL001"
+    title = "single jit dispatch site"
+    rationale = (
+        "program_cache.cached_program is the ONE sanctioned jax.jit site: "
+        "it keys compiled programs so dispatch, HLO audits, and retrace "
+        "telemetry share one signature. A bare jit() builds a fresh "
+        "closure per call (the retrace-per-invocation bug PR 3 removed) "
+        "and its program is invisible to the registry's accounting."
+    )
+    allowed = frozenset({
+        # the registry itself — the sanctioned jit site
+        "heat_tpu/core/program_cache.py",
+        # the HLO auditor lowers programs AOT; its jit is the observation
+        # instrument, not a dispatch path
+        "heat_tpu/telemetry/hlo.py",
+        # measure_compile() times an AOT jit().lower().compile() — caching
+        # it would defeat the measurement
+        "heat_tpu/telemetry/__init__.py",
+        # the driver bench measures raw-jax baseline workloads and its own
+        # compile accounting — its jits are the instrument, not dispatch
+        "bench.py",
+        # the kernel auto-tuner compiles fresh candidate variants per
+        # sweep point; registry reuse would corrupt the measurement
+        "scripts/tpu_tune.py",
+    })
+
+    def scan(self, ctx: FileContext) -> Iterator[Hit]:
+        tree = ctx.tree
+        module_level_defs = {
+            node
+            for node in tree.body
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        # module-level @jax.jit(...) call-form decorators are sanctioned:
+        # a module-level jitted function is a process-global singleton
+        allowed_decorator_calls = set()
+        for node in module_level_defs:
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call) and (
+                    _is_jax_jit(dec.func) or _is_pjit(dec.func)
+                ):
+                    allowed_decorator_calls.add(id(dec))
+
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) and (
+                _is_jax_jit(node.func) or _is_pjit(node.func)
+            ):
+                if id(node) in allowed_decorator_calls:
+                    continue
+                what = "pjit" if _is_pjit(node.func) else "jax.jit"
+                yield (
+                    node.lineno, node.col_offset,
+                    f"bare {what}( call — route this program through "
+                    "heat_tpu.core.program_cache.cached_program so repeated "
+                    "calls reuse one compiled executable and the registry/"
+                    "HLO auditor see it",
+                )
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if node in module_level_defs:
+                    continue
+                for dec in node.decorator_list:
+                    if _decorator_mentions_jit(dec):
+                        yield (
+                            dec.lineno, dec.col_offset,
+                            "@jit on a nested function builds a fresh jitted "
+                            "closure per enclosing call — use "
+                            "program_cache.cached_program (or hoist the "
+                            "decorated function to module level)",
+                        )
+
+
+# -- HL002: no raw lax collectives --------------------------------------------
+
+_COLLECTIVES = frozenset({
+    "psum", "psum_scatter", "pmean",
+    "all_gather", "all_to_all", "ppermute", "pmax", "pmin",
+})
+_LAX_OWNERS = ("jax.lax", "lax", "_lax")
+
+
+class RawCollective(Rule):
+    """Raw ``jax.lax`` collectives dodge the HLO auditor and cost model."""
+
+    id = "HL002"
+    title = "collectives route through MeshCommunication"
+    rationale = (
+        "Every collective must be visible to the planner: the "
+        "MeshCommunication wrappers emit the telemetry trace events the "
+        "cost model prices and the HLO auditor reconciles, and they are "
+        "the HEAT_TPU_COLLECTIVE_PREC compression chokepoint. A raw "
+        "lax.psum is a hop the overlap/redistribution machinery "
+        "(arXiv:2112.01075, arXiv:2211.05322) cannot see."
+    )
+    allowed = frozenset({
+        # the wrapper chokepoints themselves
+        "heat_tpu/core/communication.py",
+        "heat_tpu/core/collective_prec.py",
+        # kernel modules whose collectives the cost model already prices
+        # (telemetry/collectives.py: relayout/sort volumes, chunked plans
+        # + a2a kernels, TSQR/Gram rings, ring cdist, DP/DASO all-reduce,
+        # fusion-reduce tails)
+        "heat_tpu/core/manipulations.py",
+        "heat_tpu/core/relayout_planner.py",
+        "heat_tpu/core/linalg/qr.py",
+        "heat_tpu/spatial/distance.py",
+        "heat_tpu/optim/dp_optimizer.py",
+        "heat_tpu/nn/data_parallel.py",
+        "heat_tpu/core/fusion.py",
+    })
+
+    def scan(self, ctx: FileContext) -> Iterator[Hit]:
+        bare = _lax_imports(ctx.tree) & _COLLECTIVES
+        for node in ast.walk(ctx.tree):
+            name = None
+            # attribute REFERENCES, not just calls: partial(lax.all_to_all,
+            # ...) and `hop = lax.ppermute` aliases dodge the auditor the
+            # same way a direct call does
+            if isinstance(node, ast.Attribute) \
+                    and isinstance(node.ctx, ast.Load) \
+                    and node.attr in _COLLECTIVES:
+                owner = _dotted(node.value)
+                if owner and (owner in _LAX_OWNERS or owner.endswith(".lax")):
+                    name = node.attr
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Name) \
+                    and node.func.id in bare:
+                name = node.func.id
+            if name is not None:
+                yield (
+                    node.lineno, node.col_offset,
+                    f"raw lax.{name} — route the hop through the "
+                    f"MeshCommunication wrapper (comm.{name}) so the "
+                    "HLO auditor, cost model, and collective-precision "
+                    "knob see it",
+                )
+
+
+# -- HL003: exact-semantics sites pin precision='off' -------------------------
+
+_WRAPPER_METHODS = frozenset({
+    "psum", "all_gather", "all_to_all", "ppermute", "ring_permute",
+})
+_EXACT_TOKENS = (
+    "sort", "merge", "unique", "hist", "bincount", "topk", "gram",
+    "median", "percentile", "searchsorted", "quantile", "digitize",
+    "qr", "tsqr",
+)
+
+
+def _is_exact_fn_name(name: str) -> bool:
+    # token-segment matching, not substring: 'gram' must catch
+    # '_gram_ring' but not '_a2a_program'; 'qr' must not catch 'square'
+    segs = [s for s in re.split(r"[_.]", name.lower()) if s]
+    for seg in segs:
+        if seg.endswith("sort"):  # quicksort / oddeven_mergesort
+            return True
+        if any(seg == tok or seg.startswith(tok) for tok in _EXACT_TOKENS):
+            return True
+    return False
+
+
+class ExactPrecisionPin(Rule):
+    """Exactness-critical kernels must pin ``precision='off'``."""
+
+    id = "HL003"
+    title = "exact-semantics collectives pin precision='off'"
+    rationale = (
+        "Sort exchanges, histogram/bincount counts, unique compaction and "
+        "QR rings are EXACT by contract — a compressed wire "
+        "(HEAT_TPU_COLLECTIVE_PREC=bf16/int8) silently corrupts them. "
+        "The comm wrappers default to the global knob, so these call "
+        "sites must pin precision='off' explicitly (pmax/pmin need no "
+        "pin: the wrappers never compress extremes)."
+    )
+
+    def scan(self, ctx: FileContext) -> Iterator[Hit]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) \
+                    or not isinstance(node.func, ast.Attribute) \
+                    or node.func.attr not in _WRAPPER_METHODS:
+                continue
+            owner = _dotted(node.func.value)
+            # raw lax calls are HL002's finding, not a missing pin
+            if owner and (owner in _LAX_OWNERS or owner.endswith(".lax")):
+                continue
+            chain = [
+                fn.name
+                for fn in ctx.enclosing_functions(node)
+                if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef))
+            ]
+            if not any(_is_exact_fn_name(n) for n in chain):
+                continue
+            prec = _kwarg(node, "precision")
+            if isinstance(prec, ast.Constant) and prec.value == "off":
+                continue
+            where = chain[0] if chain else "<module>"
+            yield (
+                node.lineno, node.col_offset,
+                f"exact-semantics kernel {where}() calls comm."
+                f"{node.func.attr}( without precision='off' — the global "
+                "HEAT_TPU_COLLECTIVE_PREC knob could compress a hop whose "
+                "bits are load-bearing",
+            )
+
+
+# -- HL004: host-sync hazards inside traced programs --------------------------
+
+_HOST_MATERIALIZERS = frozenset({
+    "np.asarray", "np.array", "numpy.asarray", "numpy.array",
+    "onp.asarray", "onp.array",
+})
+
+
+def _jit_scopes(ctx: FileContext) -> Set[ast.AST]:
+    """Function/lambda nodes whose bodies are traced: jit-decorated defs,
+    functions passed to jit/pjit/shard_map, and everything inside the
+    ``build`` argument of a cached_program call (the builder's return
+    value is what gets jitted)."""
+    scopes: Set[ast.AST] = set()
+    by_name: dict = {}
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            by_name.setdefault(node.name, node)
+            if any(_decorator_mentions_jit(d) for d in node.decorator_list):
+                scopes.add(node)
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = _dotted(node.func) or ""
+        args: List[ast.expr] = []
+        if _is_jax_jit(node.func) or _is_pjit(node.func) \
+                or dotted.endswith("shard_map") or dotted == "shard_map":
+            args = list(node.args)
+        elif dotted.endswith("cached_program"):
+            build = node.args[2] if len(node.args) > 2 else _kwarg(node, "build")
+            if build is not None:
+                args = [build]
+        for arg in args:
+            if isinstance(arg, ast.Name) and arg.id in by_name:
+                scopes.add(by_name[arg.id])
+                continue
+            for sub in ast.walk(arg):
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                    ast.Lambda)):
+                    scopes.add(sub)
+                elif isinstance(sub, ast.Name) and sub.id in by_name:
+                    # `build=lambda: kernel` / `lambda: _mk(kernel)` forms
+                    scopes.add(by_name[sub.id])
+    return scopes
+
+
+class HostSyncInJit(Rule):
+    """No host materialization / blocking sync inside traced bodies."""
+
+    id = "HL004"
+    title = "host-sync hazards in traced code"
+    rationale = (
+        "Inside a traced program, np.asarray()/.item()/float()/int() on a "
+        "traced value either fails at trace time or silently bakes a "
+        "host round-trip constant into the program; block_until_ready() "
+        "inside a kernel serializes the async dispatch pipeline. All "
+        "device-host synchronization belongs OUTSIDE the jitted body "
+        "(telemetry spans do it correctly at the span boundary)."
+    )
+
+    def scan(self, ctx: FileContext) -> Iterator[Hit]:
+        scopes = _jit_scopes(ctx)
+        if not scopes:
+            return
+        emitted: Set[Tuple[int, int]] = set()
+        for scope in scopes:
+            a = scope.args
+            params = {
+                p.arg for p in list(a.args) + list(a.posonlyargs)
+                + list(a.kwonlyargs)
+            }
+            body = scope.body if isinstance(scope.body, list) else [scope.body]
+            for stmt in body:
+                for node in ast.walk(stmt):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    dotted = _dotted(node.func) or ""
+                    msg = None
+                    if dotted in _HOST_MATERIALIZERS:
+                        msg = (
+                            f"{dotted}( inside a traced program "
+                            "materializes on host at trace time — use "
+                            "jnp.* or move it outside the jitted body"
+                        )
+                    elif isinstance(node.func, ast.Attribute) \
+                            and node.func.attr == "item" and not node.args:
+                        msg = (
+                            ".item() inside a traced program is a "
+                            "device-host sync — return the array and "
+                            "convert outside the jitted body"
+                        )
+                    elif dotted.endswith("block_until_ready") or (
+                        isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "block_until_ready"
+                    ):
+                        msg = (
+                            "block_until_ready() inside a traced program "
+                            "defeats async dispatch — synchronize at the "
+                            "call site (telemetry spans do this for you)"
+                        )
+                    elif isinstance(node.func, ast.Name) \
+                            and node.func.id in ("float", "int", "bool") \
+                            and len(node.args) == 1 \
+                            and isinstance(node.args[0], ast.Name) \
+                            and node.args[0].id in params:
+                        msg = (
+                            f"{node.func.id}() on traced argument "
+                            f"'{node.args[0].id}' forces concretization — "
+                            "keep it an array (or hoist the coercion out "
+                            "of the traced body)"
+                        )
+                    if msg is None:
+                        continue
+                    # nested scopes overlap (a def inside a jitted def is
+                    # itself a scope) — report each site once
+                    loc = (node.lineno, node.col_offset)
+                    if loc in emitted:
+                        continue
+                    emitted.add(loc)
+                    yield (*loc, msg)
+
+
+# -- HL005: HEAT_TPU_* knobs go through the registry --------------------------
+
+_ENV_READ_FUNCS = ("os.environ.get", "environ.get", "os.getenv", "getenv")
+_KNOB_FUNCS = ("raw", "get")
+
+
+def _registered_knobs() -> frozenset:
+    from heat_tpu import _knobs
+
+    return _knobs.names()
+
+
+class KnobRegistry(Rule):
+    """Every ``HEAT_TPU_*`` env read goes through heat_tpu.core.knobs."""
+
+    id = "HL005"
+    title = "env knobs via the central registry"
+    rationale = (
+        "heat_tpu/_knobs.py declares every HEAT_TPU_* variable once, "
+        "with type, default, and docstring; the docs/API.md table is "
+        "generated from it. A direct os.environ read invents an "
+        "undocumented knob with a private parse convention — the exact "
+        "drift this registry exists to end. Writes (tests/benchmarks "
+        "setting knobs) are fine; reads must use knobs.raw()/get()."
+    )
+    allowed = frozenset({
+        "heat_tpu/_knobs.py",   # the one sanctioned environ read
+        "heat_tpu/core/knobs.py",
+    })
+
+    def scan(self, ctx: FileContext) -> Iterator[Hit]:
+        registered = _registered_knobs()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                dotted = _dotted(node.func) or ""
+                lit = (
+                    node.args[0].value
+                    if node.args and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)
+                    else None
+                )
+                if dotted in _ENV_READ_FUNCS or dotted.endswith(".getenv"):
+                    if lit is not None and lit.startswith("HEAT_TPU_"):
+                        yield (
+                            node.lineno, node.col_offset,
+                            f"direct environ read of {lit} — declare it in "
+                            "heat_tpu/_knobs.py and read via "
+                            "knobs.raw()/knobs.get() so it carries a type, "
+                            "default, and docstring",
+                        )
+                elif dotted.rpartition(".")[2] in _KNOB_FUNCS and (
+                    "knobs" in dotted.rpartition(".")[0]
+                ):
+                    if lit is not None and lit.startswith("HEAT_TPU_") \
+                            and lit not in registered:
+                        yield (
+                            node.lineno, node.col_offset,
+                            f"knobs.{dotted.rpartition('.')[2]}({lit!r}) "
+                            "names an UNREGISTERED knob — add it to the "
+                            "registry in heat_tpu/_knobs.py first",
+                        )
+            elif isinstance(node, ast.Subscript) \
+                    and isinstance(node.ctx, ast.Load):
+                dotted = _dotted(node.value) or ""
+                if dotted.endswith("environ") \
+                        and isinstance(node.slice, ast.Constant) \
+                        and isinstance(node.slice.value, str) \
+                        and node.slice.value.startswith("HEAT_TPU_"):
+                    yield (
+                        node.lineno, node.col_offset,
+                        f"direct environ[{node.slice.value!r}] read — use "
+                        "the knob registry (heat_tpu/core/knobs.py)",
+                    )
+
+
+# -- HL006: no closed-over numeric literals in cached programs ----------------
+
+
+class ClosedOverLiteral(Rule):
+    """Numeric literals must enter cached programs as runtime args."""
+
+    id = "HL006"
+    title = "retrace hazard: closed-over numeric literal"
+    rationale = (
+        "A Python float/int from an enclosing scope baked into a "
+        "cached_program body is either a stale constant (same cache key, "
+        "wrong value on the next call) or a cache blowup (value in the "
+        "key, one compiled program per distinct scalar) — the exact bug "
+        "class PR 4 fixed for fusion by passing float scalars as runtime "
+        "arguments so x*2.0 and x*3.0 share one executable."
+    )
+
+    def scan(self, ctx: FileContext) -> Iterator[Hit]:
+        by_scope_defs: dict = {}
+
+        for call in ast.walk(ctx.tree):
+            if not isinstance(call, ast.Call):
+                continue
+            dotted = _dotted(call.func) or ""
+            if not dotted.endswith("cached_program"):
+                continue
+            build = call.args[2] if len(call.args) > 2 else _kwarg(call, "build")
+            if build is None:
+                continue
+            enclosing = [
+                fn for fn in ctx.enclosing_functions(call)
+                if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef))
+            ]
+            # numeric-literal bindings visible from the call site,
+            # innermost scope first
+            literal_bindings = {}
+            for fn in reversed(enclosing):
+                for node in ast.walk(fn):
+                    if isinstance(node, ast.Assign):
+                        val = _numeric_literal(node.value)
+                        if val is None:
+                            continue
+                        for t in node.targets:
+                            if isinstance(t, ast.Name):
+                                literal_bindings[t.id] = val
+                    elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                        val = _numeric_literal(node.value)
+                        if val is not None and isinstance(node.target, ast.Name):
+                            literal_bindings[node.target.id] = val
+            if not literal_bindings:
+                continue
+
+            # the function bodies that get traced: lambdas/defs inside the
+            # build arg, plus local defs the build arg references by name
+            targets: List[ast.AST] = []
+            local_defs = {}
+            for fn in enclosing:
+                for node in ast.walk(fn):
+                    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        local_defs.setdefault(node.name, node)
+            for sub in ast.walk(build):
+                if isinstance(sub, (ast.Lambda, ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                    targets.append(sub)
+                elif isinstance(sub, ast.Name) and sub.id in local_defs:
+                    targets.append(local_defs[sub.id])
+
+            seen: Set[Tuple[int, str]] = set()
+            for fn in targets:
+                bound: Set[str] = set()
+                for node in ast.walk(fn):
+                    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                         ast.Lambda)):
+                        a = node.args
+                        bound.update(
+                            p.arg for p in
+                            list(a.args) + list(a.posonlyargs)
+                            + list(a.kwonlyargs)
+                        )
+                        if a.vararg:
+                            bound.add(a.vararg.arg)
+                        if a.kwarg:
+                            bound.add(a.kwarg.arg)
+                    elif isinstance(node, ast.Name) \
+                            and isinstance(node.ctx, (ast.Store, ast.Del)):
+                        # any local rebinding shadows the outer literal:
+                        # assignments, for/with/except targets,
+                        # comprehension variables, walrus
+                        bound.add(node.id)
+                    elif isinstance(node, ast.ExceptHandler) and node.name:
+                        bound.add(node.name)
+                for node in ast.walk(fn):
+                    if not isinstance(node, ast.Name) \
+                            or not isinstance(node.ctx, ast.Load):
+                        continue
+                    name = node.id
+                    if name in bound or name in ctx.module_names \
+                            or name not in literal_bindings:
+                        continue
+                    key = (node.lineno, name)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    yield (
+                        node.lineno, node.col_offset,
+                        f"'{name}' (= {literal_bindings[name]!r}) is a "
+                        "Python numeric literal closed over by a "
+                        "cached_program body — pass it as a runtime "
+                        "argument so one compiled program serves every "
+                        "value (retrace/cache-key hazard; see "
+                        "core/fusion.py's scalar-arg protocol)",
+                    )
+
+
+RULES: List[Rule] = [
+    NoStrayJit(),
+    RawCollective(),
+    ExactPrecisionPin(),
+    HostSyncInJit(),
+    KnobRegistry(),
+    ClosedOverLiteral(),
+]
+
+
+def rule_by_id(rule_id: str) -> Rule:
+    for r in RULES:
+        if r.id == rule_id.upper():
+            return r
+    raise KeyError(rule_id)

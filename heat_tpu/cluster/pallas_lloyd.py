@@ -248,8 +248,12 @@ def lloyd_fit_pallas_sharded(
             c, it, _ = carry
             sums, counts = _lloyd_update(xp, c, n, k, bm, interpret, lim,
                                          precision=precision)
-            sums = jax.lax.psum(sums, comm.axis_name)
-            counts = jax.lax.psum(counts, comm.axis_name)
+            # comm wrapper (not raw lax.psum) so the hop is visible to
+            # the HLO auditor/cost model; pinned exact — centroid
+            # accumulation predates the collective-precision knob and a
+            # compressed wire would move the fixed point (heatlint HL002)
+            sums = comm.psum(sums, precision="off")
+            counts = comm.psum(counts, precision="off")
             cnt = counts[0:1, :].T
             new_c = jnp.where(cnt > 0, sums / jnp.maximum(cnt, 1.0), c)
             shift = jnp.sum((new_c - c) ** 2)

@@ -64,11 +64,12 @@ rings) pin ``precision="off"`` at the call site.
 
 from __future__ import annotations
 
-import os
 from typing import Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+
+from heat_tpu import _knobs as knobs
 
 from ..telemetry import collectives as _cost
 
@@ -104,14 +105,14 @@ DEFAULT_BLOCK = _cost.DEFAULT_WIRE_BLOCK
 
 def mode() -> str:
     """The active ``HEAT_TPU_COLLECTIVE_PREC`` value (malformed -> off)."""
-    raw = os.environ.get(_ENV_MODE, "").strip().lower()
+    raw = (knobs.raw(_ENV_MODE, "") or "").strip().lower()
     return raw if raw in MODES else "off"
 
 
 def block_size() -> int:
     """Blockwise scale granularity (``HEAT_TPU_COLLECTIVE_PREC_BLOCK``,
     default :data:`DEFAULT_BLOCK`; malformed or non-positive -> default)."""
-    raw = os.environ.get(_ENV_BLOCK, "").strip()
+    raw = (knobs.raw(_ENV_BLOCK, "") or "").strip()
     if raw:
         try:
             n = int(raw)

@@ -91,13 +91,14 @@ Knobs / API
 from __future__ import annotations
 
 import functools
-import os
 import threading
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from heat_tpu import _knobs as knobs
 
 from .. import telemetry
 
@@ -142,7 +143,7 @@ _STATS = {
 
 
 def _env_enabled() -> bool:
-    return os.environ.get("HEAT_TPU_FUSION", "1").strip().lower() not in (
+    return knobs.raw("HEAT_TPU_FUSION", "1").strip().lower() not in (
         "0", "false", "off",
     )
 
@@ -165,7 +166,7 @@ def reduce_active() -> bool:
     elementwise fusion keeps running."""
     if not active():
         return False
-    return os.environ.get("HEAT_TPU_FUSION_REDUCE", "1").strip().lower() not in (
+    return knobs.raw("HEAT_TPU_FUSION_REDUCE", "1").strip().lower() not in (
         "0", "false", "off",
     )
 
@@ -175,7 +176,7 @@ def depth_cap() -> int:
     clamped down by the memory guard's pressure cap while the HBM budget
     predicts overflow — see :func:`set_pressure_cap`)."""
     cap = DEFAULT_DEPTH
-    raw = os.environ.get("HEAT_TPU_FUSION_DEPTH", "").strip()
+    raw = knobs.raw("HEAT_TPU_FUSION_DEPTH", "").strip()
     if raw:
         try:
             n = int(raw)

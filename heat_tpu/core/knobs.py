@@ -1,0 +1,37 @@
+"""Public face of the central ``HEAT_TPU_*`` knob registry (ISSUE 10).
+
+The implementation lives in :mod:`heat_tpu._knobs`, a stdlib-only leaf
+module, because ``heat_tpu.telemetry`` and ``heat_tpu.resilience`` must
+read knobs while ``heat_tpu.core`` is still unimported (package init
+order). Import THIS module from user code and from core modules::
+
+    from heat_tpu.core import knobs
+    knobs.get("HEAT_TPU_FUSION")      # typed read
+    knobs.raw("HEAT_TPU_FAULTS", "")  # raw string, registered-name-checked
+    knobs.REGISTRY                    # name -> Knob(type, default, doc)
+
+Early-loading package internals use ``from heat_tpu import _knobs as
+knobs`` instead — same object, no ``heat_tpu.core`` import.
+"""
+
+from heat_tpu._knobs import (  # noqa: F401
+    FALSY,
+    TRUTHY,
+    Knob,
+    REGISTRY,
+    get,
+    markdown_table,
+    names,
+    raw,
+)
+
+__all__ = [
+    "FALSY",
+    "TRUTHY",
+    "Knob",
+    "REGISTRY",
+    "get",
+    "markdown_table",
+    "names",
+    "raw",
+]

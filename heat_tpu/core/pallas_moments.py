@@ -166,9 +166,13 @@ def sharded_column_moments(
             lim=lim.reshape((1,)), pre_map=pre_map,
         )
         ns = lim.astype(jnp.float32)
-        mean_g = jax.lax.psum(ns * mean_s, comm.axis_name) / jnp.float32(n)
+        # comm wrapper (not raw lax.psum) so the hops are visible to the
+        # HLO auditor/cost model; pinned exact — the Chan/Welford merge is
+        # bit-pinned by tests and predates the collective-precision knob
+        # (heatlint HL002)
+        mean_g = comm.psum(ns * mean_s, precision="off") / jnp.float32(n)
         dlt = mean_s - mean_g
-        m2_g = jax.lax.psum(m2_s + ns * dlt * dlt, comm.axis_name)
+        m2_g = comm.psum(m2_s + ns * dlt * dlt, precision="off")
         return mean_g, m2_g
 
     return jax.shard_map(

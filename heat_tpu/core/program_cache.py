@@ -63,6 +63,8 @@ from typing import Any, Callable, Optional, Sequence, Tuple
 
 import jax
 
+from heat_tpu import _knobs as knobs
+
 from .. import resilience, telemetry
 
 __all__ = [
@@ -98,7 +100,7 @@ _SITE_STATS: dict = {}
 
 
 def _maxsize() -> int:
-    raw = os.environ.get("HEAT_TPU_PROGRAM_CACHE", "").strip()
+    raw = knobs.raw("HEAT_TPU_PROGRAM_CACHE", "").strip()
     if raw:
         try:
             n = int(raw)
@@ -286,7 +288,7 @@ def persistent_cache_dir() -> Optional[str]:
 
 # Environment activation (mirrors HEAT_TPU_TELEMETRY): HEAT_TPU_COMPILE_CACHE
 # names the cache directory; `import heat_tpu` is enough to enable it.
-_env_dir = os.environ.get("HEAT_TPU_COMPILE_CACHE", "").strip()
+_env_dir = knobs.raw("HEAT_TPU_COMPILE_CACHE", "").strip()
 if _env_dir:
     try:
         enable_persistent_cache(_env_dir)

@@ -57,12 +57,13 @@ of budget (chunk count then sized from
 
 from __future__ import annotations
 
-import os
 from dataclasses import dataclass
 from typing import Callable, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+
+from heat_tpu import _knobs as knobs
 
 from .. import telemetry
 
@@ -98,7 +99,7 @@ _CHUNK_TEMP_FACTOR = 1.5
 
 def mode() -> str:
     """The active ``HEAT_TPU_RELAYOUT_PLAN`` value (malformed -> auto)."""
-    raw = os.environ.get(_ENV_MODE, "").strip().lower()
+    raw = (knobs.raw(_ENV_MODE, "") or "").strip().lower()
     return raw if raw in _MODES else "auto"
 
 
@@ -113,7 +114,7 @@ def ring_overlap() -> bool:
     Tile values and update order are unchanged, so results are
     bit-identical to the serial schedule; ``HEAT_TPU_RING_OVERLAP=0``
     restores the serial p-hop kernels verbatim."""
-    return os.environ.get("HEAT_TPU_RING_OVERLAP", "1").strip().lower() not in (
+    return knobs.raw("HEAT_TPU_RING_OVERLAP", "1").strip().lower() not in (
         "0", "false", "off", "no",
     )
 

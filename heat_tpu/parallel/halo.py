@@ -25,7 +25,11 @@ def _halo_parts(xb, halo_size: int, axis: int, name: str, p: int, wrap: bool):
     lead = jax.lax.slice_in_dim(xb, 0, halo_size, axis=axis)
     n = xb.shape[axis]
     trail = jax.lax.slice_in_dim(xb, n - halo_size, n, axis=axis)
+    # heatlint: disable=HL002 -- generic axis-NAME helper: callers hand us
+    # a bare mesh axis string, no MeshCommunication object exists in scope;
+    # halo volumes are not yet priced by the cost model
     from_prev = jax.lax.ppermute(trail, name, perm=fwd)
+    # heatlint: disable=HL002 -- same: axis-name helper, no comm in scope
     from_next = jax.lax.ppermute(lead, name, perm=bwd)
     if not wrap:
         zero = jnp.zeros_like(from_prev)

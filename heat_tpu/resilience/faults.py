@@ -52,11 +52,12 @@ check — the same contract as telemetry.
 from __future__ import annotations
 
 import fnmatch
-import os
 import threading
 import time
 import zlib
 from typing import Dict, List, Optional
+
+from heat_tpu import _knobs as knobs
 
 __all__ = [
     "FaultRule",
@@ -350,4 +351,4 @@ _ENV_VAR = "HEAT_TPU_FAULTS"
 
 
 def env_spec() -> str:
-    return os.environ.get(_ENV_VAR, "").strip()
+    return knobs.raw(_ENV_VAR, "").strip()

@@ -35,10 +35,11 @@ permanent and escalated with a hint naming the donating site.
 
 from __future__ import annotations
 
-import os
 import time
 import zlib
 from typing import Any, Callable, Dict, List, Optional
+
+from heat_tpu import _knobs as knobs
 
 from . import faults
 from .. import telemetry
@@ -84,7 +85,7 @@ def max_retries() -> int:
     """``HEAT_TPU_RETRIES`` (default 0 = retries off). Read live — only
     consulted once the package is armed, so the disabled hot path never
     touches the environment."""
-    raw = os.environ.get("HEAT_TPU_RETRIES", "").strip()
+    raw = knobs.raw("HEAT_TPU_RETRIES", "").strip()
     if raw:
         try:
             return max(0, int(raw))
@@ -94,7 +95,7 @@ def max_retries() -> int:
 
 
 def _backoff_base() -> float:
-    raw = os.environ.get("HEAT_TPU_RETRY_BASE", "").strip()
+    raw = knobs.raw("HEAT_TPU_RETRY_BASE", "").strip()
     try:
         return float(raw) if raw else DEFAULT_BASE
     except ValueError:
@@ -102,7 +103,7 @@ def _backoff_base() -> float:
 
 
 def _backoff_cap() -> float:
-    raw = os.environ.get("HEAT_TPU_RETRY_CAP", "").strip()
+    raw = knobs.raw("HEAT_TPU_RETRY_CAP", "").strip()
     try:
         return float(raw) if raw else DEFAULT_CAP
     except ValueError:

@@ -45,10 +45,11 @@ armed, so :func:`preflight` is never called.
 from __future__ import annotations
 
 import gc
-import os
 import re
 from collections import OrderedDict
 from typing import Optional, Tuple
+
+from heat_tpu import _knobs as knobs
 
 from .guard import HeatTpuRuntimeError
 from .. import telemetry
@@ -88,7 +89,7 @@ def budget_bytes() -> Optional[int]:
     Accepts plain byte counts or K/M/G/T suffixes (``"512M"``, ``"8G"``,
     ``"8GiB"``). Malformed values disable the guard (None)."""
     global _BUDGET_CACHE
-    raw = os.environ.get("HEAT_TPU_HBM_BUDGET", "").strip()
+    raw = knobs.raw("HEAT_TPU_HBM_BUDGET", "").strip()
     if not raw:
         return None
     cached_raw, cached_val = _BUDGET_CACHE

@@ -32,10 +32,11 @@ the same budget model the memory-efficient-redistribution planner uses
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 from typing import Callable, List, Optional
+
+from heat_tpu import _knobs as knobs
 
 from .. import telemetry
 from ..resilience import memory_guard
@@ -73,7 +74,7 @@ class ServerClosedError(ServeError):
 
 
 def _env_int(name: str, default: int) -> int:
-    raw = os.environ.get(name, "").strip()
+    raw = (knobs.raw(name, "") or "").strip()
     if raw:
         try:
             v = int(raw)

@@ -33,12 +33,13 @@ static config matches.
 
 from __future__ import annotations
 
-import os
 from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from heat_tpu import _knobs as knobs
 
 __all__ = [
     "Endpoint",
@@ -57,7 +58,7 @@ def exact_mode() -> bool:
     """Whether the bit-stable serving kernels are active (default). Off
     (``HEAT_TPU_SERVE_EXACT=0``) selects the GEMM forms — faster on the
     MXU, but batched-vs-solo results are only allclose, not bit-equal."""
-    return os.environ.get("HEAT_TPU_SERVE_EXACT", "").strip().lower() not in (
+    return knobs.raw("HEAT_TPU_SERVE_EXACT", "").strip().lower() not in (
         "0", "false", "no", "off",
     )
 

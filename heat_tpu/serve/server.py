@@ -47,7 +47,6 @@ executables bit-for-bit.
 
 from __future__ import annotations
 
-import os
 import queue
 import threading
 import time
@@ -56,6 +55,8 @@ from typing import Dict, List, Optional, Sequence
 
 import jax.numpy as jnp
 import numpy as np
+
+from heat_tpu import _knobs as knobs
 
 from .. import telemetry
 from ..core import program_cache
@@ -91,7 +92,7 @@ def _resolve(fut: Future, value=None, exc=None) -> None:
 
 
 def _env_float(name: str, default: float) -> float:
-    raw = os.environ.get(name, "").strip()
+    raw = (knobs.raw(name, "") or "").strip()
     if raw:
         try:
             v = float(raw)
@@ -113,7 +114,7 @@ def _default_ladder(max_batch: int) -> List[int]:
 
 
 def _env_ladder(max_batch: int) -> List[int]:
-    raw = os.environ.get("HEAT_TPU_SERVE_LADDER", "").strip()
+    raw = knobs.raw("HEAT_TPU_SERVE_LADDER", "").strip()
     if raw:
         try:
             vals = sorted({int(v) for v in raw.split(",") if v.strip()})
@@ -150,7 +151,7 @@ class Server:
         queue_max: Optional[int] = None,
     ):
         if max_batch is None:
-            raw = os.environ.get("HEAT_TPU_SERVE_MAX_BATCH", "").strip()
+            raw = knobs.raw("HEAT_TPU_SERVE_MAX_BATCH", "").strip()
             max_batch = DEFAULT_MAX_BATCH
             if raw:
                 try:

@@ -29,7 +29,6 @@ distance.py:209); this is TPU-native plumbing under the same API.
 from __future__ import annotations
 
 import functools
-import os
 import warnings
 from typing import Optional
 
@@ -40,6 +39,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ..core.pallas_util import DotPrecision, dot_f32
+from heat_tpu import _knobs as knobs
 from .. import telemetry
 
 __all__ = ["euclid_pallas", "pallas_cdist_applicable", "cdist_precision"]
@@ -65,7 +65,7 @@ def cdist_precision() -> DotPrecision:
     unless ``HEAT_TPU_CDIST_PREC`` names one of ``bf16x3`` / ``default`` /
     ``high`` / ``highest`` (the ``jax.lax.Precision`` tiers). Read at call
     time, so a sweep can flip it between runs of one process."""
-    v = os.environ.get(_PREC_ENV, "").strip().lower()
+    v = (knobs.raw(_PREC_ENV, "") or "").strip().lower()
     if not v or v == "bf16x3":
         return "bf16x3"
     if v in _PREC_VALUES:

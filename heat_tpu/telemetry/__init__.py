@@ -50,6 +50,8 @@ from typing import Any, Dict, IO, Iterable, List, Optional, Union
 
 import jax
 
+from heat_tpu import _knobs as knobs
+
 from . import collectives  # noqa: F401  (re-exported submodule)
 
 __all__ = [
@@ -228,7 +230,7 @@ def enable(sink: Union[str, IO[str], None] = None) -> Telemetry:
     global _ENABLED
     reg = get_registry()
     if sink is None:
-        sink = os.environ.get("HEAT_TPU_TELEMETRY_SINK") or None
+        sink = knobs.raw("HEAT_TPU_TELEMETRY_SINK") or None
     if sink is not None:
         try:
             reg.attach_sink(sink)
@@ -555,7 +557,7 @@ export_trace = trace.export_trace
 
 # Environment activation: HEAT_TPU_TELEMETRY=1 turns recording on at import
 # (heat_tpu/__init__ imports this package, so `import heat_tpu` suffices).
-if os.environ.get("HEAT_TPU_TELEMETRY", "").strip().lower() in (
+if knobs.raw("HEAT_TPU_TELEMETRY", "").strip().lower() in (
     "1", "true", "yes", "on",
 ):
     enable()

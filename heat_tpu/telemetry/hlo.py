@@ -48,7 +48,6 @@ aggregates into the ``hlo_collectives`` benchmark section.
 
 from __future__ import annotations
 
-import os
 import re
 import warnings
 from collections import deque
@@ -56,6 +55,8 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
+
+from heat_tpu import _knobs as knobs
 
 __all__ = [
     "EmittedCollective",
@@ -84,7 +85,7 @@ __all__ = [
 # compiler freedom — fusion-dependent layout choices, an XLA version
 # changing the decomposition — not systematic padding arithmetic. 10%
 # still catches a wrong primitive or a doubled transfer outright.
-DEFAULT_TOLERANCE = float(os.environ.get("HEAT_TPU_HLO_TOLERANCE", "0.1"))
+DEFAULT_TOLERANCE = float(knobs.raw("HEAT_TPU_HLO_TOLERANCE", "0.1"))
 
 _COLLECTIVE_OPS = (
     "all-gather",
@@ -614,7 +615,7 @@ def audit_call(
 
 # Environment activation (mirrors HEAT_TPU_TELEMETRY): the benchmark
 # harness's --audit flag and the CI audit step set this before import.
-if os.environ.get("HEAT_TPU_HLO_AUDIT", "").strip().lower() in (
+if knobs.raw("HEAT_TPU_HLO_AUDIT", "").strip().lower() in (
     "1", "true", "yes", "on",
 ):
     _AUDIT_ENABLED = True

@@ -46,6 +46,32 @@ fi
 SIZES=${HEAT_TPU_CI_SIZES:-"1 2 3 5 8"}
 REPORT=${CI_REPORT_DIR:-}
 
+# heatlint gate (ISSUE 10): the static analyzer enforces the dispatch /
+# collective / precision / knob invariants (docs/STATIC_ANALYSIS.md) over
+# the package, benchmarks, examples, driver, and scripts. It runs FIRST —
+# an invariant regression fails in seconds, before any suite compiles.
+# Passes on the committed baseline (.heatlint-baseline.json) and inline
+# suppressions; fails on any NEW finding. HEAT_TPU_CI_SKIP_HEATLINT=1
+# opts out.
+HEATLINT_FAILED=""
+if [ -z "${HEAT_TPU_CI_SKIP_HEATLINT:-}" ]; then
+    echo "=== heatlint static-analysis gate ==="
+    heatlint_out=$(mktemp)
+    if JAX_PLATFORMS=cpu python -m heat_tpu.analysis \
+            heat_tpu benchmarks examples bench.py scripts \
+            | tee "$heatlint_out"; then
+        echo "=== heatlint gate ok ==="
+    else
+        echo "=== heatlint gate FAILED — new invariant violations above ==="
+        HEATLINT_FAILED=" heatlint"
+    fi
+    if [ -n "$REPORT" ]; then
+        mkdir -p "$REPORT"
+        cp "$heatlint_out" "${REPORT}/heatlint.log" || true
+    fi
+    rm -f "$heatlint_out"
+fi
+
 # Persistent XLA compile cache shared across the whole sweep (ISSUE 3): the
 # suite is compile-bound, and retried chunks / repeated sizes / the per-
 # module jax.clear_caches() in conftest all recompile programs a previous
@@ -881,6 +907,7 @@ if [ "$have_coverage" = 1 ]; then
         && python -m coverage report --include='*/heat_tpu/*' > coverage.txt \
         && tail -1 coverage.txt)
 fi
+FAILED_SIZES="$FAILED_SIZES$HEATLINT_FAILED"
 if [ -n "$RETRIED_ABORTS" ]; then
     # surfaced even on a green sweep: silent retries would hide a rising
     # native-crash rate (advisor round-5 finding)

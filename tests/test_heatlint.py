@@ -1,0 +1,618 @@
+"""heatlint behavioral fixtures (ISSUE 10).
+
+Every rule gets at least one fixture-proven true positive AND true
+negative, plus the suppression and baseline escape hatches, plus a
+self-run asserting the repo itself is clean against the committed
+baseline, plus the docs/API.md knob-table drift pin.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from heat_tpu import analysis
+from heat_tpu.analysis import engine as hl_engine
+from heat_tpu.analysis import rules as hl_rules
+from heat_tpu.core import knobs
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def repo_report():
+    """One full-repo analyzer run shared by the self-run assertions (the
+    scan is pure; re-running it per test would just burn suite budget)."""
+    return analysis.run(root=REPO)
+
+
+def scan(src: str, rule_id: str, relpath: str = "fixture.py"):
+    """Run ONE rule over an in-memory snippet; returns (findings, suppressed)."""
+    rule = analysis.rule_by_id(rule_id)
+    return analysis.scan_source(relpath, textwrap.dedent(src), [rule])
+
+
+def rules_fired(src: str, rule_id: str):
+    findings, _ = scan(src, rule_id)
+    return [f.rule for f in findings]
+
+
+# -- HL001: single jit dispatch site ------------------------------------------
+
+
+class TestHL001:
+    def test_positive_bare_call(self):
+        assert rules_fired(
+            "import jax\nx = jax.jit(lambda v: v)\n", "HL001"
+        ) == ["HL001"]
+
+    def test_positive_pjit(self):
+        assert rules_fired(
+            "from jax.experimental.pjit import pjit\nf = pjit(lambda v: v)\n",
+            "HL001",
+        ) == ["HL001"]
+
+    def test_positive_nested_decorator(self):
+        src = """
+        import jax
+        def outer():
+            @jax.jit
+            def inner(x):
+                return x
+            return inner
+        """
+        assert rules_fired(src, "HL001") == ["HL001"]
+
+    def test_negative_module_level_decorator(self):
+        src = """
+        import functools, jax
+        @jax.jit
+        def f(x):
+            return x
+        @functools.partial(jax.jit, static_argnums=(0,))
+        def g(n, x):
+            return x
+        """
+        assert rules_fired(src, "HL001") == []
+
+    def test_negative_allowed_file(self):
+        findings, _ = scan(
+            "import jax\nx = jax.jit(lambda v: v)\n", "HL001",
+            relpath="heat_tpu/core/program_cache.py",
+        )
+        assert findings == []
+
+
+# -- HL002: raw lax collectives -----------------------------------------------
+
+
+class TestHL002:
+    def test_positive_direct_call(self):
+        src = "import jax\ny = jax.lax.psum(x, 'i')\n"
+        assert rules_fired(src, "HL002") == ["HL002"]
+
+    def test_positive_partial_reference(self):
+        src = """
+        import functools, jax
+        hop = functools.partial(jax.lax.all_to_all, axis_name='i')
+        """
+        assert rules_fired(src, "HL002") == ["HL002"]
+
+    def test_positive_from_import(self):
+        src = "from jax.lax import ppermute\ny = ppermute(x, 'i', perm=p)\n"
+        assert rules_fired(src, "HL002") == ["HL002"]
+
+    def test_negative_comm_wrapper(self):
+        src = "y = comm.psum(x)\nz = comm.all_gather(x, tiled=True)\n"
+        assert rules_fired(src, "HL002") == []
+
+    def test_negative_non_collective_lax(self):
+        src = "import jax\ny = jax.lax.fori_loop(0, 3, body, x)\n"
+        assert rules_fired(src, "HL002") == []
+
+
+# -- HL003: exact-semantics precision pin -------------------------------------
+
+
+class TestHL003:
+    def test_positive_sort_without_pin(self):
+        src = """
+        def _oddeven_sort_kernel(comm, vv, perm):
+            return comm.ppermute(vv, perm)
+        """
+        assert rules_fired(src, "HL003") == ["HL003"]
+
+    def test_positive_histogram_nested(self):
+        src = """
+        def _hist_distributed(comm):
+            def kernel(h):
+                return comm.psum(h)
+            return kernel
+        """
+        assert rules_fired(src, "HL003") == ["HL003"]
+
+    def test_negative_pinned_off(self):
+        src = """
+        def _oddeven_sort_kernel(comm, vv, perm):
+            return comm.ppermute(vv, perm, precision="off")
+        """
+        assert rules_fired(src, "HL003") == []
+
+    def test_negative_compressible_kernel(self):
+        # ring cdist is NOT exact-semantics: the knob may compress it
+        src = """
+        def _ring_dist(comm, yblk):
+            return comm.ring_permute(yblk)
+        """
+        assert rules_fired(src, "HL003") == []
+
+    def test_negative_program_is_not_gram(self):
+        # token matching: '_a2a_program' must not trip the 'gram' token
+        src = """
+        def _a2a_program(comm, b):
+            return comm.all_to_all(b, split_axis=0, concat_axis=1)
+        """
+        assert rules_fired(src, "HL003") == []
+
+
+# -- HL004: host-sync hazards in traced code ----------------------------------
+
+
+class TestHL004:
+    def test_positive_asarray_in_jit(self):
+        src = """
+        import jax, numpy as np
+        @jax.jit
+        def f(x):
+            return np.asarray(x) + 1
+        """
+        assert rules_fired(src, "HL004") == ["HL004"]
+
+    def test_positive_item_in_cached_program(self):
+        src = """
+        def dispatch(x):
+            def build():
+                def kernel(v):
+                    return v + v.max().item()
+                return kernel
+            return program_cache.cached_program("s", ("k",), build)(x)
+        """
+        assert rules_fired(src, "HL004") == ["HL004"]
+
+    def test_positive_float_of_traced_arg(self):
+        src = """
+        import jax
+        @jax.jit
+        def f(x):
+            return float(x) * 2
+        """
+        assert rules_fired(src, "HL004") == ["HL004"]
+
+    def test_positive_block_until_ready_in_shard_map(self):
+        src = """
+        import jax
+        def run(comm, x):
+            def kernel(v):
+                v.block_until_ready()
+                return v
+            return jax.shard_map(kernel, mesh=comm.mesh)(x)
+        """
+        assert rules_fired(src, "HL004") == ["HL004"]
+
+    def test_negative_outside_traced_code(self):
+        src = """
+        import numpy as np
+        def host_side(x):
+            y = np.asarray(x)
+            x.block_until_ready()
+            return float(x[0]), y
+        """
+        assert rules_fired(src, "HL004") == []
+
+    def test_negative_jnp_inside_jit(self):
+        src = """
+        import jax, jax.numpy as jnp
+        @jax.jit
+        def f(x):
+            return jnp.asarray(x) + 1
+        """
+        assert rules_fired(src, "HL004") == []
+
+
+# -- HL005: knob registry -----------------------------------------------------
+
+
+class TestHL005:
+    def test_positive_environ_get(self):
+        src = 'import os\nv = os.environ.get("HEAT_TPU_NEW_THING", "1")\n'
+        assert rules_fired(src, "HL005") == ["HL005"]
+
+    def test_positive_getenv_and_subscript(self):
+        src = (
+            'import os\n'
+            'a = os.getenv("HEAT_TPU_A")\n'
+            'b = os.environ["HEAT_TPU_B"]\n'
+        )
+        assert rules_fired(src, "HL005") == ["HL005", "HL005"]
+
+    def test_positive_unregistered_knob_via_registry(self):
+        src = (
+            "from heat_tpu.core import knobs\n"
+            'v = knobs.raw("HEAT_TPU_NOT_DECLARED", "")\n'
+        )
+        findings, _ = scan(src, "HL005")
+        assert len(findings) == 1 and "UNREGISTERED" in findings[0].message
+
+    def test_negative_registered_and_writes(self):
+        src = (
+            "import os\n"
+            "from heat_tpu.core import knobs\n"
+            'v = knobs.raw("HEAT_TPU_FUSION", "1")\n'       # registered read
+            'os.environ["HEAT_TPU_FUSION"] = "0"\n'         # write
+            'os.environ.pop("HEAT_TPU_FUSION", None)\n'     # write
+            'flags = os.environ.get("XLA_FLAGS", "")\n'     # not a knob
+        )
+        assert rules_fired(src, "HL005") == []
+
+    def test_negative_registry_module_itself(self):
+        src = 'import os\nv = os.environ.get("HEAT_TPU_FUSION")\n'
+        findings, _ = scan(src, "HL005", relpath="heat_tpu/_knobs.py")
+        assert findings == []
+
+
+# -- HL006: closed-over numeric literal ---------------------------------------
+
+
+class TestHL006:
+    def test_positive_closed_over_float(self):
+        src = """
+        def dispatch(x):
+            scale = 2.0
+            fn = program_cache.cached_program(
+                "site", ("k",), lambda: lambda v: v * scale
+            )
+            return fn(x)
+        """
+        assert rules_fired(src, "HL006") == ["HL006"]
+
+    def test_positive_named_build_fn(self):
+        src = """
+        def dispatch(x):
+            offset = 3
+            def build():
+                def kernel(v):
+                    return v + offset
+                return kernel
+            return program_cache.cached_program("site", ("k",), build)(x)
+        """
+        assert rules_fired(src, "HL006") == ["HL006"]
+
+    def test_negative_runtime_argument(self):
+        # the PR-4 fix pattern: the scalar travels as a runtime arg
+        src = """
+        def dispatch(x, scale):
+            fn = program_cache.cached_program(
+                "site", ("k",), lambda: lambda v, s: v * s
+            )
+            return fn(x, scale)
+        """
+        assert rules_fired(src, "HL006") == []
+
+    def test_negative_locally_rebound_names(self):
+        # loop / with / comprehension targets shadow the outer literal —
+        # the traced body never closes over it
+        src = """
+        def dispatch(x):
+            n = 3
+            w = 7.0
+            def build():
+                def kernel(v):
+                    for n in range(2):
+                        v = v + n
+                    with ctx() as w:
+                        v = v * w
+                    return [v for n in (1, 2)][0]
+                return kernel
+            return program_cache.cached_program("site", ("k",), build)(x)
+        """
+        assert rules_fired(src, "HL006") == []
+
+    def test_negative_module_level_constant(self):
+        # module-level bindings are process-global: not the per-call hazard
+        src = """
+        SCALE = 2.0
+        def dispatch(x):
+            fn = program_cache.cached_program(
+                "site", ("k",), lambda: lambda v: v * SCALE
+            )
+            return fn(x)
+        """
+        assert rules_fired(src, "HL006") == []
+
+
+# -- suppression mechanics ----------------------------------------------------
+
+
+class TestSuppression:
+    SRC = """
+    import jax
+    y = jax.lax.psum(x, 'i')  # heatlint: disable=HL002 -- fixture reason
+    """
+
+    def test_inline_suppression_with_reason(self):
+        findings, suppressed = scan(self.SRC, "HL002")
+        assert findings == []
+        assert len(suppressed) == 1
+        f, reason = suppressed[0]
+        assert f.rule == "HL002" and reason == "fixture reason"
+
+    def test_standalone_comment_covers_next_code_line(self):
+        src = """
+        import jax
+        # heatlint: disable=HL002 -- spans the
+        # rest of this comment block
+        y = jax.lax.psum(x, 'i')
+        """
+        findings, suppressed = scan(src, "HL002")
+        assert findings == [] and len(suppressed) == 1
+
+    def test_standalone_comment_skips_blank_lines(self):
+        # the documented contract is "governs the next CODE line" — a
+        # blank line inside the gap must not silently void the directive
+        src = """
+        import jax
+        # heatlint: disable=HL002 -- fixture reason
+
+        y = jax.lax.psum(x, 'i')
+        """
+        findings, suppressed = scan(src, "HL002")
+        assert findings == [] and len(suppressed) == 1
+        assert suppressed[0][1] == "fixture reason"
+
+    def test_wrong_rule_id_does_not_suppress(self):
+        src = "import jax\ny = jax.lax.psum(x, 'i')  # heatlint: disable=HL001\n"
+        findings, suppressed = scan(src, "HL002")
+        assert len(findings) == 1 and suppressed == []
+
+    def test_deleting_directive_resurfaces_finding(self):
+        stripped = self.SRC.replace(
+            "  # heatlint: disable=HL002 -- fixture reason", ""
+        )
+        findings, suppressed = scan(stripped, "HL002")
+        assert len(findings) == 1 and suppressed == []
+
+
+class TestRepoSuppressionsLoadBearing:
+    """Deleting any committed `# heatlint: disable` must fail the gate."""
+
+    @pytest.mark.parametrize("relpath,rule_id", [
+        ("heat_tpu/parallel/halo.py", "HL002"),
+        ("heat_tpu/parallel/ring.py", "HL002"),
+        ("benchmarks/serving/heat_tpu.py", "HL001"),
+        ("benchmarks/_harness.py", "HL005"),
+        ("bench.py", "HL005"),
+    ])
+    def test_suppressions_are_load_bearing(self, relpath, rule_id):
+        import re
+
+        path = os.path.join(REPO, relpath)
+        src = open(path).read()
+        assert "heatlint: disable" in src, f"{relpath} lost its suppressions"
+        findings, suppressed = analysis.scan_source(
+            relpath, src, [analysis.rule_by_id(rule_id)]
+        )
+        assert findings == [], [f.render() for f in findings]
+        assert suppressed, f"{relpath}: expected suppressed {rule_id} findings"
+        for _, reason in suppressed:
+            assert reason, f"{relpath}: suppression without a reason string"
+        # now delete the directives: the findings must come back
+        stripped = re.sub(r"#\s*heatlint:\s*disable[^\n]*", "# (directive removed)", src)
+        findings2, suppressed2 = analysis.scan_source(
+            relpath, stripped, [analysis.rule_by_id(rule_id)]
+        )
+        assert len(findings2) == len(suppressed), (
+            f"{relpath}: stripping the disable comments did not resurface "
+            f"the findings"
+        )
+
+
+# -- baseline mechanics -------------------------------------------------------
+
+
+class TestBaseline:
+    def _tree(self, tmp_path):
+        mod = tmp_path / "legacy.py"
+        mod.write_text("import jax\ny = jax.lax.psum(x, 'i')\n")
+        return tmp_path
+
+    def test_grandfather_then_clean(self, tmp_path):
+        root = self._tree(tmp_path)
+        report = analysis.analyze(["legacy.py"], str(root))
+        assert len(report.findings) == 1
+        bl = root / "bl.json"
+        analysis.write_baseline(report, str(bl))
+        report2 = analysis.analyze(["legacy.py"], str(root))
+        report2 = analysis.apply_baseline(
+            report2, analysis.load_baseline(str(bl))
+        )
+        assert report2.findings == [] and len(report2.baselined) == 1
+
+    def test_new_finding_not_masked_by_baseline(self, tmp_path):
+        root = self._tree(tmp_path)
+        report = analysis.analyze(["legacy.py"], str(root))
+        bl = root / "bl.json"
+        analysis.write_baseline(report, str(bl))
+        # a NEW violation on a different line must still gate
+        (root / "legacy.py").write_text(
+            "import jax\ny = jax.lax.psum(x, 'i')\n"
+            "z = jax.lax.all_gather(x, 'i')\n"
+        )
+        report2 = analysis.apply_baseline(
+            analysis.analyze(["legacy.py"], str(root)),
+            analysis.load_baseline(str(bl)),
+        )
+        assert len(report2.findings) == 1
+        assert "all_gather" in report2.findings[0].message
+
+    def test_subset_rewrite_preserves_out_of_scope_entries(self, tmp_path):
+        """`--write-baseline` on a path or rule subset must merge, not
+        drop the grandfathered entries it did not scan."""
+        from heat_tpu.analysis.__main__ import main
+
+        (tmp_path / "a.py").write_text("import jax\ny = jax.lax.psum(x, 'i')\n")
+        (tmp_path / "b.py").write_text(
+            "import jax\nz = jax.lax.all_gather(x, 'i')\n"
+        )
+        bl = tmp_path / "bl.json"
+        assert main(["--root", str(tmp_path), "--baseline", str(bl),
+                     "--write-baseline", "a.py", "b.py"]) == 0
+        # re-grandfather only a.py: b.py's entry must survive
+        assert main(["--root", str(tmp_path), "--baseline", str(bl),
+                     "--write-baseline", "a.py"]) == 0
+        paths = {e["path"] for e in analysis.load_baseline_entries(str(bl))}
+        assert paths == {"a.py", "b.py"}
+        # and the merged baseline still gates the full tree clean
+        assert main(["--root", str(tmp_path), "--baseline", str(bl),
+                     "a.py", "b.py"]) == 0
+
+    def test_line_drift_does_not_resurrect(self, tmp_path):
+        root = self._tree(tmp_path)
+        analysis.write_baseline(
+            analysis.analyze(["legacy.py"], str(root)), str(root / "bl.json")
+        )
+        # unrelated edits above the site shift the line number only
+        (root / "legacy.py").write_text(
+            "import jax\n\n\n# pushed down\ny = jax.lax.psum(x, 'i')\n"
+        )
+        report = analysis.apply_baseline(
+            analysis.analyze(["legacy.py"], str(root)),
+            analysis.load_baseline(str(root / "bl.json")),
+        )
+        assert report.findings == [] and len(report.baselined) == 1
+
+
+# -- the repo itself ----------------------------------------------------------
+
+
+class TestRepoClean:
+    def test_run_rejects_nonexistent_explicit_path(self):
+        # a typo'd path must error, not report a clean 0-file scan
+        with pytest.raises(FileNotFoundError):
+            analysis.run(paths=["heat_tpu/anlaysis"], root=REPO)
+
+    def test_repo_clean_against_committed_baseline(self, repo_report):
+        report = repo_report
+        assert report.findings == [], "\n".join(
+            f.render() for f in report.findings
+        )
+        assert report.files_scanned > 100
+
+    def test_committed_baseline_entries_still_real(self, repo_report):
+        """Every grandfathered entry must still match a live finding —
+        paid-down debt must leave the baseline (shrink-only contract)."""
+        baseline_path = os.path.join(REPO, analysis.BASELINE_NAME)
+        baseline = analysis.load_baseline(baseline_path)
+        live = {f.key() for f in repo_report.baselined}
+        stale = [k for k in baseline if k not in live]
+        assert not stale, (
+            f"baseline entries no longer fire — remove them "
+            f"(python -m heat_tpu.analysis --write-baseline): {stale}"
+        )
+
+    def test_rule_allowlists_name_real_files(self):
+        for rule in analysis.RULES:
+            for rel in rule.allowed:
+                assert os.path.exists(os.path.join(REPO, rel)), (
+                    f"{rule.id} allowlist entry {rel!r} no longer exists"
+                )
+
+    def test_at_least_six_rules(self):
+        assert len(analysis.RULES) >= 6
+        ids = [r.id for r in analysis.RULES]
+        assert ids == sorted(ids) and len(set(ids)) == len(ids)
+        for r in analysis.RULES:
+            assert r.title and r.rationale
+
+
+# -- CLI ----------------------------------------------------------------------
+
+
+class TestCLI:
+    def test_exit_zero_on_clean_repo(self, capsys):
+        from heat_tpu.analysis.__main__ import main
+
+        rc = main(["--root", REPO, "--format", "json"])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 0 and out["new"] == 0
+        assert out["files"] > 100
+        assert out["suppressed"] and out["baselined"]
+
+    def test_exit_one_on_new_finding(self, tmp_path, capsys):
+        from heat_tpu.analysis.__main__ import main
+
+        bad = tmp_path / "bad.py"
+        bad.write_text("import jax\nf = jax.jit(lambda v: v)\n")
+        rc = main(["--root", str(tmp_path), str(bad)])
+        assert rc == 1
+        assert "HL001" in capsys.readouterr().out
+
+    def test_select_and_list_rules(self, capsys):
+        from heat_tpu.analysis.__main__ import main
+
+        assert main(["--list-rules"]) == 0
+        out = capsys.readouterr().out
+        for rid in ("HL001", "HL002", "HL003", "HL004", "HL005", "HL006"):
+            assert rid in out
+        assert main(
+            ["--root", REPO, "--select", "HL003,HL006", "heat_tpu/core"]
+        ) == 0
+
+
+# -- knob registry ------------------------------------------------------------
+
+
+class TestKnobRegistry:
+    def test_unregistered_read_raises(self):
+        # the message must name the file where _register() calls live
+        with pytest.raises(KeyError, match=r"heat_tpu/_knobs\.py"):
+            knobs.raw("HEAT_TPU_DOES_NOT_EXIST")
+
+    def test_every_knob_documented_and_namespaced(self):
+        assert len(knobs.REGISTRY) >= 25
+        for name, k in knobs.REGISTRY.items():
+            assert name.startswith("HEAT_TPU_")
+            assert k.doc and len(k.doc) > 10
+            assert k.type in ("bool", "int", "float", "str", "enum",
+                              "bytes", "spec")
+            if k.type == "enum":
+                assert k.choices and k.default in k.choices
+
+    def test_typed_get_conventions(self, monkeypatch):
+        monkeypatch.delenv("HEAT_TPU_FUSION", raising=False)
+        assert knobs.get("HEAT_TPU_FUSION") is True  # default-on
+        monkeypatch.setenv("HEAT_TPU_FUSION", "0")
+        assert knobs.get("HEAT_TPU_FUSION") is False
+        monkeypatch.delenv("HEAT_TPU_TELEMETRY", raising=False)
+        assert knobs.get("HEAT_TPU_TELEMETRY") is False  # default-off
+        monkeypatch.setenv("HEAT_TPU_TELEMETRY", "1")
+        assert knobs.get("HEAT_TPU_TELEMETRY") is True
+        monkeypatch.setenv("HEAT_TPU_FUSION_DEPTH", "not-a-number")
+        assert knobs.get("HEAT_TPU_FUSION_DEPTH") == 16  # malformed->default
+        monkeypatch.setenv("HEAT_TPU_COLLECTIVE_PREC", "bogus")
+        assert knobs.get("HEAT_TPU_COLLECTIVE_PREC") == "off"
+
+    def test_knob_table_in_api_docs_is_current(self):
+        """The docs/API.md knob table is GENERATED — regenerating must be
+        a no-op (`python -m heat_tpu.analysis --knob-table`)."""
+        doc = open(os.path.join(REPO, "docs", "API.md")).read()
+        begin, end = "<!-- knob-table:begin", "<!-- knob-table:end -->"
+        assert begin in doc and end in doc, "knob table markers missing"
+        committed = doc.split(begin, 1)[1].split("-->", 1)[1].split(end)[0]
+        assert committed.strip() == knobs.markdown_table().strip(), (
+            "docs/API.md knob table is stale — regenerate it with "
+            "`python -m heat_tpu.analysis --knob-table` and paste between "
+            "the markers"
+        )

@@ -1,175 +1,61 @@
-"""Static regression guard: every ``jax.jit`` in ``heat_tpu/`` must route
-through the process-global program registry (ISSUE 3).
+"""Single-jit-site regression guard, re-expressed over heatlint (ISSUE 10).
 
-Before ``heat_tpu.core.program_cache``, ~18 call sites built fresh jitted
-closures per invocation — every ``resplit``, repeated factory assembly and
-re-entered kernel retraced and recompiled an identical program. This test
-AST-scans the package and fails on any **bare ``jax.jit(...)`` call**
-outside the sanctioned locations, pointing the author at
-``program_cache.cached_program``.
+The original ad-hoc AST scan that lived here became heatlint rule HL001
+(``heat_tpu/analysis/rules.py``) — one source of truth shared by this
+tier-1 shim, the ``python -m heat_tpu.analysis`` CLI, and the CI gate.
+This module keeps the coverage contract: every ``jax.jit``/``pjit`` in
+``heat_tpu/`` must route through ``program_cache.cached_program``, with
+module-level decorators and the explicitly allowlisted instrument files
+(the registry itself, the HLO auditor, measure_compile) exempt.
 
-Allowed forms:
-
-* calls inside ``heat_tpu/core/program_cache.py`` (the one sanctioned
-  ``jax.jit`` site) and the explicit :data:`ALLOWED_FILES` below;
-* **module-level** ``@jax.jit`` / ``@functools.partial(jax.jit, ...)``
-  decorators — a module-level jitted function is a process-global
-  singleton already (jax's own cache memoizes it per avals), so routing it
-  through the registry would add a lookup for nothing. The same decorator
-  on a *nested* function is a fresh closure per call — exactly the
-  retrace-per-invocation bug — and is flagged.
+Behavioral fixtures for HL001 (positive/negative/suppressed/baselined
+snippets) live in ``tests/test_heatlint.py``.
 """
 
 from __future__ import annotations
 
-import ast
 import os
 
-import pytest
+from heat_tpu import analysis
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PKG = os.path.join(REPO, "heat_tpu")
-
-# Files where bare jax.jit calls are deliberate, with the reason on record.
-ALLOWED_FILES = {
-    # the one sanctioned jit site: the registry itself
-    "core/program_cache.py",
-    # the HLO auditor lowers arbitrary computations AOT; its jit is the
-    # observation instrument, not a dispatch path
-    "telemetry/hlo.py",
-    # measure_compile() times an AOT jit(f).lower().compile() — caching it
-    # would defeat the measurement
-    "telemetry/__init__.py",
-}
-
-_JIT_OWNERS = {"jax", "_jax"}
 
 
-def _is_jax_jit(node: ast.AST) -> bool:
-    """``jax.jit`` / ``_jax.jit`` attribute reference."""
-    return (
-        isinstance(node, ast.Attribute)
-        and node.attr == "jit"
-        and isinstance(node.value, ast.Name)
-        and node.value.id in _JIT_OWNERS
-    )
-
-
-def _decorator_mentions_jit(dec: ast.AST) -> bool:
-    """True when a decorator is @jax.jit, @jax.jit(...), or
-    @[functools.]partial(jax.jit, ...)."""
-    if _is_jax_jit(dec):
-        return True
-    if isinstance(dec, ast.Call):
-        if _is_jax_jit(dec.func):
-            return True
-        return any(_is_jax_jit(a) for a in dec.args)
-    return False
-
-
-def _scan_file(path: str, rel: str):
-    """Yield ``(rel, lineno, message)`` violations for one source file."""
-    with open(path, "r") as f:
-        tree = ast.parse(f.read(), filename=rel)
-
-    # module-level function defs: their decorators are sanctioned
-    module_level_defs = {
-        node
-        for node in tree.body
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
-    }
-    allowed_decorator_calls = set()
-    for node in module_level_defs:
-        for dec in node.decorator_list:
-            if isinstance(dec, ast.Call) and _is_jax_jit(dec.func):
-                allowed_decorator_calls.add(id(dec))
-
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Call) and _is_jax_jit(node.func):
-            if id(node) in allowed_decorator_calls:
-                continue
-            yield (
-                rel, node.lineno,
-                "bare jax.jit( call — route this program through "
-                "heat_tpu.core.program_cache.cached_program so repeated "
-                "calls reuse one compiled executable",
-            )
-        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            if node in module_level_defs:
-                continue
-            for dec in node.decorator_list:
-                if _decorator_mentions_jit(dec):
-                    yield (
-                        rel, dec.lineno,
-                        "@jax.jit on a nested function builds a fresh "
-                        "jitted closure per enclosing call — use "
-                        "program_cache.cached_program (or hoist the "
-                        "decorated function to module level)",
-                    )
-
-
-def _package_files():
-    for dirpath, dirnames, filenames in os.walk(PKG):
-        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
-        for name in sorted(filenames):
-            if name.endswith(".py"):
-                path = os.path.join(dirpath, name)
-                yield path, os.path.relpath(path, PKG).replace(os.sep, "/")
+def _run_hl001():
+    return analysis.analyze(["heat_tpu"], REPO, select=["HL001"])
 
 
 def test_no_stray_jax_jit():
-    violations = []
-    scanned = 0
-    for path, rel in _package_files():
-        scanned += 1
-        if rel in ALLOWED_FILES:
-            continue
-        violations.extend(_scan_file(path, rel))
-    assert scanned > 50, "package scan found suspiciously few files"
-    assert not violations, "\n".join(
-        f"heat_tpu/{rel}:{line}: {msg}" for rel, line, msg in violations
+    report = _run_hl001()
+    assert report.files_scanned > 50, "package scan found suspiciously few files"
+    assert not report.findings, "\n".join(
+        f.render() for f in report.findings
     )
 
 
+def test_hl001_needs_no_baseline_or_suppressions():
+    """The single-jit-site invariant holds OUTRIGHT in the package: no
+    grandfathered entries, no inline escapes. If this fails, a new jit
+    site was suppressed/baselined instead of routed through the
+    registry — that needs a rule-allowlist review, not an escape hatch."""
+    report = _run_hl001()
+    assert not report.suppressed, [
+        f.render() for f, _ in report.suppressed
+    ]
+    baseline_path = os.path.join(REPO, analysis.BASELINE_NAME)
+    if os.path.exists(baseline_path):
+        grandfathered = [
+            key for key in analysis.load_baseline(baseline_path)
+            if key[0] == "HL001" and key[1].startswith("heat_tpu/")
+        ]
+        assert not grandfathered, grandfathered
+
+
 def test_allowlist_entries_exist():
-    """A stale allowlist silently widens the exemption — every entry must
-    name a real file."""
-    for rel in ALLOWED_FILES:
-        assert os.path.exists(os.path.join(PKG, rel)), (
-            f"ALLOWED_FILES entry {rel!r} no longer exists; remove it"
+    """A stale allowlist silently widens the exemption — every HL001
+    entry must name a real file."""
+    rule = analysis.rule_by_id("HL001")
+    for rel in rule.allowed:
+        assert os.path.exists(os.path.join(REPO, rel)), (
+            f"HL001 allowlist entry {rel!r} no longer exists; remove it"
         )
-
-
-@pytest.mark.parametrize(
-    "src,bad",
-    [
-        ("import jax\nx = jax.jit(lambda v: v)\n", True),
-        ("import jax\n@jax.jit\ndef f(x):\n    return x\n", False),
-        (
-            "import functools, jax\n"
-            "@functools.partial(jax.jit, static_argnums=(0,))\n"
-            "def f(n, x):\n    return x\n",
-            False,
-        ),
-        (
-            "import jax\n"
-            "def outer():\n"
-            "    @jax.jit\n"
-            "    def inner(x):\n        return x\n"
-            "    return inner\n",
-            True,
-        ),
-        (
-            "import jax\n"
-            "def outer():\n"
-            "    return jax.jit(lambda v: v)\n",
-            True,
-        ),
-    ],
-)
-def test_scanner_self_check(tmp_path, src, bad):
-    """The scanner itself must keep flagging the patterns it exists for."""
-    p = tmp_path / "mod.py"
-    p.write_text(src)
-    found = list(_scan_file(str(p), "mod.py"))
-    assert bool(found) == bad, found
