@@ -1010,6 +1010,17 @@ def main():
                 detail["heatlint"] = _heatlint.bench_field()
             except Exception as e:  # noqa: BLE001
                 detail["heatlint"] = {"error": repr(e)}
+            # autotuner state (ISSUE 11, schema in docs/BENCHMARKS.md):
+            # armed bit, tuning-DB record count, trials run / DB hits in
+            # this process, and the chosen config per adopted site. The
+            # honest on_chip bit above governs this field too — a tuned
+            # config measured on a CPU fallback is a CPU number.
+            try:
+                from heat_tpu import autotune as _autotune
+
+                detail["autotune"] = _autotune.bench_field()
+            except Exception as e:  # noqa: BLE001
+                detail["autotune"] = {"error": repr(e)}
         print(json.dumps(detail), file=sys.stderr, flush=True)
 
         # honesty bit (VERDICT r5 #9, schema in docs/BENCHMARKS.md): the

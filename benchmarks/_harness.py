@@ -56,6 +56,17 @@ def base_parser(description: str) -> argparse.ArgumentParser:
                         "compiles entirely — compile_seconds in the summary "
                         "drops to the cache-deserialization cost "
                         "(docs/TUNING_RUNBOOK.md)")
+    p.add_argument("--tune-db", metavar="DIR",
+                   # heatlint: disable=HL005 -- read before `import heat_tpu`:
+                   # mirrors --compile-cache, the env must be set before the
+                   # backend probe / package import
+                   default=os.environ.get("HEAT_TPU_TUNE_DB") or None,
+                   help="persistent tuning-DB directory (default: "
+                        "$HEAT_TPU_TUNE_DB). Arms the autotuner "
+                        "(HEAT_TPU_AUTOTUNE=1): persisted knob winners for "
+                        "this mesh are adopted at dispatch time, so a "
+                        "repeated bench process starts *tuned* with zero "
+                        "measured trials (docs/AUTOTUNE.md)")
     return p
 
 
@@ -68,6 +79,13 @@ def bootstrap(args):
         # below already does): program_cache reads the env at import and
         # wires jax's persistent compilation cache from it
         os.environ["HEAT_TPU_COMPILE_CACHE"] = args.compile_cache
+    if getattr(args, "tune_db", None):
+        # same ordering contract as the compile cache; --tune-db arms
+        # the autotuner UNLESS the environment already pins
+        # HEAT_TPU_AUTOTUNE (an explicit =0 must keep a baseline run
+        # untuned even when HEAT_TPU_TUNE_DB is exported globally)
+        os.environ["HEAT_TPU_TUNE_DB"] = args.tune_db
+        os.environ.setdefault("HEAT_TPU_AUTOTUNE", "1")
     if args.mesh:
         # one canonical copy of the XLA_FLAGS/JAX_PLATFORMS dance, shared
         # with the telemetry audit CLI (backend init is lazy, so importing
@@ -122,11 +140,15 @@ def timed_trials(args, fit, sync):
         "trials": args.trials,
         "devices": _device_info(),
     }
-    from heat_tpu import telemetry
+    from heat_tpu import autotune, telemetry
 
     if telemetry.enabled():
         telemetry.memory.watermark("post_trials")
         summary.update(telemetry.report.bench_fields())
+    if autotune.enabled():
+        # what the tuner did for THIS run: trials, DB hits, adopted
+        # config per site (docs/AUTOTUNE.md; --tune-db arms this)
+        summary["autotune"] = autotune.bench_field()
     print(json.dumps(summary), flush=True)
     return summary
 

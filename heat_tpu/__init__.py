@@ -52,3 +52,9 @@ from . import datasets
 from . import nn
 from . import optim
 from . import serve
+
+# the measured-feedback knob autotuner (ISSUE 11) mounts last: it
+# consumes the substrate (knobs registry, telemetry, cost model, program
+# cache) and is consulted from dispatch sites only behind the
+# HEAT_TPU_AUTOTUNE flag check
+from . import autotune

@@ -29,16 +29,25 @@ read is registered, typed, and documented here.
 
 from __future__ import annotations
 
+import contextlib
 import os
+import threading
 from dataclasses import dataclass, field
-from typing import Dict, Optional, Tuple, Union
+from typing import Dict, Iterable, Optional, Tuple, Union
 
 __all__ = [
     "Knob",
+    "Tunable",
     "REGISTRY",
     "raw",
     "get",
     "names",
+    "tunables",
+    "default_raw",
+    "overrides",
+    "set_override",
+    "clear_overrides",
+    "overlay",
     "markdown_table",
     "FALSY",
     "TRUTHY",
@@ -52,6 +61,31 @@ TRUTHY = ("1", "true", "yes", "on")
 
 
 @dataclass(frozen=True)
+class Tunable:
+    """Autotuner metadata for one knob (ISSUE 11): the candidate search
+    space declared NEXT TO the knob, not hardcoded in the tuner.
+
+    ``values`` are raw environment strings (what ``heat_tpu.autotune``
+    installs into the knob overlay while searching). ``kind`` is the
+    constraint class the trial validator enforces:
+
+    * ``exact`` — every candidate value must leave results bit-identical
+      (fusion depth, relayout plan, ring overlap); validated by digest.
+    * ``lossy`` — values other than ``exact_value`` may change numerics
+      (collective precision, cdist dot strategy, non-exact serve
+      kernels); only searched under a caller-stated error budget, and a
+      winning lossy pick must measure within it.
+    * ``neutral`` — scheduling/throughput only (serve ladder, gather
+      window, queue bound); results are still digest-validated where the
+      workload produces any.
+    """
+
+    values: Tuple[str, ...]
+    kind: str  # 'exact' | 'lossy' | 'neutral'
+    exact_value: Optional[str] = None  # lossy knobs: the exact-semantics value
+
+
+@dataclass(frozen=True)
 class Knob:
     """One declared environment knob.
 
@@ -62,7 +96,8 @@ class Knob:
     feature is simply off / derived elsewhere). ``scope`` groups the docs
     table: ``runtime`` knobs are read by the package itself, ``bench`` by
     the benchmark harnesses, ``ci`` by ``scripts/run_ci.sh``, ``tests`` by
-    the pytest conftest.
+    the pytest conftest. ``tunable`` (perf-relevant knobs only) declares
+    the autotuner's candidate values and constraint class.
     """
 
     name: str
@@ -71,6 +106,7 @@ class Knob:
     doc: str
     choices: Tuple[str, ...] = field(default=())
     scope: str = "runtime"
+    tunable: Optional[Tunable] = None
 
 
 REGISTRY: Dict[str, Knob] = {}
@@ -84,12 +120,34 @@ def _register(
     *,
     choices: Tuple[str, ...] = (),
     scope: str = "runtime",
+    tunable: Optional[Tunable] = None,
 ) -> None:
     if name in REGISTRY:
         raise ValueError(f"knob {name!r} registered twice")
     if not name.startswith("HEAT_TPU_"):
         raise ValueError(f"knob {name!r} must be namespaced HEAT_TPU_*")
-    REGISTRY[name] = Knob(name, type, default, doc, choices=choices, scope=scope)
+    if tunable is not None:
+        if tunable.kind not in ("exact", "lossy", "neutral"):
+            raise ValueError(
+                f"knob {name!r}: tunable kind {tunable.kind!r} is not one "
+                "of exact/lossy/neutral"
+            )
+        if not tunable.values or not all(
+            isinstance(v, str) and v for v in tunable.values
+        ):
+            raise ValueError(
+                f"knob {name!r}: tunable values must be non-empty raw "
+                f"strings, got {tunable.values!r}"
+            )
+        if tunable.kind == "lossy" and tunable.exact_value is None:
+            raise ValueError(
+                f"knob {name!r}: a lossy tunable must declare its "
+                "exact-semantics value"
+            )
+    REGISTRY[name] = Knob(
+        name, type, default, doc, choices=choices, scope=scope,
+        tunable=tunable,
+    )
 
 
 # -- runtime knobs ------------------------------------------------------------
@@ -130,27 +188,32 @@ _register(
     "HEAT_TPU_FUSION", "bool", True,
     "Elementwise defer-and-fuse dispatch (core/fusion.py). `0` restores "
     "pure-eager dispatch bit-for-bit.",
+    tunable=Tunable(("1", "0"), "exact"),
 )
 _register(
     "HEAT_TPU_FUSION_REDUCE", "bool", True,
     "Fusion 2.0 through-reduction absorption and matmul/moments epilogue "
     "grafting. `0` restores flush-at-reduction dispatch.",
+    tunable=Tunable(("1", "0"), "exact"),
 )
 _register(
     "HEAT_TPU_FUSION_DEPTH", "int", 16,
     "Max fused-chain depth before a forced flush (node cap is 4x this).",
+    tunable=Tunable(("4", "8", "16", "32", "64"), "exact"),
 )
 _register(
     "HEAT_TPU_RELAYOUT_PLAN", "enum", "auto",
     "Relayout planning policy (core/relayout_planner.py): `auto` picks "
     "from tensor size vs the HBM budget; the rest force one decomposition.",
     choices=("auto", "monolithic", "chunked", "alltoall"),
+    tunable=Tunable(("auto", "monolithic", "chunked", "alltoall"), "exact"),
 )
 _register(
     "HEAT_TPU_RING_OVERLAP", "bool", True,
     "Double-buffered ring schedules (cdist/manhattan/rbf, TSQR gram "
     "ring): issue the next hop's ppermute under the local GEMM. `0` "
     "restores the serial p-hop kernels verbatim.",
+    tunable=Tunable(("1", "0"), "exact"),
 )
 _register(
     "HEAT_TPU_COLLECTIVE_PREC", "enum", "off",
@@ -159,10 +222,14 @@ _register(
     "blockwise EQuARX max-abs quantization. Exact-semantics sites pin "
     "`off` per call.",
     choices=("off", "bf16", "int8", "blockwise"),
+    tunable=Tunable(
+        ("off", "bf16", "int8", "blockwise"), "lossy", exact_value="off"
+    ),
 )
 _register(
     "HEAT_TPU_COLLECTIVE_PREC_BLOCK", "int", 128,
     "Blockwise-quantization scale granularity in elements.",
+    tunable=Tunable(("64", "128", "256"), "lossy", exact_value="128"),
 )
 _register(
     "HEAT_TPU_CDIST_PREC", "enum", "bf16x3",
@@ -170,6 +237,10 @@ _register(
     "one-line revert knob while bf16x3 is unmeasured on chip "
     "(docs/TUNING_RUNBOOK.md).",
     choices=("bf16x3", "default", "high", "highest"),
+    tunable=Tunable(
+        ("bf16x3", "default", "high", "highest"), "lossy",
+        exact_value="highest",
+    ),
 )
 _register(
     "HEAT_TPU_RETRIES", "int", 0,
@@ -198,6 +269,7 @@ _register(
 _register(
     "HEAT_TPU_SERVE_MAX_BATCH", "int", 64,
     "Top bucket of the serving micro-batch ladder (serve/server.py).",
+    tunable=Tunable(("16", "32", "64", "128"), "neutral"),
 )
 _register(
     "HEAT_TPU_SERVE_LADDER", "str", None,
@@ -207,16 +279,49 @@ _register(
 _register(
     "HEAT_TPU_SERVE_MAX_WAIT_MS", "float", 2.0,
     "Micro-batch gather window in milliseconds.",
+    tunable=Tunable(("0.5", "1.0", "2.0", "4.0"), "neutral"),
 )
 _register(
     "HEAT_TPU_SERVE_QUEUE_MAX", "int", 1024,
     "Admission-control bound on pending serving requests (503-style shed "
     "beyond it).",
+    tunable=Tunable(("256", "1024", "4096"), "neutral"),
 )
 _register(
     "HEAT_TPU_SERVE_EXACT", "bool", True,
     "Batch-shape-stable exact serving kernels (batched == solo "
     "bit-identity); `0` selects the MXU GEMM forms.",
+    tunable=Tunable(("1", "0"), "lossy", exact_value="1"),
+)
+
+# -- autotuner knobs (heat_tpu/autotune, ISSUE 11) ----------------------------
+
+_register(
+    "HEAT_TPU_AUTOTUNE", "bool", False,
+    "Arm the measured-feedback knob autotuner (heat_tpu/autotune, "
+    "docs/AUTOTUNE.md): program-cache misses and Server construction "
+    "consult the tuning DB (warm start) and `autotune.tune()` runs "
+    "measured trials. Default-off is bit-for-bit the untuned dispatch "
+    "path — one flag check, no DB reads.",
+)
+_register(
+    "HEAT_TPU_TUNE_DB", "str", None,
+    "Directory of the persistent tuning DB (atomic-swap JSON records "
+    "keyed by program signature + mesh topology + backend). A second "
+    "process pointed at a populated DB starts *tuned* with zero measured "
+    "trials, the same way HEAT_TPU_COMPILE_CACHE makes it start "
+    "*compiled*.",
+)
+_register(
+    "HEAT_TPU_AUTOTUNE_TRIALS", "int", 5,
+    "Measured trials per surviving candidate config (median-of-k with "
+    "MAD outlier rejection).",
+)
+_register(
+    "HEAT_TPU_AUTOTUNE_BUDGET", "float", None,
+    "Ambient max amax-normalized relative error the tuner may trade for "
+    "speed when the caller states none. Unset = exact-only: lossy knob "
+    "values are never searched.",
 )
 
 # -- bench harness knobs ------------------------------------------------------
@@ -273,9 +378,85 @@ for _name, _doc in (
     ("HEAT_TPU_CI_SKIP_SERVING", "Skip the open-loop serving gate."),
     ("HEAT_TPU_CI_SKIP_HEATLINT", "Skip the heatlint static-analysis "
      "gate (ISSUE 10)."),
+    ("HEAT_TPU_CI_SKIP_AUTOTUNE", "Skip the autotune gate (ISSUE 11: "
+     "tuned-vs-default wall, budget/digest validation, second-process "
+     "zero-trial warm start)."),
 ):
     _register(_name, "str", None, _doc, scope="ci")
 del _name, _doc
+
+
+# -- overlay ------------------------------------------------------------------
+# Tuned knob values (heat_tpu/autotune, ISSUE 11) are installed HERE, in
+# front of the environment, so every consumer of the registry — fusion,
+# the relayout planner, collective precision, the serving ladder, and any
+# future knob — sees tuned values through the reads it already performs.
+# The overlay is the ONLY sanctioned way to override a knob in-process;
+# it never writes os.environ (subprocesses inherit only what the caller
+# exports deliberately).
+
+_OVERRIDES: Dict[str, str] = {}
+_OVERRIDE_LOCK = threading.RLock()
+
+
+def overrides() -> Dict[str, str]:
+    """Snapshot of the active overlay (knob name -> raw string)."""
+    with _OVERRIDE_LOCK:
+        return dict(_OVERRIDES)
+
+
+def set_override(name: str, value: Optional[str]) -> None:
+    """Install (or with ``None`` remove) one overlay entry. The name must
+    be registered — the overlay cannot smuggle in undeclared knobs."""
+    if name not in REGISTRY:
+        raise KeyError(
+            f"{name!r} is not a registered HEAT_TPU knob — declare it in "
+            "heat_tpu/_knobs.py before overriding it"
+        )
+    with _OVERRIDE_LOCK:
+        if value is None:
+            _OVERRIDES.pop(name, None)
+        else:
+            _OVERRIDES[name] = str(value)
+
+
+def clear_overrides(names_: Optional[Iterable[str]] = None) -> None:
+    """Drop the whole overlay (default) or just ``names_``."""
+    with _OVERRIDE_LOCK:
+        if names_ is None:
+            _OVERRIDES.clear()
+        else:
+            for n in names_:
+                _OVERRIDES.pop(n, None)
+
+
+@contextlib.contextmanager
+def overlay(mapping: Dict[str, Optional[str]]):
+    """Temporarily install ``mapping`` into the overlay (the autotuner's
+    per-candidate scope), restoring the previous entries — including
+    their absence — on exit."""
+    with _OVERRIDE_LOCK:
+        # validate every name BEFORE installing anything: a mid-loop
+        # KeyError would otherwise leak the already-installed entries
+        # permanently (the restore below never runs on an install error)
+        unknown = [n for n in mapping if n not in REGISTRY]
+        if unknown:
+            raise KeyError(
+                f"{unknown[0]!r} is not a registered HEAT_TPU knob — "
+                "declare it in heat_tpu/_knobs.py before overriding it"
+            )
+        prev = {n: _OVERRIDES.get(n) for n in mapping}
+        for n, v in mapping.items():
+            set_override(n, v)
+    try:
+        yield
+    finally:
+        with _OVERRIDE_LOCK:
+            for n, v in prev.items():
+                if v is None:
+                    _OVERRIDES.pop(n, None)
+                else:
+                    _OVERRIDES[n] = v
 
 
 # -- reads --------------------------------------------------------------------
@@ -286,8 +467,28 @@ def names() -> frozenset:
     return frozenset(REGISTRY)
 
 
+def tunables() -> Dict[str, Knob]:
+    """The knobs carrying autotuner search-space metadata."""
+    return {n: k for n, k in REGISTRY.items() if k.tunable is not None}
+
+
+def default_raw(name: str) -> str:
+    """The raw string a knob effectively has RIGHT NOW without tuning:
+    the overlay/environment value when set, else the declared default
+    rendered in env convention. This is the autotuner's "default config"
+    entry — the candidate the winner must beat or tie."""
+    k = REGISTRY[name]
+    v = raw(name)
+    if v is not None and v.strip():
+        return v.strip()
+    if k.type == "bool":
+        return "1" if k.default else "0"
+    return "" if k.default is None else str(k.default)
+
+
 def raw(name: str, default: Optional[str] = None) -> Optional[str]:
-    """The raw environment string for a registered knob.
+    """The raw string for a registered knob: the overlay entry when one
+    is installed (tuned values, ISSUE 11), else the environment.
 
     This is the ONE sanctioned ``os.environ`` read for ``HEAT_TPU_*``
     variables (heatlint HL005). Unregistered names raise — a new knob
@@ -300,6 +501,11 @@ def raw(name: str, default: Optional[str] = None) -> Optional[str]:
             "heat_tpu/_knobs.py (type, default, docstring; re-exported via "
             "heat_tpu.core.knobs) before reading it"
         )
+    if _OVERRIDES:
+        with _OVERRIDE_LOCK:
+            v = _OVERRIDES.get(name)
+        if v is not None:
+            return v
     return os.environ.get(name, default)
 
 
@@ -308,9 +514,10 @@ def get(name: str):
     knob's declared type, falling back to the declared default when unset
     or malformed. Bool parsing follows the shared conventions: default-on
     knobs stay on unless the value is in :data:`FALSY`; default-off knobs
-    need an explicit :data:`TRUTHY`."""
+    need an explicit :data:`TRUTHY`. Consults the overlay first, like
+    :func:`raw`."""
     k = REGISTRY[name]
-    s = (os.environ.get(name) or "").strip()
+    s = (raw(name) or "").strip()
     if not s:
         return k.default
     if k.type == "bool":
@@ -350,24 +557,38 @@ def _default_str(k: Knob) -> str:
     return f"`{k.default}`"
 
 
+def _tunable_str(k: Knob) -> str:
+    t = k.tunable
+    if t is None:
+        return "—"
+    vals = ", ".join(t.values)
+    if t.kind == "lossy":
+        return f"lossy (exact: `{t.exact_value}`): `{vals}`"
+    return f"{t.kind}: `{vals}`"
+
+
 def markdown_table() -> str:
     """The knob catalog as markdown, grouped by scope — the generated
     section of docs/API.md (``tests/test_heatlint.py`` pins the committed
     doc to this output; regenerate with
-    ``python -m heat_tpu.analysis --knob-table``)."""
+    ``python -m heat_tpu.analysis --knob-table``). The *Tunable* column
+    is the autotuner's declared search space (docs/AUTOTUNE.md)."""
     out = []
     for scope, title in _SCOPE_TITLES:
         knobs = [k for k in REGISTRY.values() if k.scope == scope]
         if not knobs:
             continue
         out.append(f"### {title}\n")
-        out.append("| Knob | Type | Default | Description |")
-        out.append("|---|---|---|---|")
+        out.append("| Knob | Type | Default | Tunable | Description |")
+        out.append("|---|---|---|---|---|")
         for k in sorted(knobs, key=lambda k: k.name):
             typ = k.type
             if k.choices:
                 typ = " \\| ".join(k.choices)
             doc = " ".join(k.doc.split())
-            out.append(f"| `{k.name}` | {typ} | {_default_str(k)} | {doc} |")
+            out.append(
+                f"| `{k.name}` | {typ} | {_default_str(k)} | "
+                f"{_tunable_str(k)} | {doc} |"
+            )
         out.append("")
     return "\n".join(out).rstrip() + "\n"

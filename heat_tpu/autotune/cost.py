@@ -1,0 +1,125 @@
+"""Offline candidate pruning (ISSUE 11 stage 1): the analytic collective
+cost model plus the planner's ``memory_analysis``-calibrated temp model
+rank the lattice before anything is measured.
+
+A *cost function* maps one config dict to a predicted scalar (lower is
+better; ``inf`` = infeasible, pruned outright). The built-in
+:func:`relayout_cost_fn` prices the relayout family the same way the
+planner and the HLO auditor do — wire bytes from
+:mod:`heat_tpu.telemetry.collectives` (``precision=`` included, so a
+compressed candidate is priced byte-for-byte like the program it would
+dispatch) and per-device temp bytes from
+:mod:`heat_tpu.core.relayout_planner` (optionally replaced by a compiled
+program's measured ``memory_analysis()`` figure, exactly like
+``plan(measured_need=...)``). Sites without an analytic model skip
+pruning and go straight to measured trials.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Dict, List, Optional, Sequence
+
+__all__ = ["prune", "rank", "relayout_cost_fn"]
+
+ConfigCost = Callable[[Dict[str, str]], float]
+
+
+def rank(
+    configs: List[Dict[str, str]], cost_fn: ConfigCost
+) -> List[tuple]:
+    """``(predicted_cost, lattice_index, config)`` rows sorted by the
+    analytic model (stable on ties via the lattice index). A cost
+    function that raises for a config marks it infeasible rather than
+    killing the tune."""
+    rows = []
+    for i, cfg in enumerate(configs):
+        try:
+            c = float(cost_fn(cfg))
+        except Exception:
+            c = math.inf
+        rows.append((c, i, cfg))
+    return sorted(rows, key=lambda r: (r[0], r[1]))
+
+
+def prune(
+    configs: List[Dict[str, str]],
+    cost_fn: Optional[ConfigCost],
+    *,
+    keep: int = 8,
+) -> List[Dict[str, str]]:
+    """The configs that graduate to measured trials: the default config
+    (``configs[0]``) unconditionally — the never-worse guarantee needs
+    its measured wall — plus the ``keep - 1`` analytically cheapest
+    feasible challengers, in predicted order. ``cost_fn=None`` skips
+    pruning entirely (no analytic model for this site: every lattice
+    candidate is measured, so callers without a model keep their search
+    lists small)."""
+    if cost_fn is None or len(configs) <= 1:
+        return list(configs)
+    default = configs[0]
+    kept = [default]
+    for c, i, cfg in rank(configs[1:], cost_fn):
+        if len(kept) >= max(1, keep):
+            break
+        if math.isinf(c):
+            continue
+        kept.append(cfg)
+    return kept
+
+
+def relayout_cost_fn(
+    gshape: Sequence[int],
+    itemsize: int,
+    src_split: Optional[int],
+    dst_split: Optional[int],
+    nproc: int,
+    *,
+    budget: Optional[int] = None,
+    measured_need: Optional[int] = None,
+) -> ConfigCost:
+    """Analytic cost of one relayout signature under a candidate config:
+    the plan the candidate's ``HEAT_TPU_RELAYOUT_PLAN`` would select
+    (``budget``/``measured_need`` in the planner's own convention),
+    priced in wire bytes at the candidate's collective precision.
+    Candidates whose per-device temp exceeds the budget are infeasible
+    (``inf``) — the temp model is the same one ``memory_analysis``
+    calibrates in the planner tests."""
+    # lazy imports: cost.py is reachable from the knobs/telemetry layer
+    # and must not drag core in at module load
+    from ..core import relayout_planner as planner
+    from ..telemetry import collectives as model
+
+    gshape = tuple(int(s) for s in gshape)
+
+    def fn(config: Dict[str, str]) -> float:
+        plan_mode = (config.get("HEAT_TPU_RELAYOUT_PLAN") or "auto").strip()
+        prec = (config.get("HEAT_TPU_COLLECTIVE_PREC") or "off").strip()
+        try:
+            block = int(config.get("HEAT_TPU_COLLECTIVE_PREC_BLOCK") or 0)
+        except ValueError:
+            block = 0
+        block = block if block > 0 else model.DEFAULT_WIRE_BLOCK
+        pl = planner.plan(
+            gshape, itemsize, src_split, dst_split, nproc,
+            budget=budget, measured_need=measured_need,
+            plan_mode=plan_mode,
+        )
+        if budget is not None and pl.temp_bytes > budget:
+            return math.inf
+        if getattr(pl, "stages", None):
+            wire = sum(
+                model.relayout_chunk_cost(
+                    gshape, itemsize, src_split, dst_split,
+                    s.hi - s.lo, nproc, precision=prec, block=block,
+                ).bytes
+                for s in pl.stages
+            )
+        else:
+            wire = model.relayout_cost(
+                gshape, itemsize, src_split, dst_split, nproc,
+                precision=prec, block=block,
+            ).bytes
+        return float(wire)
+
+    return fn

@@ -1,0 +1,194 @@
+"""Persistent tuning DB (ISSUE 11): winners on disk, keyed like the
+compile cache.
+
+One JSON record per tuned signature, written with the same
+atomic-swap discipline as :mod:`heat_tpu.resilience.checkpoint` (write a
+tmp file, ``os.replace`` into place), so a reader never sees a torn
+record and concurrent tuners last-write-win a whole record at a time.
+
+The key is a content hash over ``(schema, site, signature, mesh
+topology, backend platform, device kind)`` — ``program_key()``-compatible
+in the sense that the ``(site, static-config)`` pair the program registry
+keys on is the same pair that keys the tuning record, with the
+process-local communicator identity replaced by its stable cross-process
+description (device count + platform + kind). Two processes on the same
+mesh therefore compute the same key, which is what makes the
+second-process zero-trial warm start work; a record written on a
+different mesh or backend is *foreign* and is cleanly rejected at lookup
+(same contract as a checkpoint CRC mismatch: skip, never crash, never
+apply).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import tempfile
+from typing import Any, Dict, Iterator, List, Optional
+
+from heat_tpu import _knobs as knobs
+
+__all__ = [
+    "SCHEMA",
+    "TuneDB",
+    "mesh_fingerprint",
+    "tune_key",
+    "open_db",
+]
+
+# Bump on any record-shape change: old records become foreign (rejected
+# at lookup), never misread.
+SCHEMA = 1
+
+
+def mesh_fingerprint() -> Dict[str, Any]:
+    """Stable cross-process description of the mesh the tuning ran on:
+    a record only applies to the topology+backend it was measured on."""
+    import jax
+
+    devs = jax.devices()
+    return {
+        "devices": len(devs),
+        "platform": devs[0].platform,
+        "device_kind": devs[0].device_kind,
+    }
+
+
+def tune_key(
+    site: str, signature: Any, mesh: Optional[Dict[str, Any]] = None
+) -> str:
+    """The DB key for one tuned program signature (module docstring has
+    the contract). ``signature`` is the caller's static config — same
+    role as the ``key`` argument of ``program_cache.program_key`` — and
+    participates by ``repr``, so it must be a stable value (tuples of
+    ints/strs, not object identities)."""
+    mesh = mesh or mesh_fingerprint()
+    payload = repr((
+        SCHEMA, str(site), signature,
+        int(mesh["devices"]), str(mesh["platform"]),
+        str(mesh["device_kind"]),
+    ))
+    return hashlib.sha256(payload.encode()).hexdigest()[:32]
+
+
+def _valid(rec: Any, key: Optional[str], mesh: Dict[str, Any]) -> bool:
+    """Schema/key/mesh validation — the foreign-record gate."""
+    if not isinstance(rec, dict):
+        return False
+    if rec.get("schema") != SCHEMA:
+        return False
+    if key is not None and rec.get("key") != key:
+        return False
+    m = rec.get("mesh")
+    if not isinstance(m, dict) or (
+        m.get("devices") != mesh["devices"]
+        or m.get("platform") != mesh["platform"]
+        or m.get("device_kind") != mesh["device_kind"]
+    ):
+        return False
+    cfg = rec.get("config")
+    if not isinstance(cfg, dict) or not all(
+        isinstance(k, str) and k in knobs.REGISTRY and isinstance(v, str)
+        for k, v in cfg.items()
+    ):
+        # a config naming unregistered knobs (or non-string values) can
+        # never be installed into the overlay — reject the whole record
+        return False
+    return True
+
+
+class TuneDB:
+    """Directory of atomic-swap JSON tuning records.
+
+    The directory is created lazily on first :meth:`store` — read-only
+    consults (``lookup``/``records``/``count``, e.g. the bench probe or
+    a disabled tuner with ``HEAT_TPU_TUNE_DB`` merely exported) never
+    touch the filesystem beyond reads."""
+
+    def __init__(self, path: str):
+        self.path = os.fspath(path)
+
+    def _file(self, key: str) -> str:
+        return os.path.join(self.path, f"{key}.json")
+
+    def store(self, record: Dict[str, Any]) -> str:
+        """Atomically write one record (validated against the current
+        mesh first — a tuner must never persist a record it would itself
+        reject). Returns the record path."""
+        key = record.get("key")
+        if not key or not _valid(record, key, mesh_fingerprint()):
+            raise ValueError(
+                "refusing to store an invalid tuning record "
+                f"(schema/key/mesh/config): {record.get('key')!r}"
+            )
+        os.makedirs(self.path, exist_ok=True)
+        final = self._file(key)
+        fd, tmp = tempfile.mkstemp(
+            prefix=f".{key}.", suffix=".tmp", dir=self.path
+        )
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(record, f, sort_keys=True)
+                f.write("\n")
+            os.replace(tmp, final)  # atomic swap: readers see old or new
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        return final
+
+    def lookup(
+        self, key: str, mesh: Optional[Dict[str, Any]] = None
+    ) -> Optional[Dict[str, Any]]:
+        """The record for ``key``, or None. Corrupt files (torn JSON),
+        schema drift, key mismatches, and foreign mesh/backend records
+        all return None — a bad DB entry degrades to "untuned", never to
+        a crash or a wrong config."""
+        mesh = mesh or mesh_fingerprint()
+        try:
+            with open(self._file(key)) as f:
+                rec = json.load(f)
+        except (OSError, json.JSONDecodeError, UnicodeDecodeError):
+            return None
+        return rec if _valid(rec, key, mesh) else None
+
+    def records(
+        self, mesh: Optional[Dict[str, Any]] = None
+    ) -> Iterator[Dict[str, Any]]:
+        """Every valid record for this mesh, oldest store first (so a
+        warm start that merges overlapping configs lets the newest tune
+        win)."""
+        mesh = mesh or mesh_fingerprint()
+        rows: List[tuple] = []
+        try:
+            entries = os.listdir(self.path)
+        except OSError:
+            return
+        for fn in entries:
+            if not fn.endswith(".json") or fn.startswith("."):
+                continue
+            key = fn[: -len(".json")]
+            path = os.path.join(self.path, fn)
+            try:
+                mtime = os.path.getmtime(path)
+            except OSError:
+                continue
+            rec = self.lookup(key, mesh)
+            if rec is not None:
+                rows.append((mtime, key, rec))
+        for _, _, rec in sorted(rows, key=lambda r: (r[0], r[1])):
+            yield rec
+
+    def count(self, mesh: Optional[Dict[str, Any]] = None) -> int:
+        return sum(1 for _ in self.records(mesh))
+
+
+def open_db(path: Optional[str] = None) -> Optional[TuneDB]:
+    """The active tuning DB: explicit ``path``, else ``HEAT_TPU_TUNE_DB``
+    (overlay-aware), else None (tuning runs in memory only — winners are
+    adopted for this process but not persisted)."""
+    path = path or (knobs.raw("HEAT_TPU_TUNE_DB", "") or "").strip()
+    return TuneDB(path) if path else None

@@ -1,0 +1,118 @@
+"""Measured-trial machinery (ISSUE 11 stage 2): guarded, telemetry-
+spanned workload timings plus the digest/allclose validators.
+
+Timing protocol: per candidate config, ``warmup`` untimed calls (the
+first call owns the compile wait — same discipline as the bench
+harness), then ``k`` timed calls, each blocked to completion via
+``jax.block_until_ready`` before the clock stops (async-dispatch
+honesty, same contract as ``telemetry.Span``). The per-config statistic
+is the **median of k after MAD outlier rejection** — a GC pause or a
+noisy-neighbor blip disqualifies a sample, not a config.
+
+Validation: outputs are flattened to leaves; :func:`digest` is the
+bit-identity oracle (sha256 over each leaf's bytes + dtype/shape),
+:func:`max_rel_err` the amax-normalized error the budget bounds (the
+same metric the collective-precision CI gate pins).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import statistics
+import time
+from typing import Any, Callable, List, Tuple
+
+import jax
+import numpy as np
+
+__all__ = [
+    "measure",
+    "robust_median",
+    "digest",
+    "max_rel_err",
+]
+
+# MAD z-score beyond which a sample is an outlier (the conventional
+# 1.4826 factor makes MAD a consistent sigma estimator for normal noise).
+_MAD_SIGMA = 1.4826
+_OUTLIER_Z = 3.5
+
+
+def robust_median(samples: List[float]) -> float:
+    """Median after MAD outlier rejection; degenerate spreads (MAD 0)
+    fall back to the plain median."""
+    if not samples:
+        raise ValueError("no samples")
+    med = statistics.median(samples)
+    mad = statistics.median([abs(s - med) for s in samples])
+    if mad <= 0.0:
+        return med
+    kept = [
+        s for s in samples
+        if abs(s - med) / (_MAD_SIGMA * mad) <= _OUTLIER_Z
+    ]
+    return statistics.median(kept or samples)
+
+
+def measure(
+    workload: Callable[[], Any],
+    *,
+    k: int,
+    warmup: int = 1,
+    on_sample: Callable[[int, float], None] = None,
+) -> Tuple[List[float], Any]:
+    """Run ``workload`` ``warmup + k`` times; returns ``(samples, out)``
+    where ``out`` is the last call's (blocked) output — the value the
+    validators judge. ``on_sample(trial_index, seconds)`` fires per timed
+    trial (the tuner's telemetry hook)."""
+    out = None
+    for _ in range(max(0, warmup)):
+        out = jax.block_until_ready(workload())
+    samples: List[float] = []
+    for i in range(max(1, k)):
+        t0 = time.perf_counter()
+        out = jax.block_until_ready(workload())
+        dt = time.perf_counter() - t0
+        samples.append(dt)
+        if on_sample is not None:
+            on_sample(i, dt)
+    return samples, out
+
+
+def _leaves(out: Any) -> List[np.ndarray]:
+    leaves = jax.tree_util.tree_leaves(out)
+    return [np.asarray(leaf) for leaf in leaves]
+
+
+def digest(out: Any) -> str:
+    """Bit-identity digest of a pytree of arrays (dtype/shape included:
+    a float64 zero and a float32 zero must not collide)."""
+    h = hashlib.sha256()
+    for a in _leaves(out):
+        h.update(str((a.dtype.str, a.shape)).encode())
+        h.update(np.ascontiguousarray(a).tobytes())
+    return h.hexdigest()
+
+
+def max_rel_err(out: Any, ref: Any) -> float:
+    """Max over leaves of ``max|out - ref| / max|ref|`` (amax-normalized;
+    an all-zero reference leaf normalizes by 1). Structure or shape
+    mismatches are infinite error — a candidate that changes the output
+    SHAPE can never pass a numeric budget."""
+    a_leaves, b_leaves = _leaves(out), _leaves(ref)
+    if len(a_leaves) != len(b_leaves):
+        return float("inf")
+    worst = 0.0
+    for a, b in zip(a_leaves, b_leaves):
+        if a.shape != b.shape:
+            return float("inf")
+        if a.size == 0:
+            continue
+        bf = b.astype(np.float64, copy=False)
+        af = a.astype(np.float64, copy=False)
+        denom = float(np.max(np.abs(bf))) or 1.0
+        err = float(np.max(np.abs(af - bf))) / denom
+        if not np.isfinite(err):
+            return float("inf")
+        worst = max(worst, err)
+    return worst

@@ -19,10 +19,17 @@ from heat_tpu._knobs import (  # noqa: F401
     TRUTHY,
     Knob,
     REGISTRY,
+    Tunable,
+    clear_overrides,
+    default_raw,
     get,
     markdown_table,
     names,
+    overlay,
+    overrides,
     raw,
+    set_override,
+    tunables,
 )
 
 __all__ = [
@@ -30,8 +37,15 @@ __all__ = [
     "TRUTHY",
     "Knob",
     "REGISTRY",
+    "Tunable",
+    "clear_overrides",
+    "default_raw",
     "get",
     "markdown_table",
     "names",
+    "overlay",
+    "overrides",
     "raw",
+    "set_override",
+    "tunables",
 ]

@@ -154,6 +154,21 @@ def cached_program(
     """
     donate = tuple(donate)
     full_key = program_key(site, key, comm=comm, donate=donate)
+    if full_key not in _PROGRAMS and knobs.get("HEAT_TPU_AUTOTUNE"):
+        # measured-feedback autotuner (ISSUE 11): a registry miss is the
+        # cold path (a trace+compile follows), so the tuning-DB consult —
+        # a memoized warm start that installs persisted winners into the
+        # knob overlay — costs nothing in steady state. Runs OUTSIDE
+        # _LOCK: the first warm start may scan an on-disk DB, and
+        # holding the registry lock through that would stall concurrent
+        # hit-path lookups. The lock-free probe can race a concurrent
+        # insert into a false miss; that costs one memoized dict check.
+        # Default-off, dispatch is bit-for-bit the untuned path: the hit
+        # path pays one dict probe that short-circuits before the flag
+        # read, no DB is touched, no new compiles.
+        from .. import autotune as _autotune
+
+        _autotune.on_program_miss(site)
     evicted = 0
     miss = False
     with _LOCK:

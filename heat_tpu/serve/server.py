@@ -150,6 +150,15 @@ class Server:
         max_wait_ms: Optional[float] = None,
         queue_max: Optional[int] = None,
     ):
+        if knobs.get("HEAT_TPU_AUTOTUNE"):
+            # tuned serve knobs (ladder top / gather window / queue
+            # bound, ISSUE 11) land in the knob overlay BEFORE the reads
+            # below, so a fresh process constructs its server already
+            # tuned — one flag check when off, explicit constructor
+            # arguments still win over any tuned value
+            from .. import autotune as _autotune
+
+            _autotune.warm_start()
         if max_batch is None:
             raw = knobs.raw("HEAT_TPU_SERVE_MAX_BATCH", "").strip()
             max_batch = DEFAULT_MAX_BATCH

@@ -70,6 +70,7 @@ def summarize(
     serve_span: list = [None, None]  # [first ts, last ts] of serve traffic
     pc_retraces: dict = {}
     res_events: dict = {}
+    at_events: dict = {}
     plan_counts: dict = {}
     plan_last: Optional[dict] = None
     plan_wire = 0
@@ -111,6 +112,9 @@ def summarize(
         elif kind == "resilience":
             what = ev.get("event") or "event"
             res_events[what] = res_events.get(what, 0) + 1
+        elif kind == "autotune":
+            what = ev.get("event") or "event"
+            at_events[what] = at_events.get(what, 0) + 1
         elif kind == "relayout_plan":
             p = ev.get("plan") or ev.get("name")
             plan_counts[p] = plan_counts.get(p, 0) + 1
@@ -315,6 +319,30 @@ def summarize(
         if transients:
             res["transient_faults"] = transients
         out["resilience"] = res
+    # autotune counters (heat_tpu/autotune, ISSUE 11): live summaries
+    # read the registry's aggregate counters (trials/db_hits/stores/
+    # adopted/...); offline summaries reconstruct the SAME block from the
+    # recorded instant events — every counter increments exactly once
+    # alongside its event, so live == offline (the resilience
+    # reconciliation contract from PR 5, pinned in tests/test_autotune.py).
+    # Absent entirely when the tuner never fired, so untuned summary
+    # shapes are unchanged.
+    if live:
+        from . import get_registry as _get_registry
+
+        at = {
+            k[len("autotune."):]: (int(v) if float(v).is_integer() else v)
+            for k, v in _get_registry().counters.items()
+            if k.startswith("autotune.")
+        }
+        if at:
+            out["autotune"] = at
+    elif at_events:
+        from heat_tpu.autotune import EVENT_COUNTER as _at_names
+
+        out["autotune"] = {
+            _at_names.get(k, k): v for k, v in at_events.items()
+        }
     if watermarks:
         peak = watermarks.get("live_bytes.total")
         if peak is not None:

@@ -32,12 +32,14 @@ _TID_SPANS = 1
 _TID_COMPILE = 2
 _TID_EVENTS = 3
 _TID_MEMORY = 4
+_TID_AUTOTUNE = 5
 
 _THREAD_NAMES = {
     _TID_SPANS: "spans",
     _TID_COMPILE: "compile",
     _TID_EVENTS: "events",
     _TID_MEMORY: "memory",
+    _TID_AUTOTUNE: "autotune",
 }
 
 _META_KEYS = ("ts", "kind", "name", "seconds", "depth", "parent", "start_ts")
@@ -116,6 +118,15 @@ def to_trace_events(
                 "name": "live_bytes", "cat": "memory", "ph": "C",
                 "ts": ts_us, "pid": pid, "tid": _TID_MEMORY,
                 "args": {"total": ev.get("total", 0)},
+            })
+        elif kind == "autotune":
+            # tuner activity gets its own track (ISSUE 11): trial /
+            # db_hit / pick / adopt markers, named by their event so the
+            # timeline reads as a tuning narrative
+            out.append({
+                "name": f"{ev.get('event', 'event')}:{name}",
+                "cat": "autotune", "ph": "i", "ts": ts_us, "s": "p",
+                "pid": pid, "tid": _TID_AUTOTUNE, "args": _args(clean),
             })
         else:  # collective_trace, hlo_audit, and future kinds
             out.append({

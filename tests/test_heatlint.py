@@ -616,3 +616,13 @@ class TestKnobRegistry:
             "`python -m heat_tpu.analysis --knob-table` and paste between "
             "the markers"
         )
+
+    def test_knob_table_declares_the_search_space(self):
+        """ISSUE 11: the generated table carries the autotuner's Tunable
+        column, so the search space is documented next to the knob —
+        lossy knobs name their exact-semantics value."""
+        table = knobs.markdown_table()
+        assert "| Tunable |" in table
+        assert "lossy (exact: `off`)" in table  # HEAT_TPU_COLLECTIVE_PREC
+        for name, k in knobs.tunables().items():
+            assert f"`{name}`" in table
