@@ -1021,6 +1021,19 @@ def main():
                 detail["autotune"] = _autotune.bench_field()
             except Exception as e:  # noqa: BLE001
                 detail["autotune"] = {"error": repr(e)}
+            # horizontally-scaled serving probe (ISSUE 12, schema in
+            # docs/BENCHMARKS.md): a quick 1→2 replica-pool scaling run
+            # (QPS table + scale factor) through the HTTP router.
+            # Replica processes always run virtual CPU meshes — the row
+            # carries its own on_chip=false + cpu_fallback reason even
+            # when the parent bench is on-chip (an accelerator cannot be
+            # shared across replica processes).
+            try:
+                from benchmarks.serving import net as _snet
+
+                detail["serving_net"] = _snet.bench_field()
+            except Exception as e:  # noqa: BLE001
+                detail["serving_net"] = {"error": repr(e)}
         print(json.dumps(detail), file=sys.stderr, flush=True)
 
         # honesty bit (VERDICT r5 #9, schema in docs/BENCHMARKS.md): the

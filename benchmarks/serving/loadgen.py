@@ -140,6 +140,14 @@ def run_open_loop(
             continue
         try:
             out = futures[i].result(max(0.001, deadline - time.monotonic()))
+        except ServerOverloadedError:
+            # a 503 resolved THROUGH the future (the router learns a
+            # request was shed only after offering it to every sibling,
+            # unlike the in-process server's synchronous admission gate)
+            # is still a shed, not a failure
+            shed += 1
+            row["shed"] += 1
+            continue
         except Exception as e:  # noqa: BLE001 — a failed request is data
             failed += 1
             row["failed"] += 1

@@ -294,6 +294,36 @@ _register(
     tunable=Tunable(("1", "0"), "lossy", exact_value="1"),
 )
 
+# -- network serving tier knobs (heat_tpu/serve/net, ISSUE 12) ----------------
+
+_register(
+    "HEAT_TPU_SERVE_NET_PORT", "int", 0,
+    "HTTP listen port of a serving replica (serve/net/transport.py). "
+    "0 (the default) binds an ephemeral port — the replica prints the "
+    "bound port in its ready line, which is how ReplicaPool wires the "
+    "router without port collisions.",
+)
+_register(
+    "HEAT_TPU_SERVE_NET_REPLICAS", "int", 2,
+    "Default replica-process count of serve.net.ReplicaPool (each "
+    "replica restores the endpoint checkpoint and warms from the shared "
+    "HEAT_TPU_COMPILE_CACHE / HEAT_TPU_TUNE_DB).",
+)
+_register(
+    "HEAT_TPU_SERVE_NET_POLL_MS", "float", 25.0,
+    "Router /stats poll interval in milliseconds: refreshes the "
+    "least-loaded scores of healthy replicas and health-probes evicted "
+    "ones for re-add (serve/net/router.py).",
+    tunable=Tunable(("10", "25", "50", "100"), "neutral"),
+)
+_register(
+    "HEAT_TPU_SERVE_NET_RETRIES", "int", 2,
+    "Router sibling-retry cap: how many ADDITIONAL replicas a request "
+    "that was shed (503) or met a connect-refused replica is offered "
+    "before the client sees the failure. In-flight connection drops are "
+    "never blindly retried (the request may have executed).",
+)
+
 # -- autotuner knobs (heat_tpu/autotune, ISSUE 11) ----------------------------
 
 _register(
@@ -376,6 +406,10 @@ for _name, _doc in (
      "audit step."),
     ("HEAT_TPU_CI_SKIP_CHAOS", "Skip the fault-injection chaos step."),
     ("HEAT_TPU_CI_SKIP_SERVING", "Skip the open-loop serving gate."),
+    ("HEAT_TPU_CI_SKIP_SERVING_NET", "Skip the horizontally-scaled "
+     "serving gate (ISSUE 12: 2-replica pool, router-vs-direct digest "
+     "bit-identity, kill-one-replica recovery, zero steady-state "
+     "compiles on the warm-started second replica)."),
     ("HEAT_TPU_CI_SKIP_HEATLINT", "Skip the heatlint static-analysis "
      "gate (ISSUE 10)."),
     ("HEAT_TPU_CI_SKIP_AUTOTUNE", "Skip the autotune gate (ISSUE 11: "

@@ -52,11 +52,12 @@ from .endpoints import (
     rbf_query,
 )
 from .server import Server
-from . import admission, endpoints, metrics, server  # noqa: F401
+from . import admission, endpoints, metrics, net, server  # noqa: F401
 
 __all__ = [
     "Server",
     "Endpoint",
+    "net",
     "AdmissionController",
     "ServeError",
     "ServerOverloadedError",

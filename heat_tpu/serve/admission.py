@@ -57,8 +57,8 @@ class ServeError(RuntimeError):
 
 class ServerOverloadedError(ServeError):
     """Request shed by admission control (HTTP-503 analog). Carries
-    ``status`` (always 503), ``reason`` (``"queue_full"`` | ``"memory"``)
-    and ``endpoint``."""
+    ``status`` (always 503), ``reason`` (``"queue_full"`` | ``"memory"``
+    | ``"draining"``) and ``endpoint``."""
 
     status = 503
 
@@ -148,7 +148,10 @@ class AdmissionController:
             reg = telemetry.get_registry()
             reg.emit("serve", "ladder", event="degrade_release")
 
-    def _shed(self, endpoint: str, reason: str, message: str) -> None:
+    def shed(self, endpoint: str, reason: str, message: str) -> None:
+        """Count + emit one shed and raise the 503-style error. Public:
+        the server routes its own shed reasons (``"draining"``, ISSUE 12)
+        through here so every shed carries identical telemetry."""
         with self._lock:
             self.sheds += 1
         if telemetry.enabled():
@@ -188,7 +191,7 @@ class AdmissionController:
         Degradation is a side effect: the ladder cap the batcher reads
         may shrink (or recover) here."""
         if queue_depth >= self.queue_max:
-            self._shed(
+            self.shed(
                 name, "queue_full",
                 f"serve queue is full ({queue_depth} >= "
                 f"{self.queue_max} pending requests); retry later or raise "
@@ -210,7 +213,7 @@ class AdmissionController:
             if live + self._cost(name, ep, b) <= budget:
                 self._degrade_to(b, name)
                 return
-        self._shed(
+        self.shed(
             name, "memory",
             f"projected dispatch cost {need:,} B on top of {live:,} B live "
             f"exceeds HEAT_TPU_HBM_BUDGET {budget:,} B even at the smallest "

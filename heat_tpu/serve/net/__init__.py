@@ -1,0 +1,50 @@
+"""heat_tpu.serve.net — horizontally scaled serving tier (ISSUE 12).
+
+PR 8 built the serving engine as an in-process library; this package
+puts a network in front of it and scales it out, using only the stdlib:
+
+* :mod:`.wire` — the JSON + base64-``.npy`` wire schema (bitwise
+  round-trip, so exact-mode answers survive the network hop);
+* :mod:`.transport` — :class:`HttpFront`, a thin
+  ``ThreadingHTTPServer`` adapter translating ``POST /v1/<endpoint>``
+  into the existing ``Server.submit()`` futures API, plus ``/healthz``
+  and ``/stats`` (stats carries the remote zero-compile oracle:
+  ``steady_backend_compiles`` from a CompileWatcher armed post-warmup);
+* :mod:`.replica` — the replica process
+  (``python -m heat_tpu.serve.net.replica``): restore an endpoint
+  checkpoint, warm from the SHARED persistent compile cache + tuning
+  DB (replica 2..N reach zero-compile, pre-tuned steady state without
+  retracing), serve until SIGTERM, then drain → ``telemetry.flush()``
+  → exit 0;
+* :mod:`.pool` — :class:`ReplicaPool`, spawning/scaling/draining/
+  killing N replica processes over one checkpoint;
+* :mod:`.router` — :class:`Router`, least-loaded dispatch from polled
+  ``/stats``, sticky degradation (a 503 shed retries siblings before
+  the client sees it), health-check eviction + re-add, and the same
+  ``submit``/``predict``/``stats`` client surface as the in-process
+  server (so one load generator drives both).
+
+docs/SERVING.md §"Network serving" has the architecture, wire schema,
+routing policy, degradation ladder, and failure semantics;
+``benchmarks/serving/net.py`` is the multi-process load generator
+behind the committed replica-scaling artifact.
+"""
+
+from __future__ import annotations
+
+from .events import EVENT_COUNTER
+from .pool import ReplicaHandle, ReplicaPool
+from .router import ReplicaDownError, Router
+from .transport import HttpFront
+from .wire import WireError
+from . import events, pool, replica, router, transport, wire  # noqa: F401
+
+__all__ = [
+    "HttpFront",
+    "ReplicaPool",
+    "ReplicaHandle",
+    "Router",
+    "ReplicaDownError",
+    "WireError",
+    "EVENT_COUNTER",
+]

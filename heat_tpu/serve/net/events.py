@@ -1,0 +1,44 @@
+"""Telemetry naming contract of the network serving tier (ISSUE 12).
+
+Every ``serve_net`` instant event increments exactly one aggregate
+counter (``serve_net.<name>``) alongside its emission, so a **live**
+``report.summarize()`` (reading counters) and an **offline** one
+(replaying a JSONL sink) reconstruct the *same* ``serving_net`` block —
+the reconciliation contract PR 5 established for resilience and PR 11
+for autotune, extended to the router/pool tier. ``EVENT_COUNTER`` is
+that event-name → counter-name map; :mod:`heat_tpu.telemetry.report`
+imports it for the offline rename.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ... import telemetry
+
+__all__ = ["EVENT_COUNTER", "emit"]
+
+# event (on the wire / in the sink)  ->  counter suffix (live registry)
+EVENT_COUNTER = {
+    "route": "requests",         # one successfully routed request
+    "retry": "retries",          # sibling retry after a 503/connect-refused
+    "evict": "evictions",        # replica marked down, out of rotation
+    "readd": "readds",           # health probe brought a replica back
+    "failed": "failed",          # request failed after the retry ladder
+    "shed": "shed",              # every replica shed (503 to the client)
+    "spawn": "replicas_spawned",  # pool started a replica process
+    "remove": "replicas_removed",  # drain-then-kill removal completed
+    "kill": "replicas_killed",   # hard kill (chaos)
+    "listen": "listens",         # HTTP front bound its port
+    "drain": "drains",           # graceful drain began
+}
+
+
+def emit(name: str, event: str, **fields: Any) -> None:
+    """Emit one ``serve_net`` instant event + its paired counter (no-op
+    while telemetry is disabled — one flag check)."""
+    if not telemetry.enabled():
+        return
+    reg = telemetry.get_registry()
+    reg.add(f"serve_net.{EVENT_COUNTER[event]}", 1)
+    reg.emit("serve_net", name, event=event, **fields)

@@ -1,0 +1,663 @@
+"""Least-loaded request router over a set of replica HTTP fronts.
+
+The horizontal half of the ISSUE 12 tentpole: N replica processes (each
+a :class:`~heat_tpu.serve.Server` behind :class:`~.transport.HttpFront`)
+scale QPS past the single-process ceiling, and the router is the piece
+that makes them look like ONE server to a client:
+
+* **least-loaded dispatch** — a poll thread refreshes every healthy
+  replica's ``/stats`` each ``HEAT_TPU_SERVE_NET_POLL_MS``; the dispatch
+  score is the polled backlog (admitted-but-unresolved ``pending``)
+  plus this router's own in-flight count to that replica (fresher than
+  any poll). Requests go to the minimum-score replica. An optional
+  ``max_inflight`` caps concurrent requests per replica (the client
+  half of the per-replica admission-budget discipline — the analog of a
+  proxy's per-backend circuit-breaker concurrency cap): workers block
+  for a free slot instead of piling onto a busy replica, and a request
+  whose deadline passes while every slot stays taken sheds 503-style
+  (``router_timeout``).
+* **sticky degradation** — a 503 shed from one replica (queue_full /
+  memory / draining) retries up to ``HEAT_TPU_SERVE_NET_RETRIES``
+  *siblings* before the client sees :class:`ServerOverloadedError`:
+  one overloaded (or draining) replica degrades to "the others absorb
+  it", not to client-visible failure. The shedding replica is NOT
+  evicted — it is alive and telling us so.
+* **health eviction + re-add** — a connection-level failure evicts the
+  replica from rotation (its queued work re-routes); the poll thread
+  keeps probing ``/healthz`` and re-adds it the moment it answers —
+  a drained-and-restarted (or crash-restored) replica rejoins without
+  router restart.
+* **failure semantics** — a connect-refused replica never saw the
+  request: safe to retry a sibling. A connection that drops *after* the
+  request was sent is ambiguous (it may have executed), so by default
+  those fail with :class:`ReplicaDownError` — the bench chaos phase's
+  "killing a replica loses only its in-flight requests" contract.
+  ``retry_in_flight=True`` opts into at-least-once re-dispatch for
+  callers that know their endpoints are pure.
+
+The client surface mirrors the in-process server — ``submit()`` returns
+a future, ``predict()`` blocks, ``stats()["endpoints"]`` carries the
+same per-endpoint latency aggregates (:class:`~..metrics.EndpointStats`)
+— so the PR 8 open-loop load generator drives a router and a local
+server through the identical code path (the scaling artifact's
+apples-to-apples requirement).
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import threading
+import time
+from concurrent.futures import Future
+from queue import Empty, Queue
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+from urllib.parse import urlparse
+
+import numpy as np
+
+from heat_tpu import _knobs as knobs
+
+from ..admission import ServeError, ServerClosedError, ServerOverloadedError
+from ..metrics import EndpointStats
+from . import wire
+from .events import emit as _emit
+
+__all__ = ["Router", "ReplicaDownError"]
+
+_POLL_TIMEOUT = 2.0  # seconds per /stats / /healthz probe
+
+
+class _NoDelayConnection(http.client.HTTPConnection):
+    """HTTPConnection with Nagle disabled — request/response pairs are
+    single small write-read exchanges, exactly the pattern Nagle +
+    delayed ACK stalls (measured: 33 ms loopback round trips without
+    this, ~3 ms with)."""
+
+    def connect(self):
+        super().connect()
+        import socket as _socket
+
+        self.sock.setsockopt(
+            _socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1
+        )
+
+
+class ReplicaDownError(ServeError):
+    """No healthy replica could (safely) serve the request: every
+    candidate was down, or the chosen replica's connection dropped with
+    the request in flight (``retry_in_flight=False``)."""
+
+
+class _Target:
+    """One replica as the router sees it."""
+
+    __slots__ = ("url", "host", "port", "up", "inflight", "polled_pending",
+                 "poll_fails", "evictions")
+
+    def __init__(self, url: str):
+        parsed = urlparse(url if "//" in url else f"http://{url}")
+        if not parsed.hostname or not parsed.port:
+            raise ValueError(f"replica url needs host:port, got {url!r}")
+        self.url = f"http://{parsed.hostname}:{parsed.port}"
+        self.host = parsed.hostname
+        self.port = parsed.port
+        self.up = True
+        self.inflight = 0
+        self.polled_pending = 0
+        self.poll_fails = 0
+        self.evictions = 0
+
+    def score(self) -> int:
+        # routing state is guarded by the router's one Condition; reads
+        # of two ints race only with themselves (shed tolerance: the
+        # score is a heuristic, not an allocator)
+        return self.polled_pending + self.inflight
+
+
+class _Job:
+    __slots__ = ("endpoint", "body", "future", "t0")
+
+    def __init__(self, endpoint: str, body: bytes):
+        self.endpoint = endpoint
+        self.body = body
+        self.future: Future = Future()
+        self.t0 = time.perf_counter()
+
+
+class _InFlightDrop(Exception):
+    """Connection died after the request was on the wire (internal)."""
+
+
+class _ResponseTimeout(Exception):
+    """The replica accepted the request but did not answer within the
+    socket timeout (internal). NOT an outage: the replica is healthy,
+    just slow — it must not be evicted, and the request must not be
+    blindly retried (it may still execute)."""
+
+
+class Router:
+    """Least-loaded HTTP router over replica fronts (module docstring
+    has the policy). ``targets`` is a sequence of replica base URLs
+    (``http://host:port`` or ``host:port``) or an object with a
+    ``urls()`` method (:class:`~.pool.ReplicaPool`)."""
+
+    def __init__(
+        self,
+        targets: Union[Sequence[str], object],
+        *,
+        retries: Optional[int] = None,
+        poll_ms: Optional[float] = None,
+        workers: Optional[int] = None,
+        request_timeout: float = 30.0,
+        retry_in_flight: bool = False,
+        max_inflight: Optional[int] = None,
+    ):
+        if hasattr(targets, "urls"):
+            targets = targets.urls()
+        self._targets: List[_Target] = [_Target(u) for u in targets]
+        if not self._targets:
+            raise ValueError("router needs at least one replica url")
+        # per-replica in-flight budget (the client half of the bounded
+        # per-replica admission discipline): a worker holding a request
+        # BLOCKS for a slot rather than piling more concurrency onto a
+        # busy replica. None = unlimited.
+        self.max_inflight = (
+            None if max_inflight is None else max(1, int(max_inflight))
+        )
+        self._state = threading.Condition()
+        self.retries = int(
+            retries if retries is not None
+            else knobs.get("HEAT_TPU_SERVE_NET_RETRIES")
+        )
+        poll_ms = (
+            poll_ms if poll_ms is not None
+            else knobs.get("HEAT_TPU_SERVE_NET_POLL_MS")
+        )
+        self.poll_interval = max(0.001, float(poll_ms) / 1e3)
+        self.request_timeout = float(request_timeout)
+        self.retry_in_flight = bool(retry_in_flight)
+        n_workers = (
+            workers if workers is not None
+            else max(8, 4 * len(self._targets))
+        )
+        self._stats: Dict[str, EndpointStats] = {}
+        self._stats_lock = threading.Lock()
+        self._queue: "Queue" = Queue()
+        self._closed = False
+        self._counts = {"requests": 0, "retries": 0, "evictions": 0,
+                        "readds": 0, "failed": 0, "shed": 0}
+        self._counts_lock = threading.Lock()
+        self._local = threading.local()  # per-worker connection cache
+        self._poll_conns: Dict[str, http.client.HTTPConnection] = {}
+        self._workers = [
+            threading.Thread(
+                target=self._work, name=f"heat_tpu.serve.net.router-{i}",
+                daemon=True,
+            )
+            for i in range(int(n_workers))
+        ]
+        for t in self._workers:
+            t.start()
+        self._poll_thread = threading.Thread(
+            target=self._poll_loop, name="heat_tpu.serve.net.router-poll",
+            daemon=True,
+        )
+        self._poll_thread.start()
+
+    # -- client surface ------------------------------------------------------
+
+    def submit(self, name: str, payload) -> Future:
+        """Enqueue one request; the future resolves to the result rows,
+        or to :class:`ServerOverloadedError` (every candidate shed),
+        :class:`ReplicaDownError` (no healthy replica / in-flight drop),
+        or the upstream error."""
+        if self._closed:
+            raise ServerClosedError("router is closed")
+        job = _Job(name, wire.encode_request(np.asarray(payload)))
+        self._ep_stats(name).record_request(
+            int(np.asarray(payload).shape[0])
+            if np.asarray(payload).ndim else 1
+        )
+        self._queue.put(job)
+        return job.future
+
+    def predict(self, name: str, payload, timeout: Optional[float] = 30.0):
+        """Synchronous convenience: ``submit(...).result(timeout)``."""
+        return self.submit(name, payload).result(timeout)
+
+    def add_target(self, url: str) -> None:
+        """Join a new replica into the rotation (scale-up / re-add of a
+        freshly spawned process)."""
+        t = _Target(url)
+        with self._state:
+            if any(x.url == t.url for x in self._targets):
+                return
+            self._targets.append(t)
+            self._state.notify_all()
+
+    def stats(self) -> dict:
+        """Loadgen-compatible aggregates: per-endpoint latency stats
+        (client-observed submit→resolve), per-replica routing state, and
+        the router counters."""
+        with self._counts_lock:
+            counts = dict(self._counts)
+        with self._stats_lock:  # first-seen endpoints insert concurrently
+            stats_items = list(self._stats.items())
+        return {
+            "endpoints": {n: s.snapshot() for n, s in stats_items},
+            "queue_depth": self._queue.qsize(),
+            "replicas": {
+                t.url: {
+                    "up": t.up,
+                    "score": t.score(),
+                    "inflight": t.inflight,
+                    "polled_pending": t.polled_pending,
+                    "evictions": t.evictions,
+                }
+                for t in list(self._targets)
+            },
+            "router": counts,
+            "closed": self._closed,
+        }
+
+    def close(self) -> None:
+        """Stop workers + poll thread; fail queued requests with
+        :class:`ServerClosedError`. Idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        with self._state:
+            self._state.notify_all()  # wake workers blocked on a slot
+        for _ in self._workers:
+            self._queue.put(None)
+        for t in self._workers:
+            t.join(5.0)
+        self._poll_thread.join(5.0)
+        for conn in self._poll_conns.values():
+            try:
+                conn.close()
+            except Exception:
+                pass
+        self._poll_conns.clear()
+        while True:
+            try:
+                job = self._queue.get_nowait()
+            except Empty:
+                break
+            if job is not None:
+                try:
+                    job.future.set_exception(
+                        ServerClosedError("router closed with request "
+                                          "pending")
+                    )
+                except Exception:
+                    pass
+
+    def __enter__(self) -> "Router":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
+
+    # -- internals -----------------------------------------------------------
+
+    def _ep_stats(self, name: str) -> EndpointStats:
+        st = self._stats.get(name)
+        if st is None:
+            with self._stats_lock:
+                st = self._stats.setdefault(name, EndpointStats(name))
+        return st
+
+    def _count(self, key: str, n: int = 1) -> None:
+        with self._counts_lock:
+            self._counts[key] += n
+
+    def _pick_locked(self, exclude: set):
+        """(best-free-target, any-up-but-at-budget) under ``_state``."""
+        best, best_score, busy = None, None, False
+        for t in self._targets:
+            if not t.up or t.url in exclude:
+                continue
+            if (
+                self.max_inflight is not None
+                and t.inflight >= self.max_inflight
+            ):
+                busy = True
+                continue
+            s = t.score()
+            if best_score is None or s < best_score:
+                best, best_score = t, s
+        return best, busy
+
+    def _acquire(self, exclude: set, deadline: float):
+        """Claim an in-flight slot on the least-loaded eligible replica;
+        blocks while every eligible replica is at its in-flight budget.
+        Returns ``(target, None)``, or ``(None, "down")`` when no healthy
+        replica exists (fail fast), or ``(None, "timeout")`` when the
+        request's deadline passed while waiting for a slot."""
+        with self._state:
+            while True:
+                best, busy = self._pick_locked(exclude)
+                if best is not None:
+                    best.inflight += 1
+                    return best, None
+                if not busy or self._closed:
+                    return None, "down"
+                if time.perf_counter() >= deadline:
+                    return None, "timeout"
+                self._state.wait(
+                    max(0.001, min(0.1, deadline - time.perf_counter()))
+                )
+
+    def _release(self, target: _Target) -> None:
+        with self._state:
+            target.inflight -= 1
+            self._state.notify()
+
+    def _evict(self, target: _Target, why: str) -> None:
+        with self._state:
+            if not target.up:
+                return
+            target.up = False
+            target.evictions += 1
+            target.poll_fails = 0
+            self._state.notify_all()
+        self._count("evictions")
+        _emit("router", "evict", replica=target.url, reason=why)
+
+    def _readd(self, target: _Target) -> None:
+        with self._state:
+            if target.up:
+                return
+            target.up = True
+            target.polled_pending = 0
+            self._state.notify_all()
+        self._count("readds")
+        _emit("router", "readd", replica=target.url)
+
+    # one keep-alive connection per (worker thread, replica)
+    def _conn(self, target: _Target, fresh: bool = False):
+        cache = getattr(self._local, "conns", None)
+        if cache is None:
+            cache = self._local.conns = {}
+        conn = cache.get(target.url)
+        if fresh and conn is not None:
+            try:
+                conn.close()
+            except Exception:
+                pass
+            conn = None
+        if conn is None:
+            conn = _NoDelayConnection(
+                target.host, target.port, timeout=self.request_timeout
+            )
+            cache[target.url] = conn
+        return conn
+
+    def _post(self, target: _Target, path: str, body: bytes):
+        """POST once; returns ``(status, body_bytes)``. Raises
+        ``ConnectionError``-family when the request never made it onto
+        an accepted connection (safe to retry a sibling),
+        :class:`_InFlightDrop` when the connection died after the send
+        (ambiguous — the request may have executed)."""
+        conn = self._conn(target)
+        reused = conn.sock is not None
+        try:
+            conn.request(
+                "POST", path, body=body,
+                headers={"Content-Type": "application/json"},
+            )
+        except Exception:
+            conn.close()
+            if not reused:
+                raise  # fresh connect failed: replica is unreachable
+            # keep-alive race: the server closed the idle conn under us
+            # and the send never happened — one fresh-connection resend
+            conn = self._conn(target, fresh=True)
+            conn.request(
+                "POST", path, body=body,
+                headers={"Content-Type": "application/json"},
+            )
+        try:
+            resp = conn.getresponse()
+            data = resp.read()
+            return resp.status, data
+        except TimeoutError as e:  # socket.timeout: slow, not dead
+            conn.close()
+            raise _ResponseTimeout(repr(e)) from e
+        except Exception as e:
+            conn.close()
+            raise _InFlightDrop(repr(e)) from e
+
+    def _work(self) -> None:
+        while True:
+            job = self._queue.get()
+            if job is None:
+                return
+            try:
+                self._dispatch(job)
+            except Exception as e:  # noqa: BLE001 — never kill a worker
+                try:
+                    job.future.set_exception(e)
+                except Exception:
+                    pass
+
+    def _dispatch(self, job: _Job) -> None:
+        st = self._ep_stats(job.endpoint)
+        path = f"/v1/{job.endpoint}"
+        tried: set = set()
+        attempts = 1 + max(0, self.retries)
+        shed_reasons: List[str] = []
+        down: List[str] = []
+        deadline = job.t0 + self.request_timeout
+        while len(tried) < attempts:
+            target, why = self._acquire(tried, deadline)
+            if target is None:
+                if why == "timeout":
+                    # every eligible replica stayed at its in-flight
+                    # budget for the whole deadline — overload, not
+                    # outage: shed 503-style
+                    shed_reasons.append("router_timeout")
+                break
+            tried.add(target.url)
+            try:
+                status, data = self._post(target, path, job.body)
+            except _ResponseTimeout as e:
+                # the replica is healthy but did not answer in time —
+                # 504-analog: no eviction (one slow request must not
+                # bounce a live replica), no retry (ambiguous: the
+                # request may still execute)
+                st.record_error()
+                self._count("failed")
+                _emit("router", "failed", replica=target.url,
+                      endpoint=job.endpoint, reason="timeout")
+                job.future.set_exception(ServeError(
+                    f"replica {target.url} did not answer "
+                    f"{job.endpoint!r} within {self.request_timeout}s: {e}"
+                ))
+                return
+            except _InFlightDrop as e:
+                self._evict(target, "in_flight_drop")
+                if self.retry_in_flight:
+                    self._count("retries")
+                    _emit("router", "retry", replica=target.url,
+                          endpoint=job.endpoint, reason="in_flight_drop")
+                    continue
+                st.record_error()
+                self._count("failed")
+                _emit("router", "failed", replica=target.url,
+                      endpoint=job.endpoint, reason="in_flight_drop")
+                job.future.set_exception(ReplicaDownError(
+                    f"replica {target.url} dropped the connection with "
+                    f"the request in flight: {e}"
+                ))
+                return
+            except Exception:
+                # connect-level failure: the replica never saw the
+                # request — evict it and retry a sibling
+                self._evict(target, "connect")
+                down.append(target.url)
+                self._count("retries")
+                _emit("router", "retry", replica=target.url,
+                      endpoint=job.endpoint, reason="connect")
+                continue
+            finally:
+                self._release(target)
+            if status == 200:
+                try:
+                    ok, result, _reason = wire.decode_response(data)
+                    if not ok:
+                        raise wire.WireError(
+                            f"200 response carried ok=false: {result}"
+                        )
+                except wire.WireError as e:
+                    st.record_error()
+                    self._count("failed")
+                    _emit("router", "failed", replica=target.url,
+                          endpoint=job.endpoint, reason="wire")
+                    job.future.set_exception(e)
+                    return
+                dt = time.perf_counter() - job.t0
+                st.record_done(dt)
+                self._count("requests")
+                _emit("router", "route", replica=target.url,
+                      endpoint=job.endpoint, seconds=dt)
+                job.future.set_result(result)
+                return
+            ok, message, reason = _safe_decode(data)
+            if status == 503:
+                # sticky degradation: a shed (queue_full/memory/
+                # draining/closed) retries siblings before failing
+                shed_reasons.append(reason or "shed")
+                _emit("router", "retry", replica=target.url,
+                      endpoint=job.endpoint, reason=reason or "shed")
+                self._count("retries")
+                continue
+            # 4xx/5xx: deterministic upstream verdict — do not retry
+            st.record_error()
+            self._count("failed")
+            _emit("router", "failed", replica=target.url,
+                  endpoint=job.endpoint, reason=reason or str(status))
+            exc: Exception
+            if status == 400 or status == 404:
+                exc = ValueError(message or f"HTTP {status}")
+            else:
+                exc = ServeError(
+                    f"replica {target.url} answered HTTP {status}: "
+                    f"{message}"
+                )
+            job.future.set_exception(exc)
+            return
+        # retry ladder exhausted
+        if shed_reasons:
+            st.record_shed()
+            self._count("shed")
+            _emit("router", "shed", endpoint=job.endpoint,
+                  reasons=shed_reasons[:4])
+            job.future.set_exception(ServerOverloadedError(
+                f"every tried replica shed the request "
+                f"(reasons: {shed_reasons})",
+                reason=shed_reasons[-1], endpoint=job.endpoint,
+            ))
+        else:
+            st.record_error()
+            self._count("failed")
+            _emit("router", "failed", endpoint=job.endpoint,
+                  reason="no_replicas")
+            job.future.set_exception(ReplicaDownError(
+                f"no healthy replica for {job.endpoint!r} "
+                f"(down: {down or [t.url for t in self._targets]})"
+            ))
+
+    # -- background poll -----------------------------------------------------
+
+    # one keep-alive poll connection per replica (poll-thread-only +
+    # close(); default 25 ms ticks would otherwise open ~40 TCP
+    # connections per replica per second)
+    def _poll_conn(self, target: _Target, fresh: bool = False):
+        conn = self._poll_conns.get(target.url)
+        if fresh and conn is not None:
+            try:
+                conn.close()
+            except Exception:
+                pass
+            conn = None
+        if conn is None:
+            conn = _NoDelayConnection(
+                target.host, target.port, timeout=_POLL_TIMEOUT
+            )
+            self._poll_conns[target.url] = conn
+        return conn
+
+    def _poll_get(self, target: _Target, path: str):
+        """GET over the cached poll connection → ``(status, body)``;
+        one fresh-connection resend when a reused conn died idle."""
+        conn = self._poll_conn(target)
+        reused = conn.sock is not None
+        try:
+            conn.request("GET", path)
+            resp = conn.getresponse()
+            return resp.status, resp.read()
+        except Exception:
+            try:
+                conn.close()
+            except Exception:
+                pass
+            if not reused:
+                self._poll_conns.pop(target.url, None)
+                raise
+            conn = self._poll_conn(target, fresh=True)
+            try:
+                conn.request("GET", path)
+                resp = conn.getresponse()
+                return resp.status, resp.read()
+            except Exception:
+                try:
+                    conn.close()
+                except Exception:
+                    pass
+                self._poll_conns.pop(target.url, None)
+                raise
+
+    def _poll_one(self, target: _Target) -> None:
+        try:
+            if target.up:
+                _status, body = self._poll_get(target, "/stats")
+                payload = json.loads(body.decode())
+                with self._state:
+                    target.polled_pending = int(
+                        payload.get("pending", payload.get("queue_depth", 0))
+                        or 0
+                    )
+                    target.poll_fails = 0
+            else:
+                status, _body = self._poll_get(target, "/healthz")
+                if status == 200:
+                    self._readd(target)
+        except Exception:
+            if target.up:
+                with self._state:
+                    target.poll_fails += 1
+                    fails = target.poll_fails
+                # two consecutive poll misses = gone (a single slow
+                # poll under load must not bounce a healthy replica)
+                if fails >= 2:
+                    self._evict(target, "health_poll")
+
+    def _poll_loop(self) -> None:
+        while not self._closed:
+            for target in list(self._targets):
+                if self._closed:
+                    return
+                self._poll_one(target)
+            time.sleep(self.poll_interval)
+
+
+def _safe_decode(data: bytes) -> Tuple[bool, str, str]:
+    try:
+        ok, message, reason = wire.decode_response(data)
+        return ok, str(message), reason
+    except Exception:
+        return False, data[:200].decode("utf-8", "replace"), ""

@@ -1,0 +1,287 @@
+"""HTTP transport: one replica's network front (ISSUE 12 tentpole).
+
+A deliberately thin adapter — stdlib ``http.server.ThreadingHTTPServer``
+(zero new dependencies) translating wire requests (:mod:`.wire`) into
+the existing :meth:`heat_tpu.serve.Server.submit` futures API. All the
+hard serving problems stay where PR 8 solved them: the micro-batcher
+coalesces *across* concurrent handler threads exactly as it does across
+in-process submitters, admission control sheds before OOM, and the
+cached-program registry keeps steady state at zero compiles. The
+transport adds only sockets, the wire codec, and the three operational
+endpoints a router needs:
+
+* ``POST /v1/<endpoint>`` — decode payload, ``submit()``, wait the
+  future, encode the result. Admission sheds map to **HTTP 503** with
+  the machine ``reason`` (``queue_full`` | ``memory`` | ``draining``)
+  in the body, which is what the router's sticky-degradation ladder
+  keys on; malformed payloads are 400, a missing endpoint 404, a future
+  timeout 504.
+* ``GET /healthz`` — 200 while accepting, 503 while draining/closed
+  (the router's eviction/re-add probe).
+* ``GET /stats`` — :meth:`Server.stats` plus a ``net`` block: pid,
+  bound port, draining flag, HTTP tallies, the warm-up report, and
+  ``steady_backend_compiles`` — a :class:`telemetry.CompileWatcher`
+  armed when the front starts (i.e. *after* warm-up), so the router and
+  the CI gate can pin the zero-compile steady state of a warm-started
+  replica remotely.
+
+Graceful shutdown: :meth:`HttpFront.drain` sheds new work 503-style
+(router retries siblings), lets queued + in-flight batches finish
+(:meth:`Server.drain`), then stops the listener — the replica's SIGTERM
+handler drives exactly this, then ``telemetry.flush()`` and ``exit 0``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from concurrent.futures import TimeoutError as FutureTimeoutError
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+from heat_tpu import _knobs as knobs
+
+from ... import telemetry
+from ..admission import ServerClosedError, ServerOverloadedError
+from . import wire
+from .events import emit as _emit
+
+__all__ = ["HttpFront"]
+
+
+class _NetHTTPServer(ThreadingHTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+    # BaseHTTPRequestHandler writes status line / headers / body as
+    # separate small sends; with Nagle on, the write-write-read pattern
+    # stalls tens of ms per response on some kernels — measured 33 ms
+    # round trips on loopback before this flag
+    disable_nagle_algorithm = True
+    front: "HttpFront"  # set by HttpFront.start
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"  # keep-alive: every response sets length
+
+    # -- plumbing ------------------------------------------------------------
+
+    def log_message(self, fmt, *args):  # noqa: D102 — silence per-request
+        pass                            # stderr chatter (telemetry has it)
+
+    def _send(self, code: int, body: bytes) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        try:
+            self.wfile.write(body)
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # client went away mid-response; nothing to salvage
+
+    def _send_error(self, code: int, message: str, reason: str) -> None:
+        self.server.front._count(code)
+        self._send(code, wire.encode_error(message, reason))
+
+    # -- routes --------------------------------------------------------------
+
+    def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
+        front = self.server.front
+        if self.path == "/healthz":
+            accepting = front.accepting()
+            body = json.dumps(
+                {"ok": accepting, "draining": front.draining,
+                 "pid": front.pid}
+            ).encode()
+            self._send(200 if accepting else 503, body)
+        elif self.path == "/stats":
+            self._send(200, json.dumps(front.stats_payload()).encode())
+        else:
+            self._send_error(404, f"unknown path {self.path!r}", "not_found")
+
+    def do_POST(self):  # noqa: N802
+        front = self.server.front
+        if not self.path.startswith("/v1/"):
+            self._send_error(404, f"unknown path {self.path!r}", "not_found")
+            return
+        name = self.path[len("/v1/"):]
+        endpoints = getattr(front.server, "endpoints", None)
+        if endpoints is not None and name not in endpoints():
+            # documented contract: a missing endpoint is 404 ("not
+            # deployed on this replica"), distinct from 400 (bad payload)
+            self._send_error(
+                404, f"no endpoint {name!r} on this replica", "not_found"
+            )
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            payload = wire.decode_request(self.rfile.read(length))
+        except wire.WireError as e:
+            self._send_error(400, str(e), "bad_request")
+            return
+        try:
+            fut = front.server.submit(name, payload)
+            result = fut.result(front.request_timeout)
+        except ServerOverloadedError as e:
+            self._send_error(503, str(e), e.reason)
+            return
+        except ServerClosedError as e:
+            self._send_error(503, str(e), "closed")
+            return
+        except FutureTimeoutError:
+            self._send_error(
+                504,
+                f"endpoint {name!r} did not answer within "
+                f"{front.request_timeout}s", "timeout",
+            )
+            return
+        except ValueError as e:
+            # unknown endpoint / wrong feature count — caller bug, 400
+            self._send_error(400, str(e), "bad_request")
+            return
+        except Exception as e:  # noqa: BLE001 — a failed batch is data
+            self._send_error(500, repr(e), "internal")
+            return
+        front._count(200)
+        self._send(200, wire.encode_response(np.asarray(result)))
+
+
+class HttpFront:
+    """One replica's HTTP listener over an existing
+    :class:`heat_tpu.serve.Server` (module docstring has the routes).
+    ``port`` 0 (default, knob ``HEAT_TPU_SERVE_NET_PORT``) binds an
+    ephemeral port; read :attr:`port` / :attr:`url` after
+    :meth:`start`."""
+
+    def __init__(
+        self,
+        server,
+        *,
+        host: str = "127.0.0.1",
+        port: Optional[int] = None,
+        request_timeout: float = 30.0,
+    ):
+        self.server = server
+        self.host = host
+        self.port = int(
+            port if port is not None else knobs.get("HEAT_TPU_SERVE_NET_PORT")
+        )
+        self.request_timeout = float(request_timeout)
+        self.pid = os.getpid()
+        self.warmup_report: Optional[dict] = None  # replica main fills this
+        self._httpd: Optional[_NetHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._steady_cw: Optional[telemetry.CompileWatcher] = None
+        self._lock = threading.Lock()
+        self._http_by_code: dict = {}
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> int:
+        """Bind + serve in a daemon thread; returns the bound port. Arms
+        the steady-state CompileWatcher — call *after* ``warmup()`` so
+        every later backend compile is a steady-state violation."""
+        if self._httpd is not None:
+            return self.port
+        self._httpd = _NetHTTPServer((self.host, self.port), _Handler)
+        self._httpd.front = self
+        self.port = self._httpd.server_address[1]
+        # held open for the front's lifetime: backend_compiles read by
+        # /stats is the remote zero-compile oracle
+        self._steady_cw = telemetry.CompileWatcher()
+        self._steady_cw.__enter__()
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            kwargs={"poll_interval": 0.05},
+            name="heat_tpu.serve.net.http", daemon=True,
+        )
+        self._thread.start()
+        _emit("http", "listen", port=self.port, pid=self.pid)
+        return self.port
+
+    def stop(self, timeout: float = 5.0) -> None:
+        """Stop the listener (does not touch the serve.Server)."""
+        httpd, self._httpd = self._httpd, None
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+        if self._steady_cw is not None:
+            self._steady_cw.__exit__(None, None, None)
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Graceful shutdown: shed new submits 503/``draining`` (the
+        router retries siblings), finish queued + in-flight batches,
+        then stop the listener. Returns ``Server.drain``'s verdict."""
+        _emit("http", "drain", port=self.port, pid=self.pid)
+        drained = self.server.drain(timeout)
+        self.stop()
+        return drained
+
+    def __enter__(self) -> "HttpFront":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.stop()
+        return False
+
+    # -- introspection -------------------------------------------------------
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    @property
+    def draining(self) -> bool:
+        return bool(getattr(self.server, "draining", False))
+
+    def accepting(self) -> bool:
+        return (
+            self._httpd is not None
+            and not self.draining
+            and not getattr(self.server, "_closed", False)
+        )
+
+    def _count(self, code: int) -> None:
+        with self._lock:
+            self._http_by_code[code] = self._http_by_code.get(code, 0) + 1
+
+    def steady_backend_compiles(self) -> int:
+        cw = self._steady_cw
+        return cw.backend_compiles if cw is not None else 0
+
+    def stats_payload(self) -> dict:
+        """``GET /stats`` body: ``Server.stats()`` + the ``net`` block
+        (docs/SERVING.md schema)."""
+        with self._lock:
+            by_code = dict(self._http_by_code)
+        stats = self.server.stats()
+        stats["net"] = {
+            "pid": self.pid,
+            "port": self.port,
+            "draining": self.draining,
+            "http_requests": sum(by_code.values()),
+            "http_by_code": {str(k): v for k, v in by_code.items()},
+            "steady_backend_compiles": self.steady_backend_compiles(),
+            "warmup": self.warmup_report,
+            "autotune_trials": _autotune_trials(),
+        }
+        return stats
+
+
+def _autotune_trials() -> Optional[int]:
+    """Measured autotune trials this process ran — 0 when every site
+    warm-started from the shared DB (the remote half of the PR 11 replay
+    oracle, pinned by the cross-process warm-start test). The tuner
+    counts trials through the telemetry registry, so this reads ``None``
+    (unknown) while telemetry is disabled."""
+    if not telemetry.enabled():
+        return None
+    # single dict lookup, not an items() scan: /stats runs on handler
+    # threads while batcher threads mutate the counters dict
+    return int(telemetry.get_registry().counters.get("autotune.trials", 0))

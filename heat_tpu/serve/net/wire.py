@@ -1,0 +1,133 @@
+"""Wire schema of the network serving tier (ISSUE 12).
+
+One request/response format shared by the HTTP transport
+(:mod:`.transport`), the router client (:mod:`.router`), and the
+multi-process load generator: a JSON envelope whose array payload rides
+as the **base64 of the array's ``.npy`` serialization**. The ``.npy``
+container is self-describing (dtype + shape + C-order bytes) and
+round-trips bitwise, so the exact-mode serving contract survives the
+network hop: a request answered through the router is *bit-identical* to
+the same request dispatched against an in-process
+:class:`~heat_tpu.serve.Server` — the property the CI serving-net gate's
+router-vs-direct digest comparison pins.
+
+Request body (``POST /v1/<endpoint>``)::
+
+    {"payload": "<base64(npy bytes)>"}
+
+Success response (HTTP 200)::
+
+    {"ok": true, "result": "<base64(npy bytes)>"}
+
+Error response (HTTP 4xx/5xx)::
+
+    {"ok": false, "error": "<message>", "reason": "<machine tag>"}
+
+``reason`` carries the admission-control taxonomy across the wire
+(``queue_full`` | ``memory`` | ``draining`` | ``closed`` | ...), so the
+router's sticky-degradation logic can distinguish a shed worth retrying
+on a sibling from a caller bug worth surfacing.
+
+Object-dtype arrays never serialize (``allow_pickle=False`` on both
+directions — a replica must not unpickle attacker-controlled bytes), and
+malformed envelopes raise :class:`WireError` rather than leaking numpy
+internals to the transport layer.
+"""
+
+from __future__ import annotations
+
+import base64
+import io
+import json
+from typing import Tuple
+
+import numpy as np
+
+__all__ = [
+    "WireError",
+    "encode_array",
+    "decode_array",
+    "encode_request",
+    "decode_request",
+    "encode_response",
+    "encode_error",
+    "decode_response",
+]
+
+
+class WireError(ValueError):
+    """Malformed wire envelope or payload (maps to HTTP 400)."""
+
+
+def encode_array(arr: np.ndarray) -> str:
+    """``base64(npy bytes)`` of ``arr`` — dtype/shape self-describing,
+    bitwise round-trip (:func:`decode_array` is the inverse)."""
+    arr = np.ascontiguousarray(arr)
+    if arr.dtype.hasobject:
+        raise WireError("object-dtype arrays cannot travel on the wire")
+    buf = io.BytesIO()
+    np.save(buf, arr, allow_pickle=False)
+    return base64.b64encode(buf.getvalue()).decode("ascii")
+
+
+def decode_array(data: str) -> np.ndarray:
+    """Inverse of :func:`encode_array`; raises :class:`WireError` on
+    garbage instead of leaking codec internals."""
+    if not isinstance(data, str):
+        raise WireError(f"payload must be a base64 string, got {type(data)}")
+    try:
+        raw = base64.b64decode(data.encode("ascii"), validate=True)
+    except Exception as e:
+        raise WireError(f"payload is not valid base64: {e}") from None
+    try:
+        return np.load(io.BytesIO(raw), allow_pickle=False)
+    except Exception as e:
+        raise WireError(f"payload is not a valid .npy blob: {e}") from None
+
+
+def encode_request(payload: np.ndarray) -> bytes:
+    """The JSON body of ``POST /v1/<endpoint>``."""
+    return json.dumps({"payload": encode_array(payload)}).encode("utf-8")
+
+
+def decode_request(body: bytes) -> np.ndarray:
+    """Parse a request body into the payload array (server side)."""
+    try:
+        obj = json.loads(body.decode("utf-8"))
+    except Exception as e:
+        raise WireError(f"request body is not JSON: {e}") from None
+    if not isinstance(obj, dict) or "payload" not in obj:
+        raise WireError('request JSON must be {"payload": "<base64 npy>"}')
+    return decode_array(obj["payload"])
+
+
+def encode_response(result: np.ndarray) -> bytes:
+    """The JSON body of a 200 response."""
+    return json.dumps(
+        {"ok": True, "result": encode_array(result)}
+    ).encode("utf-8")
+
+
+def encode_error(message: str, reason: str) -> bytes:
+    """The JSON body of an error response (``reason`` is the machine
+    tag the router keys its retry policy on)."""
+    return json.dumps(
+        {"ok": False, "error": str(message), "reason": reason}
+    ).encode("utf-8")
+
+
+def decode_response(body: bytes) -> Tuple[bool, object, str]:
+    """Parse a response body → ``(ok, result_or_message, reason)``:
+    ``(True, ndarray, "")`` on success, ``(False, message, reason)`` on a
+    structured error."""
+    try:
+        obj = json.loads(body.decode("utf-8"))
+    except Exception as e:
+        raise WireError(f"response body is not JSON: {e}") from None
+    if not isinstance(obj, dict) or "ok" not in obj:
+        raise WireError('response JSON must carry an "ok" field')
+    if obj["ok"]:
+        if "result" not in obj:
+            raise WireError('ok response is missing "result"')
+        return True, decode_array(obj["result"]), ""
+    return False, str(obj.get("error", "")), str(obj.get("reason", ""))

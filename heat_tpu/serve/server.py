@@ -193,6 +193,12 @@ class Server:
         self._lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
         self._closed = False
+        self._draining = False
+        # admitted-but-unresolved request count (NOT queue depth: a
+        # request leaves the queue before its batch resolves). drain()
+        # waits on this reaching zero, so in-flight batches finish.
+        self._pending = 0
+        self._pending_lock = threading.Lock()
 
     # -- registration --------------------------------------------------------
 
@@ -290,6 +296,15 @@ class Server:
             )
         st = self._stats[name]
         try:
+            if self._draining:
+                # drain-then-kill (ISSUE 12): a draining replica sheds
+                # every NEW request 503-style so the router retries a
+                # sibling, while queued + in-flight work still completes
+                self.admission.shed(
+                    name, "draining",
+                    "server is draining (shutting down gracefully); "
+                    "retry another replica",
+                )
             self.admission.admit(
                 name, ep, arr.shape[0], self._queue.qsize(), self.ladder
             )
@@ -297,6 +312,8 @@ class Server:
             st.record_shed()
             raise
         req = _Request(name, arr, squeeze)
+        with self._pending_lock:
+            self._pending += 1
         st.record_request(req.rows)
         if telemetry.enabled():
             reg = telemetry.get_registry()
@@ -315,6 +332,42 @@ class Server:
         return self.submit(name, payload).result(timeout)
 
     # -- lifecycle -----------------------------------------------------------
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Graceful shutdown, phase one (ISSUE 12): stop admitting —
+        every new :meth:`submit` sheds with ``reason="draining"``
+        (status 503, so a router retries siblings) — then wait for every
+        already-admitted request (queued *and* in-flight batches) to
+        resolve, and :meth:`close`. Returns ``True`` when the backlog
+        fully resolved inside ``timeout`` (a ``False`` close still
+        failed the leftovers with :class:`ServerClosedError`, nothing
+        hangs). Idempotent; the replica SIGTERM handler runs exactly
+        ``drain() -> telemetry.flush() -> exit 0``."""
+        with self._lock:
+            if self._closed:
+                return True
+            self._draining = True
+        if telemetry.enabled():
+            telemetry.get_registry().emit(
+                "serve", "server", event="drain",
+                pending=self._pending, queue_depth=self._queue.qsize(),
+            )
+        deadline = time.monotonic() + max(0.0, timeout)
+        drained = False
+        while True:
+            with self._pending_lock:
+                if self._pending == 0:
+                    drained = True
+            if drained or time.monotonic() >= deadline:
+                break
+            time.sleep(0.002)
+        self.close()
+        return drained
+
+    @property
+    def draining(self) -> bool:
+        """Whether :meth:`drain` has begun (new submits shed 503)."""
+        return self._draining
 
     def close(self, timeout: float = 10.0) -> None:
         """Stop accepting requests, drain the batcher, fail whatever is
@@ -348,6 +401,9 @@ class Server:
                 req.future,
                 exc=ServerClosedError("server closed with request pending"),
             )
+        if leftovers:
+            with self._pending_lock:
+                self._pending -= len(leftovers)
 
     def __enter__(self) -> "Server":
         return self
@@ -425,6 +481,8 @@ class Server:
             "shed": self.admission.sheds,
             "degrades": self.admission.degrades,
             "programs": program_cache.site_stats("serve."),
+            "pending": self._pending,
+            "draining": self._draining,
             "closed": self._closed,
         }
 
@@ -491,6 +549,16 @@ class Server:
             self._run_batch(batch)
 
     def _run_batch(self, reqs: List[_Request]) -> None:
+        try:
+            self._dispatch_batch(reqs)
+        finally:
+            # every request in this batch is resolved by now (result,
+            # error, or the idempotent no-op if close() raced us) — it
+            # stops counting against drain()
+            with self._pending_lock:
+                self._pending -= len(reqs)
+
+    def _dispatch_batch(self, reqs: List[_Request]) -> None:
         name = reqs[0].endpoint
         ep = self._endpoints[name]
         st = self._stats[name]

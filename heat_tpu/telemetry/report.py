@@ -71,6 +71,7 @@ def summarize(
     pc_retraces: dict = {}
     res_events: dict = {}
     at_events: dict = {}
+    sn_events: dict = {}
     plan_counts: dict = {}
     plan_last: Optional[dict] = None
     plan_wire = 0
@@ -115,6 +116,9 @@ def summarize(
         elif kind == "autotune":
             what = ev.get("event") or "event"
             at_events[what] = at_events.get(what, 0) + 1
+        elif kind == "serve_net":
+            what = ev.get("event") or "event"
+            sn_events[what] = sn_events.get(what, 0) + 1
         elif kind == "relayout_plan":
             p = ev.get("plan") or ev.get("name")
             plan_counts[p] = plan_counts.get(p, 0) + 1
@@ -342,6 +346,29 @@ def summarize(
 
         out["autotune"] = {
             _at_names.get(k, k): v for k, v in at_events.items()
+        }
+    # network-serving-tier counters (heat_tpu/serve/net, ISSUE 12): the
+    # router/pool/transport layer emits one `serve_net` event per counter
+    # increment (serve/net/events.py), so live summaries (registry
+    # counters) and offline sink replays reconstruct the SAME
+    # `serving_net` block — the PR 5/PR 11 reconciliation contract.
+    # Absent entirely when no router/pool ran, so single-process serving
+    # summaries keep their exact shape.
+    if live:
+        from . import get_registry as _get_registry
+
+        sn = {
+            k[len("serve_net."):]: (int(v) if float(v).is_integer() else v)
+            for k, v in _get_registry().counters.items()
+            if k.startswith("serve_net.")
+        }
+        if sn:
+            out["serving_net"] = sn
+    elif sn_events:
+        from heat_tpu.serve.net.events import EVENT_COUNTER as _sn_names
+
+        out["serving_net"] = {
+            _sn_names.get(k, k): v for k, v in sn_events.items()
         }
     if watermarks:
         peak = watermarks.get("live_bytes.total")

@@ -1092,6 +1092,95 @@ EOF
     fi
 fi
 
+# Serving-net gate (ISSUE 12): a 2-replica pool on the 4-dev CPU mesh
+# behind the least-loaded router, one shared compile cache. Gates:
+#   digest:   the same seeded request set through an in-process Server
+#             and through the router over HTTP produces BIT-IDENTICAL
+#             response digests (wire round-trip is bitwise; zero sheds
+#             on both sides);
+#   warm:     every replica reports steady_backend_compiles == 0 in
+#             /stats — the CompileWatcher armed post-warmup saw nothing
+#             (the warm-started second replica is the headline: it
+#             reached steady state from the SHARED cache);
+#   chaos:    SIGKILL one replica mid-load — only its in-flight
+#             requests fail (bounded by the router worker count), and
+#             the post-kill recovery probe (fresh replica spawned from
+#             the checkpoint, joined via add_target) answers
+#             bit-identically to the direct single-dispatch reference.
+# HEAT_TPU_CI_SKIP_SERVING_NET=1 opts out.
+if [ -z "${HEAT_TPU_CI_SKIP_SERVING_NET:-}" ]; then
+    echo "=== serving-net gate: 2-replica pool + router (4-device mesh) ==="
+    snet_rc=0
+    snet_out=$(mktemp)
+    if HEAT_TPU_TELEMETRY=1 python benchmarks/serving/net.py \
+            --n 256 --features 16 --mesh 4 --replica-mesh 4 \
+            --replicas-list 2 --requests 80 --rate 120 \
+            --digest-requests 40 --digest-rate 60 \
+            --endpoints cdist,dense --chaos > "$snet_out"; then
+        python - "$snet_out" <<'EOF' || snet_rc=$?
+import json, sys
+
+summary = None
+for line in open(sys.argv[1]):
+    line = line.strip()
+    if not line:
+        continue
+    try:
+        obj = json.loads(line)
+    except json.JSONDecodeError:
+        continue
+    if obj.get("bench") == "serving_net":
+        summary = obj
+if summary is None:
+    raise SystemExit("serving-net: no summary line")
+
+dp = summary["digest_probe"] or {}
+if not (dp.get("match") and dp.get("direct_clean") and dp.get("routed_clean")):
+    raise SystemExit(f"serving-net: router-vs-direct digest diverged: {dp}")
+
+if not summary["steady_backend_compiles_ok"]:
+    raise SystemExit(
+        "serving-net: a replica backend-compiled in steady state "
+        "(warm start from the shared cache failed): "
+        f"{summary['qps_by_replicas']}"
+    )
+
+chaos = summary["chaos"] or {}
+if not chaos.get("post_ok"):
+    raise SystemExit(
+        f"serving-net: post-kill recovery probe not bit-identical: {chaos}"
+    )
+if not chaos.get("failed_within_inflight_bound"):
+    raise SystemExit(
+        f"serving-net: killing one replica lost more than its in-flight "
+        f"requests (failed={chaos.get('failed')}, "
+        f"bound={chaos.get('max_inflight_bound')})"
+    )
+if (chaos.get("completed") or 0) + (chaos.get("failed") or 0) + \
+        (chaos.get("shed") or 0) != summary["requests"]:
+    raise SystemExit(f"serving-net: chaos phase dropped requests: {chaos}")
+
+print(
+    f"serving-net ok: digest bit-identical router-vs-direct, "
+    f"steady compiles 0 across replicas, chaos lost "
+    f"{chaos.get('failed')} in-flight (bound "
+    f"{chaos.get('max_inflight_bound')}), replacement joined in "
+    f"{chaos.get('replacement_join_seconds')}s, post_ok"
+)
+EOF
+    else
+        snet_rc=$?
+    fi
+    if [ -n "$REPORT" ]; then
+        cp "$snet_out" "${REPORT}/serving_net.jsonl" || true
+    fi
+    rm -f "$snet_out"
+    if [ "$snet_rc" != 0 ]; then
+        echo "=== serving-net gate FAILED (rc=$snet_rc) ==="
+        FAILED_SIZES="$FAILED_SIZES serving-net"
+    fi
+fi
+
 if [ "$have_coverage" = 1 ]; then
     # merge the per-size coverage files, as the reference CI merges its
     # 8 mpirun passes (Jenkinsfile:33-44 / codecov)
