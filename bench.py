@@ -458,6 +458,29 @@ def bench_heat_tpu(errors, profile_dir=None, small=False, only=None,
 
         return run, 2.0 * ns * ns * (d + mlan) + 2.0 * mlan * mlan * ns
 
+    def make_sparse():
+        # Sparse spmv through heat_tpu.sparse (ISSUE 13): a 1%-density
+        # (n, n) CSR operand driven through the cached shard_map
+        # spmv with the replicated all-reduce tail — the Spectral/graph
+        # matvec shape. Counted flops: 2·nnz per matvec (the sparse
+        # contract; the dense twin would count 2·n² — the honesty gap IS
+        # the point). Detail row, not in the geomean; the full
+        # density-sweep microbenchmark lives in benchmarks/sparse/.
+        ns, reps = (2048, 3) if small else (16384, 5)
+        rng = np.random.default_rng(11)
+        dense_h = rng.standard_normal((ns, ns)).astype(np.float32)
+        dense_h[rng.random((ns, ns)) > 0.01] = 0.0
+        A = ht.sparse.csr_from_dense(dense_h)
+        xv = ht.array(rng.standard_normal(ns).astype(np.float32))
+
+        def run():
+            out = None
+            for _ in range(reps):
+                out = ht.sparse.spmv(A, xv, out_split=None).larray
+            return _sync(out)
+
+        return run, reps * 2.0 * A.nnz
+
     def make_matmul_1b():
         # BASELINE.md north star: a >=1B-element split DNDarray driven
         # through framework matmul on the chip. 32768^2 bf16 operands are
@@ -593,6 +616,7 @@ def bench_heat_tpu(errors, profile_dir=None, small=False, only=None,
         ("matmul_f32", make_matmul_f32),
         ("matmul_int8", make_matmul_int8),
         ("spectral", make_spectral),
+        ("sparse", make_sparse),
         ("kmeans_1b", make_kmeans_1b),
         ("lm_step", make_lm_step),
     ]
@@ -880,7 +904,7 @@ def main():
             "matmul", "matmul_f32", "matmul_bf16", "cdist", "kmeans",
             "moments", "elementwise", "reduction", "lasso", "attention",
             "attention_bwd", "matmul_int8", "lm_step", "matmul_1b",
-            "spectral", "kmeans_1b", "serving",
+            "spectral", "kmeans_1b", "serving", "sparse",
         }
         unknown = only - known
         if unknown:
@@ -913,7 +937,8 @@ def main():
             for k, v in ours_now.items()
             if k not in ("matmul_bf16", "matmul_f32", "attention",
                          "attention_bwd", "matmul_int8", "lm_step",
-                         "matmul_1b", "spectral", "kmeans_1b", "serving")
+                         "matmul_1b", "spectral", "kmeans_1b", "serving",
+                         "sparse")
         }
         geo_ours = (
             float(np.exp(np.mean([np.log(v) for v in f32.values()]))) if f32 else 0.0

@@ -1,0 +1,278 @@
+#!/usr/bin/env python
+"""Sparse container microbenchmark — spmv/spmm vs the dense matmul
+across densities, the budget-bounded transpose, and a Spectral
+eNeighbour end-to-end row (ISSUE 13, heat_tpu/sparse).
+
+What the dense stack could not express: an (n, n) operator at 0.1%
+density holds ~n²/1000 elements, but every dense pipeline pays the full
+n² in bytes and flops. This runner measures where the crossover sits on
+the attached backend:
+
+* per density (0.1% / 1% / 10%): one ``spmv`` (row-split result — zero
+  wire), one replicated-result ``spmv`` (the audited all-reduce tail),
+  one ``spmm`` over ``--features`` dense columns, and the dense
+  ``matmul`` twin on the same masked operand;
+* ``digest_match``: the row-split spmv against a dense reference
+  mask-matmul evaluated **in the same per-row element order**
+  (vectorized left-fold over element ranks) — BIT-identical, the
+  ``run_ci.sh`` sparse gate's oracle;
+* the transpose slab exchange, monolithic vs stage-decomposed
+  (``slab=`` forced to capacity/4 — the deterministic form of the
+  HEAT_TPU_HBM_BUDGET planning), digest-pinned bit-identical;
+* a Spectral eNeighbour end-to-end row: the sparse pipeline
+  (SparseDNDarray Laplacian + spmv Lanczos) vs the legacy dense one,
+  with label agreement.
+
+Summary line ``{"sparse_compare": ...}`` carries the honest
+``on_chip`` + ``cpu_fallback`` pair like every bench in this tree.
+"""
+
+import hashlib
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+from benchmarks._harness import base_parser, bootstrap
+
+DENSITIES = (0.001, 0.01, 0.1)
+
+
+def _digest(*arrays):
+    import numpy as np
+
+    h = hashlib.sha256()
+    for a in arrays:
+        h.update(np.ascontiguousarray(np.asarray(a)).tobytes())
+    return h.hexdigest()
+
+
+def sequential_reference(dense, x):
+    """The dense mask-matmul evaluated in CSR element order: a
+    vectorized left-fold over per-row element ranks, so each row's sum
+    accumulates its stored entries left to right — the exact order the
+    CSR segment reduction applies. Bit-comparable to the row-split spmv
+    (trailing +0.0 pad adds are bitwise no-ops on a +0.0-initialized
+    accumulator)."""
+    import numpy as np
+
+    m, n = dense.shape
+    rows, cols = np.nonzero(dense)
+    contrib = (dense[rows, cols] * x[cols]).astype(
+        np.promote_types(dense.dtype, x.dtype)
+    )
+    counts = np.zeros(m, dtype=np.int64)
+    np.add.at(counts, rows, 1)
+    K = int(counts.max(initial=0))
+    rank = np.arange(rows.shape[0]) - np.concatenate(
+        [[0], np.cumsum(counts)[:-1]]
+    )[rows]
+    C = np.zeros((m, K), dtype=contrib.dtype)
+    C[rows, rank] = contrib
+    acc = np.zeros(m, dtype=contrib.dtype)
+    for k in range(K):
+        acc = acc + C[:, k]
+    return acc
+
+
+def _time(fn, trials):
+    t0 = time.perf_counter()
+    out = fn()
+    first = time.perf_counter() - t0
+    times = []
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        fn()
+        times.append(time.perf_counter() - t0)
+    return out, first, min(times)
+
+
+def density_row(ht, n, k, density, trials, audit):
+    import numpy as np
+
+    from heat_tpu import sparse, telemetry
+
+    rng = np.random.default_rng(42)
+    dense_h = rng.standard_normal((n, n)).astype(np.float32)
+    dense_h[rng.random((n, n)) > density] = 0.0
+    xh = rng.standard_normal(n).astype(np.float32)
+    Xh = rng.standard_normal((n, k)).astype(np.float32)
+
+    A = sparse.csr_from_dense(dense_h)
+    D = ht.array(dense_h, split=0)
+    x = ht.array(xh)
+    X = ht.array(Xh)
+
+    with telemetry.CompileWatcher() as cw:
+        y_split, first_spmv, best_spmv = _time(
+            lambda: np.asarray(
+                sparse.spmv(A, x, audit=audit).larray
+            ),
+            trials,
+        )
+    spmv_compiles = cw.backend_compiles
+    _, _, best_spmv_rep = _time(
+        lambda: np.asarray(sparse.spmv(A, x, out_split=None).larray), trials
+    )
+    _, _, best_spmm = _time(
+        lambda: np.asarray(sparse.spmm(A, X, audit=audit).larray), trials
+    )
+    _, _, best_dense_mv = _time(
+        lambda: np.asarray(ht.matmul(D, x).larray), trials
+    )
+    _, _, best_dense_mm = _time(
+        lambda: np.asarray(ht.matmul(D, X).larray), trials
+    )
+
+    ref = sequential_reference(dense_h, xh)
+    got = np.asarray(sparse.spmv(A, x).numpy())
+    row = {
+        "density": density,
+        "n": n,
+        "nnz": A.nnz,
+        "capacity": A.capacity,
+        "spmv_best_s": round(best_spmv, 6),
+        "spmv_replicated_best_s": round(best_spmv_rep, 6),
+        "spmm_best_s": round(best_spmm, 6),
+        "dense_matvec_best_s": round(best_dense_mv, 6),
+        "dense_matmul_best_s": round(best_dense_mm, 6),
+        "spmv_first_call_s": round(first_spmv, 6),
+        "spmv_programs_compiled": spmv_compiles,
+        "spmv_vs_dense": round(best_dense_mv / max(best_spmv, 1e-9), 3),
+        "spmm_vs_dense": round(best_dense_mm / max(best_spmm, 1e-9), 3),
+        # the CI gate's oracle: same per-row element order -> same bits
+        "digest_spmv": _digest(got),
+        "digest_reference": _digest(ref),
+        "digest_match": bool(np.array_equal(got, ref)),
+        "allclose_replicated": bool(np.allclose(
+            np.asarray(sparse.spmv(A, x, out_split=None).numpy()),
+            dense_h @ xh, rtol=1e-4, atol=1e-5,
+        )),
+    }
+    return row, A
+
+
+def transpose_row(ht, A, trials):
+    import numpy as np
+
+    from heat_tpu import sparse
+
+    mono, _, best_mono = _time(lambda: sparse.transpose(A), trials)
+    slab = max(1, A.capacity // 4)
+    chunk, _, best_chunk = _time(
+        lambda: sparse.transpose(A, slab=slab), trials
+    )
+    stages = max(1, -(-A.capacity // slab))
+    return {
+        "nnz": A.nnz,
+        "capacity": A.capacity,
+        "monolithic_best_s": round(best_mono, 6),
+        "chunked_best_s": round(best_chunk, 6),
+        "chunked_slab": slab,
+        "chunked_stages": stages,
+        "digest_match": bool(
+            np.array_equal(
+                np.asarray(mono.values), np.asarray(chunk.values)
+            ) and np.array_equal(
+                np.asarray(mono.indices), np.asarray(chunk.indices)
+            )
+        ),
+    }
+
+
+def spectral_row(ht, n, trials):
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    pts = np.concatenate([
+        rng.standard_normal((n // 2, 8)) * 0.3,
+        rng.standard_normal((n - n // 2, 8)) * 0.3 + 4.0,
+    ]).astype(np.float32)
+    X = ht.array(pts, split=0)
+
+    def fit(sparse_flag):
+        sp = ht.cluster.Spectral(
+            n_clusters=2, gamma=0.5, laplacian="eNeighbour",
+            threshold=0.1, boundary="lower", n_lanczos=min(48, n),
+            sparse=sparse_flag,
+        )
+        sp.fit(X)
+        return sp.labels_.numpy()
+
+    ls, _, best_sparse = _time(lambda: fit(True), max(1, trials - 1))
+    ld, _, best_dense = _time(lambda: fit(False), max(1, trials - 1))
+    agree = max(float((ls == ld).mean()), float((ls == 1 - ld).mean()))
+    return {
+        "n": n,
+        "sparse_best_s": round(best_sparse, 6),
+        "dense_best_s": round(best_dense, 6),
+        "sparse_vs_dense": round(best_dense / max(best_sparse, 1e-9), 3),
+        "label_agreement": agree,
+    }
+
+
+def main():
+    parser = base_parser("heat_tpu sparse container microbenchmark")
+    parser.add_argument(
+        "--densities", default=",".join(str(d) for d in DENSITIES),
+        help="comma-separated density sweep (default 0.001,0.01,0.1)")
+    parser.add_argument(
+        "--spectral-n", type=int, default=256,
+        help="rows of the Spectral end-to-end row (0 skips it)")
+    args = parser.parse_args()
+    ht = bootstrap(args)
+    import jax
+    import numpy as np
+
+    from heat_tpu import telemetry
+
+    devs = jax.devices()
+    on_chip = devs[0].platform != "cpu"
+    cpu_fallback = (
+        None if on_chip else
+        ("forced virtual cpu mesh (--mesh)" if args.mesh
+         else "default backend is cpu (no accelerator attached)")
+    )
+    n = int(args.n)
+    densities = [float(d) for d in args.densities.split(",") if d.strip()]
+
+    rows = []
+    last_A = None
+    for d in densities:
+        row, A = density_row(
+            ht, n, args.features, d, args.trials, args.audit
+        )
+        rows.append(row)
+        last_A = A
+        print(json.dumps({"sparse_density": row}), flush=True)
+
+    tr = transpose_row(ht, last_A, args.trials)
+    print(json.dumps({"sparse_transpose": tr}), flush=True)
+
+    spec = None
+    if args.spectral_n:
+        spec = spectral_row(ht, int(args.spectral_n), args.trials)
+        print(json.dumps({"sparse_spectral": spec}), flush=True)
+
+    summary = {
+        "bench": "sparse",
+        "n": n,
+        "features": args.features,
+        "densities": rows,
+        "transpose": tr,
+        "spectral": spec,
+        "digest_match_all": bool(all(r["digest_match"] for r in rows)),
+        "on_chip": on_chip,
+        "cpu_fallback": cpu_fallback,
+        "devices": {"count": len(devs), "kind": devs[0].device_kind},
+    }
+    if telemetry.enabled():
+        summary.update(telemetry.report.bench_fields())
+    print(json.dumps({"sparse_compare": summary}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -37,6 +37,11 @@ from .core import linalg, program_cache, random, version
 from .core.ragged import Ragged, ragged
 from .core.version import version as __version__
 
+# sparse container + audited SpMV/SpMM (ISSUE 13): mounts right after
+# core (it consumes program_cache/telemetry/memory_guard) and before the
+# ML subpackages (graph/cluster/serve route workloads through it)
+from . import sparse
+
 # ML subpackages (assembled as they are built; reference heat/__init__.py
 # mounts cluster/classification/graph/naive_bayes/regression/spatial/nn/
 # optim/utils the same way)

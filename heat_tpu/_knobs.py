@@ -294,6 +294,28 @@ _register(
     tunable=Tunable(("1", "0"), "lossy", exact_value="1"),
 )
 
+# -- sparse container knobs (heat_tpu/sparse, ISSUE 13) -----------------------
+
+_register(
+    "HEAT_TPU_SPARSE_DENSE_THRESHOLD", "float", 0.25,
+    "Density (nnz / rows*cols) above which sparse construction paths "
+    "fall back to the dense pipeline (heat_tpu/sparse; the "
+    "graph.Laplacian eNeighbour path densifies past it — a CSR denser "
+    "than this moves more bytes than the dense GEMM it replaces).",
+)
+_register(
+    "HEAT_TPU_SPARSE_SPMV_PREC", "enum", "off",
+    "Wire precision of the float VALUE payloads in the sparse "
+    "spmv/spmm collectives (operand gather + result all-reduce, "
+    "heat_tpu/sparse/ops.py). Default pinned exact: index/indptr "
+    "payloads never ride these hops at all (they stay shard-local), "
+    "and the default keeps Krylov matvecs bit-stable. `bf16` moves the "
+    "gathered operand as the uint16 bit pattern and the all-reduce on "
+    "a bf16 payload.",
+    choices=("off", "bf16"),
+    tunable=Tunable(("off", "bf16"), "lossy", exact_value="off"),
+)
+
 # -- network serving tier knobs (heat_tpu/serve/net, ISSUE 12) ----------------
 
 _register(
@@ -415,6 +437,10 @@ for _name, _doc in (
     ("HEAT_TPU_CI_SKIP_AUTOTUNE", "Skip the autotune gate (ISSUE 11: "
      "tuned-vs-default wall, budget/digest validation, second-process "
      "zero-trial warm start)."),
+    ("HEAT_TPU_CI_SKIP_SPARSE", "Skip the sparse gate (ISSUE 13: spmv "
+     "digest bit-identical to the dense reference mask-matmul, "
+     "budget-bounded transpose, zero HLO-audit drift on the sparse "
+     "collective sites)."),
 ):
     _register(_name, "str", None, _doc, scope="ci")
 del _name, _doc

@@ -257,6 +257,12 @@ _EXACT_TOKENS = (
     "sort", "merge", "unique", "hist", "bincount", "topk", "gram",
     "median", "percentile", "searchsorted", "quantile", "digitize",
     "qr", "tsqr",
+    # sparse kernels (ISSUE 13): index/indptr payloads live in
+    # spmv/spmm-named bodies, so any hop added there must pin exact —
+    # the knob-gated float value tails deliberately live in the
+    # module-level _gather_operand/_combine_replicated helpers outside
+    # this token scope (heat_tpu/sparse/ops.py documents the split)
+    "spmv", "spmm",
 )
 
 

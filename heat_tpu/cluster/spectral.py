@@ -44,6 +44,7 @@ class Spectral(BaseEstimator, ClusteringMixin):
         boundary: str = "upper",
         n_lanczos: int = 300,
         assign_labels: str = "kmeans",
+        sparse: Optional[bool] = None,
         **params,
     ):
         self.n_clusters = n_clusters
@@ -54,20 +55,29 @@ class Spectral(BaseEstimator, ClusteringMixin):
         self.boundary = boundary
         self.n_lanczos = n_lanczos
         self.assign_labels = assign_labels
+        self.sparse = sparse
 
         sigma = float(np.sqrt(1.0 / (2.0 * gamma)))
+        pair = None
         if callable(metric):
             # extension over the reference (spectral.py:84 raises for
             # anything beyond rbf/euclidean): any DNDarray -> DNDarray
-            # similarity callable plugs into the Laplacian
+            # similarity callable plugs into the Laplacian (no block
+            # form, so an eNeighbour graph loses the O(n²)-free
+            # construction guarantee — Laplacian degrades gracefully)
             sim = metric
         elif metric == "rbf":
             sim = lambda x: spatial.rbf(x, sigma=sigma, quadratic_expansion=True)
+            pair = lambda a, b: spatial.rbf(
+                a, b, sigma=sigma, quadratic_expansion=True
+            )
         elif metric == "euclidean":
             sim = lambda x: spatial.cdist(x, quadratic_expansion=True)
+            pair = lambda a, b: spatial.cdist(a, b, quadratic_expansion=True)
         elif metric == "manhattan":
             # extension: L1 affinity via the same ring/GEMM machinery
             sim = lambda x: spatial.manhattan(x)
+            pair = lambda a, b: spatial.manhattan(a, b)
         else:
             raise NotImplementedError(f"Metric {metric} is currently not implemented")
         self._laplacian = Laplacian(
@@ -76,6 +86,11 @@ class Spectral(BaseEstimator, ClusteringMixin):
             mode="eNeighbour" if laplacian == "eNeighbour" else "fully_connected",
             threshold_key=boundary,
             threshold_value=threshold,
+            sparse=sparse,
+            # the two-operand block form: what lets the eNeighbour graph
+            # build as a SparseDNDarray in temp_budget-sized row blocks
+            # instead of materializing the O(n²) similarity (ISSUE 13)
+            pair_similarity=pair,
         )
         if assign_labels == "kmeans":
             self._cluster = KMeans(
@@ -93,7 +108,11 @@ class Spectral(BaseEstimator, ClusteringMixin):
         return self._labels
 
     def _spectral_embedding(self, x: DNDarray):
-        """Lowest eigenpairs of L via Lanczos (reference spectral.py:103)."""
+        """Lowest eigenpairs of L via Lanczos (reference spectral.py:103).
+        An eNeighbour graph arrives as a
+        :class:`~heat_tpu.sparse.SparseDNDarray` and the Krylov matvecs
+        run as spmv inside the very same cached Lanczos program — the
+        solver's operator protocol makes sparse a drop-in (ISSUE 13)."""
         L = self._laplacian.construct(x)
         m = min(self.n_lanczos, x.shape[0])
         V, T = lanczos(L, m)

@@ -1,9 +1,23 @@
 """Iterative solvers written in framework ops (reference:
 heat/core/linalg/solver.py:10-184). Because they are expressed in DNDarray
-arithmetic, distribution is inherited — identical design here."""
+arithmetic, distribution is inherited — identical design here.
+
+Operator protocol (ISSUE 13): the kernels take their matrix as a
+``matvec`` **operator** — a tuple of program-argument leaves plus a pure
+traceable ``mv(leaves, x, n)`` — instead of hard-coding ``a @ x``. A
+dense :class:`DNDarray` resolves to the padded sharded buffer with the
+historical masked matvec (bit-identical programs to the pre-protocol
+kernels); any object exposing ``_matvec_spec(dt)`` — e.g.
+:class:`heat_tpu.sparse.SparseDNDarray`, whose matvec is the shard-local
+CSR contraction + audited all-reduce tail — drops in without the solver
+knowing its layout. The operator kind joins the program-cache key, so a
+dense and a sparse Lanczos never share an executable and each stays
+zero-recompile on repeat.
+"""
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Optional, Tuple
 
 import jax.numpy as jnp
@@ -14,19 +28,64 @@ from ..dndarray import DNDarray
 __all__ = ["cg", "lanczos"]
 
 
-def _cg_kernel(a: "jnp.ndarray", b: "jnp.ndarray", x0: "jnp.ndarray", n: int):
+def _dense_matvec(leaves, x, n: int):
+    """The historical dense matvec: ``leaves[0]`` may be the PADDED
+    split-0 physical buffer (n_pad, n) with zeroed pad rows — the matvec
+    stays sharded (XLA partitions it) and only the logical slice relays
+    per step."""
+    return (leaves[0] @ x)[:n]
+
+
+def _operator(A, dt):
+    """Resolve ``A`` into ``(leaves, mv, kind_key, comm_or_None)``.
+
+    ``leaves`` are the program arguments (a pytree — the kernels take
+    them as one tuple), ``mv(leaves, x, n)`` the pure traceable matvec,
+    ``kind_key`` the static signature fragment for the program cache,
+    and the comm is non-None when the leaves are sharded (the kernel
+    variants then pin replicated ``out_shardings`` — an XLA-chosen
+    output sharding can hit jax's device-order reshard assertion in the
+    downstream device_put under multi-host)."""
+    if isinstance(A, DNDarray):
+        if A.split == 0 and A.comm.size > 1:
+            # keep A sharded: the matvec partitions over the mesh (pad
+            # rows are zeroed and sliced off inside the kernel)
+            return (
+                (A._masked(0).astype(dt.jnp_type()),),
+                _dense_matvec, ("dense",), A.comm,
+            )
+        return (
+            (A._replicated().astype(dt.jnp_type()),),
+            _dense_matvec, ("dense",), None,
+        )
+    spec = getattr(A, "_matvec_spec", None)
+    if spec is None:
+        raise TypeError(
+            f"A must be a DNDarray or expose _matvec_spec (e.g. "
+            f"heat_tpu.sparse.SparseDNDarray), got {type(A)}"
+        )
+    leaves, mv, kind_key = spec(dt)
+    return leaves, mv, kind_key, (A.comm if A.comm.size > 1 else None)
+
+
+def _is_operator(A) -> bool:
+    return isinstance(A, DNDarray) or hasattr(A, "_matvec_spec")
+
+
+def _cg_kernel(mv, a, b: "jnp.ndarray", x0: "jnp.ndarray", n: int):
     """Whole CG iteration as ONE compiled program: `lax.while_loop` with the
     convergence test on-device (reference solver.py:13 drives the loop from
     the host with four `.item()` syncs per iteration; here zero scalars cross
-    to the host until the solve finishes). ``a`` may be the PADDED split-0
-    physical buffer (n_pad, n) with zeroed pad rows — the matvec stays
-    sharded and only (n,) vectors carry between steps."""
+    to the host until the solve finishes). ``a`` is the operator leaf tuple
+    (dense: the possibly-padded sharded buffer; sparse: the CSR shards) and
+    ``mv`` the statically-bound matvec — only (n,) vectors carry between
+    steps."""
     import jax.lax as lax
 
     def matvec(x):
-        return (a @ x)[:n]  # pad rows contribute zeros; slice to logical
+        return mv(a, x, n)
 
-    tol2 = jnp.asarray(1e-20, dtype=a.dtype)  # (1e-10)^2, tested on r.r
+    tol2 = jnp.asarray(1e-20, dtype=b.dtype)  # (1e-10)^2, tested on r.r
 
     r0 = b - matvec(x0)
     rs0 = jnp.dot(r0, r0)
@@ -51,17 +110,18 @@ def _cg_kernel(a: "jnp.ndarray", b: "jnp.ndarray", x0: "jnp.ndarray", n: int):
     return x
 
 
-def _cg_init_kernel(a: "jnp.ndarray", b: "jnp.ndarray", x0: "jnp.ndarray", n: int):
+def _cg_init_kernel(mv, a, b: "jnp.ndarray", x0: "jnp.ndarray", n: int):
     """Initial CG carry ``(x, r, p, rsold, it)`` — the pre-loop segment of
     :func:`_cg_kernel`, split out so the checkpointed driver can resume the
     iteration mid-solve (resilience hooks, ISSUE 5)."""
-    r0 = b - (a @ x0)[:n]
+    r0 = b - mv(a, x0, n)
     rs0 = jnp.dot(r0, r0)
     return x0, r0, r0, rs0, jnp.asarray(0, dtype=jnp.int32)
 
 
 def _cg_chunk_kernel(
-    a: "jnp.ndarray",
+    mv,
+    a,
     x: "jnp.ndarray",
     r: "jnp.ndarray",
     p: "jnp.ndarray",
@@ -77,9 +137,9 @@ def _cg_chunk_kernel(
     import jax.lax as lax
 
     def matvec(v):
-        return (a @ v)[:n]
+        return mv(a, v, n)
 
-    tol2 = jnp.asarray(1e-20, dtype=a.dtype)
+    tol2 = jnp.asarray(1e-20, dtype=x.dtype)
     lim = jnp.minimum(it + k, n)
 
     def cond(carry):
@@ -100,7 +160,7 @@ def _cg_chunk_kernel(
 
 
 def cg(
-    A: DNDarray,
+    A,
     b: DNDarray,
     x0: DNDarray,
     out: Optional[DNDarray] = None,
@@ -115,6 +175,9 @@ def cg(
     convergence check — runs as one jitted `lax.while_loop` dispatch, the
     same treatment `lanczos` gets below; A stays sharded (split=0 matvecs
     partition over the mesh) and no scalar reaches the host mid-solve.
+    ``A`` may be a dense :class:`DNDarray` or any operator exposing
+    ``_matvec_spec`` (a :class:`heat_tpu.sparse.SparseDNDarray` runs its
+    matvecs as the shard-local CSR contraction — ISSUE 13).
 
     ``checkpoint_every=k`` (resilience hook, ISSUE 5) instead drives the
     solve as exact ``k``-iteration windows, checkpointing the CG carry
@@ -124,11 +187,12 @@ def cg(
     results to an uninterrupted run (the window kernel's body is the same
     per-iteration math)."""
     if (
-        not isinstance(A, DNDarray)
+        not _is_operator(A)
         or not isinstance(b, DNDarray)
         or not isinstance(x0, DNDarray)
     ):
-        raise TypeError("cg expects DNDarray operands for A, b and x0")
+        raise TypeError("cg expects DNDarray (or sparse operator) A, and "
+                        "DNDarray b and x0")
     if A.ndim != 2:
         raise RuntimeError(f"cg expects a 2-D matrix A, got {A.ndim}-D")
     if b.ndim != 1:
@@ -140,15 +204,8 @@ def cg(
     dt = types.promote_types(
         types.promote_types(A.dtype, b.dtype), types.promote_types(x0.dtype, types.float32)
     )
-    sharded = A.split == 0 and A.comm.size > 1
-    if sharded:
-        # keep A sharded: the matvec partitions over the mesh (pad rows are
-        # zeroed and sliced off inside the kernel) — A never replicates
-        a_log = A._masked(0).astype(dt.jnp_type())
-        kernel_jit = _cg_jit_for(A.comm)
-    else:
-        a_log = A._replicated().astype(dt.jnp_type())
-        kernel_jit = _cg_jit()
+    leaves, mv, kind_key, op_comm = _operator(A, dt)
+    kernel_jit = _cg_jit(mv, kind_key, op_comm)
     b_log = b._replicated().astype(dt.jnp_type())
     x0_log = x0._replicated().astype(dt.jnp_type())
 
@@ -160,13 +217,13 @@ def cg(
         if not checkpoint_path:
             raise ValueError("checkpoint_every requires checkpoint_path")
         x_log = _cg_checkpointed(
-            A.comm if sharded else None, a_log, b_log, x0_log, n,
+            mv, kind_key, op_comm, leaves, b_log, x0_log, n,
             int(checkpoint_every), checkpoint_path, resume,
         )
     elif resume:
         raise ValueError("resume=True requires checkpoint_every")
     else:
-        x_log = kernel_jit(a_log, b_log, x0_log, n)
+        x_log = kernel_jit(leaves, b_log, x0_log, n)
     if not bool(jnp.all(jnp.isfinite(x_log))):
         # breakdown (p^T A p = 0 ⇒ alpha = inf inside the kernel) exits the
         # while_loop via the NaN residual; surface it loudly — the solve is
@@ -183,7 +240,7 @@ def cg(
     return x
 
 
-def _lanczos_kernel(a: "jnp.ndarray", v0: "jnp.ndarray", m: int, n: int):
+def _lanczos_kernel(mv, a, v0: "jnp.ndarray", m: int, n: int):
     """The whole Lanczos iteration as ONE compiled program (jit over
     static ``m``/``n``): `lax.fori_loop` over Krylov steps with masked full
     reorthogonalization against a fixed (m, n) basis buffer, breakdown
@@ -193,21 +250,22 @@ def _lanczos_kernel(a: "jnp.ndarray", v0: "jnp.ndarray", m: int, n: int):
     in-process CPU backend and deadlock (observed; and on TPU it would pay
     a dispatch round-trip per step).
 
-    ``a`` may be the PADDED split-0 physical buffer (n_pad, n) with zeroed
-    pad rows — the matvec stays sharded (XLA partitions it) and only the
-    (n,)-vector slice relays per step. Krylov vectors are length ``n``."""
+    ``a`` is the operator leaf tuple (dense: possibly the PADDED split-0
+    physical buffer with zeroed pad rows; sparse: the sharded CSR
+    buffers, whose matvec is a shard_map contraction + all-reduce tail
+    embedded in this very trace). Krylov vectors are length ``n``."""
     import jax
 
     def norm(x):
         return jnp.sqrt(jnp.sum(x * x))
 
     def matvec(x):
-        return (a @ x)[:n]  # pad rows produce zeros; slice to logical
+        return mv(a, x, n)
 
     v = v0 / norm(v0)
-    Vb = jnp.zeros((m, n), dtype=a.dtype).at[0].set(v)
-    alphas = jnp.zeros((m,), dtype=a.dtype)
-    betas = jnp.zeros((m,), dtype=a.dtype)
+    Vb = jnp.zeros((m, n), dtype=v0.dtype).at[0].set(v)
+    alphas = jnp.zeros((m,), dtype=v0.dtype)
+    betas = jnp.zeros((m,), dtype=v0.dtype)
     w = matvec(v)
     alpha = jnp.dot(w, v)
     w = w - alpha * v
@@ -215,14 +273,14 @@ def _lanczos_kernel(a: "jnp.ndarray", v0: "jnp.ndarray", m: int, n: int):
     key = jax.random.PRNGKey(0)
 
     # breakdown threshold scaled to the compute dtype's resolution
-    eps = 1e-13 if a.dtype == jnp.float64 else 1e-6
+    eps = 1e-13 if v0.dtype == jnp.float64 else 1e-6
 
     def body(i, carry):
         Vb, alphas, betas, w = carry
         beta = norm(w)
         ok = beta > eps
         # breakdown: restart with a pseudo-random vector (deterministic in i)
-        restart = jax.random.normal(jax.random.fold_in(key, i), (n,), dtype=a.dtype)
+        restart = jax.random.normal(jax.random.fold_in(key, i), (n,), dtype=v0.dtype)
         v_next = jnp.where(ok, w / jnp.where(ok, beta, 1.0), restart)
         # masked full re-orthogonalization against columns < i
         proj = (Vb @ v_next) * (jnp.arange(m) < i)
@@ -243,7 +301,7 @@ def _lanczos_kernel(a: "jnp.ndarray", v0: "jnp.ndarray", m: int, n: int):
     return Vb.T, alphas, betas
 
 
-def _lanczos_init_kernel(a: "jnp.ndarray", v0: "jnp.ndarray", m: int, n: int):
+def _lanczos_init_kernel(mv, a, v0: "jnp.ndarray", m: int, n: int):
     """Initial Lanczos carry ``(Vb, alphas, betas, w)`` — the pre-loop
     segment of :func:`_lanczos_kernel`, split out for the checkpointed
     driver (resilience hooks, ISSUE 5)."""
@@ -252,12 +310,12 @@ def _lanczos_init_kernel(a: "jnp.ndarray", v0: "jnp.ndarray", m: int, n: int):
         return jnp.sqrt(jnp.sum(x * x))
 
     def matvec(x):
-        return (a @ x)[:n]
+        return mv(a, x, n)
 
     v = v0 / norm(v0)
-    Vb = jnp.zeros((m, n), dtype=a.dtype).at[0].set(v)
-    alphas = jnp.zeros((m,), dtype=a.dtype)
-    betas = jnp.zeros((m,), dtype=a.dtype)
+    Vb = jnp.zeros((m, n), dtype=v0.dtype).at[0].set(v)
+    alphas = jnp.zeros((m,), dtype=v0.dtype)
+    betas = jnp.zeros((m,), dtype=v0.dtype)
     w = matvec(v)
     alpha = jnp.dot(w, v)
     w = w - alpha * v
@@ -266,7 +324,8 @@ def _lanczos_init_kernel(a: "jnp.ndarray", v0: "jnp.ndarray", m: int, n: int):
 
 
 def _lanczos_chunk_kernel(
-    a: "jnp.ndarray",
+    mv,
+    a,
     Vb: "jnp.ndarray",
     alphas: "jnp.ndarray",
     betas: "jnp.ndarray",
@@ -288,16 +347,16 @@ def _lanczos_chunk_kernel(
         return jnp.sqrt(jnp.sum(x * x))
 
     def matvec(x):
-        return (a @ x)[:n]
+        return mv(a, x, n)
 
     key = jax.random.PRNGKey(0)
-    eps = 1e-13 if a.dtype == jnp.float64 else 1e-6
+    eps = 1e-13 if Vb.dtype == jnp.float64 else 1e-6
 
     def body(i, carry):
         Vb, alphas, betas, w = carry
         beta = norm(w)
         ok = beta > eps
-        restart = jax.random.normal(jax.random.fold_in(key, i), (n,), dtype=a.dtype)
+        restart = jax.random.normal(jax.random.fold_in(key, i), (n,), dtype=Vb.dtype)
         v_next = jnp.where(ok, w / jnp.where(ok, beta, 1.0), restart)
         proj = (Vb @ v_next) * (jnp.arange(m) < i)
         v_next = v_next - Vb.T @ proj
@@ -318,81 +377,82 @@ def _lanczos_chunk_kernel(
 from .. import program_cache
 
 
-def _cg_jit():
-    """cg program compiled once per (shape, dtype) — memoized in the
-    process-global program registry."""
+def _cg_jit(mv, kind_key, comm):
+    """cg program memoized per (operator kind, comm, layout family) in
+    the process-global registry. The comm variant pins replicated
+    out_shardings for sharded operator leaves (same multi-host
+    reshard-assertion guard as `_lanczos_jit_for`)."""
+    if comm is None:
+        return program_cache.cached_program(
+            "cg", ("plain", kind_key), lambda: partial(_cg_kernel, mv),
+            static_argnums=(3,),
+        )
     return program_cache.cached_program(
-        "cg", "plain", lambda: _cg_kernel, static_argnums=(3,)
+        "cg", ("replicated", kind_key), lambda: partial(_cg_kernel, mv),
+        comm=comm, out_shardings=comm.replicated(), static_argnums=(3,),
     )
 
 
-def _cg_jit_for(comm):
-    """cg variant with replicated out_shardings for sharded operands
-    (same multi-host reshard-assertion guard as `_lanczos_jit_for`)."""
-    return program_cache.cached_program(
-        "cg", "replicated", lambda: _cg_kernel, comm=comm,
-        out_shardings=comm.replicated(), static_argnums=(3,),
-    )
-
-
-def _cg_chunk_jits(comm):
+def _cg_chunk_jits(mv, kind_key, comm):
     """(init, chunk) cached programs for the checkpointed CG driver —
-    ``comm=None`` for replicated operands, else replicated out_shardings
-    over the sharded-matvec mesh (same guard as :func:`_cg_jit_for`)."""
+    ``comm=None`` for replicated operator leaves, else replicated
+    out_shardings over the sharded-matvec mesh."""
     if comm is None:
         init = program_cache.cached_program(
-            "cg_init", "plain", lambda: _cg_init_kernel, static_argnums=(3,)
+            "cg_init", ("plain", kind_key),
+            lambda: partial(_cg_init_kernel, mv), static_argnums=(3,),
         )
         chunk = program_cache.cached_program(
-            "cg_chunk", "plain", lambda: _cg_chunk_kernel,
-            static_argnums=(6, 7),
+            "cg_chunk", ("plain", kind_key),
+            lambda: partial(_cg_chunk_kernel, mv), static_argnums=(6, 7),
         )
     else:
         rep = comm.replicated()
         init = program_cache.cached_program(
-            "cg_init", "replicated", lambda: _cg_init_kernel, comm=comm,
+            "cg_init", ("replicated", kind_key),
+            lambda: partial(_cg_init_kernel, mv), comm=comm,
             out_shardings=(rep,) * 5, static_argnums=(3,),
         )
         chunk = program_cache.cached_program(
-            "cg_chunk", "replicated", lambda: _cg_chunk_kernel, comm=comm,
+            "cg_chunk", ("replicated", kind_key),
+            lambda: partial(_cg_chunk_kernel, mv), comm=comm,
             out_shardings=(rep,) * 5, static_argnums=(6, 7),
         )
     return init, chunk
 
 
-def _cg_checkpointed(comm, a_log, b_log, x0_log, n, every, path, resume):
+def _cg_checkpointed(mv, kind_key, op_comm, leaves, b_log, x0_log, n, every,
+                     path, resume):
     """Window-driven CG with checkpoint/resume (see :func:`cg`). Progress
     is measured by the carried iteration counter, so a window that makes
     no progress (converged, or iteration budget reached) terminates the
     loop regardless of host-side tolerance arithmetic."""
-    import os
-
     import numpy as np
 
     from ... import resilience
 
-    init_jit, chunk_jit = _cg_chunk_jits(comm)
+    init_jit, chunk_jit = _cg_chunk_jits(mv, kind_key, op_comm)
     carry = None
     if resume and resilience.checkpoint.exists(path):
-        leaves, extra = resilience.load_checkpoint(path, with_extra=True)
-        if extra.get("algo") != "cg" or len(leaves) != 3:
+        leaves_ckpt, extra = resilience.load_checkpoint(path, with_extra=True)
+        if extra.get("algo") != "cg" or len(leaves_ckpt) != 3:
             raise resilience.CheckpointError(
                 f"{path!r} is a {extra.get('algo')!r} checkpoint, not cg"
             )
-        x, r, p = leaves
-        dt = a_log.dtype
+        x, r, p = leaves_ckpt
+        dt = b_log.dtype
         carry = (
             jnp.asarray(x, dt), jnp.asarray(r, dt), jnp.asarray(p, dt),
             jnp.asarray(extra["rsold"], dt),
             jnp.asarray(extra["it"], jnp.int32),
         )
     if carry is None:
-        carry = init_jit(a_log, b_log, x0_log, n)
+        carry = init_jit(leaves, b_log, x0_log, n)
     while True:
         it_before = int(carry[4])
         if it_before >= n:
             break
-        carry = chunk_jit(a_log, *carry[:5], n, every)
+        carry = chunk_jit(leaves, *carry[:5], n, every)
         it_after = int(carry[4])
         if it_after == it_before:
             break  # converged (rsold under tolerance) — no progress made
@@ -404,21 +464,20 @@ def _cg_checkpointed(comm, a_log, b_log, x0_log, n, every, path, resume):
     return carry[0]
 
 
-def _lanczos_jit():
-    """lanczos program compiled once per (shape, dtype, m) — memoized in
-    the process-global program registry."""
+def _lanczos_jit(mv, kind_key, comm):
+    """lanczos program memoized per (operator kind, comm, layout family).
+    The comm variant pins explicit replicated out_shardings for sharded
+    operator leaves — an XLA-chosen output sharding can otherwise hit
+    jax's device-order reshard assertion in the downstream device_put
+    under multi-host."""
+    if comm is None:
+        return program_cache.cached_program(
+            "lanczos", ("plain", kind_key),
+            lambda: partial(_lanczos_kernel, mv), static_argnums=(2, 3),
+        )
     return program_cache.cached_program(
-        "lanczos", "plain", lambda: _lanczos_kernel, static_argnums=(2, 3)
-    )
-
-
-def _lanczos_jit_for(comm):
-    """jit variant with explicit replicated out_shardings for sharded
-    operands — an XLA-chosen output sharding can otherwise hit jax's
-    device-order reshard assertion in the downstream device_put under
-    multi-host."""
-    return program_cache.cached_program(
-        "lanczos", "replicated", lambda: _lanczos_kernel, comm=comm,
+        "lanczos", ("replicated", kind_key),
+        lambda: partial(_lanczos_kernel, mv), comm=comm,
         out_shardings=(
             comm.replicated(), comm.replicated(), comm.replicated()
         ),
@@ -426,62 +485,64 @@ def _lanczos_jit_for(comm):
     )
 
 
-def _lanczos_chunk_jits(comm):
+def _lanczos_chunk_jits(mv, kind_key, comm):
     """(init, chunk) cached programs for the checkpointed Lanczos driver
-    (``comm=None`` → replicated operands)."""
+    (``comm=None`` → replicated operator leaves)."""
     if comm is None:
         init = program_cache.cached_program(
-            "lanczos_init", "plain", lambda: _lanczos_init_kernel,
-            static_argnums=(2, 3),
+            "lanczos_init", ("plain", kind_key),
+            lambda: partial(_lanczos_init_kernel, mv), static_argnums=(2, 3),
         )
         chunk = program_cache.cached_program(
-            "lanczos_chunk", "plain", lambda: _lanczos_chunk_kernel,
+            "lanczos_chunk", ("plain", kind_key),
+            lambda: partial(_lanczos_chunk_kernel, mv),
             static_argnums=(6, 7, 8),
         )
     else:
         rep = comm.replicated()
         init = program_cache.cached_program(
-            "lanczos_init", "replicated", lambda: _lanczos_init_kernel,
-            comm=comm, out_shardings=(rep,) * 4, static_argnums=(2, 3),
+            "lanczos_init", ("replicated", kind_key),
+            lambda: partial(_lanczos_init_kernel, mv), comm=comm,
+            out_shardings=(rep,) * 4, static_argnums=(2, 3),
         )
         chunk = program_cache.cached_program(
-            "lanczos_chunk", "replicated", lambda: _lanczos_chunk_kernel,
-            comm=comm, out_shardings=(rep,) * 4, static_argnums=(6, 7, 8),
+            "lanczos_chunk", ("replicated", kind_key),
+            lambda: partial(_lanczos_chunk_kernel, mv), comm=comm,
+            out_shardings=(rep,) * 4, static_argnums=(6, 7, 8),
         )
     return init, chunk
 
 
-def _lanczos_checkpointed(comm, a_log, v, m, n, every, path, resume):
+def _lanczos_checkpointed(mv, kind_key, op_comm, leaves, v, m, n, every,
+                          path, resume):
     """Window-driven Lanczos with checkpoint/resume (see :func:`lanczos`).
     The trip count is exact (no convergence test), so windows advance by
     ``every`` steps until ``m``."""
-    import os
-
     import numpy as np
 
     from ... import resilience
 
-    init_jit, chunk_jit = _lanczos_chunk_jits(comm)
+    init_jit, chunk_jit = _lanczos_chunk_jits(mv, kind_key, op_comm)
     carry = None
     i = 1
     if resume and resilience.checkpoint.exists(path):
-        leaves, extra = resilience.load_checkpoint(path, with_extra=True)
-        if extra.get("algo") != "lanczos" or len(leaves) != 4:
+        leaves_ckpt, extra = resilience.load_checkpoint(path, with_extra=True)
+        if extra.get("algo") != "lanczos" or len(leaves_ckpt) != 4:
             raise resilience.CheckpointError(
                 f"{path!r} is a {extra.get('algo')!r} checkpoint, not lanczos"
             )
-        Vb, alphas, betas, w = leaves
-        dt = a_log.dtype
+        Vb, alphas, betas, w = leaves_ckpt
+        dt = v.dtype
         carry = (
             jnp.asarray(Vb, dt), jnp.asarray(alphas, dt),
             jnp.asarray(betas, dt), jnp.asarray(w, dt),
         )
         i = int(extra["i"])
     if carry is None:
-        carry = init_jit(a_log, v, m, n)
+        carry = init_jit(leaves, v, m, n)
     while i < m:
         carry = chunk_jit(
-            a_log, *carry, jnp.asarray(i, jnp.int32), m, n, every
+            leaves, *carry, jnp.asarray(i, jnp.int32), m, n, every
         )
         i = min(i + every, m)
         resilience.save_checkpoint(
@@ -493,7 +554,7 @@ def _lanczos_checkpointed(comm, a_log, v, m, n, every, path, resume):
 
 
 def lanczos(
-    A: DNDarray,
+    A,
     m: int,
     v0: Optional[DNDarray] = None,
     V_out: Optional[DNDarray] = None,
@@ -508,15 +569,22 @@ def lanczos(
     Lanczos vectors, used by spectral clustering). Returns (V, T) with
     ``V (n×m)`` orthonormal Krylov basis and ``T (m×m)`` tridiagonal.
     The iteration itself runs as one jit dispatch (see `_lanczos_kernel`),
-    in the input's promoted dtype (f64 inputs iterate at f64).
+    in the input's promoted dtype (f64 inputs iterate at f64). ``A`` may
+    be a dense :class:`DNDarray` or any operator exposing
+    ``_matvec_spec`` — a :class:`heat_tpu.sparse.SparseDNDarray` runs
+    each Krylov matvec as the shard-local CSR contraction with the
+    all-reduce tail inside this very program (ISSUE 13: the Spectral
+    pipeline's matvecs become spmv without materializing O(n²)).
 
     ``checkpoint_every=k`` (resilience hook, ISSUE 5) instead runs the
     Krylov iteration as exact ``k``-step windows, checkpointing the carry
     to ``checkpoint_path`` after each; ``resume=True`` continues a killed
     run from the last completed window — the step body is deterministic in
     the step index, so the chunked results match the uninterrupted run."""
-    if not isinstance(A, DNDarray):
-        raise TypeError(f"A needs to be of type ht.DNDarray, but was {type(A)}")
+    if not _is_operator(A):
+        raise TypeError(
+            f"A needs to be a ht.DNDarray or sparse operator, but was {type(A)}"
+        )
     if A.ndim != 2 or A.shape[0] != A.shape[1]:
         raise RuntimeError("A needs to be a square matrix")
     if not isinstance(m, int) or m <= 0:
@@ -524,15 +592,8 @@ def lanczos(
 
     n = A.shape[0]
     dt = types.promote_types(A.dtype, types.float32)
-    sharded = A.split == 0 and A.comm.size > 1
-    if sharded:
-        # keep A sharded: the matvec partitions over the mesh (pad rows are
-        # zeroed and sliced off inside the kernel) — A never replicates
-        a_log = A._masked(0).astype(dt.jnp_type())
-        kernel_jit = _lanczos_jit_for(A.comm)
-    else:
-        a_log = A._replicated().astype(dt.jnp_type())
-        kernel_jit = _lanczos_jit()
+    leaves, mv, kind_key, op_comm = _operator(A, dt)
+    kernel_jit = _lanczos_jit(mv, kind_key, op_comm)
 
     if v0 is None:
         import numpy as _np
@@ -550,13 +611,13 @@ def lanczos(
         if not checkpoint_path:
             raise ValueError("checkpoint_every requires checkpoint_path")
         V_mat, alphas, betas = _lanczos_checkpointed(
-            A.comm if sharded else None, a_log, v, m, n,
+            mv, kind_key, op_comm, leaves, v, m, n,
             int(checkpoint_every), checkpoint_path, resume,
         )
     elif resume:
         raise ValueError("resume=True requires checkpoint_every")
     else:
-        V_mat, alphas, betas = kernel_jit(a_log, v, m, n)
+        V_mat, alphas, betas = kernel_jit(leaves, v, m, n)
 
     T_mat = (
         jnp.diag(alphas)

@@ -1,3 +1,4 @@
 """Graph algorithms (reference: heat/graph/)."""
 
 from .laplacian import *
+from .components import *

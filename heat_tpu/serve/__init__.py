@@ -50,6 +50,7 @@ from .endpoints import (
     knn_classify,
     lasso_predict,
     rbf_query,
+    sparse_query,
 )
 from .server import Server
 from . import admission, endpoints, metrics, net, server  # noqa: F401
@@ -69,4 +70,5 @@ __all__ = [
     "cdist_query",
     "rbf_query",
     "dense_forward",
+    "sparse_query",
 ]

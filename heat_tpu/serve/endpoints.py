@@ -50,6 +50,7 @@ __all__ = [
     "cdist_query",
     "rbf_query",
     "dense_forward",
+    "sparse_query",
     "rebuild",
 ]
 
@@ -162,6 +163,41 @@ def _dense_fn(xb, params, cfg):
     return y
 
 
+def _sparse_query_fn(xb, params, cfg):
+    """Sparse-feature affine map: request rows arrive as padded CSR
+    (``xb = (indptr, indices, values)``, the micro-batcher's
+    ``(row bucket, nnz bucket)`` lattice — ISSUE 13) and contract
+    against the dense weight by per-row segment reduction. Pad element
+    slots sit past ``indptr[-1]`` and land on segment ``bucket`` (out of
+    range — structurally dropped), pad rows have empty segments (bias
+    only), so a request served inside a padded bucket reduces exactly
+    its own elements in exactly its own order regardless of bucket —
+    the sparse analog of the exact-mode broadcast+reduce contract."""
+    indptr, indices, values = xb
+    w = params[0]
+    rows = (
+        jnp.searchsorted(
+            indptr,
+            jnp.arange(indices.shape[0], dtype=indptr.dtype),
+            side="right",
+        ) - 1
+    )
+    contrib = values[:, None] * w[indices]
+    y = jax.ops.segment_sum(
+        contrib, rows, num_segments=indptr.shape[0] - 1
+    )
+    if cfg["bias"]:
+        y = y + params[1]
+    act = cfg.get("activation")
+    if act == "relu":
+        y = jnp.maximum(y, 0.0)
+    elif act == "tanh":
+        y = jnp.tanh(y)
+    elif act == "sigmoid":
+        y = 1.0 / (1.0 + jnp.exp(-y))
+    return y
+
+
 _KIND_FNS: Dict[str, Callable] = {
     "kmeans_predict": _kmeans_fn,
     "knn_classify": _knn_fn,
@@ -169,7 +205,13 @@ _KIND_FNS: Dict[str, Callable] = {
     "lasso_predict": _lasso_fn,
     "cdist_query": _cdist_fn,
     "dense_forward": _dense_fn,
+    "sparse_query": _sparse_query_fn,
 }
+
+# kinds whose request payload is a ragged CSR row batch
+# (heat_tpu.sparse.host.CsrRows) rather than a dense (rows, features)
+# matrix — the server's submit/batcher/warmup paths branch on this
+_SPARSE_KINDS = frozenset({"sparse_query"})
 
 
 class Endpoint:
@@ -210,29 +252,78 @@ class Endpoint:
 
     # -- program plumbing ----------------------------------------------------
 
+    @property
+    def is_sparse(self) -> bool:
+        """Whether requests are ragged CSR row batches
+        (:class:`heat_tpu.sparse.host.CsrRows`) instead of dense
+        ``(rows, features)`` matrices."""
+        return self.kind in _SPARSE_KINDS
+
     def cfg_key(self) -> Tuple:
         return tuple(sorted(self.config.items()))
 
-    def program_key(self, bucket: int) -> Tuple:
+    def program_key(self, bucket: int, nnz_cap: Optional[int] = None) -> Tuple:
         """The program-cache static key for one ladder bucket. Parameter
         *avals* ride in the key so two same-kind endpoints with different
         reference-set sizes never collide, while a restored estimator
-        with identical shapes re-hits the warm entry."""
+        with identical shapes re-hits the warm entry. Sparse endpoints
+        key additionally on the nnz bucket — the second axis of the
+        ragged pad lattice."""
         psig = tuple((tuple(p.shape), str(p.dtype)) for p in self.params)
-        return (
+        key = (
             self.kind, self.cfg_key(), int(bucket), self.features,
             str(self.dtype), psig,
         )
+        if nnz_cap is not None:
+            key = key + (int(nnz_cap),)
+        return key
 
     def build(self) -> Callable:
         """The pure callable to jit — runs only on a registry miss."""
         fn = _KIND_FNS[self.kind]
         cfg = dict(self.config)
 
+        if self.is_sparse:
+            def call(indptr, indices, values, *params):
+                return fn((indptr, indices, values), params, cfg)
+
+            return call
+
         def call(xb, *params):
             return fn(xb, params, cfg)
 
         return call
+
+    def nnz_cap_for(self, bucket: int, nnz: int) -> int:
+        """The nnz bucket for a coalesced sparse batch: per-row element
+        capacity rounded to the next power of two (floored at 1) times
+        the row bucket. Duplicate-free rows top out at ``features``
+        per row — the finite lattice :meth:`nnz_ladder` pre-traces, so
+        ragged steady-state traffic stays zero-compile. Rows carrying
+        duplicate columns (legal: the kernel sums them, scipy-style) can
+        exceed ``features`` nnz; those keep the uncapped power-of-two
+        bucket — an un-warmed program compiles on first use rather than
+        failing the batch."""
+        per_row = max(1, -(-int(nnz) // max(1, int(bucket))))
+        cap = 1
+        while cap < per_row:
+            cap *= 2
+        if per_row <= max(1, self.features):
+            cap = min(cap, max(1, self.features))
+        return int(bucket) * cap
+
+    def nnz_ladder(self, bucket: int) -> Tuple[int, ...]:
+        """Every nnz bucket :meth:`nnz_cap_for` can produce for one row
+        bucket — the warm-up lattice (power-of-two per-row capacities up
+        to ``features``)."""
+        caps = []
+        c = 1
+        while True:
+            caps.append(int(bucket) * min(c, max(1, self.features)))
+            if c >= self.features:
+                break
+            c *= 2
+        return tuple(dict.fromkeys(caps))
 
     def cost_bytes(self, bucket: int) -> int:
         """Analytic temp+output byte estimate for one ``bucket``-row
@@ -240,8 +331,17 @@ class Endpoint:
         was never warmed (measured ``memory_analysis`` bytes win once
         available). Counts the request buffer, the (bucket, n_ref)
         intermediate the distance/likelihood kernels materialize, and
-        the output."""
+        the output. Sparse endpoints price the worst-case nnz bucket
+        (dense rows) — conservative by design until the warmed
+        measurement takes over."""
         item = max(self.dtype.itemsize, 4)
+        if self.is_sparse:
+            k = self.params[0].shape[1] if self.params[0].ndim > 1 else 1
+            nnz = bucket * self.features
+            inp = (bucket + 1) * 4 + nnz * (4 + item)
+            mid = nnz * max(k, 1) * item
+            out = bucket * max(k, 1) * item
+            return int(inp + mid + out)
         n_ref = self.params[0].shape[0] if self.params[0].ndim else 1
         inp = bucket * self.features * item
         mid = bucket * max(n_ref, 1) * item
@@ -369,6 +469,40 @@ def rbf_query(y, sigma: float = 1.0) -> Endpoint:
     return Endpoint(
         "cdist_query", [yb], {"gamma": gamma},
         features=int(yb.shape[1]), dtype=np.dtype(yb.dtype),
+    )
+
+
+def sparse_query(w, bias=None, activation: Optional[str] = None) -> Endpoint:
+    """Serve ``activation(x_sparse @ w + bias)`` over **sparse feature
+    rows** (ISSUE 13): requests are
+    :class:`heat_tpu.sparse.host.CsrRows` batches — the realistic shape
+    of high-volume inference traffic — and the micro-batcher pads them
+    onto a ``(row bucket, nnz bucket)`` lattice so genuinely ragged
+    streams stay zero-compile after warm-up. ``w`` is the dense
+    ``(features, out)`` weight (DNDarray or array);
+    ``activation`` ∈ {None, 'relu', 'tanh', 'sigmoid'}."""
+    wb = _replicated(w)
+    if wb.ndim != 2:
+        raise ValueError(f"weight must be 2-D (features, out), got {wb.ndim}-D")
+    if activation not in (None, "relu", "tanh", "sigmoid"):
+        raise ValueError(
+            f"activation must be None/'relu'/'tanh'/'sigmoid', "
+            f"got {activation!r}"
+        )
+    if not jnp.issubdtype(wb.dtype, jnp.floating):
+        wb = wb.astype(jnp.float32)
+    params = [wb]
+    if bias is not None:
+        bb = _replicated(bias).ravel().astype(wb.dtype)
+        if bb.shape[0] != wb.shape[1]:
+            raise ValueError(
+                f"bias length {bb.shape[0]} != output width {wb.shape[1]}"
+            )
+        params.append(bb)
+    return Endpoint(
+        "sparse_query", params,
+        {"bias": bias is not None, "activation": activation},
+        features=int(wb.shape[0]), dtype=np.dtype(wb.dtype),
     )
 
 

@@ -15,6 +15,12 @@ Request body (``POST /v1/<endpoint>``)::
 
     {"payload": "<base64(npy bytes)>"}
 
+Sparse request body (ISSUE 13 — ragged CSR rows for ``sparse_query``
+endpoints, :class:`heat_tpu.sparse.host.CsrRows`)::
+
+    {"payload_csr": {"indptr": "<b64 npy>", "indices": "<b64 npy>",
+                     "values": "<b64 npy>", "cols": <int>}}
+
 Success response (HTTP 200)::
 
     {"ok": true, "result": "<base64(npy bytes)>"}
@@ -85,17 +91,55 @@ def decode_array(data: str) -> np.ndarray:
         raise WireError(f"payload is not a valid .npy blob: {e}") from None
 
 
-def encode_request(payload: np.ndarray) -> bytes:
-    """The JSON body of ``POST /v1/<endpoint>``."""
+def encode_request(payload) -> bytes:
+    """The JSON body of ``POST /v1/<endpoint>``. Dense payloads ride the
+    ``payload`` envelope; :class:`~heat_tpu.sparse.host.CsrRows` batches
+    ride ``payload_csr`` — three self-describing ``.npy`` blobs plus the
+    feature width, bitwise round-trip like the dense form."""
+    from ...sparse.host import CsrRows
+
+    if isinstance(payload, CsrRows):
+        return json.dumps({
+            "payload_csr": {
+                "indptr": encode_array(payload.indptr),
+                "indices": encode_array(payload.indices),
+                "values": encode_array(payload.values),
+                "cols": int(payload.cols),
+            }
+        }).encode("utf-8")
     return json.dumps({"payload": encode_array(payload)}).encode("utf-8")
 
 
-def decode_request(body: bytes) -> np.ndarray:
-    """Parse a request body into the payload array (server side)."""
+def decode_request(body: bytes):
+    """Parse a request body into the payload — a dense array, or a
+    :class:`~heat_tpu.sparse.host.CsrRows` batch for the sparse
+    envelope (server side; ``Server.submit`` accepts both)."""
     try:
         obj = json.loads(body.decode("utf-8"))
     except Exception as e:
         raise WireError(f"request body is not JSON: {e}") from None
+    if isinstance(obj, dict) and "payload_csr" in obj:
+        csr = obj["payload_csr"]
+        if not isinstance(csr, dict) or not all(
+            k in csr for k in ("indptr", "indices", "values", "cols")
+        ):
+            raise WireError(
+                'payload_csr must carry {"indptr", "indices", "values", '
+                '"cols"}'
+            )
+        from ...sparse.host import CsrRows
+
+        try:
+            return CsrRows(
+                decode_array(csr["indptr"]),
+                decode_array(csr["indices"]),
+                decode_array(csr["values"]),
+                int(csr["cols"]),
+            )
+        except WireError:
+            raise
+        except Exception as e:
+            raise WireError(f"malformed CSR payload: {e}") from None
     if not isinstance(obj, dict) or "payload" not in obj:
         raise WireError('request JSON must be {"payload": "<base64 npy>"}')
     return decode_array(obj["payload"])

@@ -128,7 +128,9 @@ def _env_ladder(max_batch: int) -> List[int]:
 class _Request:
     __slots__ = ("endpoint", "array", "rows", "squeeze", "future", "t_submit")
 
-    def __init__(self, endpoint: str, array: np.ndarray, squeeze: bool):
+    def __init__(self, endpoint: str, array, squeeze: bool):
+        # `array` is a dense (rows, features) ndarray, or a CsrRows
+        # batch for sparse endpoints (both expose .shape[0])
         self.endpoint = endpoint
         self.array = array
         self.rows = int(array.shape[0])
@@ -243,6 +245,26 @@ class Server:
             for name in targets:
                 ep = self._endpoints[name]  # KeyError = caller bug, loud
                 for bucket in self.ladder:
+                    if ep.is_sparse:
+                        # sparse endpoints warm the whole (row bucket,
+                        # nnz bucket) lattice — ragged steady-state
+                        # traffic then lands only on warm programs
+                        for nnz_cap in ep.nnz_ladder(bucket):
+                            prog = self._program(name, ep, bucket, nnz_cap)
+                            args = (
+                                jnp.zeros((bucket + 1,), dtype=jnp.int32),
+                                jnp.zeros((nnz_cap,), dtype=jnp.int32),
+                                jnp.zeros((nnz_cap,), dtype=ep.dtype),
+                            ) + tuple(ep.params)
+                            out = prog(*args)
+                            np.asarray(out)
+                            programs += 1
+                            if budget_armed:
+                                self._measured[(name, bucket)] = max(
+                                    self._measured.get((name, bucket), 0),
+                                    memory_guard.program_bytes(prog, args),
+                                )
+                        continue
                     prog = self._program(name, ep, bucket)
                     zeros = jnp.zeros((bucket, ep.features), dtype=ep.dtype)
                     out = prog(zeros, *ep.params)
@@ -285,15 +307,35 @@ class Server:
                 f"unknown endpoint {name!r}; registered: "
                 f"{sorted(self._endpoints)}"
             )
-        arr = np.asarray(payload, dtype=ep.dtype)
-        squeeze = arr.ndim == 1
-        if squeeze:
-            arr = arr[None, :]
-        if arr.ndim != 2 or arr.shape[1] != ep.features:
-            raise ValueError(
-                f"endpoint {name!r} expects (rows, {ep.features}) payloads, "
-                f"got shape {np.asarray(payload).shape}"
+        if ep.is_sparse:
+            from ..sparse.host import CsrRows
+
+            squeeze = False
+            if not isinstance(payload, CsrRows):
+                # a dense row (or batch) is a legal sparse request too —
+                # compact it so callers need not hand-build CSR
+                dense = np.asarray(payload, dtype=ep.dtype)
+                squeeze = dense.ndim == 1
+                payload = CsrRows.from_dense(dense)
+            if payload.cols != ep.features:
+                raise ValueError(
+                    f"endpoint {name!r} expects CSR rows over "
+                    f"{ep.features} features, got {payload.cols}"
+                )
+            arr = CsrRows(
+                payload.indptr, payload.indices,
+                payload.values.astype(ep.dtype, copy=False), ep.features,
             )
+        else:
+            arr = np.asarray(payload, dtype=ep.dtype)
+            squeeze = arr.ndim == 1
+            if squeeze:
+                arr = arr[None, :]
+            if arr.ndim != 2 or arr.shape[1] != ep.features:
+                raise ValueError(
+                    f"endpoint {name!r} expects (rows, {ep.features}) "
+                    f"payloads, got shape {np.asarray(payload).shape}"
+                )
         st = self._stats[name]
         try:
             if self._draining:
@@ -503,9 +545,12 @@ class Server:
                 return b
         return self.ladder[-1]
 
-    def _program(self, name: str, ep: Endpoint, bucket: int):
+    def _program(
+        self, name: str, ep: Endpoint, bucket: int,
+        nnz_cap: Optional[int] = None,
+    ):
         return program_cache.cached_program(
-            f"serve.{name}", ep.program_key(bucket), ep.build
+            f"serve.{name}", ep.program_key(bucket, nnz_cap), ep.build
         )
 
     def _loop(self) -> None:
@@ -563,10 +608,18 @@ class Server:
         ep = self._endpoints[name]
         st = self._stats[name]
         rows = sum(r.rows for r in reqs)
-        x = (
-            reqs[0].array if len(reqs) == 1
-            else np.concatenate([r.array for r in reqs], axis=0)
-        )
+        if ep.is_sparse:
+            from ..sparse.host import CsrRows
+
+            x = (
+                reqs[0].array if len(reqs) == 1
+                else CsrRows.concat([r.array for r in reqs])
+            )
+        else:
+            x = (
+                reqs[0].array if len(reqs) == 1
+                else np.concatenate([r.array for r in reqs], axis=0)
+            )
         cap = self.admission.bucket_cap(self.ladder)
         t0 = time.perf_counter()
         try:
@@ -581,12 +634,24 @@ class Server:
                 crows = chunk.shape[0]
                 bucket = self._bucket_for(crows)
                 pad = bucket - crows
+                padded_total += pad
+                if ep.is_sparse:
+                    nnz_cap = ep.nnz_cap_for(bucket, chunk.nnz)
+                    padded = chunk.padded(bucket, nnz_cap)
+                    prog = self._program(name, ep, bucket, nnz_cap)
+                    out = prog(
+                        jnp.asarray(padded.indptr.astype(np.int32)),
+                        jnp.asarray(padded.indices),
+                        jnp.asarray(padded.values),
+                        *ep.params,
+                    )
+                    pieces.append(np.asarray(out)[:crows])
+                    continue
                 if pad:
                     chunk = np.concatenate(
                         [chunk, np.zeros((pad, ep.features), dtype=ep.dtype)],
                         axis=0,
                     )
-                padded_total += pad
                 prog = self._program(name, ep, bucket)
                 out = prog(jnp.asarray(chunk), *ep.params)
                 pieces.append(np.asarray(out)[:crows])

@@ -1,0 +1,60 @@
+"""``heat_tpu.sparse`` — sharded CSR/COO arrays with audited SpMV/SpMM
+(ISSUE 13).
+
+The widest scenario gap the dense stack left open: ``heat/graph``-class
+workloads (Laplacians, spectral clustering, KNN graphs) materialize
+O(n²) dense similarity matrices. This package adds the row-split CSR
+container (:class:`SparseDNDarray` — the ``ht.ragged`` design language:
+replicated counts/displs metadata plus a shard-aligned owner map over
+uniform-capacity shards), cached ``shard_map`` sparse × dense products
+whose collective tails are cost-model-priced and HLO-audit-pinned, a
+budget-planned all-to-all transpose, and the construction paths
+(thresholded dense compaction, distributed-sort COO assembly). Consumers
+wired through it: ``graph.Laplacian`` (eNeighbour), ``cluster.Spectral``
+(Lanczos matvecs become spmv), ``graph.connected_components`` (iterated
+structure-only min-propagation), and the ``sparse_query`` serving
+endpoint (ragged CSR rows through the micro-batcher —
+:class:`~heat_tpu.sparse.host.CsrRows` on the wire).
+
+Observability: every op pairs one ``sparse.*`` counter with one
+``sparse`` instant event (:data:`EVENT_COUNTER`), so
+``report.summarize()``'s ``sparse`` block reconstructs identically live
+and offline. docs/SPARSE.md is the operator guide.
+"""
+
+from .container import SparseDNDarray
+from .host import CsrRows
+from .ops import (
+    csr_from_coo,
+    csr_from_dense,
+    spmm,
+    spmv,
+    spmv_wire,
+    to_dense,
+    transpose,
+)
+
+__all__ = [
+    "SparseDNDarray",
+    "CsrRows",
+    "csr_from_coo",
+    "csr_from_dense",
+    "spmv",
+    "spmm",
+    "spmv_wire",
+    "to_dense",
+    "transpose",
+    "EVENT_COUNTER",
+]
+
+# sparse event name -> registry counter suffix: every `sparse` event is
+# paired 1:1 with a `sparse.<name>` counter increment, so the offline
+# summarize reconstruction matches the live counters exactly (the PR 5 /
+# PR 11 / PR 12 reconciliation contract).
+EVENT_COUNTER = {
+    name: f"sparse.{name}"
+    for name in (
+        "spmv", "spmm", "to_dense", "transpose", "from_dense", "from_coo",
+        "laplacian", "dense_fallback", "components",
+    )
+}

@@ -1,0 +1,396 @@
+"""The sharded sparse array container (ISSUE 13 tentpole).
+
+A :class:`SparseDNDarray` is the CSR analog of the dense
+:class:`~heat_tpu.core.dndarray.DNDarray`: **row-split along
+``split=0``** with replicated host metadata, the same design language as
+``ht.ragged`` (core/ragged.py — counts/displs plus a shard-aligned owner
+map). Dense arrays carry a tail pad because XLA wants equal shards; a
+sparse array additionally carries a per-shard **element capacity** pad,
+because per-shard nnz is data-dependent while XLA shards must be
+uniform:
+
+* ``indptr``  — physical ``(p·(r+1),)`` int32, sharded: each mesh
+  position holds its own local CSR row pointer (``r = ceil(m/p)`` rows
+  per shard, tail rows of the last shard are *pad rows* with zero
+  entries); ``indptr[r] = local_nnz``.
+* ``indices`` — physical ``(p·cap,)`` int32, sharded: shard-local column
+  ids; slots past ``local_nnz`` are pad (column 0), never reachable
+  through ``indptr``.
+* ``values``  — physical ``(p·cap,)``, sharded, same slot layout.
+
+``cap = max(1, max_s nnz_s)`` is uniform across shards (the ragged
+intent — "shard *s* owns ``counts[s]`` elements" — is metadata, exactly
+like :class:`~heat_tpu.core.ragged.Ragged`). Replicated host metadata:
+``counts``/``displs`` (per-shard element tallies) and the ceil-rule row
+``owner`` map. Pad slots obey the dense pad invariant: their values are
+zeros and **must never influence a result** — every kernel drops them by
+segment id (an out-of-range segment, not a masked multiply, so even
+inf/nan payloads in the dense operand cannot leak through a pad slot).
+
+Index and pointer payloads live shard-local for the container's whole
+life: :func:`~heat_tpu.sparse.ops.spmv`/``spmm`` move only float
+operand/result payloads over the wire, and :func:`transpose` (the one
+all-to-all-bearing op) pins its index-carrying slab exchange
+``precision='off'`` — heatlint HL003's ``spmv``/``spmm`` kernel tokens
+enforce that invariant for future edits (docs/STATIC_ANALYSIS.md).
+"""
+
+from __future__ import annotations
+
+import builtins
+from typing import Optional, Tuple, Type
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import types
+from ..core.communication import MeshCommunication, sanitize_comm
+from ..core.devices import Device, get_device
+from ..core.dndarray import DNDarray
+
+__all__ = ["SparseDNDarray"]
+
+
+def _shard_array(comm: MeshCommunication, host: np.ndarray) -> jax.Array:
+    """Lay a ``(p, per_shard)`` host matrix out as the flat sharded
+    physical buffer ``(p·per_shard,)``."""
+    flat = jnp.asarray(host.reshape(-1))
+    if comm.size > 1:
+        flat = jax.device_put(flat, comm.sharding(0, 1))
+    return flat
+
+
+class SparseDNDarray:
+    """Distributed CSR matrix (see module docstring for the layout).
+
+    Construct through :func:`heat_tpu.sparse.csr_from_dense` /
+    :func:`~heat_tpu.sparse.csr_from_coo` (or
+    :meth:`from_shard_arrays` when the sharded buffers already exist —
+    the path the compiled transpose program uses).
+    """
+
+    def __init__(
+        self,
+        indptr: jax.Array,
+        indices: jax.Array,
+        values: jax.Array,
+        gshape: Tuple[int, int],
+        dtype: Type[types.datatype],
+        counts: np.ndarray,
+        device: Device,
+        comm: MeshCommunication,
+    ):
+        m, n = (int(s) for s in gshape)
+        if m <= 0 or n <= 0:
+            raise ValueError(f"sparse shape must be positive, got {gshape}")
+        p = comm.size
+        r = comm.chunk_size(m)
+        counts = np.asarray(counts, dtype=np.int64).reshape(-1)
+        if counts.shape[0] != p:
+            raise ValueError(
+                f"counts must have one entry per mesh position ({p}), "
+                f"got {counts.shape[0]}"
+            )
+        if (counts < 0).any():
+            raise ValueError(f"counts must be non-negative: {counts.tolist()}")
+        if indptr.shape != (p * (r + 1),):
+            raise ValueError(
+                f"indptr physical shape {tuple(indptr.shape)} != "
+                f"({p * (r + 1)},) for gshape {gshape} on a {p}-mesh"
+            )
+        if indices.shape != values.shape or indices.ndim != 1:
+            raise ValueError(
+                f"indices/values must be matching 1-D buffers, got "
+                f"{tuple(indices.shape)} vs {tuple(values.shape)}"
+            )
+        if indices.shape[0] % p:
+            raise ValueError(
+                f"element buffer length {indices.shape[0]} does not shard "
+                f"over {p} positions"
+            )
+        cap = indices.shape[0] // p
+        if int(counts.max(initial=0)) > cap:
+            raise ValueError(
+                f"counts {counts.tolist()} exceed the per-shard capacity {cap}"
+            )
+        self.__indptr = indptr
+        self.__indices = indices
+        self.__values = values
+        self.__gshape = (m, n)
+        self.__dtype = dtype
+        self.__counts = counts
+        self.__device = device
+        self.__comm = comm
+        self.__owner = None
+
+    # -- metadata -------------------------------------------------------------
+
+    @property
+    def shape(self) -> Tuple[int, int]:
+        return self.__gshape
+
+    @property
+    def ndim(self) -> int:
+        return 2
+
+    @property
+    def split(self) -> int:
+        """Always row-split: CSR's natural distribution axis."""
+        return 0
+
+    @property
+    def dtype(self) -> Type[types.datatype]:
+        return self.__dtype
+
+    @property
+    def device(self) -> Device:
+        return self.__device
+
+    @property
+    def comm(self) -> MeshCommunication:
+        return self.__comm
+
+    @property
+    def indptr(self) -> jax.Array:
+        """The sharded physical ``(p·(r+1),)`` local row pointers."""
+        return self.__indptr
+
+    @property
+    def indices(self) -> jax.Array:
+        """The sharded physical ``(p·cap,)`` column ids."""
+        return self.__indices
+
+    @property
+    def values(self) -> jax.Array:
+        """The sharded physical ``(p·cap,)`` element values."""
+        return self.__values
+
+    @property
+    def counts(self) -> np.ndarray:
+        """Per-shard element tallies (a copy) — the ragged metadata."""
+        return self.__counts.copy()
+
+    @property
+    def displs(self) -> np.ndarray:
+        """Per-shard element start offsets into the global nnz order."""
+        return np.concatenate([[0], np.cumsum(self.__counts)[:-1]])
+
+    @property
+    def nnz(self) -> int:
+        return int(self.__counts.sum())
+
+    @property
+    def capacity(self) -> int:
+        """Uniform per-shard element capacity (the sparse analog of the
+        dense tail pad)."""
+        return int(self.__indices.shape[0]) // self.__comm.size
+
+    @property
+    def row_chunk(self) -> int:
+        """Rows per shard (ceil rule) — ``indptr`` stride minus one."""
+        return self.__comm.chunk_size(self.__gshape[0])
+
+    @property
+    def density(self) -> float:
+        m, n = self.__gshape
+        return self.nnz / float(m * n)
+
+    @property
+    def owner(self) -> DNDarray:
+        """``owner[i]`` = mesh position holding row ``i`` — the ceil-rule
+        map as a row-aligned int64 DNDarray (split 0), mirroring
+        :attr:`heat_tpu.core.ragged.Ragged.owner`. Built once, cached."""
+        if self.__owner is None:
+            from ..core import factories
+
+            m = self.__gshape[0]
+            r = self.row_chunk
+            vec = np.minimum(
+                np.arange(m, dtype=np.int64) // max(r, 1),
+                self.__comm.size - 1,
+            )
+            self.__owner = factories.array(
+                vec, split=0, device=self.__device, comm=self.__comm
+            )
+        return self.__owner
+
+    def __repr__(self) -> str:
+        m, n = self.__gshape
+        return (
+            f"SparseDNDarray(shape=({m}, {n}), nnz={self.nnz}, "
+            f"density={self.density:.4g}, dtype={self.__dtype.__name__}, "
+            f"split=0, mesh={self.__comm.size}, cap={self.capacity})"
+        )
+
+    # -- construction helpers -------------------------------------------------
+
+    @classmethod
+    def from_shard_arrays(
+        cls,
+        indptr: jax.Array,
+        indices: jax.Array,
+        values: jax.Array,
+        gshape: Tuple[int, int],
+        counts: np.ndarray,
+        device: Optional[Device] = None,
+        comm: Optional[MeshCommunication] = None,
+        dtype: Optional[Type[types.datatype]] = None,
+    ) -> "SparseDNDarray":
+        """Wrap already-sharded physical buffers (the compiled-program
+        construction path: transpose's build stage hands its outputs
+        straight here, no host round-trip)."""
+        comm = sanitize_comm(comm)
+        device = device if device is not None else get_device()
+        ht_dtype = (
+            dtype if dtype is not None
+            else types.canonical_heat_type(values.dtype)
+        )
+        return cls(
+            indptr, indices, values, tuple(gshape), ht_dtype,
+            counts, device, comm,
+        )
+
+    @classmethod
+    def _from_host_csr_shards(
+        cls,
+        indptr: np.ndarray,    # (p, r+1)
+        indices: np.ndarray,   # (p, cap)
+        values: np.ndarray,    # (p, cap)
+        gshape: Tuple[int, int],
+        counts: np.ndarray,
+        device: Optional[Device] = None,
+        comm: Optional[MeshCommunication] = None,
+        dtype: Optional[Type[types.datatype]] = None,
+    ) -> "SparseDNDarray":
+        """Lay host per-shard CSR blocks onto the mesh (the constructor
+        finishing pass of ``csr_from_dense``/``csr_from_coo``)."""
+        comm = sanitize_comm(comm)
+        device = device if device is not None else get_device()
+        vals = np.ascontiguousarray(values)
+        ht_dtype = (
+            dtype if dtype is not None
+            else types.canonical_heat_type(vals.dtype)
+        )
+        return cls(
+            _shard_array(comm, np.ascontiguousarray(indptr, dtype=np.int32)),
+            _shard_array(comm, np.ascontiguousarray(indices, dtype=np.int32)),
+            _shard_array(comm, vals),
+            tuple(gshape), ht_dtype, counts, device, comm,
+        )
+
+    # -- conversions ----------------------------------------------------------
+
+    def to_dense(self) -> DNDarray:
+        """Materialize the dense row-split DNDarray (one cached scatter
+        program; see :func:`heat_tpu.sparse.to_dense`)."""
+        from . import ops
+
+        return ops.to_dense(self)
+
+    def coo(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Host COO triplets ``(rows, cols, values)`` in global CSR
+        order — the inspection/export path (small host sync)."""
+        p = self.__comm.size
+        r = self.row_chunk
+        cap = self.capacity
+        ip = np.asarray(self.__indptr).reshape(p, r + 1)
+        ix = np.asarray(self.__indices).reshape(p, cap)
+        v = np.asarray(self.__values).reshape(p, cap)
+        rows, cols, vals = [], [], []
+        for s in range(p):
+            c = int(self.__counts[s])
+            local = np.repeat(np.arange(r, dtype=np.int64), np.diff(ip[s]))
+            rows.append(local[:c] + s * r)
+            cols.append(ix[s, :c].astype(np.int64))
+            vals.append(v[s, :c])
+        return (
+            np.concatenate(rows), np.concatenate(cols), np.concatenate(vals),
+        )
+
+    # -- structural ops -------------------------------------------------------
+
+    def transpose(self) -> "SparseDNDarray":
+        from . import ops
+
+        return ops.transpose(self)
+
+    @property
+    def T(self) -> "SparseDNDarray":
+        return self.transpose()
+
+    # -- elementwise scalar ops on values -------------------------------------
+
+    def _map_values(self, fn, dtype=None) -> "SparseDNDarray":
+        """New container with ``values`` mapped elementwise — the
+        structure (indptr/indices/counts) is shared, so scalar ops are
+        one sharded elementwise dispatch over the element buffer."""
+        new_vals = fn(self.__values)
+        ht_dtype = (
+            dtype if dtype is not None
+            else types.canonical_heat_type(new_vals.dtype)
+        )
+        return SparseDNDarray(
+            self.__indptr, self.__indices, new_vals, self.__gshape,
+            ht_dtype, self.__counts, self.__device, self.__comm,
+        )
+
+    def astype(self, dtype) -> "SparseDNDarray":
+        ht_dtype = types.canonical_heat_type(dtype)
+        return self._map_values(
+            lambda v: v.astype(ht_dtype.jnp_type()), ht_dtype
+        )
+
+    def __mul__(self, other) -> "SparseDNDarray":
+        if not isinstance(other, (builtins.int, builtins.float)):
+            return NotImplemented
+        return self._map_values(lambda v: v * other)
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other) -> "SparseDNDarray":
+        if not isinstance(other, (builtins.int, builtins.float)):
+            return NotImplemented
+        return self._map_values(lambda v: v / other)
+
+    def __neg__(self) -> "SparseDNDarray":
+        return self._map_values(lambda v: -v)
+
+    def __abs__(self) -> "SparseDNDarray":
+        return self._map_values(jnp.abs)
+
+    # -- linear algebra -------------------------------------------------------
+
+    def __matmul__(self, other):
+        from . import ops
+
+        if isinstance(other, DNDarray):
+            if other.ndim == 1:
+                return ops.spmv(self, other)
+            if other.ndim == 2:
+                return ops.spmm(self, other)
+        return NotImplemented
+
+    def matvec(self, x: DNDarray, **kwargs) -> DNDarray:
+        from . import ops
+
+        return ops.spmv(self, x, **kwargs)
+
+    # -- solver operator protocol (core/linalg/solver.py) ---------------------
+
+    def _matvec_spec(self, dt: Type[types.datatype]):
+        """The iterative-solver operator hook: ``(leaves, matvec, key)``
+        where ``leaves`` are the program arguments (sharded CSR buffers,
+        values cast to the solve dtype), ``matvec(leaves, x, n)`` is a
+        pure traceable replicated-in/replicated-out product, and ``key``
+        joins the solver's program-cache signature. Lets
+        ``linalg.lanczos``/``cg`` treat a sparse matrix as a drop-in
+        operator (ISSUE 13: Spectral's Krylov matvecs become spmv)."""
+        from . import ops
+
+        wire = ops.spmv_wire(dt.jnp_type())
+        leaves = (
+            self.__indptr, self.__indices,
+            self.__values.astype(dt.jnp_type()),
+        )
+        return leaves, ops.make_solver_matvec(self.__comm, wire), ("csr", wire)

@@ -1,0 +1,801 @@
+"""Sparse kernels and constructors (ISSUE 13).
+
+``spmv``/``spmm`` are cached ``shard_map`` programs (sites
+``sparse.spmv``/``sparse.spmm`` in the process-global program registry):
+every shard contracts its local CSR rows against the dense operand with
+a segment reduction, and the only wire traffic is the **float tails** —
+an in-kernel all-gather when the dense operand is row-split, and one
+all-reduce when the caller asks for a replicated result. Both tails are
+priced by :func:`heat_tpu.telemetry.collectives.spmv_cost` /
+``spmm_cost`` and pinned zero-drift by the HLO auditor; index/indptr
+payloads never leave their shard. The wire precision of the float tails
+is ``HEAT_TPU_SPARSE_SPMV_PREC`` (default exact) — the hop call sites
+live in :func:`_gather_operand` / :func:`_combine_replicated`, *outside*
+any ``spmv``/``spmm``-named function, because heatlint HL003 treats
+those kernel names as exact-semantics tokens: any future hop added
+inside them (the place index data lives) must pin ``precision='off'``
+or fail the lint gate.
+
+``transpose`` is the one all-to-all-bearing op: elements route to the
+shard owning their destination row through worst-case-sized static
+slabs, planned against ``HEAT_TPU_HBM_BUDGET`` into bounded-memory
+stages exactly like the dense relayout planner (arXiv:2112.01075 —
+each stage is its own cached program whose slab fits the temp budget).
+Both slab payloads (packed int64 sort keys carrying ``(row, col)``, and
+the values) pin ``precision='off'``: the key payload IS index data.
+
+Constructors (``csr_from_dense``, ``csr_from_coo``) are host-finishing
+paths: the heavy compute (the distributed sort ``csr_from_coo`` reuses
+from ``manipulations.sort``) runs on device, the final per-shard packing
+runs on host — construction is not a steady-state hot path, and the
+metadata (counts/displs) is replicated host state by design, exactly
+like :class:`~heat_tpu.core.ragged.Ragged`.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from heat_tpu import _knobs as knobs
+
+from .. import telemetry
+from ..core import program_cache, types
+from ..core.communication import MeshCommunication, sanitize_comm
+from ..core.devices import get_device
+from ..core.dndarray import DNDarray
+from ..resilience import memory_guard
+from .container import SparseDNDarray
+
+__all__ = [
+    "spmv",
+    "spmm",
+    "to_dense",
+    "transpose",
+    "csr_from_dense",
+    "csr_from_coo",
+    "spmv_wire",
+    "make_solver_matvec",
+]
+
+# Packed transpose sort key sentinel: sorts past every real (col, row)
+# key and survives // and % arithmetic without overflow.
+_SENTINEL = np.int64(np.iinfo(np.int64).max)
+
+_REDUCES = ("sum", "min", "max")
+
+
+def _record(op: str, **fields) -> None:
+    """One counter + one instant event per sparse operation, with
+    matching names — the live==offline summarize-reconciliation contract
+    (telemetry/report.py ``sparse`` block)."""
+    if telemetry.enabled():
+        reg = telemetry.get_registry()
+        reg.add(f"sparse.{op}", 1)
+        reg.emit("sparse", op, event=op, **fields)
+
+
+def spmv_wire(dtype, precision: Optional[str] = None) -> str:
+    """The effective wire mode of the sparse float tails: the per-call
+    override, else ``HEAT_TPU_SPARSE_SPMV_PREC`` — demoted to ``off``
+    for non-float payloads (index/integer data always moves exact)."""
+    if precision is None:
+        precision = knobs.get("HEAT_TPU_SPARSE_SPMV_PREC") or "off"
+    p = str(precision).strip().lower()
+    if p not in ("off", "bf16"):
+        raise ValueError(
+            f"sparse wire precision must be 'off' or 'bf16', got {precision!r}"
+        )
+    if p != "off" and not jnp.issubdtype(jnp.dtype(dtype), jnp.floating):
+        return "off"
+    return p
+
+
+# -- in-kernel building blocks -------------------------------------------------
+#
+# NOTE these hop helpers are module-level on purpose (not nested inside
+# the spmv/spmm kernel bodies): HL003 token-matches the enclosing
+# function chain, and spmv/spmm are exact-semantics tokens — a comm hop
+# inside a function of that name must pin precision='off'. The float
+# value tails here are legitimately knob-gated (the ring-cdist
+# contract), so they live outside the token scope; index payloads never
+# ride a collective at all.
+
+
+def _slot_rows(indptr: jax.Array, nslots: int) -> jax.Array:
+    """Local row id per element slot, derived from the shard CSR
+    pointer. Pad slots (``>= local_nnz``) land on row ``r`` — one past
+    the last local row — so segment reductions with ``num_segments=r``
+    drop them structurally (no masked multiply: even inf/nan operand
+    values cannot leak through a pad slot)."""
+    slots = jnp.arange(nslots, dtype=indptr.dtype)
+    return jnp.searchsorted(indptr, slots, side="right") - 1
+
+
+def _gather_operand(comm: MeshCommunication, xc: jax.Array, wire: str):
+    """All-gather a row-split dense operand's physical chunks inside the
+    kernel (float value payload; wire mode = the resolved sparse knob)."""
+    return comm.all_gather(xc, tiled=True, precision=wire)
+
+
+def _combine_replicated(
+    comm: MeshCommunication, yg: jax.Array, wire: str, reduce: str
+):
+    """Combine per-shard global partials into the replicated result —
+    the spmv all-reduce tail (float value payload; ``min``/``max``
+    extremes ride the never-compressed pmin/pmax wrappers)."""
+    if reduce == "min":
+        return comm.pmin(yg)
+    if reduce == "max":
+        return comm.pmax(yg)
+    return comm.psum(yg, precision=wire)
+
+
+def _reduce_identity(dtype, reduce: str):
+    if reduce == "sum":
+        return jnp.zeros((), dtype=dtype)
+    info = (
+        jnp.finfo(dtype)
+        if jnp.issubdtype(jnp.dtype(dtype), jnp.floating)
+        else jnp.iinfo(dtype)
+    )
+    return jnp.asarray(info.max if reduce == "min" else info.min, dtype=dtype)
+
+
+def _segment_reduce(contrib, rows, num_segments: int, reduce: str):
+    if reduce == "min":
+        return jax.ops.segment_min(contrib, rows, num_segments=num_segments)
+    if reduce == "max":
+        return jax.ops.segment_max(contrib, rows, num_segments=num_segments)
+    return jax.ops.segment_sum(contrib, rows, num_segments=num_segments)
+
+
+def _local_contract(ip, ix, vals, xg, reduce: str, pattern: bool):
+    """One shard's CSR × dense contraction: ``(r,)`` for a vector
+    operand, ``(r, k)`` for a matrix operand. ``pattern=True`` ignores
+    the stored values (structure-only propagation — the
+    connected-components label relay)."""
+    rows = _slot_rows(ip, ix.shape[0])
+    taken = xg[ix]
+    if pattern:
+        contrib = taken
+    elif taken.ndim == 2:
+        contrib = vals[:, None] * taken
+    else:
+        contrib = vals * taken
+    return _segment_reduce(contrib, rows, ip.shape[0] - 1, reduce)
+
+
+def _spmv_build(
+    comm: MeshCommunication,
+    x_split: Optional[int],
+    out_split: Optional[int],
+    wire: str,
+    reduce: str,
+    pattern: bool,
+    x_ndim: int,
+):
+    """Program builder for one (layout, wire, reduce) spmv/spmm family —
+    runs only on a registry miss; shapes dispatch inside the wrapper."""
+    e_spec = comm.spec(0, 1)
+    x_spec = comm.spec(0 if x_split == 0 else None, x_ndim)
+    out_spec = (
+        comm.spec(0, x_ndim) if out_split == 0 else comm.spec(None, x_ndim)
+    )
+    p = comm.size
+
+    def body(ip, ix, vals, x):
+        xg = (
+            _gather_operand(comm, x, wire)
+            if (x_split == 0 and p > 1) else x
+        )
+        y = _local_contract(ip, ix, vals, xg, reduce, pattern)
+        if out_split == 0:
+            return y
+        r = ip.shape[0] - 1
+        full_shape = (r * p,) + y.shape[1:]
+        yg = jnp.full(full_shape, _reduce_identity(y.dtype, reduce))
+        zero = jnp.zeros((), dtype=jnp.int32)
+        start = (comm.axis_index() * r,) + (zero,) * (y.ndim - 1)
+        yg = jax.lax.dynamic_update_slice(yg, y, start)
+        # the combine runs on 1-position meshes too (a trivial hop):
+        # the collective is what makes the output mesh-invariant, so
+        # the replicated out_spec typechecks on every mesh size
+        return _combine_replicated(comm, yg, wire, reduce)
+
+    def call(ip, ix, vals, x):
+        # NOTE the logical row count is NOT closed over here (it is not
+        # part of the program key — one entry serves every shape family);
+        # the dispatch slices the replicated result to logical rows
+        # eagerly, a local op
+        return jax.shard_map(
+            body, mesh=comm.mesh,
+            in_specs=(e_spec, e_spec, e_spec, x_spec),
+            out_specs=out_spec,
+        )(ip, ix, vals, x)
+
+    return call
+
+
+def _dispatch_sparse_dense(
+    op: str,
+    A: SparseDNDarray,
+    x: DNDarray,
+    out_split: Optional[int],
+    precision: Optional[str],
+    reduce: str,
+    pattern: bool,
+    audit: bool,
+):
+    """Shared spmv/spmm dispatch: resolve dtype + wire, price the
+    collective tails, fetch the cached program, audit on request."""
+    if not isinstance(A, SparseDNDarray):
+        raise TypeError(f"expected a SparseDNDarray, got {type(A)}")
+    if not isinstance(x, DNDarray):
+        raise TypeError(f"dense operand must be a DNDarray, got {type(x)}")
+    want_ndim = 1 if op == "spmv" else 2
+    if x.ndim != want_ndim:
+        raise ValueError(f"{op} expects a {want_ndim}-D dense operand")
+    if x.shape[0] != A.shape[1]:
+        raise ValueError(
+            f"{op}: operand leading dim {x.shape[0]} != sparse cols "
+            f"{A.shape[1]}"
+        )
+    if x.split not in (None, 0):
+        raise NotImplementedError(f"{op} requires x.split in (None, 0)")
+    if out_split not in (None, 0):
+        raise NotImplementedError(f"{op} supports out_split in (None, 0)")
+    if reduce not in _REDUCES:
+        raise ValueError(f"reduce must be one of {_REDUCES}, got {reduce!r}")
+    if x.comm != A.comm:
+        raise ValueError(f"{op}: operands live on different communicators")
+
+    comm = A.comm
+    p = comm.size
+    m, n = A.shape
+    k = 1 if op == "spmv" else x.shape[1]
+    dt = x.dtype if pattern else types.promote_types(A.dtype, x.dtype)
+    # extremes and structure-only relays are exactness-critical; only
+    # the summing VALUE tails are knob-compressible
+    compressible = reduce == "sum" and not pattern
+    wire = spmv_wire(dt.jnp_type(), precision) if compressible else "off"
+
+    cost_fn = (
+        telemetry.collectives.spmv_cost if op == "spmv"
+        else telemetry.collectives.spmm_cost
+    )
+    cost_args = (m, n) if op == "spmv" else (m, n, k)
+    cost, fields, do_audit = telemetry.op_cost(
+        cost_fn, *cost_args, dt.byte_size(), p, x.split, out_split, wire,
+        audit=audit,
+    )
+
+    key = (x.split, out_split, wire, reduce, pattern, dt.char())
+    xb = x.larray.astype(dt.jnp_type())
+    vals = A.values if pattern else A.values.astype(dt.jnp_type())
+    args = (A.indptr, A.indices, vals, xb)
+    with telemetry.span(
+        f"sparse.{op}", gshape=[m, n], nnz=A.nnz, mesh=p, **fields
+    ) as sp:
+        prog = program_cache.cached_program(
+            f"sparse.{op}", key,
+            lambda: _spmv_build(
+                comm, x.split, out_split, wire, reduce, pattern, want_ndim,
+            ),
+            comm=comm,
+        )
+        if do_audit:
+            # the audit memo key carries the physical aval signature ON
+            # TOP of the program key: one registry entry serves every
+            # shape family (avals dispatch inside the wrapper), but each
+            # shape lowers a distinct program whose collective bytes the
+            # prediction must match shape-for-shape
+            aval_sig = tuple(tuple(a.shape) for a in args)
+            telemetry.hlo.audit_call(
+                f"sparse.{op}",
+                lambda: (prog, args),
+                predicted=cost,
+                key=program_cache.program_key(
+                    f"sparse.{op}", key + (aval_sig,), comm=comm
+                ),
+                fields={"mesh": p, "nnz": A.nnz},
+            )
+        out = sp.output(prog(*args))
+        if out_split is None:
+            out = out[:m]  # replicated physical → logical rows (local slice)
+    _record(
+        op, nnz=A.nnz, rows=m, cols=n, out_split=out_split, wire=wire,
+        **({"bytes": cost.bytes} if cost is not None else {}),
+    )
+    gshape = (m,) if op == "spmv" else (m, k)
+    return DNDarray(out, gshape, dt, out_split, A.device, comm, True)
+
+
+def spmv(
+    A: SparseDNDarray,
+    x: DNDarray,
+    *,
+    out_split: Optional[int] = 0,
+    precision: Optional[str] = None,
+    reduce: str = "sum",
+    pattern: bool = False,
+    audit: bool = False,
+) -> DNDarray:
+    """Sparse matrix–vector product ``A @ x`` as one cached ``shard_map``
+    program (site ``sparse.spmv``).
+
+    ``x`` may be replicated or row-split (``split=0`` pays the audited
+    in-kernel all-gather). ``out_split=0`` (default) returns the
+    row-split result with zero tail collectives; ``out_split=None``
+    returns it replicated through the audited all-reduce tail — the form
+    the iterative solvers consume. ``reduce`` selects the per-row
+    combiner (``'sum'`` | ``'min'`` | ``'max'``; extremes always move
+    exact) and ``pattern=True`` ignores the stored values (structure-only
+    propagation, e.g. :func:`heat_tpu.graph.connected_components`).
+    ``precision`` overrides ``HEAT_TPU_SPARSE_SPMV_PREC`` for the float
+    value tails. Rows with no stored elements yield the reduction
+    identity (0 for sum, ±dtype-max for min/max)."""
+    return _dispatch_sparse_dense(
+        "spmv", A, x, out_split, precision, reduce, pattern, audit
+    )
+
+
+def spmm(
+    A: SparseDNDarray,
+    X: DNDarray,
+    *,
+    out_split: Optional[int] = 0,
+    precision: Optional[str] = None,
+    audit: bool = False,
+) -> DNDarray:
+    """Sparse × dense matrix product ``A @ X`` (site ``sparse.spmm``) —
+    :func:`spmv` semantics over a ``(n, k)`` dense operand (replicated or
+    row-split), result ``(m, k)`` row-split (default) or replicated via
+    the audited all-reduce tail."""
+    return _dispatch_sparse_dense(
+        "spmm", A, X, out_split, precision, "sum", False, audit
+    )
+
+
+# -- solver operator hook ------------------------------------------------------
+
+
+def make_solver_matvec(comm: MeshCommunication, wire: str):
+    """The traceable matvec the iterative solvers embed
+    (``SparseDNDarray._matvec_spec``): replicated logical ``(n,)`` in,
+    replicated logical ``(n,)`` out, CSR leaves as program arguments —
+    so a Lanczos/CG program over a sparse operator carries ONE cache
+    signature and its per-iteration matvec is the same shard-local
+    contraction + all-reduce tail as the standalone ``sparse.spmv``
+    program."""
+    e_spec = comm.spec(0, 1)
+    rep = comm.spec(None, 1)
+    p = comm.size
+
+    def matvec(leaves, x, n):
+        ip, ix, vals = leaves
+
+        def body(ipl, ixl, vl, xl):
+            y = _local_contract(ipl, ixl, vl, xl, "sum", False)
+            r = ipl.shape[0] - 1
+            yg = jnp.zeros((r * p,), dtype=y.dtype)
+            yg = jax.lax.dynamic_update_slice(yg, y, (comm.axis_index() * r,))
+            return _combine_replicated(comm, yg, wire, "sum")
+
+        y = jax.shard_map(
+            body, mesh=comm.mesh, in_specs=(e_spec, e_spec, e_spec, rep),
+            out_specs=rep,
+        )(ip, ix, vals, x)
+        return y[:n]
+
+    return matvec
+
+
+# -- densify -------------------------------------------------------------------
+
+
+def to_dense(A: SparseDNDarray) -> DNDarray:
+    """Materialize the dense row-split :class:`DNDarray` (one cached
+    scatter program, site ``sparse.to_dense``; duplicate coordinates —
+    which the constructors reject — would sum)."""
+    if not isinstance(A, SparseDNDarray):
+        raise TypeError(f"expected a SparseDNDarray, got {type(A)}")
+    comm = A.comm
+    m, n = A.shape
+    e_spec = comm.spec(0, 1)
+
+    def build():
+        def body(ip, ix, vals):
+            rows = _slot_rows(ip, ix.shape[0])
+            r = ip.shape[0] - 1
+            dense = jnp.zeros((r, n), dtype=vals.dtype)
+            return dense.at[rows, ix].add(vals, mode="drop")
+
+        def call(ip, ix, vals):
+            return jax.shard_map(
+                body, mesh=comm.mesh, in_specs=(e_spec, e_spec, e_spec),
+                out_specs=comm.spec(0, 2),
+            )(ip, ix, vals)
+
+        return call
+
+    prog = program_cache.cached_program(
+        "sparse.to_dense", (n, A.dtype.char()), build, comm=comm
+    )
+    out = prog(A.indptr, A.indices, A.values)
+    _record("to_dense", nnz=A.nnz, rows=m, cols=n)
+    return DNDarray(out, (m, n), A.dtype, 0, A.device, comm, True)
+
+
+# -- transpose (the all-to-all-bearing op) -------------------------------------
+
+
+def _transpose_stage_build(comm: MeshCommunication, R: int, r_new: int):
+    """One bounded-memory transpose stage: bucket this stage's element
+    slice by destination shard (the owner of its column under the
+    ceil rule), exchange worst-case-sized slabs with ONE all-to-all per
+    payload (packed int64 keys = index data, values), and report the
+    per-shard received tallies. ``R`` (the packed-key row base) and
+    ``r_new`` (destination rows per shard) ride the program key."""
+    e2_spec = comm.spec(0, 2)
+    p = comm.size
+
+    def body(ip, ixc, vc, k0):
+        ixc, vc = ixc[0], vc[0]
+        chunk = ixc.shape[0]
+        slots = k0 + jnp.arange(chunk, dtype=ip.dtype)
+        row_local = jnp.searchsorted(ip, slots, side="right") - 1
+        valid = slots < ip[-1]
+        r = ip.shape[0] - 1
+        row_g = comm.axis_index() * r + row_local
+        key = jnp.where(
+            valid,
+            ixc.astype(jnp.int64) * R + row_g.astype(jnp.int64),
+            jnp.asarray(_SENTINEL),
+        )
+        dest = jnp.where(valid, ixc // r_new, p).astype(jnp.int32)
+        order = jnp.argsort(dest)
+        key_s, v_s, dest_s = key[order], vc[order], dest[order]
+        start = jnp.searchsorted(
+            dest_s, jnp.arange(p + 1, dtype=dest_s.dtype), side="left"
+        )
+        pos = jnp.arange(chunk, dtype=jnp.int32) - start[dest_s]
+        flat = dest_s * chunk + pos  # dest p (pad) lands out of range
+        send_k = (
+            jnp.full((p * chunk,), _SENTINEL, dtype=jnp.int64)
+            .at[flat].set(key_s, mode="drop").reshape(p, chunk)
+        )
+        send_v = (
+            jnp.zeros((p * chunk,), dtype=vc.dtype)
+            .at[flat].set(v_s, mode="drop").reshape(p, chunk)
+        )
+        if p > 1:
+            # index-carrying payload: exactness pinned regardless of any
+            # global wire knob (int64 would move exact anyway — the pin
+            # makes the contract lint-visible)
+            rk = comm.all_to_all(send_k, 0, 0, precision="off")
+            rv = comm.all_to_all(send_v, 0, 0, precision="off")
+        else:
+            rk, rv = send_k, send_v
+        rk, rv = rk.reshape(-1), rv.reshape(-1)
+        cnt = jnp.sum(rk != _SENTINEL).astype(jnp.int32)
+        return rk, rv, cnt[None]
+
+    def call(ip, ixc, vc, k0):
+        return jax.shard_map(
+            body, mesh=comm.mesh,
+            in_specs=(comm.spec(0, 1), e2_spec, e2_spec, comm.spec(None, 0)),
+            out_specs=(comm.spec(0, 1), comm.spec(0, 1), comm.spec(0, 1)),
+        )(ip, ixc, vc, k0)
+
+    return call
+
+
+def _transpose_build_build(
+    comm: MeshCommunication, R: int, r_new: int, new_cap: int, n_stages: int
+):
+    """The compaction stage: merge every exchange stage's received slab,
+    sort by packed key (destination CSR order — sentinels sink to the
+    tail), and emit the transposed shard CSR directly as sharded
+    buffers. Shard-local; no collectives."""
+    e_spec = comm.spec(0, 1)
+
+    def body(*arrs):
+        ks = jnp.concatenate(arrs[:n_stages])
+        vs = jnp.concatenate(arrs[n_stages:])
+        order = jnp.argsort(ks)
+        k_s = ks[order][:new_cap]
+        v_s = vs[order][:new_cap]
+        valid = k_s != _SENTINEL
+        col = k_s // R          # destination (transposed) global row
+        row = k_s % R           # destination column = source row
+        local_row = col - (comm.axis_index() * r_new).astype(col.dtype)
+        new_ip = jnp.searchsorted(
+            local_row, jnp.arange(r_new + 1, dtype=local_row.dtype),
+            side="left",
+        ).astype(jnp.int32)
+        new_ix = jnp.where(valid, row, 0).astype(jnp.int32)
+        new_v = jnp.where(valid, v_s, jnp.zeros((), dtype=v_s.dtype))
+        return new_ip, new_ix, new_v
+
+    def call(*arrs):
+        return jax.shard_map(
+            body, mesh=comm.mesh, in_specs=(e_spec,) * (2 * n_stages),
+            out_specs=(e_spec, e_spec, e_spec),
+        )(*arrs)
+
+    return call
+
+
+def transpose(
+    A: SparseDNDarray, *, audit: bool = False, slab: Optional[int] = None,
+) -> SparseDNDarray:
+    """``A.T`` as a planned slab exchange (sites ``sparse.transpose_a2a``
+    + ``sparse.transpose_build``). With ``HEAT_TPU_HBM_BUDGET`` set the
+    capacity axis decomposes into stages whose worst-case ``(p, slab)``
+    send/receive slabs fit :func:`memory_guard.temp_budget` — the same
+    bounded-memory discipline the dense relayout planner applies
+    (arXiv:2112.01075); without a budget one monolithic stage runs. Each
+    stage's all-to-alls are priced by
+    :func:`~heat_tpu.telemetry.collectives.sparse_transpose_cost` and
+    auditable per stage. ``slab`` overrides the planned stage width
+    (testing/tuning hook — the budget arithmetic normally picks it)."""
+    if not isinstance(A, SparseDNDarray):
+        raise TypeError(f"expected a SparseDNDarray, got {type(A)}")
+    comm = A.comm
+    p = comm.size
+    m, n = A.shape
+    cap = A.capacity
+    item = A.dtype.byte_size()
+    R = comm.padded_size(m)
+    r_new = comm.chunk_size(n)
+
+    if slab is not None:
+        slab = max(1, min(int(slab), cap))
+    elif memory_guard.budget_bytes() is None:
+        slab = cap
+    else:
+        # per-device working set of one stage: send + receive slabs of
+        # (p, slab) for the 8-byte key and the value payload, plus the
+        # sort scratch — bounded by the shared temp budget (budget/4,
+        # the cdist row-blocking rule)
+        per_elem = 3 * p * (8 + item)
+        slab = max(1, min(cap, memory_guard.temp_budget() // per_elem))
+    n_stages = max(1, math.ceil(cap / slab))
+
+    cost, fields, do_audit = telemetry.op_cost(
+        telemetry.collectives.sparse_transpose_cost, slab, item, p, n_stages,
+        audit=audit,
+    )
+
+    ix2 = A.indices.reshape(p, cap)
+    v2 = A.values.reshape(p, cap)
+    stage_keys = []
+    stage_vals = []
+    stage_shapes = []
+    counts_total = np.zeros(p, dtype=np.int64)
+    with telemetry.span(
+        "sparse.transpose", gshape=[m, n], nnz=A.nnz, mesh=p,
+        stages=n_stages, slab=slab, **fields,
+    ) as sp:
+        for k0 in range(0, cap, slab):
+            chunk = min(slab, cap - k0)
+            prog = program_cache.cached_program(
+                "sparse.transpose_a2a", (R, r_new, A.dtype.char()),
+                lambda: _transpose_stage_build(comm, R, r_new),
+                comm=comm,
+            )
+            args = (
+                A.indptr, ix2[:, k0:k0 + chunk], v2[:, k0:k0 + chunk],
+                jnp.asarray(k0, dtype=jnp.int32),
+            )
+            if do_audit:
+                telemetry.hlo.audit_call(
+                    "sparse.transpose_a2a",
+                    lambda: (prog, args),
+                    predicted=telemetry.collectives.sparse_transpose_cost(
+                        chunk, item, p, 1
+                    ),
+                    key=program_cache.program_key(
+                        "sparse.transpose_a2a",
+                        (R, r_new, A.dtype.char(), chunk), comm=comm,
+                    ),
+                    fields={"mesh": p, "stage_of": n_stages},
+                )
+            rk, rv, cnt = prog(*args)
+            stage_keys.append(rk)
+            stage_vals.append(rv)
+            stage_shapes.append(chunk)
+            counts_total += np.asarray(cnt, dtype=np.int64)
+        new_cap = max(1, int(counts_total.max()))
+        build_prog = program_cache.cached_program(
+            "sparse.transpose_build",
+            (R, r_new, new_cap, tuple(stage_shapes), A.dtype.char()),
+            lambda: _transpose_build_build(comm, R, r_new, new_cap,
+                                           len(stage_keys)),
+            comm=comm,
+        )
+        new_ip, new_ix, new_v = build_prog(*stage_keys, *stage_vals)
+        sp.output(new_v)
+    _record(
+        "transpose", nnz=A.nnz, rows=m, cols=n, stages=n_stages, slab=slab,
+        **({"bytes": cost.bytes * cost.steps} if cost is not None else {}),
+    )
+    return SparseDNDarray.from_shard_arrays(
+        new_ip, new_ix, new_v, (n, m), counts_total,
+        device=A.device, comm=comm, dtype=A.dtype,
+    )
+
+
+# -- constructors --------------------------------------------------------------
+
+
+def _from_host_coo(
+    rows: np.ndarray,
+    cols: np.ndarray,
+    vals: np.ndarray,
+    shape: Tuple[int, int],
+    comm: MeshCommunication,
+    device,
+    dtype=None,
+) -> SparseDNDarray:
+    """Pack sorted host COO triplets into the sharded CSR layout (the
+    constructor finishing pass — see the module docstring for why this
+    is a host path)."""
+    m, n = (int(s) for s in shape)
+    p = comm.size
+    r = comm.chunk_size(m)
+    rows = np.asarray(rows, dtype=np.int64)
+    cols = np.asarray(cols, dtype=np.int64)
+    vals = np.asarray(vals)
+    if rows.size:
+        if rows.min(initial=0) < 0 or rows.max(initial=0) >= m:
+            raise ValueError(f"row indices must lie in [0, {m})")
+        if cols.min(initial=0) < 0 or cols.max(initial=0) >= n:
+            raise ValueError(f"column indices must lie in [0, {n})")
+        packed = rows * n + cols
+        if (np.diff(packed) <= 0).any():
+            raise ValueError(
+                "COO triplets must be sorted by (row, col) and free of "
+                "duplicate coordinates"
+            )
+    bounds = np.searchsorted(rows, np.arange(p + 1) * r)
+    counts = np.diff(bounds)
+    cap = max(1, int(counts.max(initial=0)))
+    ip = np.zeros((p, r + 1), dtype=np.int32)
+    ix = np.zeros((p, cap), dtype=np.int32)
+    v = np.zeros((p, cap), dtype=vals.dtype)
+    for s in range(p):
+        lo, hi = int(bounds[s]), int(bounds[s + 1])
+        c = hi - lo
+        ip[s] = np.searchsorted(
+            rows[lo:hi], s * r + np.arange(r + 1)
+        ).astype(np.int32)
+        ix[s, :c] = cols[lo:hi]
+        v[s, :c] = vals[lo:hi]
+    return SparseDNDarray._from_host_csr_shards(
+        ip, ix, v, (m, n), counts, device=device, comm=comm, dtype=dtype,
+    )
+
+
+def csr_from_dense(
+    x,
+    *,
+    threshold: float = 0.0,
+    keep: str = "nonzero",
+    include_diagonal: bool = False,
+    comm: Optional[MeshCommunication] = None,
+    device=None,
+) -> SparseDNDarray:
+    """Compact a dense matrix into a :class:`SparseDNDarray`.
+
+    ``keep`` selects the thresholding rule — ``'nonzero'`` (entries with
+    ``|v| > threshold``, default 0), ``'above'`` (``v > threshold``) or
+    ``'below'`` (``v < threshold``): the eNeighbour boundary semantics
+    of :class:`heat_tpu.graph.Laplacian`. ``include_diagonal`` forces an
+    explicit diagonal slot per row on square inputs (entries that fail
+    the rule store 0) so structure-preserving transforms — the Laplacian
+    ``I − D^{-1/2} A D^{-1/2}`` value rewrite — never need a structural
+    insert. Reads the dense input to host once (a constructor, not a
+    steady-state path; the memory-bounded construction route is the
+    chunked Laplacian builder, which never materializes the full dense
+    matrix)."""
+    if keep not in ("nonzero", "above", "below"):
+        raise ValueError(
+            f"keep must be 'nonzero'/'above'/'below', got {keep!r}"
+        )
+    if isinstance(x, DNDarray):
+        comm = x.comm if comm is None else comm
+        device = x.device if device is None else device
+        host = x.numpy()
+        dtype = x.dtype
+    else:
+        host = np.asarray(x)
+        dtype = None
+    comm = sanitize_comm(comm)
+    device = device if device is not None else get_device()
+    if host.ndim != 2:
+        raise ValueError(f"expected a 2-D matrix, got {host.ndim}-D")
+    if keep == "above":
+        rule = host > threshold
+    elif keep == "below":
+        rule = host < threshold
+    else:
+        rule = np.abs(host) > threshold
+    mask = rule
+    if include_diagonal:
+        if host.shape[0] != host.shape[1]:
+            raise ValueError("include_diagonal requires a square matrix")
+        # forced diagonal slots are STRUCTURAL: entries failing the keep
+        # rule store 0 (the documented contract) — values come from the
+        # rule mask, not the structure mask
+        mask = rule.copy()
+        np.fill_diagonal(mask, True)
+    rows, cols = np.nonzero(mask)
+    vals = np.where(rule, host, 0)[rows, cols]
+    out = _from_host_coo(
+        rows, cols, vals, host.shape, comm, device, dtype=dtype
+    )
+    _record(
+        "from_dense", nnz=out.nnz, rows=host.shape[0], cols=host.shape[1],
+        keep=keep,
+    )
+    return out
+
+
+def csr_from_coo(
+    rows,
+    cols,
+    values,
+    shape: Tuple[int, int],
+    *,
+    comm: Optional[MeshCommunication] = None,
+    device=None,
+) -> SparseDNDarray:
+    """Build a :class:`SparseDNDarray` from COO triplets.
+
+    DNDarray inputs (any split) route the ordering through the
+    **distributed sort machinery** (``manipulations.sort``'s odd-even
+    merge network) over packed ``row·n + col`` int64 keys — the
+    device-side heavy lifting — with a host finishing pass that gathers
+    the sorted permutation and packs the per-shard CSR blocks. Host
+    array inputs lexsort locally. Duplicate coordinates are rejected."""
+    m, n = (int(s) for s in shape)
+    is_dnd = isinstance(rows, DNDarray)
+    if is_dnd:
+        if not (isinstance(cols, DNDarray) and isinstance(values, DNDarray)):
+            raise TypeError(
+                "csr_from_coo: rows/cols/values must all be DNDarrays "
+                "(or all host arrays)"
+            )
+        comm = rows.comm if comm is None else comm
+        device = rows.device if device is None else device
+        if not (rows.shape == cols.shape == values.shape) or rows.ndim != 1:
+            raise ValueError(
+                f"csr_from_coo: triplets must be matching 1-D vectors, got "
+                f"{rows.shape}/{cols.shape}/{values.shape}"
+            )
+        from ..core import manipulations
+
+        packed = rows.astype(types.int64) * n + cols.astype(types.int64)
+        sorted_keys, order = manipulations.sort(packed)
+        ks = sorted_keys.numpy()
+        vh = values.numpy()[order.numpy()]
+        rh, ch = ks // n, ks % n
+        sorted_via = "distributed-sort"
+    else:
+        rh = np.asarray(rows, dtype=np.int64)
+        ch = np.asarray(cols, dtype=np.int64)
+        vh = np.asarray(values)
+        order = np.lexsort((ch, rh))
+        rh, ch, vh = rh[order], ch[order], vh[order]
+        sorted_via = "lexsort"
+    comm = sanitize_comm(comm)
+    device = device if device is not None else get_device()
+    out = _from_host_coo(rh, ch, vh, (m, n), comm, device)
+    _record("from_coo", nnz=out.nnz, rows=m, cols=n, sorted_via=sorted_via)
+    return out

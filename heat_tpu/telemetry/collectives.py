@@ -43,6 +43,9 @@ __all__ = [
     "gram_ring_cost",
     "fusion_reduce_cost",
     "allreduce_cost",
+    "spmv_cost",
+    "spmm_cost",
+    "sparse_transpose_cost",
 ]
 
 # Blockwise collective-compression scale granularity (ISSUE 9): one f32
@@ -394,3 +397,99 @@ def allreduce_cost(
     payload = 2 * numel_p * (nproc - 1)          # a2a phase + gather phase
     scales = 2 * 2 * nproc * nb * (nproc - 1)    # bf16 scales, both phases
     return CollectiveCost("all-to-all+all-gather", payload + scales)
+
+
+def spmm_cost(
+    m: int,
+    n: int,
+    k: int,
+    itemsize: int,
+    nproc: int,
+    x_split: Optional[int] = None,
+    out_split: Optional[int] = 0,
+    precision: str = "off",
+) -> CollectiveCost:
+    """Cost of one cached sparse × dense ``shard_map`` program
+    (:func:`heat_tpu.sparse.spmm`, site ``sparse.spmm``; ``spmv`` is the
+    ``k = 1`` special case). The CSR operand is row-split with
+    shard-local ``indptr``/``indices``/``values`` — **index/ptr payloads
+    never touch the wire** — so the only collectives are the float tails:
+
+    * **operand gather** (``x_split == 0``): the dense ``(n, k)`` operand
+      is row-split, so each shard all-gathers the other shards' physical
+      chunks before the local contraction — ``p·(p−1)·ceil(n/p)·k``
+      elements total (tail-pad inclusive, like :func:`tsqr_cost`).
+      ``precision='bf16'`` moves the uint16 bit pattern (2-byte wire
+      element, the ISSUE 9 bitcast pair).
+    * **result all-reduce** (``out_split is None``): each shard scatters
+      its local rows into a zero global ``(m_pad·k)`` partial and one
+      ``psum`` combines them — :func:`allreduce_cost` of the *physical*
+      (pad-inclusive) result under the same wire mode. A row-split
+      result (``out_split == 0``) stays shard-local: zero wire bytes.
+
+    Mirrors ``heat_tpu/sparse/ops.py`` byte-for-byte so the HLO audit of
+    a sparse program stays zero-drift (the acceptance oracle of
+    ISSUE 13)."""
+    if nproc <= 1:
+        return CollectiveCost("none", 0)
+    itemsize = int(itemsize)
+    wire_item = min(itemsize, 2) if precision == "bf16" else itemsize
+    kinds = []
+    total = 0
+    if x_split == 0:
+        chunk = math.ceil(n / nproc)
+        kinds.append("all-gather")
+        total += nproc * (nproc - 1) * chunk * int(k) * wire_item
+    if out_split is None:
+        m_pad = math.ceil(m / nproc) * nproc
+        tail = allreduce_cost(m_pad * int(k), itemsize, nproc, precision)
+        kinds.append(tail.kind)
+        total += tail.bytes
+    if not kinds:
+        return CollectiveCost("none", 0)
+    return CollectiveCost("+".join(kinds), total)
+
+
+def spmv_cost(
+    m: int,
+    n: int,
+    itemsize: int,
+    nproc: int,
+    x_split: Optional[int] = None,
+    out_split: Optional[int] = 0,
+    precision: str = "off",
+) -> CollectiveCost:
+    """Cost of one sparse matrix-vector product (site ``sparse.spmv``) —
+    :func:`spmm_cost` with a single dense column. See there for the
+    component rules (operand gather / result all-reduce)."""
+    return spmm_cost(
+        m, n, 1, itemsize, nproc,
+        x_split=x_split, out_split=out_split, precision=precision,
+    )
+
+
+def sparse_transpose_cost(
+    slab: int,
+    itemsize: int,
+    nproc: int,
+    stages: int = 1,
+) -> CollectiveCost:
+    """Cost of ONE stage of the sparse CSR transpose
+    (:func:`heat_tpu.sparse.transpose`, site ``sparse.transpose_a2a``):
+    every shard routes its local elements to the shard owning their
+    destination row through a static ``(p, slab)`` slab exchange — one
+    **all-to-all** for the packed int64 ``(row, col)`` sort keys and one
+    for the values, both pinned exact (the key payload IS index data).
+    Slabs are worst-case sized (every element of a stage could target
+    one destination), so each device ships ``(p−1)`` slabs of ``slab``
+    elements per payload regardless of occupancy:
+    ``p·(p−1)·slab·(8 + itemsize)`` wire bytes per stage. ``stages`` is
+    the bounded-memory decomposition count the planner picked against
+    ``HEAT_TPU_HBM_BUDGET`` (each stage is its own cached program, the
+    arXiv:2112.01075 discipline dense relayout already uses); the figure
+    here prices one stage — a plan's total is ``stages ×`` this, which
+    the ``steps`` field records."""
+    if nproc <= 1:
+        return CollectiveCost("none", 0)
+    per_stage = nproc * (nproc - 1) * int(slab) * (8 + int(itemsize))
+    return CollectiveCost("all-to-all", per_stage, steps=int(stages))

@@ -72,6 +72,7 @@ def summarize(
     res_events: dict = {}
     at_events: dict = {}
     sn_events: dict = {}
+    sp_events: dict = {}
     plan_counts: dict = {}
     plan_last: Optional[dict] = None
     plan_wire = 0
@@ -119,6 +120,9 @@ def summarize(
         elif kind == "serve_net":
             what = ev.get("event") or "event"
             sn_events[what] = sn_events.get(what, 0) + 1
+        elif kind == "sparse":
+            what = ev.get("event") or "event"
+            sp_events[what] = sp_events.get(what, 0) + 1
         elif kind == "relayout_plan":
             p = ev.get("plan") or ev.get("name")
             plan_counts[p] = plan_counts.get(p, 0) + 1
@@ -370,6 +374,29 @@ def summarize(
         out["serving_net"] = {
             _sn_names.get(k, k): v for k, v in sn_events.items()
         }
+    # sparse-container counters (heat_tpu/sparse, ISSUE 13): every op
+    # pairs one `sparse.<op>` counter with one `sparse` instant event
+    # (sparse.EVENT_COUNTER), so live summaries (registry counters) and
+    # offline sink replays reconstruct the SAME `sparse` block — the
+    # PR 5/11/12 reconciliation contract. Absent entirely when no sparse
+    # op ran, so dense-only summary shapes are unchanged.
+    if live:
+        from . import get_registry as _get_registry
+
+        sm = {
+            k[len("sparse."):]: (int(v) if float(v).is_integer() else v)
+            for k, v in _get_registry().counters.items()
+            if k.startswith("sparse.")
+        }
+        sm.pop("laplacian_live_bytes", None)  # a watermark key, not a counter
+        if sm:
+            out["sparse"] = sm
+    elif sp_events:
+        out["sparse"] = dict(sp_events)
+    if watermarks and "sparse.laplacian_live_bytes" in watermarks:
+        out.setdefault("sparse", {})["laplacian_live_bytes"] = int(
+            watermarks["sparse.laplacian_live_bytes"]
+        )
     if watermarks:
         peak = watermarks.get("live_bytes.total")
         if peak is not None:

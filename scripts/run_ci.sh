@@ -1181,6 +1181,92 @@ EOF
     fi
 fi
 
+# Sparse gate (ISSUE 13, heat_tpu/sparse): the density-sweep
+# microbenchmark on the 4-device mesh must show
+#   (a) the row-split spmv digest BIT-identical to the dense reference
+#       mask-matmul evaluated in the same per-row element order, at
+#       every density (0.1%/1%/10%),
+#   (b) the budget-bounded transpose (stage-decomposed slab exchange)
+#       bit-identical to the monolithic exchange,
+#   (c) zero HLO-audit drift on every audited sparse collective site
+#       (--audit arms the auditor over the whole run), and
+#   (d) the Spectral eNeighbour end-to-end row agreeing with the dense
+#       pipeline's labels exactly.
+# HEAT_TPU_CI_SKIP_SPARSE=1 opts out.
+if [ -z "${HEAT_TPU_CI_SKIP_SPARSE:-}" ]; then
+    echo "=== sparse gate: density sweep + transpose + spectral (4-device mesh) ==="
+    sp_rc=0
+    sp_out=$(mktemp)
+    if HEAT_TPU_TELEMETRY=1 python benchmarks/sparse/heat_tpu.py \
+            --n 512 --features 8 --trials 2 --mesh 4 --audit \
+            --spectral-n 128 > "$sp_out"; then
+        python - "$sp_out" <<'EOF' || sp_rc=$?
+import json, sys
+
+summary = None
+for line in open(sys.argv[1]):
+    line = line.strip()
+    if not line:
+        continue
+    try:
+        obj = json.loads(line)
+    except json.JSONDecodeError:
+        continue
+    if "sparse_compare" in obj:
+        summary = obj["sparse_compare"]
+if summary is None:
+    raise SystemExit("sparse: no sparse_compare summary line")
+
+bad = [r["density"] for r in summary["densities"] if not r["digest_match"]]
+if bad:
+    raise SystemExit(
+        f"sparse: spmv digest diverged from the dense reference "
+        f"mask-matmul at densities {bad}"
+    )
+tr = summary["transpose"]
+if tr["chunked_stages"] < 2:
+    raise SystemExit(
+        f"sparse: transpose did not decompose ({tr['chunked_stages']} stage)"
+    )
+if not tr["digest_match"]:
+    raise SystemExit(
+        "sparse: stage-decomposed transpose diverged from the monolithic "
+        "exchange"
+    )
+hlo = (summary.get("telemetry") or {}).get("hlo_collectives") or {}
+if hlo.get("audits", 0) < 1:
+    raise SystemExit("sparse: --audit recorded no HLO audits")
+if hlo.get("drift", 0) != 0:
+    raise SystemExit(
+        f"sparse: HLO audit drift on sparse collective sites: "
+        f"{ {k: v for k, v in (hlo.get('sites') or {}).items() if v.get('drift')} }"
+    )
+spec = summary.get("spectral") or {}
+if spec.get("label_agreement") != 1.0:
+    raise SystemExit(
+        f"sparse: Spectral sparse-vs-dense labels disagree "
+        f"({spec.get('label_agreement')})"
+    )
+print(
+    f"sparse ok: digest bit-identical at densities "
+    f"{[r['density'] for r in summary['densities']]}, transpose "
+    f"{tr['chunked_stages']}-stage bit-identical, "
+    f"{hlo.get('audits')} audits zero-drift, spectral agreement 1.0"
+)
+EOF
+    else
+        sp_rc=$?
+    fi
+    if [ -n "$REPORT" ]; then
+        cp "$sp_out" "${REPORT}/sparse.jsonl" || true
+    fi
+    rm -f "$sp_out"
+    if [ "$sp_rc" != 0 ]; then
+        echo "=== sparse gate FAILED (rc=$sp_rc) ==="
+        FAILED_SIZES="$FAILED_SIZES sparse"
+    fi
+fi
+
 if [ "$have_coverage" = 1 ]; then
     # merge the per-size coverage files, as the reference CI merges its
     # 8 mpirun passes (Jenkinsfile:33-44 / codecov)
