@@ -17,7 +17,8 @@ import shlex
 HERE = os.path.dirname(os.path.abspath(__file__))
 REPO = os.path.dirname(HERE)
 ALGOS = ("kmeans", "distance_matrix", "statistical_moments", "lasso",
-         "resplit", "elementwise", "reduction", "serving", "sparse")
+         "resplit", "elementwise", "reduction", "serving", "sparse",
+         "hierarchy")
 
 
 def _param_flags(params: dict) -> list[str]:

@@ -294,6 +294,47 @@ _register(
     tunable=Tunable(("1", "0"), "lossy", exact_value="1"),
 )
 
+# -- hierarchy-aware tiered collectives (heat_tpu/core/topology.py, ISSUE 15) -
+
+_register(
+    "HEAT_TPU_TOPOLOGY", "str", None,
+    "Declared 2-level (node x local) factorization of the device mesh, "
+    "e.g. `2x4`: `node` is the slow (DCN) tier, `local` the fast (ICI) "
+    "tier (core/topology.py). Unset auto-detects: the host-process "
+    "structure on real multi-host hardware, the DASO-style emulated "
+    "2-node split on a single even-sized host mesh. Malformed or "
+    "mismatched values (node*local != mesh size) fall back to "
+    "auto-detection.",
+)
+_register(
+    "HEAT_TPU_HIERARCHICAL", "bool", False,
+    "Tiered lowering of the payload-moving MeshCommunication wrappers "
+    "(psum/all_gather/reduce_scatter/all_to_all): in-node reduce-scatter "
+    "-> cross-node collective over the 1/local shard -> in-node "
+    "all-gather, with per-tier wire precision (exact inside the node, "
+    "HEAT_TPU_HIERARCHICAL_PREC across). `0` (default) keeps the flat "
+    "lowering bit-for-bit.",
+    tunable=Tunable(("0", "1"), "exact"),
+)
+_register(
+    "HEAT_TPU_HIERARCHICAL_PREC", "str", None,
+    "Wire precision of the CROSS-NODE tier of a tiered collective "
+    "(core/topology.py; the DCN wire): off | bf16 | int8 | blockwise. "
+    "Unset inherits HEAT_TPU_COLLECTIVE_PREC; the in-node (ICI) tier "
+    "always moves exact.",
+    tunable=Tunable(
+        ("off", "bf16", "int8", "blockwise"), "lossy", exact_value="off"
+    ),
+)
+_register(
+    "HEAT_TPU_DCN_PREMIUM", "float", 8.0,
+    "Relative cost of one cross-node (DCN) wire byte vs one in-node "
+    "(ICI) byte in the analytic cost model "
+    "(telemetry/collectives.weighted_wire): the planner and autotuner "
+    "price tiered vs flat lowerings with DCN bytes multiplied by this "
+    "factor. ~8-10 matches the production ICI/DCN bandwidth gap.",
+)
+
 # -- sparse container knobs (heat_tpu/sparse, ISSUE 13) -----------------------
 
 _register(
@@ -441,6 +482,10 @@ for _name, _doc in (
      "digest bit-identical to the dense reference mask-matmul, "
      "budget-bounded transpose, zero HLO-audit drift on the sparse "
      "collective sites)."),
+    ("HEAT_TPU_CI_SKIP_HIERARCHY", "Skip the hierarchy gate (ISSUE 15: "
+     "flat-vs-tiered digest bit-identity on the emulated 2x2 mesh, "
+     "audited cross-node byte reduction >= the local shard factor, "
+     "DASO tiered-send equivalence, ZeRO watermark check)."),
 ):
     _register(_name, "str", None, _doc, scope="ci")
 del _name, _doc

@@ -208,6 +208,10 @@ class RawCollective(Rule):
         # the wrapper chokepoints themselves
         "heat_tpu/core/communication.py",
         "heat_tpu/core/collective_prec.py",
+        # the tiered-lowering chokepoint (ISSUE 15): its grouped
+        # collectives ARE the hierarchical programs the wrappers
+        # dispatch and the hierarchical_*_cost entries price
+        "heat_tpu/core/topology.py",
         # kernel modules whose collectives the cost model already prices
         # (telemetry/collectives.py: relayout/sort volumes, chunked plans
         # + a2a kernels, TSQR/Gram rings, ring cdist, DP/DASO all-reduce,

@@ -93,6 +93,8 @@ def relayout_cost_fn(
     gshape = tuple(int(s) for s in gshape)
 
     def fn(config: Dict[str, str]) -> float:
+        from ..core import topology
+
         plan_mode = (config.get("HEAT_TPU_RELAYOUT_PLAN") or "auto").strip()
         prec = (config.get("HEAT_TPU_COLLECTIVE_PREC") or "off").strip()
         try:
@@ -107,19 +109,70 @@ def relayout_cost_fn(
         )
         if budget is not None and pl.temp_bytes > budget:
             return math.inf
+        # topology-aware pricing (ISSUE 15), armed ONLY when the lattice
+        # searches HEAT_TPU_HIERARCHICAL (every config of such a lattice
+        # carries the key): on a non-trivial (node x local)
+        # factorization, a FLAT collective's single replica group spans
+        # nodes, so its whole volume is DCN-priced; the tiered
+        # all-to-all charges only its cross-node stage at the premium.
+        # This is what lets the analytic stage pick tiered vs flat per
+        # signature before anything is measured. Lattices that do not
+        # search the knob keep the historic plain-byte pricing exactly.
+        searching_hier = "HEAT_TPU_HIERARCHICAL" in config
+        hier_on = (config.get("HEAT_TPU_HIERARCHICAL") or "0").strip() in (
+            "1", "true", "yes", "on",
+        )
+        topo = topology.resolve(nproc)
+        tiered = hier_on and topo.nontrivial
         if getattr(pl, "stages", None):
-            wire = sum(
+            costs = [
                 model.relayout_chunk_cost(
                     gshape, itemsize, src_split, dst_split,
                     s.hi - s.lo, nproc, precision=prec, block=block,
-                ).bytes
+                )
                 for s in pl.stages
-            )
+            ]
+        elif pl.kind == "alltoall" and tiered:
+            phys_numel = 1
+            for d, s_ in enumerate(gshape):
+                s_ = int(s_)
+                if d in (src_split, dst_split):
+                    s_ = -(-s_ // nproc) * nproc
+                phys_numel *= s_
+            # cross tier priced at the config's COLLECTIVE_PREC: the
+            # relayout program resolves its wire mode explicitly per
+            # call, so the HIERARCHICAL_PREC fallback never reaches it —
+            # pricing it here would reward a compression the executed
+            # program cannot deliver
+            costs = [
+                model.hierarchical_a2a_cost(
+                    phys_numel, itemsize, topo.node, topo.local,
+                    prec, block=block,
+                )
+            ]
         else:
-            wire = model.relayout_cost(
-                gshape, itemsize, src_split, dst_split, nproc,
-                precision=prec, block=block,
-            ).bytes
-        return float(wire)
+            costs = [
+                model.relayout_cost(
+                    gshape, itemsize, src_split, dst_split, nproc,
+                    precision=prec, block=block,
+                )
+            ]
+        if not searching_hier:
+            return float(sum(c.bytes for c in costs))
+        try:
+            premium = float(config.get("HEAT_TPU_DCN_PREMIUM") or 0)
+        except ValueError:
+            premium = 0.0
+        if premium <= 0:
+            premium = None  # weighted_wire falls back to the live knob
+        total = 0.0
+        for c in costs:
+            if topo.nontrivial and not c.dcn_bytes and c.bytes:
+                # flat lowering on a 2-level topology: all bytes ride DCN
+                c = model.CollectiveCost(
+                    c.kind, c.bytes, steps=c.steps, dcn_bytes=c.bytes
+                )
+            total += model.weighted_wire(c, premium)
+        return float(total)
 
     return fn

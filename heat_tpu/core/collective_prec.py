@@ -84,6 +84,7 @@ __all__ = [
     "blockwise_axis_ok",
     "psum",
     "pmean",
+    "reduce_scatter",
     "all_gather",
     "ppermute",
     "all_to_all",
@@ -312,21 +313,17 @@ def all_gather(
     return deq
 
 
-def psum(x, axis_name: str, nproc: int, mode_: str,
-         block: Optional[int] = None):
-    """Compressed ``lax.psum`` — the EQuARX two-phase quantized
-    all-reduce. ``bf16`` keeps the native all-reduce on a bf16 payload;
-    ``int8``/``blockwise`` run quantize → all-to-all (each device
-    collects everyone's partial of its 1/p chunk) → dequantize +
-    accumulate in f32 → requantize → all-gather → dequantize. Two int8
-    passes instead of one f32 ring: ``2·(B/4)·(p-1)`` wire bytes, a 4x
-    reduction, at ≤ (p+1) quantization steps of error per element."""
-    if mode_ == "off" or not compressible(x.dtype):
-        return jax.lax.psum(x, axis_name)
-    if mode_ == "bf16":
-        w = x if x.dtype == jnp.bfloat16 else x.astype(jnp.bfloat16)
-        return jax.lax.psum(w, axis_name).astype(x.dtype)
-    block = block or block_size()
+def _quant_scatter_phase(x, axis_name: str, nproc: int, mode_: str,
+                         block: int, groups):
+    """The EQuARX FIRST phase: quantize this device's partial into
+    ``nproc`` per-destination sub-chunks, all-to-all them (each device
+    collects everyone's partial of its 1/p chunk), dequantize and
+    accumulate in f32. Returns ``(red, chunk)`` where ``red`` is the
+    f32 ``(chunk,)`` group-sum chunk this position owns — a quantized
+    reduce-scatter standing alone, and the front half of the quantized
+    :func:`psum`. ``groups`` (``axis_index_groups``) scopes every
+    collective to a tier's replica groups (ISSUE 15); ``nproc`` is then
+    the GROUP size, not the axis size."""
     n = x.size
     chunk = -(-n // nproc)
     if mode_ == "blockwise":
@@ -340,9 +337,13 @@ def psum(x, axis_name: str, nproc: int, mode_: str,
     if mode_ == "int8":
         s = _scale_of(jnp.max(jnp.abs(parts)))          # scalar
         q = jnp.clip(jnp.round(parts / s), -127.0, 127.0).astype(jnp.int8)
-        qt = jax.lax.all_to_all(q, axis_name, 0, 0, tiled=True)
+        qt = jax.lax.all_to_all(
+            q, axis_name, 0, 0, tiled=True, axis_index_groups=groups
+        )
         sg = _move_u16(
-            lambda u: jax.lax.all_gather(u, axis_name), s
+            lambda u: jax.lax.all_gather(
+                u, axis_name, axis_index_groups=groups
+            ), s
         )                                               # (p,)
         deq = _deq(qt, sg[:, None])
     else:
@@ -350,18 +351,89 @@ def psum(x, axis_name: str, nproc: int, mode_: str,
         s = _scale_of(jnp.max(jnp.abs(b3), axis=2))     # (p, nb)
         q = jnp.clip(jnp.round(b3 / s[..., None]), -127.0, 127.0)
         q = q.astype(jnp.int8)
-        qt = jax.lax.all_to_all(q, axis_name, 0, 0, tiled=True)
+        qt = jax.lax.all_to_all(
+            q, axis_name, 0, 0, tiled=True, axis_index_groups=groups
+        )
         st = _move_u16(
-            lambda u: jax.lax.all_to_all(u, axis_name, 0, 0, tiled=True), s
+            lambda u: jax.lax.all_to_all(
+                u, axis_name, 0, 0, tiled=True, axis_index_groups=groups
+            ), s
         )
         deq = _deq(qt, st[..., None]).reshape(nproc, chunk)
-    red = jnp.sum(deq, axis=0)                          # this device's chunk
+    return jnp.sum(deq, axis=0), chunk                  # this device's chunk
+
+
+def reduce_scatter(x, axis_name: str, nproc: int, mode_: str,
+                   block: Optional[int] = None, groups=None):
+    """Reduce-scatter of a payload flattened and zero-padded to ``nproc``
+    equal chunks: position ``i`` (within its group) returns the 1-D
+    ``(ceil(numel/nproc),)`` chunk ``i`` of the group sum, in the
+    payload's dtype. ``off`` is the native ring ``lax.psum_scatter``;
+    ``bf16`` the same on a bf16 payload; ``int8``/``blockwise`` the
+    EQuARX first phase (:func:`_quant_scatter_phase`) standing alone —
+    the ZeRO gradient-sharding primitive (ISSUE 15). Blockwise pads the
+    chunk to whole blocks, so the returned chunk can be one block-pad
+    longer than ``ceil(numel/nproc)``; callers slice by their own
+    arithmetic."""
+    n = x.size
+    chunk = -(-n // nproc)
+    if mode_ == "off" or not compressible(x.dtype):
+        flat = jnp.ravel(x)
+        if chunk * nproc != n:
+            flat = jnp.pad(flat, (0, chunk * nproc - n))
+        return jax.lax.psum_scatter(
+            flat, axis_name, scatter_dimension=0,
+            axis_index_groups=groups, tiled=True,
+        )
+    if mode_ == "bf16":
+        flat = jnp.ravel(x).astype(jnp.bfloat16)
+        if chunk * nproc != n:
+            flat = jnp.pad(flat, (0, chunk * nproc - n))
+        return jax.lax.psum_scatter(
+            flat, axis_name, scatter_dimension=0,
+            axis_index_groups=groups, tiled=True,
+        ).astype(x.dtype)
+    red, _chunk = _quant_scatter_phase(
+        x, axis_name, nproc, mode_, block or block_size(), groups
+    )
+    return red.astype(x.dtype)
+
+
+def psum(x, axis_name: str, nproc: int, mode_: str,
+         block: Optional[int] = None, groups=None):
+    """Compressed ``lax.psum`` — the EQuARX two-phase quantized
+    all-reduce. ``bf16`` keeps the native all-reduce on a bf16 payload;
+    ``int8``/``blockwise`` run quantize → all-to-all (each device
+    collects everyone's partial of its 1/p chunk) → dequantize +
+    accumulate in f32 → requantize → all-gather → dequantize. Two int8
+    passes instead of one f32 ring: ``2·(B/4)·(p-1)`` wire bytes, a 4x
+    reduction, at ≤ (p+1) quantization steps of error per element.
+    ``groups`` scopes every collective to ``axis_index_groups`` (the
+    ISSUE 15 cross-node tier); ``nproc`` is then the group size."""
+    if mode_ == "off" or not compressible(x.dtype):
+        return jax.lax.psum(x, axis_name, axis_index_groups=groups)
+    if mode_ == "bf16":
+        w = x if x.dtype == jnp.bfloat16 else x.astype(jnp.bfloat16)
+        return jax.lax.psum(
+            w, axis_name, axis_index_groups=groups
+        ).astype(x.dtype)
+    block = block or block_size()
+    n = x.size
+    red, chunk = _quant_scatter_phase(
+        x, axis_name, nproc, mode_, block, groups
+    )
+    if mode_ == "blockwise":
+        block = max(1, min(block, -(-n // nproc)))
     if mode_ == "int8":
         s2 = _scale_of(jnp.max(jnp.abs(red)))
         q2 = jnp.clip(jnp.round(red / s2), -127.0, 127.0).astype(jnp.int8)
-        q2g = jax.lax.all_gather(q2, axis_name)         # (p, chunk)
+        q2g = jax.lax.all_gather(
+            q2, axis_name, axis_index_groups=groups
+        )                                               # (p, chunk)
         s2g = _move_u16(
-            lambda u: jax.lax.all_gather(u, axis_name), s2
+            lambda u: jax.lax.all_gather(
+                u, axis_name, axis_index_groups=groups
+            ), s2
         )                                               # (p,)
         out = _deq(q2g, s2g[:, None])
     else:
@@ -369,9 +441,13 @@ def psum(x, axis_name: str, nproc: int, mode_: str,
         s2 = _scale_of(jnp.max(jnp.abs(rb), axis=1))
         q2 = jnp.clip(jnp.round(rb / s2[:, None]), -127.0, 127.0)
         q2 = q2.astype(jnp.int8)
-        q2g = jax.lax.all_gather(q2, axis_name)         # (p, nb, block)
+        q2g = jax.lax.all_gather(
+            q2, axis_name, axis_index_groups=groups
+        )                                               # (p, nb, block)
         s2g = _move_u16(
-            lambda u: jax.lax.all_gather(u, axis_name), s2
+            lambda u: jax.lax.all_gather(
+                u, axis_name, axis_index_groups=groups
+            ), s2
         )                                               # (p, nb)
         out = _deq(q2g, s2g[..., None]).reshape(nproc, chunk)
     return out.reshape(-1)[:n].reshape(x.shape).astype(x.dtype)

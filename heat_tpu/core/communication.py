@@ -258,6 +258,45 @@ class MeshCommunication(Communication):
             precision=precision, block=collective_prec.block_size(),
         )
 
+    # -- 2-level topology (ISSUE 15) -----------------------------------------
+
+    def topology(self):
+        """The resolved 2-level ``(node, local)`` factorization of this
+        mesh (:mod:`heat_tpu.core.topology`): the ``HEAT_TPU_TOPOLOGY``
+        knob when declared, else auto-detection (host-process structure
+        on real hardware; the DASO-style emulated two-node split on a
+        single even host mesh). Resolved per call — the knob may change
+        between traces."""
+        from . import topology as _topo
+
+        return _topo.resolve(self.size)
+
+    def _hier(self):
+        """The topology to lower tiered against, or None for flat:
+        requires ``HEAT_TPU_HIERARCHICAL=1`` and a nontrivial
+        factorization."""
+        from . import topology as _topo
+
+        return _topo.active(self.size)
+
+    def hier_token(self):
+        """The tiered-lowering program-cache key component
+        (:func:`heat_tpu.core.topology.cache_token`). Callers caching
+        programs built over the payload-moving wrappers must include
+        this alongside ``collective_prec.effective(dtype)`` — same
+        contract, same reason."""
+        from . import topology as _topo
+
+        return _topo.cache_token(self.size)
+
+    def _cross_wire(self, x, precision: Optional[str]) -> str:
+        """The cross-node tier's wire mode for one payload (per-call
+        override → ``HEAT_TPU_HIERARCHICAL_PREC`` →
+        ``HEAT_TPU_COLLECTIVE_PREC``; off for non-floats)."""
+        from . import topology as _topo
+
+        return _topo.cross_mode(x.dtype, precision)
+
     # -- explicit collectives (for hand-written shard_map kernels) -----------
     # These are thin curried wrappers so kernels don't hard-code axis names.
     # With telemetry enabled each wrapper records a trace-time event: the
@@ -297,6 +336,22 @@ class MeshCommunication(Communication):
     def psum(self, x, precision: Optional[str] = None):
         from . import collective_prec
 
+        topo = self._hier()
+        if topo is not None:
+            from . import topology as _topo
+
+            wire = self._cross_wire(x, precision)
+            telemetry.trace_event(
+                "psum", axis=self.__axis, wire=wire, hier=topo.describe(),
+                **telemetry.collectives.hierarchical_allreduce_cost(
+                    x.size, x.dtype.itemsize, topo.node, topo.local,
+                    wire, collective_prec.block_size(),
+                ).as_fields(),
+            )
+            return self._coll(
+                "psum", _topo.hier_psum, x, self.__axis, topo, wire,
+                collective_prec.block_size(),
+            )
         wire = self._wire(x, precision)
         telemetry.trace_event("psum", axis=self.__axis, wire=wire)
         if wire != "off":
@@ -304,6 +359,42 @@ class MeshCommunication(Communication):
                 "psum", collective_prec.psum, x, self.__axis, self.size, wire,
             )
         return self._coll("psum", jax.lax.psum, x, self.__axis)
+
+    def reduce_scatter(self, x, precision: Optional[str] = None):
+        """Reduce-scatter of this payload, flattened: position ``i``
+        returns the 1-D ``(ceil(numel/p),)`` chunk ``i`` of the global
+        sum (the ZeRO gradient primitive — arXiv:2004.13336). Flat it is
+        one ``psum_scatter`` (quantized modes: the EQuARX first phase);
+        tiered it is in-node reduce-scatter (exact) then cross-node
+        reduce-scatter of the 1/local shard (``precision`` compresses
+        the cross tier only)."""
+        from . import collective_prec
+
+        topo = self._hier()
+        if topo is not None:
+            from . import topology as _topo
+
+            wire = self._cross_wire(x, precision)
+            telemetry.trace_event(
+                "reduce_scatter", axis=self.__axis, wire=wire,
+                hier=topo.describe(),
+                **telemetry.collectives.hierarchical_reduce_scatter_cost(
+                    x.size, x.dtype.itemsize, topo.node, topo.local,
+                    wire, collective_prec.block_size(),
+                ).as_fields(),
+            )
+            return self._coll(
+                "reduce_scatter", _topo.hier_reduce_scatter, x,
+                self.__axis, topo, wire, collective_prec.block_size(),
+            )
+        wire = self._wire(x, precision)
+        telemetry.trace_event(
+            "reduce_scatter", axis=self.__axis, wire=wire
+        )
+        return self._coll(
+            "reduce_scatter", collective_prec.reduce_scatter, x,
+            self.__axis, self.size, wire,
+        )
 
     def pmax(self, x):
         # extremes are exactness-critical (argmin/argmax tie-breaking,
@@ -322,6 +413,23 @@ class MeshCommunication(Communication):
                    precision: Optional[str] = None):
         from . import collective_prec
 
+        topo = self._hier()
+        if topo is not None:
+            from . import topology as _topo
+
+            wire = self._cross_wire(x, precision)
+            telemetry.trace_event(
+                "all_gather", axis=self.__axis, wire=wire,
+                hier=topo.describe(),
+                **telemetry.collectives.hierarchical_allgather_cost(
+                    x.size, x.dtype.itemsize, topo.node, topo.local,
+                    wire, collective_prec.block_size(),
+                ).as_fields(),
+            )
+            return self._coll(
+                "all_gather", _topo.hier_all_gather, x, self.__axis,
+                topo, wire, collective_prec.block_size(), tiled=tiled,
+            )
         wire = self._wire(x, precision)
         telemetry.trace_event("all_gather", axis=self.__axis, wire=wire)
         if wire != "off":
@@ -365,6 +473,25 @@ class MeshCommunication(Communication):
                    precision: Optional[str] = None):
         from . import collective_prec
 
+        topo = self._hier()
+        if topo is not None:
+            from . import topology as _topo
+
+            wire = self._cross_wire(x, precision)
+            phys = x.size * self.size  # per-shard payload × participants
+            telemetry.trace_event(
+                "all_to_all", axis=self.__axis, wire=wire,
+                hier=topo.describe(),
+                **telemetry.collectives.hierarchical_a2a_cost(
+                    phys, x.dtype.itemsize, topo.node, topo.local,
+                    wire, collective_prec.block_size(),
+                ).as_fields(),
+            )
+            return self._coll(
+                "all_to_all", _topo.hier_all_to_all, x, self.__axis,
+                topo, split_axis, concat_axis, wire,
+                collective_prec.block_size(),
+            )
         wire = self._wire(x, precision)
         telemetry.trace_event("all_to_all", axis=self.__axis, wire=wire)
         if wire != "off":

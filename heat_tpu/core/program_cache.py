@@ -122,8 +122,31 @@ def program_key(
     share one signature. ``comm`` participates by identity (two
     communicators over the same devices are distinct meshes to XLA too);
     ``key`` is the caller's static config (shapes, dtypes, splits, flags —
-    anything that changes the traced program)."""
-    return (site, comm, key, tuple(donate))
+    anything that changes the traced program).
+
+    The tiered-lowering state (ISSUE 15: ``HEAT_TPU_HIERARCHICAL`` +
+    topology + cross-tier precision) is appended HERE, once, for every
+    site: any program built over the MeshCommunication wrappers changes
+    shape under the knob, and threading the token through forty caller
+    keys is exactly the drift this chokepoint exists to prevent. Flat
+    (the default) contributes the constant ``("flat",)``."""
+    return (site, comm, key, tuple(donate), _topology_token(comm))
+
+
+def _topology_token(comm: Any) -> Tuple:
+    """The ISSUE 15 cache-token component (see
+    :func:`heat_tpu.core.topology.cache_token`); ``("flat",)`` whenever
+    tiered lowering is off or unresolvable — the zero-overhead default
+    is one knob read."""
+    try:
+        from . import topology
+
+        p = getattr(comm, "size", None)
+        if p is None:
+            p = jax.device_count()
+        return topology.cache_token(int(p))
+    except Exception:  # never let key construction take dispatch down
+        return ("flat",)
 
 
 def cached_program(

@@ -608,6 +608,9 @@ def _a2a_program(plan_: RelayoutPlan, comm, dtype_str, wire: str = "off"):
             in_specs=comm.spec(s, nd), out_specs=comm.spec(t, nd),
         )
 
+    # the tiered-lowering state (ISSUE 15) is appended by program_key
+    # itself, so flipping HEAT_TPU_HIERARCHICAL keys a fresh build here
+    # like at every other site
     return program_cache.cached_program(
         "relayout_a2a", (gshape, dtype_str, s, t, wire), build, comm=comm,
     )
@@ -637,15 +640,29 @@ def run(
             for axx in (plan_.src_split, plan_.dst_split):
                 if axx is not None:
                     phys[axx] = -(-phys[axx] // comm.size) * comm.size
-            from . import collective_prec
+            from . import collective_prec, topology
 
             # the shard_map a2a kernel quantizes per outgoing slab —
             # scales ride their own all-to-all, the per-slab max-abs is
-            # local — a2a_kernel_cost mirrors the wrapper byte-for-byte
-            a2a_cost = telemetry.collectives.a2a_kernel_cost(
-                phys, plan_.itemsize, comm.size, precision=wire,
-                block=collective_prec.block_size(),
-            )
+            # local — a2a_kernel_cost mirrors the wrapper byte-for-byte;
+            # under the tiered lowering (ISSUE 15) the wrapper's cross
+            # wire mode and hierarchical_a2a_cost take over, still
+            # byte-for-byte
+            topo = topology.active(comm.size)
+            if topo is not None:
+                a2a_wire = topology.cross_mode(buf.dtype, wire or None)
+                phys_numel = 1
+                for s_ in phys:
+                    phys_numel *= int(s_)
+                a2a_cost = telemetry.collectives.hierarchical_a2a_cost(
+                    phys_numel, plan_.itemsize, topo.node, topo.local,
+                    a2a_wire, block=collective_prec.block_size(),
+                )
+            else:
+                a2a_cost = telemetry.collectives.a2a_kernel_cost(
+                    phys, plan_.itemsize, comm.size, precision=wire,
+                    block=collective_prec.block_size(),
+                )
             telemetry.hlo.audit_call(
                 "relayout_stage",
                 lambda: (fn, (buf,)),

@@ -8,11 +8,13 @@ to ``torch.optim`` (:19-36). The TPU-native fallthrough target is **optax**:
 from . import lr_scheduler, utils
 from .dp_optimizer import DASO, DataParallelOptimizer
 from .utils import DetectMetricPlateau
+from .zero_optimizer import ZeroOptimizer
 
 __all__ = [
     "DASO",
     "DataParallelOptimizer",
     "DetectMetricPlateau",
+    "ZeroOptimizer",
     "lr_scheduler",
     "utils",
 ]

@@ -200,10 +200,16 @@ class DASO:
         devices = self.comm.devices
         p = len(devices)
         if n_nodes is None:
-            if jax.process_count() > 1:
-                n_nodes = jax.process_count()
-            elif p % 2 == 0 and p > 1:
-                n_nodes = 2  # simulated 2-node split
+            # the 2-level factorization is the shared topology capability
+            # now (ISSUE 15): HEAT_TPU_TOPOLOGY declares it, detection
+            # reproduces DASO's historic defaults exactly (process count
+            # on multi-host, the simulated 2-node split on even
+            # single-host meshes)
+            from ..core import topology as _topology
+
+            topo = _topology.resolve(p)
+            if topo.node > 1:
+                n_nodes = topo.node
             else:
                 # odd single-host meshes: every device its own "node"
                 # (local axis of 1 — DASO degenerates to pure global sync)
@@ -364,22 +370,24 @@ class DASO:
         wire = collective_prec.resolve(self._collective_precision)
         block = collective_prec.block_size()
 
+        from ..core import topology as _topology
+
         def kernel(params):
             params = jax.tree.map(lambda x: x[0], params)
             # node representative: mean over the ICI axis, reduced
             # precision on the wire, summed (not averaged) across nodes —
             # the reference transmits the raw sum and folds n_nodes into
-            # the merge denominator
+            # the merge denominator. The hop itself is the shared tier
+            # primitive now (ISSUE 15): DASO's formerly hand-rolled
+            # node-group collective routes through
+            # topology.node_mean_cross_sum, bit-equivalent to the legacy
+            # inline kernel (tests/test_hierarchy.py pins it).
             def one(x):
-                rep = jax.lax.pmean(x, "local")
-                if wire in ("int8", "blockwise") and (
-                    collective_prec.compressible(x.dtype)
-                ):
-                    return collective_prec.psum(
-                        rep, "node", n_nodes, wire, block
-                    )[None]
-                wire_cast = jnp.bfloat16 if wire == "bf16" else cast
-                return jax.lax.psum(rep.astype(wire_cast), "node")[None]
+                return _topology.node_mean_cross_sum(
+                    x, local_axis="local", node_axis="node",
+                    n_node=n_nodes, wire=wire, cast_dtype=cast,
+                    block=block,
+                )[None]
 
             return jax.tree.map(one, params)
 

@@ -171,8 +171,13 @@ def ring_attention(
                 qb, kc, vc,
                 m, l, o, rank * tc, origin * tc, scale, causal, seq_len,
             )
-            kc = jax.lax.ppermute(kc, axis, perm=perm)
-            vc = jax.lax.ppermute(vc, axis, perm=perm)
+            # the K/V hops ride the wrapper chokepoint (ISSUE 15: the
+            # cost model prices them — ring_attention_cost — and the
+            # HLO auditor sees them); exact pinned: a compressed block
+            # would re-quantize p times around the ring and drift the
+            # softmax renormalization
+            kc = comm.ppermute(kc, perm, precision="off")
+            vc = comm.ppermute(vc, perm, precision="off")
             return (kc, vc, m, l, o)
 
         kc, vc, m, l, o = jax.lax.fori_loop(0, p, body, (kb, vb, m, l, o))
@@ -208,7 +213,6 @@ def ulysses_attention(
     at its tuned tile sizes — ``block_size`` applies to the XLA path only.
     """
     p = comm.size
-    axis = comm.axis_name
     b, t_pad, h, d = q.shape
     if h % p != 0:
         raise ValueError(f"heads ({h}) must divide over mesh size ({p})")
@@ -219,10 +223,13 @@ def ulysses_attention(
     pallas_interpret = any(d.platform != "tpu" for d in comm.devices)
 
     def kernel(qb, kb, vb):
-        # (B, T/p, H, D) -> (B, T, H/p, D): gather seq, scatter heads
+        # (B, T/p, H, D) -> (B, T, H/p, D): gather seq, scatter heads.
+        # Wrapper-routed (ISSUE 15): the exchanges are priced by
+        # ulysses_attention_cost and lower tiered under
+        # HEAT_TPU_HIERARCHICAL; exact pinned — Q/K/V bits feed the
+        # softmax, compression belongs to the collective, not here.
         a2a = functools.partial(
-            jax.lax.all_to_all, axis_name=axis, split_axis=2, concat_axis=1,
-            tiled=True,
+            comm.all_to_all, split_axis=2, concat_axis=1, precision="off",
         )
         qh, kh, vh = a2a(qb), a2a(kb), a2a(vb)
         if use_pallas:
@@ -238,8 +245,7 @@ def ulysses_attention(
                 kv_valid=seq_len,
             )
         back = functools.partial(
-            jax.lax.all_to_all, axis_name=axis, split_axis=1, concat_axis=2,
-            tiled=True,
+            comm.all_to_all, split_axis=1, concat_axis=2, precision="off",
         )
         return back(oh)
 

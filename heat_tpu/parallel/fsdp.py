@@ -22,8 +22,16 @@ from __future__ import annotations
 from typing import Any
 
 import jax
+import jax.numpy as jnp
 
-__all__ = ["shard_pytree", "constrain_pytree", "replicate_pytree"]
+__all__ = [
+    "shard_pytree",
+    "constrain_pytree",
+    "replicate_pytree",
+    "flat_chunk",
+    "flat_shard_pytree",
+    "flat_unshard_leaf",
+]
 
 
 def _leaf_sharding(leaf, comm, min_size):
@@ -70,3 +78,58 @@ def replicate_pytree(tree: Any, comm) -> Any:
     return jax.tree_util.tree_map(
         lambda l: jax.device_put(l, comm.replicated()), tree
     )
+
+
+# -- flat 1/p shard layout (the ZeRO state layout, ISSUE 15) -------------------
+# ZeRO-style optimizer-state sharding (arXiv:2004.13336) flattens each
+# leaf and gives every mesh position one contiguous 1/p chunk — the layout
+# heat_tpu.optim.ZeroOptimizer builds its reduce-scatter → shard update →
+# all-gather step on. Kept here because it is the same capability family
+# as shard_pytree: placement over the mesh, XLA does the rest.
+
+
+def flat_chunk(numel: int, p: int, wire: str = "off", block: int = 128) -> int:
+    """Per-position chunk length of a flattened ``numel``-element leaf:
+    ``ceil(numel/p)``, rounded up to whole quantization blocks when the
+    gradient reduce-scatter wire is ``blockwise`` — so the compressed
+    collective's chunk boundaries coincide with the state shards
+    (one fixed point of collective_prec's clamp arithmetic)."""
+    c = -(-int(numel) // int(p))
+    if wire == "blockwise":
+        b = max(1, min(int(block), c))
+        c = -(-c // b) * b
+    return c
+
+
+def flat_shard_pytree(tree: Any, comm, wire: str = "off",
+                      block: int = 128) -> Any:
+    """Every leaf flattened, zero-padded to ``p * flat_chunk`` and placed
+    as a ``(p, chunk)`` array sharded along axis 0 — position ``i`` owns
+    flat elements ``[i*chunk, (i+1)*chunk)``."""
+    p = comm.size
+
+    def shard(l):
+        l = jnp.asarray(l)
+        c = flat_chunk(l.size, p, wire, block)
+        flat = l.reshape(-1)
+        if p * c != l.size:
+            flat = jnp.pad(flat, (0, p * c - l.size))
+        return jax.device_put(flat.reshape(p, c), comm.sharding(0, 2))
+
+    return jax.tree_util.tree_map(shard, tree)
+
+
+def flat_unshard_leaf(padded, shape, dtype=None):
+    """Invert :func:`flat_shard_pytree` for one leaf: ``(p, chunk)`` back
+    to the logical ``shape`` (pad rows sliced off). The inverse is
+    topology-independent — a leaf sharded over 4 positions unshards to
+    the same logical bytes as one sharded over 8, which is what makes
+    the ZeRO checkpoint restore cross-topology bit-exact."""
+    import numpy as np
+
+    numel = 1
+    for s in shape:
+        numel *= int(s)
+    flat = np.asarray(padded).reshape(-1)[:numel]
+    out = flat.reshape(tuple(int(s) for s in shape))
+    return out.astype(dtype) if dtype is not None else out

@@ -102,13 +102,17 @@ def pipeline_apply(
                 lambda o: o,
                 out,
             )
-            act = jax.lax.ppermute(h, axis, fwd_perm)
+            # stage->stage hop through the wrapper chokepoint (ISSUE 15:
+            # priced by pipeline_cost, visible to the HLO auditor); exact
+            # pinned — activations are the model's forward values
+            act = comm.ppermute(h, fwd_perm, precision="off")
             return act, out
 
         act, out = jax.lax.fori_loop(0, p + m - 1, tick, (act, out))
         # only the last position ever wrote `out` (others carry their zero
-        # init), so the psum both collects and replicates the result
-        return jax.lax.psum(out, axis)
+        # init), so the psum both collects and replicates the result —
+        # exact by construction (one nonzero contribution per element)
+        return comm.psum(out, precision="off")
 
     from jax.sharding import PartitionSpec as P
 

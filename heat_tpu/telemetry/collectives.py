@@ -34,7 +34,9 @@ from typing import Dict, Optional, Sequence
 __all__ = [
     "CollectiveCost",
     "DEFAULT_WIRE_BLOCK",
+    "DEFAULT_DCN_PREMIUM",
     "compression_factor",
+    "weighted_wire",
     "relayout_cost",
     "relayout_chunk_cost",
     "a2a_kernel_cost",
@@ -43,6 +45,14 @@ __all__ = [
     "gram_ring_cost",
     "fusion_reduce_cost",
     "allreduce_cost",
+    "reduce_scatter_cost",
+    "hierarchical_allreduce_cost",
+    "hierarchical_reduce_scatter_cost",
+    "hierarchical_allgather_cost",
+    "hierarchical_a2a_cost",
+    "ring_attention_cost",
+    "ulysses_attention_cost",
+    "pipeline_cost",
     "spmv_cost",
     "spmm_cost",
     "sparse_transpose_cost",
@@ -55,6 +65,12 @@ __all__ = [
 DEFAULT_WIRE_BLOCK = 128
 
 
+# Default ICI-vs-DCN byte premium. The registered knob HEAT_TPU_DCN_PREMIUM
+# carries the same value; kept here too so this module stays usable as the
+# import-light leaf it is documented to be.
+DEFAULT_DCN_PREMIUM = 8.0
+
+
 @dataclass(frozen=True)
 class CollectiveCost:
     """One collective's analytic cost.
@@ -64,15 +80,45 @@ class CollectiveCost:
     bytes : total wire bytes summed over devices (see module conventions).
     steps : number of sequential communication rounds (1 for one-shot
         collectives, p for a p-hop ring).
+    dcn_bytes : the portion of ``bytes`` that rides the slow cross-node
+        (DCN) tier of a 2-level topology (ISSUE 15). The tier assignment
+        follows the emitted replica-group structure: an op whose groups
+        stay inside one node is ICI; an op whose groups span nodes is
+        DCN. Flat lowerings on a non-trivial topology are therefore
+        all-DCN (their single group spans every node); tiered lowerings
+        charge only the cross-node stage here. 0 on 1-level meshes.
     """
 
     kind: str
     bytes: int
     steps: int = 1
+    dcn_bytes: int = 0
 
     def as_fields(self) -> Dict[str, object]:
         """Span/event field dict (`collective=`, `bytes=`, `steps=`)."""
-        return {"collective": self.kind, "bytes": self.bytes, "steps": self.steps}
+        out = {"collective": self.kind, "bytes": self.bytes, "steps": self.steps}
+        if self.dcn_bytes:
+            out["dcn_bytes"] = self.dcn_bytes
+        return out
+
+
+def weighted_wire(cost: "CollectiveCost", premium: Optional[float] = None) -> float:
+    """Topology-priced wire figure: ICI bytes at 1x plus DCN bytes at the
+    ``premium`` multiplier (default: the ``HEAT_TPU_DCN_PREMIUM`` knob).
+    This is the scalar the relayout planner and the autotuner's analytic
+    stage compare when picking tiered vs flat per program signature — on
+    a 1-level mesh (``dcn_bytes == 0``) it degenerates to plain bytes."""
+    if premium is None:
+        try:
+            from heat_tpu import _knobs as _k
+
+            premium = _k.get("HEAT_TPU_DCN_PREMIUM")
+        except Exception:  # registry unavailable: price flat
+            premium = DEFAULT_DCN_PREMIUM
+        if premium is None:
+            premium = DEFAULT_DCN_PREMIUM
+    local_bytes = int(cost.bytes) - int(cost.dcn_bytes)
+    return float(local_bytes) + float(premium) * float(cost.dcn_bytes)
 
 
 def _numel(gshape: Sequence[int]) -> int:
@@ -397,6 +443,294 @@ def allreduce_cost(
     payload = 2 * numel_p * (nproc - 1)          # a2a phase + gather phase
     scales = 2 * 2 * nproc * nb * (nproc - 1)    # bf16 scales, both phases
     return CollectiveCost("all-to-all+all-gather", payload + scales)
+
+
+def reduce_scatter_cost(
+    numel: int,
+    itemsize: int,
+    nproc: int,
+    precision: str = "off",
+    block: int = DEFAULT_WIRE_BLOCK,
+) -> CollectiveCost:
+    """Cost of one flat ``MeshCommunication.reduce_scatter`` of a
+    ``numel``-element payload (the payload is flattened and zero-padded to
+    ``p`` equal chunks in flight — the physical figure counted here):
+
+    * ``off``/narrow — ring reduce-scatter, ``B_pad · (p-1)``
+      (per-participant operand ``B_pad``, the hlo.py wire model);
+    * ``bf16`` — the same reduce-scatter on a bf16 payload;
+    * ``int8``/``blockwise`` — the EQuARX first phase standing alone
+      (``collective_prec.reduce_scatter``): an all-to-all of each
+      device's quantized per-destination sub-chunks plus their scales,
+      dequantize + accumulate on the receiver. Mirrors the
+      implementation byte-for-byte.
+    """
+    numel, itemsize = int(numel), int(itemsize)
+    if nproc <= 1:
+        return CollectiveCost("none", 0)
+    chunk = -(-numel // nproc)
+    if precision == "off" or itemsize <= 1 or (
+        precision == "bf16" and itemsize <= 2
+    ):
+        return CollectiveCost(
+            "reduce-scatter", chunk * nproc * itemsize * (nproc - 1)
+        )
+    if precision == "bf16":
+        return CollectiveCost(
+            "reduce-scatter", chunk * nproc * 2 * (nproc - 1)
+        )
+    if precision == "blockwise":
+        blk = max(1, min(int(block), chunk))
+        chunk = -(-chunk // blk) * blk
+        nb = chunk // blk
+    else:
+        nb = 1
+    payload = chunk * nproc * (nproc - 1)            # int8 a2a phase
+    scales = 2 * nproc * nb * (nproc - 1)            # bf16 scales alongside
+    return CollectiveCost("all-to-all", payload + scales)
+
+
+# -- hierarchy-aware tiered lowerings (ISSUE 15, core/topology.py) ------------
+# Per-tier conventions: the in-node (ICI) tier always moves exact payloads;
+# ``cross_precision`` is the wire mode of the cross-node (DCN) tier only.
+# ``dcn_bytes`` carries the cross-node stage's volume so weighted_wire can
+# price the DCN premium. Each function mirrors the topology.py lowering
+# byte-for-byte so the HLO audit of a tiered program stays zero-drift.
+
+
+def _hier_chunk(numel: int, local: int) -> int:
+    """Per-device shard length of the in-node reduce-scatter: the flat
+    payload zero-padded to ``local`` equal chunks."""
+    return -(-int(numel) // int(local))
+
+
+def hierarchical_allreduce_cost(
+    numel: int,
+    itemsize: int,
+    node: int,
+    local: int,
+    cross_precision: str = "off",
+    block: int = DEFAULT_WIRE_BLOCK,
+) -> CollectiveCost:
+    """Cost of one tiered all-reduce (``MeshCommunication.psum`` under
+    ``HEAT_TPU_HIERARCHICAL=1`` on a ``node x local`` topology):
+
+    1. **in-node reduce-scatter** (ICI, exact) of the padded flat payload
+       — ``B_pad · (local-1) · node`` wire bytes, node groups;
+    2. **cross-node all-reduce** (DCN) of the ``1/local``-sized shard —
+       each device's cross payload is ``B_pad/local``, exactly the shard
+       factor the acceptance oracle pins; ``local`` cross groups of
+       ``node`` participants. ``cross_precision`` compresses THIS stage
+       only (bf16 payload, or the EQuARX two-phase form per group);
+    3. **in-node all-gather** (ICI, exact) of the reduced shard —
+       ``B_pad · (local-1) · node``.
+
+    Degenerate topologies (``node == 1`` or ``local == 1``) lower flat
+    (:func:`allreduce_cost`) — a 1-level hierarchy IS the flat ring.
+    """
+    numel, itemsize = int(numel), int(itemsize)
+    node, local = int(node), int(local)
+    p = node * local
+    if p <= 1:
+        return CollectiveCost("none", 0)
+    if node == 1 or local == 1:
+        return allreduce_cost(numel, itemsize, p, cross_precision, block)
+    chunk = _hier_chunk(numel, local)
+    n_pad = chunk * local
+    tier_ici = n_pad * itemsize * (local - 1) * node  # rs == ag volume
+    if cross_precision in ("int8", "blockwise") and itemsize > 1:
+        per_group = allreduce_cost(
+            chunk, itemsize, node, cross_precision, block
+        )
+        cross = per_group.bytes * local
+        kind = "reduce-scatter+all-to-all+all-gather"
+    else:
+        wire = itemsize
+        if cross_precision == "bf16" and itemsize > 2:
+            wire = 2
+        cross = 2 * chunk * wire * (node - 1) * local
+        kind = "reduce-scatter+all-reduce+all-gather"
+    return CollectiveCost(
+        kind, tier_ici * 2 + cross, dcn_bytes=cross
+    )
+
+
+def hierarchical_reduce_scatter_cost(
+    numel: int,
+    itemsize: int,
+    node: int,
+    local: int,
+    cross_precision: str = "off",
+    block: int = DEFAULT_WIRE_BLOCK,
+) -> CollectiveCost:
+    """Cost of one tiered reduce-scatter: in-node reduce-scatter (ICI,
+    exact) to the ``1/local`` shard, then a cross-node reduce-scatter of
+    that shard (DCN, ``cross_precision``-priced) down to the global
+    ``1/p`` chunk. Degenerates to :func:`reduce_scatter_cost` on 1-level
+    topologies."""
+    numel, itemsize = int(numel), int(itemsize)
+    node, local = int(node), int(local)
+    p = node * local
+    if p <= 1:
+        return CollectiveCost("none", 0)
+    if node == 1 or local == 1:
+        return reduce_scatter_cost(numel, itemsize, p, cross_precision, block)
+    # stage 1 pads to p (not just local) chunks so stage 2 scatters evenly
+    chunk_p = -(-numel // p)
+    n_pad = chunk_p * p
+    chunk = n_pad // local
+    tier_ici = n_pad * itemsize * (local - 1) * node
+    per_group = reduce_scatter_cost(
+        chunk, itemsize, node, cross_precision, block
+    )
+    cross = per_group.bytes * local
+    kind = "reduce-scatter" if per_group.kind == "reduce-scatter" else (
+        "reduce-scatter+" + per_group.kind
+    )
+    return CollectiveCost(kind, tier_ici + cross, dcn_bytes=cross)
+
+
+def hierarchical_allgather_cost(
+    shard_numel: int,
+    itemsize: int,
+    node: int,
+    local: int,
+    cross_precision: str = "off",
+    block: int = DEFAULT_WIRE_BLOCK,
+) -> CollectiveCost:
+    """Cost of one tiered all-gather of a per-device ``shard_numel``
+    payload: cross-node gather first (DCN — each device receives its
+    ``node-1`` peer shards), then the in-node gather of the stacked
+    blocks (ICI). Compressed modes quantize ONCE at the source and move
+    the compressed payload through both stages (the scales ride both
+    gathers), so the error bound is one quantization step — identical to
+    the flat compressed gather. Exact total equals the flat
+    ``p·s·(p-1)`` volume; only the tier split changes."""
+    s, itemsize = int(shard_numel), int(itemsize)
+    node, local = int(node), int(local)
+    p = node * local
+    if p <= 1:
+        return CollectiveCost("none", 0)
+    wire = itemsize
+    scale_elems = 0
+    if itemsize > 1 and cross_precision == "bf16":
+        wire = min(itemsize, 2)
+    elif itemsize > 1 and cross_precision == "int8":
+        wire, scale_elems = 1, 1
+    elif itemsize > 1 and cross_precision == "blockwise":
+        seg = max(1, min(int(block), s))
+        nb = max(1, -(-s // seg))
+        s_padded = nb * seg
+        wire, scale_elems, s = 1, nb, s_padded
+    if node == 1 or local == 1:
+        return CollectiveCost(
+            "all-gather",
+            p * (p - 1) * (s * wire + scale_elems * 2),
+        )
+    cross = (s * wire + scale_elems * 2) * (node - 1) * p
+    ici = node * (s * wire + scale_elems * 2) * (local - 1) * p
+    return CollectiveCost("all-gather", cross + ici, dcn_bytes=cross)
+
+
+def hierarchical_a2a_cost(
+    phys_numel: int,
+    itemsize: int,
+    node: int,
+    local: int,
+    cross_precision: str = "off",
+    block: int = DEFAULT_WIRE_BLOCK,
+) -> CollectiveCost:
+    """Cost of one tiered all-to-all on the PHYSICAL (pad-inclusive)
+    global element count: stage A exchanges destination-local slabs
+    inside each node (ICI), stage B exchanges destination-node bundles
+    across nodes (DCN). Total volume is ``B·((local-1)/local +
+    (node-1)/node)`` — slightly above the flat ``B·(p-1)/p`` — but the
+    DCN tier carries only the ``(node-1)/node`` share as ``local``-way
+    aggregated transfers, which is what the premium pricing rewards.
+    Compressed modes quantize per final-destination slab at the source
+    (the :func:`a2a_kernel_cost` slab scheme) and move payload + scales
+    through both stages."""
+    numel, itemsize = int(phys_numel), int(itemsize)
+    node, local = int(node), int(local)
+    p = node * local
+    if p <= 1:
+        return CollectiveCost("none", 0)
+    if node == 1 or local == 1:
+        return a2a_kernel_cost((numel,), itemsize, p, cross_precision, block)
+    if cross_precision == "off" or itemsize <= 1:
+        total_payload = numel * itemsize
+    elif cross_precision == "bf16":
+        total_payload = numel * min(itemsize, 2)
+    else:
+        m = numel // (p * p)
+        if cross_precision == "int8":
+            nb, seg = 1, m
+        else:
+            seg = max(1, min(int(block), m))
+            nb = max(1, -(-m // seg))
+        total_payload = p * p * (nb * seg + nb * 2)
+    ici = total_payload * (local - 1) // local
+    cross = total_payload * (node - 1) // node
+    return CollectiveCost("all-to-all", ici + cross, dcn_bytes=cross)
+
+
+# -- attention / pipeline kernels (the last unpriced collectives) -------------
+
+
+def ring_attention_cost(
+    b: int, t: int, h: int, d: int, itemsize: int, nproc: int
+) -> CollectiveCost:
+    """Cost of :func:`heat_tpu.parallel.ring_attention`: the K and V
+    blocks — each ``(b, t/p, h, d)`` — circulate one ring hop per step
+    for ``p`` steps (the serial fori_loop permutes on every iteration,
+    including the final home hop), two collective-permutes per step.
+    The stationary Q never touches the wire."""
+    if nproc <= 1:
+        return CollectiveCost("none", 0)
+    per_hop = 2 * int(b) * (int(t) // nproc) * int(h) * int(d) * int(itemsize)
+    return CollectiveCost(
+        "ppermute-ring", nproc * nproc * per_hop, steps=nproc
+    )
+
+
+def ulysses_attention_cost(
+    b: int, t: int, h: int, d: int, itemsize: int, nproc: int
+) -> CollectiveCost:
+    """Cost of :func:`heat_tpu.parallel.ulysses_attention`: three
+    all-to-alls reshard Q/K/V sequence->heads and one reshards the
+    output back — four exchanges of the full ``(b, t, h, d)`` tensor at
+    the analytic all-to-all volume ``B·(p-1)/p`` each."""
+    if nproc <= 1:
+        return CollectiveCost("none", 0)
+    full = int(b) * int(t) * int(h) * int(d) * int(itemsize)
+    return CollectiveCost("all-to-all", 4 * (full * (nproc - 1)) // nproc)
+
+
+def pipeline_cost(
+    batch: int,
+    feat_numel: int,
+    itemsize: int,
+    nproc: int,
+    n_microbatches: int,
+) -> CollectiveCost:
+    """Cost of :func:`heat_tpu.parallel.pipeline_apply` (GPipe schedule):
+    every one of the ``p + m - 1`` ticks permutes each stage's activation
+    — a ``(batch/m, feat)`` microbatch on all ``p`` positions — one hop
+    forward, then one final all-reduce both collects and replicates the
+    ``(batch, feat)`` output buffer (only the last stage ever wrote it)."""
+    if nproc <= 1:
+        return CollectiveCost("none", 0)
+    m = int(n_microbatches)
+    mb_bytes = (int(batch) // m) * int(feat_numel) * int(itemsize)
+    ticks = nproc + m - 1
+    ring = ticks * nproc * mb_bytes
+    out_bytes = int(batch) * int(feat_numel) * int(itemsize)
+    # the out accumulator carries the microbatch-major (m, b/m, feat)
+    # buffer on every position: a full-batch payload per participant
+    allreduce = 2 * out_bytes * (nproc - 1)
+    return CollectiveCost(
+        "ppermute-ring+all-reduce", ring + allreduce, steps=ticks
+    )
 
 
 def spmm_cost(

@@ -74,6 +74,7 @@ def summarize(
     sn_events: dict = {}
     sp_events: dict = {}
     plan_counts: dict = {}
+    hier_rows: dict = {}
     plan_last: Optional[dict] = None
     plan_wire = 0
     pc_evictions = 0
@@ -105,6 +106,22 @@ def summarize(
         elif kind == "collective_trace":
             name = ev.get("name")
             traced[name] = traced.get(name, 0) + 1
+            if ev.get("hier"):
+                # tiered-lowering rows (ISSUE 15): per wrapper, how many
+                # hierarchical programs were traced, on what topology,
+                # and the analytic per-tier split (total vs DCN bytes —
+                # the cross-node stage the DCN premium prices)
+                hrow = hier_rows.setdefault(
+                    name,
+                    {"traced": 0, "topology": ev.get("hier"),
+                     "bytes": 0, "dcn_bytes": 0, "wire": {}},
+                )
+                hrow["traced"] += 1
+                hrow["topology"] = ev.get("hier")
+                hrow["bytes"] += int(ev.get("bytes", 0) or 0)
+                hrow["dcn_bytes"] += int(ev.get("dcn_bytes", 0) or 0)
+                w = ev.get("wire") or "off"
+                hrow["wire"][w] = hrow["wire"].get(w, 0) + 1
         elif kind == "program_cache":
             if ev.get("event") == "retrace":
                 name = ev.get("name")
@@ -194,6 +211,17 @@ def summarize(
         "traced_collectives": traced,
         "events": n,
     }
+    if hier_rows:
+        # hierarchy view (core/topology.py, ISSUE 15): per tiered
+        # wrapper, traced-program counts, the (node x local) topology,
+        # the analytic total-vs-DCN byte split, and the cross-tier wire
+        # modes seen. Absent when no tiered program was traced, so flat
+        # summaries keep their exact shape.
+        out["hierarchy"] = {
+            "collectives": hier_rows,
+            "dcn_bytes": sum(r["dcn_bytes"] for r in hier_rows.values()),
+            "bytes": sum(r["bytes"] for r in hier_rows.values()),
+        }
     if plan_counts:
         # relayout-planner decisions (core/relayout_planner.py): how many
         # relayouts planned per plan kind, the summed predicted wire

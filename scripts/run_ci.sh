@@ -1267,6 +1267,190 @@ EOF
     fi
 fi
 
+# Hierarchy gate (ISSUE 15): on the emulated 2x2 mesh — flat-vs-tiered
+# digest bit-identity for exact payloads, audited cross-node wire-byte
+# reduction >= the 1/local shard factor (x the PR 9 compression factor
+# under a cross-tier precision), DASO send bit-equivalence through the
+# shared tier primitive, and the ZeRO sharded-state watermark strictly
+# below the replicated base. HEAT_TPU_CI_SKIP_HIERARCHY=1 opts out.
+if [ -z "${HEAT_TPU_CI_SKIP_HIERARCHY:-}" ]; then
+    echo "=== hierarchy gate: tiered collectives + ZeRO (emulated 2x2 mesh) ==="
+    hier_rc=0
+    hier_out=$(mktemp)
+    XLA_FLAGS="--xla_force_host_platform_device_count=4" JAX_PLATFORMS=cpu \
+        HEAT_TPU_TOPOLOGY=2x2 \
+        python - <<'EOF' > "$hier_out" 2>&1 || hier_rc=$?
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import heat_tpu as ht
+from heat_tpu.telemetry import collectives as model, hlo
+
+comm = ht.get_comm()
+p = comm.size
+assert p == 4, f"expected a 4-device mesh, got {p}"
+topo = comm.topology()
+assert (topo.node, topo.local) == (2, 2), topo
+report = {"mesh": p, "topology": topo.describe()}
+spec = comm.spec(0, 2)
+
+
+def run(kernel, x):
+    return jax.shard_map(
+        kernel, mesh=comm.mesh, in_specs=spec, out_specs=spec
+    )(x)
+
+
+# -- flat-vs-tiered digest bit-identity (exact payloads) ---------------------
+rng = np.random.default_rng(0)
+xi = jnp.asarray(np.round(rng.standard_normal((4, 1027)) * 8).astype(np.float32))
+xs = jax.device_put(xi, comm.sharding(0, 2))
+digests = {}
+for hier in ("0", "1"):
+    os.environ["HEAT_TPU_HIERARCHICAL"] = hier
+    out = {
+        "psum": np.asarray(run(lambda v: comm.psum(v), xs)),
+        "gather": np.asarray(run(lambda v: comm.all_gather(v)[: v.shape[0]], xs)),
+        "rs": np.asarray(run(lambda v: comm.reduce_scatter(v).reshape(1, -1), xs)),
+    }
+    digests[hier] = {k: v.tobytes() for k, v in out.items()}
+for k in digests["0"]:
+    if digests["0"][k] != digests["1"][k]:
+        raise SystemExit(f"hierarchy: {k} tiered digest != flat digest")
+
+# -- audited cross-node byte reduction >= local shard factor ------------------
+n = 4096
+xb = jax.device_put(jnp.ones((4, n), jnp.float32), comm.sharding(0, 2))
+os.environ["HEAT_TPU_HIERARCHICAL"] = "0"
+aud_flat = hlo.audit_computation(
+    lambda v: jax.shard_map(lambda b: comm.psum(b), mesh=comm.mesh,
+                            in_specs=spec, out_specs=spec)(v), xb)
+os.environ["HEAT_TPU_HIERARCHICAL"] = "1"
+aud_hier = hlo.audit_computation(
+    lambda v: jax.shard_map(lambda b: comm.psum(b), mesh=comm.mesh,
+                            in_specs=spec, out_specs=spec)(v), xb)
+flat_ar = [c for c in aud_flat.collectives if c.op == "all-reduce"]
+cross = [c for c in aud_hier.collectives if c.op == "all-reduce"]
+assert len(flat_ar) == 1 and len(cross) == 1
+if flat_ar[0].in_bytes != cross[0].in_bytes * topo.local:
+    raise SystemExit(
+        f"hierarchy: cross-node payload {cross[0].in_bytes} is not the "
+        f"1/{topo.local} shard of the flat {flat_ar[0].in_bytes}"
+    )
+reduction = flat_ar[0].wire_bytes / cross[0].wire_bytes
+if reduction < topo.local:
+    raise SystemExit(
+        f"hierarchy: cross wire reduction {reduction:.2f}x below the "
+        f"{topo.local}x shard factor"
+    )
+pred = model.hierarchical_allreduce_cost(n, 4, topo.node, topo.local)
+rep = hlo.compare(aud_hier, pred)
+if not rep.ok:
+    raise SystemExit(
+        f"hierarchy: tiered psum audit drifted: {json.dumps(rep.summary())}"
+    )
+report["cross_reduction"] = round(reduction, 2)
+
+# x the PR 9 compression factor under a cross-tier precision
+aud_q = hlo.audit_computation(
+    lambda v: jax.shard_map(lambda b: comm.psum(b, precision="int8"),
+                            mesh=comm.mesh, in_specs=spec,
+                            out_specs=spec)(v), xb)
+pred_q = model.hierarchical_allreduce_cost(n, 4, topo.node, topo.local, "int8")
+rep_q = hlo.compare(aud_q, pred_q)
+if not rep_q.ok:
+    raise SystemExit(
+        f"hierarchy: int8 cross-tier audit drifted: "
+        f"{json.dumps(rep_q.summary())}"
+    )
+if pred_q.dcn_bytes * 3.5 > pred.dcn_bytes:
+    raise SystemExit(
+        f"hierarchy: int8 cross tier did not compress "
+        f"({pred_q.dcn_bytes} vs exact {pred.dcn_bytes})"
+    )
+report["dcn_bytes"] = {"exact": pred.dcn_bytes, "int8": pred_q.dcn_bytes}
+
+# -- DASO send bit-equivalence through the tier primitive ---------------------
+os.environ.pop("HEAT_TPU_HIERARCHICAL", None)
+from jax.sharding import PartitionSpec as P
+
+daso = ht.optim.DASO(optax.sgd(0.05), total_epochs=2)
+params = daso.stack_params(
+    {"w": jnp.asarray(rng.standard_normal((24, 3)).astype(np.float32))}
+)
+
+
+def legacy_send(params):
+    cast = daso.cast_dtype
+
+    def kernel(params):
+        params = jax.tree.map(lambda x: x[0], params)
+
+        def one(x):
+            rep = jax.lax.pmean(x, "local")
+            return jax.lax.psum(rep.astype(cast), "node")[None]
+
+        return jax.tree.map(one, params)
+
+    stacked = P(("node", "local"))
+    specs_p = jax.tree.map(lambda _: stacked, params)
+    return jax.shard_map(
+        kernel, mesh=daso.mesh, in_specs=(specs_p,), out_specs=specs_p
+    )(params)
+
+
+got = daso._get_global_send()(params)
+want = legacy_send(params)
+for a, b in zip(jax.tree.leaves(got), jax.tree.leaves(want)):
+    if np.asarray(a).tobytes() != np.asarray(b).tobytes():
+        raise SystemExit("hierarchy: DASO tiered send != legacy send bits")
+
+# -- ZeRO watermark: sharded state strictly below replicated ------------------
+params0 = {"w": jnp.asarray(rng.standard_normal((512, 8)).astype(np.float32))}
+zo = ht.optim.ZeroOptimizer(optax.adam(1e-2))
+dp = ht.optim.DataParallelOptimizer(optax.adam(1e-2))
+zb = zo.state_bytes_per_device(zo.init(params0))
+db = sum(np.asarray(l).nbytes for l in jax.tree.leaves(dp.init(params0)))
+if not (0 < zb < db):
+    raise SystemExit(
+        f"hierarchy: ZeRO state bytes/device {zb} not strictly below "
+        f"replicated {db}"
+    )
+# and the trajectory matches the replicated base
+grads = jax.tree.map(
+    lambda l: jnp.asarray(rng.standard_normal(l.shape).astype(np.float32)),
+    params0,
+)
+zp, zs = params0, zo.init(params0)
+pp, ps = params0, dp.init(params0)
+for _ in range(4):
+    zp, zs = zo.step(zp, zs, grads)
+    pp, ps = dp.step(pp, ps, grads)
+drift = max(
+    float(np.abs(np.asarray(a) - np.asarray(b)).max())
+    for a, b in zip(jax.tree.leaves(zp), jax.tree.leaves(pp))
+)
+if drift > 1e-6:
+    raise SystemExit(f"hierarchy: ZeRO trajectory drifted {drift}")
+report["zero_state_bytes"] = {"sharded_per_device": zb, "replicated": db}
+print(json.dumps({"hierarchy": "ok", **report}))
+EOF
+    cat "$hier_out"
+    if [ -n "$REPORT" ]; then
+        cp "$hier_out" "${REPORT}/hierarchy_gate.log" || true
+    fi
+    rm -f "$hier_out"
+    if [ "$hier_rc" != 0 ]; then
+        echo "=== hierarchy gate FAILED (rc=$hier_rc) ==="
+        FAILED_SIZES="$FAILED_SIZES hierarchy"
+    fi
+fi
+
 if [ "$have_coverage" = 1 ]; then
     # merge the per-size coverage files, as the reference CI merges its
     # 8 mpirun passes (Jenkinsfile:33-44 / codecov)

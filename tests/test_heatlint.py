@@ -548,7 +548,21 @@ class TestCLI:
         out = json.loads(capsys.readouterr().out)
         assert rc == 0 and out["new"] == 0
         assert out["files"] > 100
-        assert out["suppressed"] and out["baselined"]
+        # ISSUE 15 retired the last grandfathered findings (the unpriced
+        # attention/pipeline collectives): the committed baseline is
+        # EMPTY now and must stay that way — suppressions (which carry
+        # inline reasons) remain the only sanctioned escape hatch
+        assert out["suppressed"] and not out["baselined"]
+
+    def test_committed_baseline_is_empty(self):
+        """The baseline-shrink oracle (ISSUE 15 satellite): ROADMAP item
+        3 retires the 6 grandfathered HL002 attention/pipeline entries —
+        they route through the MeshCommunication wrappers now, priced by
+        ring_attention_cost/ulysses_attention_cost/pipeline_cost. Zero
+        entries of ANY rule may ever be grandfathered again."""
+        with open(os.path.join(REPO, ".heatlint-baseline.json")) as f:
+            baseline = json.load(f)
+        assert baseline["findings"] == []
 
     def test_exit_one_on_new_finding(self, tmp_path, capsys):
         from heat_tpu.analysis.__main__ import main
