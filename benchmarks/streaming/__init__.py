@@ -1,0 +1,3 @@
+"""Streaming benchmarks (ISSUE 16): out-of-core ingestion throughput +
+bounded-memory watermark, and the versioned rolling-update serving
+p99-under-roll vs steady-state comparison."""

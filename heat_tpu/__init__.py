@@ -58,6 +58,11 @@ from . import nn
 from . import optim
 from . import serve
 
+# streaming (ISSUE 16) mounts after the estimators and the serving tier
+# it composes: online partial_fit estimators, out-of-core ChunkStream
+# ingestion, and the versioned fit-while-serve rolling-update driver
+from . import streaming
+
 # the measured-feedback knob autotuner (ISSUE 11) mounts last: it
 # consumes the substrate (knobs registry, telemetry, cost model, program
 # cache) and is consulted from dispatch sites only behind the

@@ -437,6 +437,22 @@ _register(
     scope="bench",
 )
 
+# -- streaming knobs (ISSUE 16; docs/STREAMING.md) ----------------------------
+
+_register(
+    "HEAT_TPU_STREAM_CHUNK_ROWS", "int", 0,
+    "streaming.ChunkStream: rows per out-of-core chunk. 0 = auto-size "
+    "so the chunk's device bytes fit memory_guard.temp_budget() "
+    "(a quarter of HEAT_TPU_HBM_BUDGET when armed).",
+)
+
+_register(
+    "HEAT_TPU_STREAM_DRAIN_TIMEOUT", "float", 60.0,
+    "streaming.rolling_update: seconds an old replica may take to drain "
+    "its backlog before the roll fails loudly (the version-swap drain "
+    "policy).",
+)
+
 # -- test-suite knobs ---------------------------------------------------------
 
 _register(
@@ -482,6 +498,12 @@ for _name, _doc in (
      "digest bit-identical to the dense reference mask-matmul, "
      "budget-bounded transpose, zero HLO-audit drift on the sparse "
      "collective sites)."),
+    ("HEAT_TPU_CI_SKIP_STREAMING", "Skip the streaming gate (ISSUE 16: "
+     "2-file HDF5 out-of-core stream under a pinned HEAT_TPU_HBM_BUDGET "
+     "that forbids load-all, watermark strictly below the load-all "
+     "bytes, digest parity vs the in-memory fit, and a 2-replica "
+     "rolling update with zero steady-state compiles and zero failed "
+     "requests)."),
     ("HEAT_TPU_CI_SKIP_HIERARCHY", "Skip the hierarchy gate (ISSUE 15: "
      "flat-vs-tiered digest bit-identity on the emulated 2x2 mesh, "
      "audited cross-node byte reduction >= the local shard factor, "

@@ -44,16 +44,15 @@ def _lloyd_step(xb: jax.Array, w: jax.Array, centers: jax.Array):
     return new_centers, labels, inertia, shift
 
 
-@partial(jax.jit, static_argnames=("max_iter",))
-def _lloyd_fit_carry(
+def _lloyd_window(
     xb: jax.Array, w: jax.Array, centers: jax.Array, shift0, max_iter: int, tol
 ):
-    """A resumable window of Lloyd iterations: same body as
-    :func:`_lloyd_fit`, but the convergence carry (``shift``) enters and
-    leaves the program, so the checkpoint driver can run the fit as exact
-    chunks — ``k`` windows of ``checkpoint_every`` iterations apply the
-    identical per-iteration math as one uninterrupted ``while_loop``
-    (the resume-equivalence oracle in tests/test_resilience.py)."""
+    """The traceable body of :func:`_lloyd_fit_carry` — a resumable
+    window of Lloyd iterations with the convergence carry entering and
+    leaving. Split out so the streaming mini-batch updater
+    (:class:`heat_tpu.streaming.MiniBatchKMeans`) can compose the SAME
+    window math inside its own cached program (one program per chunk
+    shape) instead of re-deriving the iteration."""
 
     def cond(carry):
         _, it, shift = carry
@@ -64,10 +63,20 @@ def _lloyd_fit_carry(
         new_c, _, _, shift = _lloyd_step.__wrapped__(xb, w, c)
         return new_c, it + 1, shift
 
-    centers, n_iter, shift = jax.lax.while_loop(
-        cond, body, (centers, jnp.int32(0), shift0)
-    )
-    return centers, n_iter, shift
+    return jax.lax.while_loop(cond, body, (centers, jnp.int32(0), shift0))
+
+
+@partial(jax.jit, static_argnames=("max_iter",))
+def _lloyd_fit_carry(
+    xb: jax.Array, w: jax.Array, centers: jax.Array, shift0, max_iter: int, tol
+):
+    """A resumable window of Lloyd iterations: same body as
+    :func:`_lloyd_fit`, but the convergence carry (``shift``) enters and
+    leaves the program, so the checkpoint driver can run the fit as exact
+    chunks — ``k`` windows of ``checkpoint_every`` iterations apply the
+    identical per-iteration math as one uninterrupted ``while_loop``
+    (the resume-equivalence oracle in tests/test_resilience.py)."""
+    return _lloyd_window(xb, w, centers, shift0, max_iter, tol)
 
 
 @jax.jit

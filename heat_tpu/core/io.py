@@ -27,6 +27,7 @@ from .factories import array as _array
 from .stride_tricks import sanitize_axis
 
 __all__ = [
+    "dataset_shape",
     "load",
     "load_csv",
     "load_npy",
@@ -367,11 +368,75 @@ def save_csv(data: DNDarray, path: str, header_lines: Optional[str] = None, sep:
     _atomic_write(path, write)
 
 
-def load_npy(path: str, dtype=None, split=None, device=None, comm=None) -> DNDarray:
+def _check_chunks(chunks, nrows: int, path: str) -> tuple:
+    """Validate a ``chunks=(start, stop)`` half-open row range against a
+    file's leading dimension (ISSUE 16: the out-of-core read path).
+    Returns the normalized ``(start, stop)`` ints; raises the documented
+    clear errors instead of letting a silent short read through."""
+    try:
+        start, stop = (int(chunks[0]), int(chunks[1]))
+        if len(chunks) != 2:
+            raise TypeError
+    except (TypeError, ValueError, IndexError):
+        raise TypeError(
+            f"chunks must be a (start, stop) row-range pair, got {chunks!r}"
+        ) from None
+    if start < 0 or stop < 0:
+        raise ValueError(
+            f"chunks=({start}, {stop}): negative row indices are not "
+            f"supported for chunked reads"
+        )
+    if start >= stop:
+        raise ValueError(
+            f"chunks=({start}, {stop}) is an empty row range — a chunked "
+            f"read needs start < stop"
+        )
+    if stop > nrows:
+        raise ValueError(
+            f"chunks=({start}, {stop}) is a truncated final chunk: "
+            f"{path!r} has only {nrows} rows — clamp stop to the row "
+            f"count (ChunkStream does this for you)"
+        )
+    return start, stop
+
+
+def dataset_shape(path: str, dataset: Optional[str] = None) -> tuple:
+    """The on-disk shape of an array file WITHOUT materializing it:
+    ``.npy`` header peek (memory map) or HDF5 dataset metadata. The
+    chunk-sizing primitive of :class:`heat_tpu.streaming.ChunkStream`."""
+    if dataset is not None or path.endswith((".h5", ".hdf5")):
+        if not __HDF5:
+            raise RuntimeError(
+                "hdf5 is required for this operation (h5py not available)"
+            )
+        if dataset is None:
+            raise ValueError(
+                f"dataset_shape({path!r}) needs dataset= for HDF5 files"
+            )
+        with h5py.File(path, "r") as handle:
+            return tuple(handle[dataset].shape)
+    try:
+        data = np.load(path, mmap_mode="r", allow_pickle=False)
+    except (ValueError, OSError, EOFError) as e:
+        raise ValueError(
+            f"dataset_shape: {path!r} is not a readable .npy array file "
+            f"({e})"
+        ) from None
+    return tuple(data.shape)
+
+
+def load_npy(
+    path: str, dtype=None, split=None, device=None, comm=None, chunks=None
+) -> DNDarray:
     """Load a numpy .npy file (extension; memory-maps then shards).
 
     Multi-host with ``split``: the memory map means each process touches
-    ONLY its canonical slab's pages — per-process slab reads for free."""
+    ONLY its canonical slab's pages — per-process slab reads for free.
+
+    ``chunks=(start, stop)`` (ISSUE 16) reads ONLY that half-open row
+    block — the memory map touches just those pages, so a caller can
+    walk a file far larger than the budget. Out-of-bounds ranges raise
+    (see :func:`_check_chunks`) rather than silently short-reading."""
     import jax
 
     try:
@@ -387,6 +452,21 @@ def load_npy(path: str, dtype=None, split=None, device=None, comm=None) -> DNDar
         raise ValueError(
             f"load_npy: {path!r} holds dtype=object data, which has no "
             "DNDarray representation — save numeric arrays only"
+        )
+    if chunks is not None:
+        if jax.process_count() > 1:
+            raise NotImplementedError(
+                "chunked (row-range) reads are single-controller; "
+                "multi-host runs use the per-process slab path instead"
+            )
+        if data.ndim == 0:
+            raise ValueError(
+                f"load_npy: {path!r} is 0-d — chunked reads need a row axis"
+            )
+        start, stop = _check_chunks(chunks, data.shape[0], path)
+        return _array(
+            np.asarray(data[start:stop]), dtype=dtype, split=split,
+            device=device, comm=comm,
         )
     if jax.process_count() > 1 and split is not None:
         c = sanitize_comm(comm)
@@ -529,6 +609,7 @@ def load_hdf5(
     split: Optional[int] = None,
     device=None,
     comm=None,
+    chunks=None,
 ) -> DNDarray:
     """Load an HDF5 dataset (reference io.py:55 reads per-rank slices
     ``f[dataset][slices]``).
@@ -536,7 +617,13 @@ def load_hdf5(
     Single-controller: one host read + shard. Multi-host with ``split``:
     every process reads ONLY its canonical slab of the dataset (an h5py
     range read — the file is never materialized whole on any host) and the
-    slabs assemble via ``is_split``."""
+    slabs assemble via ``is_split``.
+
+    ``chunks=(start, stop)`` (ISSUE 16) reads ONLY that half-open row
+    block (an h5py range read — the reference's ``PartialH5Dataset``
+    access pattern, feeding :class:`heat_tpu.streaming.ChunkStream`).
+    Out-of-bounds ranges raise the documented truncated-final-chunk /
+    empty-range errors instead of silently short-reading."""
     if not __HDF5:
         raise RuntimeError("hdf5 is required for this operation (h5py not available)")
     if not isinstance(path, str):
@@ -545,6 +632,24 @@ def load_hdf5(
         raise TypeError(f"dataset must be str, not {type(dataset)}")
     import jax
 
+    if chunks is not None:
+        if jax.process_count() > 1:
+            raise NotImplementedError(
+                "chunked (row-range) reads are single-controller; "
+                "multi-host runs use the per-process slab path instead"
+            )
+        with h5py.File(path, "r") as handle:
+            ds = handle[dataset]
+            if len(ds.shape) == 0:
+                raise ValueError(
+                    f"load_hdf5: {path!r}:{dataset} is 0-d — chunked "
+                    f"reads need a row axis"
+                )
+            start, stop = _check_chunks(chunks, ds.shape[0], path)
+            block = np.asarray(ds[start:stop])
+        return _array(
+            block, dtype=dtype, split=split, device=device, comm=comm
+        )
     if jax.process_count() > 1 and split is not None:
         c = sanitize_comm(comm)
         with h5py.File(path, "r") as handle:

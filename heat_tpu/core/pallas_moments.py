@@ -26,7 +26,12 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["column_moments", "sharded_column_moments", "pallas_moments_applicable"]
+__all__ = [
+    "chan_merge",
+    "column_moments",
+    "sharded_column_moments",
+    "pallas_moments_applicable",
+]
 
 _I0 = np.int32(0)
 _MAX_D = 4096  # (bm, dp) f32 block + 4 (8, dp) accumulators must fit VMEM
@@ -34,6 +39,26 @@ _MAX_D = 4096  # (bm, dp) f32 block + 4 (8, dp) accumulators must fit VMEM
 
 def _round_up(v: int, m: int) -> int:
     return -(-v // m) * m
+
+
+def chan_merge(na, mean_a, m2_a, nb, mean_b, m2_b):
+    """Chan/Welford pairwise combine of two (count, mean, M2) moment
+    carries — the SAME merge rule the kernel applies across row blocks
+    (``_moments_kernel``) and :func:`sharded_column_moments` applies
+    across shards, exposed as the mergeable-carry algebra of
+    :class:`heat_tpu.streaming.StreamingMoments`: ``partial_fit`` chunks
+    combine associatively through this exact formula, so a resumed
+    stream reproduces the uninterrupted carry bit-for-bit. Host-side
+    arithmetic (python/numpy operands — the streaming carry is kept in
+    float64 on the host); an empty pair (``tot == 0``) passes the left
+    side through unchanged."""
+    tot = na + nb
+    if float(tot) == 0.0:
+        return tot, mean_a, m2_a
+    delta = mean_b - mean_a
+    mean = mean_a + delta * (nb / tot)
+    m2 = m2_a + m2_b + delta * delta * (na * nb / tot)
+    return tot, mean, m2
 
 
 def _moments_kernel(lim_ref, x_ref, mean_ref, m2_ref, mean_s, m2_s, cnt_s, *, bm):

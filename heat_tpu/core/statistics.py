@@ -27,6 +27,7 @@ __all__ = [
     "argmin",
     "average",
     "bincount",
+    "chunk_moments",
     "cov",
     "histc",
     "histogram",
@@ -476,6 +477,78 @@ def _pallas_moments_fused(
     buf = fn(*args)
     fusion._note_absorbed(x, "moments_absorb", want=want)
     return buf
+
+
+def chunk_moments(x: DNDarray, interpret: bool = False) -> Tuple:
+    """Per-chunk column-moment carry ``(n, mean (d,), M2 (d,))`` over the
+    rows of a 2-D chunk — the device half of
+    :class:`heat_tpu.streaming.StreamingMoments` (ISSUE 16).
+
+    ONE :func:`~heat_tpu.core.program_cache.cached_program` per
+    (chunk shape, split) at site ``streaming.moments``: a steady stream
+    of equal-shaped chunks re-enters the same warm executable every
+    ``partial_fit`` (the zero-compile oracle pins
+    ``site_stats("streaming.")``). On TPU the program drives the
+    single-HBM-read pallas Welford kernel
+    (:func:`~heat_tpu.core.pallas_moments.column_moments` /
+    the sharded psum-merge variant); elsewhere a masked one-pass XLA
+    form computes the identical carry. Chunk carries combine across
+    ``partial_fit`` calls via :func:`pallas_moments.chan_merge` — the
+    same merge rule the kernel applies across row blocks."""
+    from . import program_cache
+    from .pallas_moments import (
+        column_moments,
+        pallas_moments_applicable,
+        sharded_column_moments,
+    )
+
+    if not isinstance(x, DNDarray):
+        raise TypeError(f"chunk_moments needs a DNDarray, got {type(x)}")
+    if x.ndim != 2:
+        raise ValueError("chunk_moments needs a 2-D (rows, features) chunk")
+    comm = x.comm
+    n = builtins.int(x.shape[0])
+    if n == 0:
+        raise ValueError("chunk_moments: empty chunk (0 rows)")
+    d = builtins.int(x.shape[1])
+    xb = x._masked(0)  # tail pads zeroed (and weighted out below)
+    sharded = comm.size > 1 and x.split is not None
+    use_pallas = pallas_moments_applicable(
+        comm.size, x.split, x.ndim, 0, d, xb.dtype
+    )
+    key = (
+        "chunk_moments", tuple(xb.shape), str(xb.dtype), x.split, n,
+        use_pallas, interpret,
+    )
+
+    def build():
+        def prog(xv):
+            if use_pallas:
+                if comm.size > 1:
+                    mu, m2 = sharded_column_moments(
+                        comm, xv, n, interpret=interpret
+                    )
+                else:
+                    mu, m2 = column_moments(xv, n, interpret=interpret)
+                return mu, m2
+            # XLA fallback: masked one-pass (sum, centered square sum).
+            # Pad rows sit at GLOBAL tail indices (the physical-buffer
+            # invariant every fitter relies on, cf. lasso._cd_fit)
+            w = (jnp.arange(xv.shape[0]) < n).astype(xv.dtype)
+            ns = jnp.sum(w)
+            mu = (w @ xv) / ns
+            dc = (xv - mu[None, :]) * w[:, None]
+            m2 = jnp.sum(dc * dc, axis=0)
+            return mu, m2
+
+        return prog
+
+    fn = program_cache.cached_program(
+        "streaming.moments", key, build, comm=comm,
+        out_shardings=comm.replicated() if sharded else None,
+    )
+    mu, m2 = fn(xb)
+    return n, mu, m2
 
 
 def _central_moment(x: DNDarray, axis, k: int):

@@ -22,17 +22,19 @@ from ..core.dndarray import DNDarray
 __all__ = ["Lasso"]
 
 
-@jax.jit
-def _cd_fit(xbuf: jax.Array, ybuf: jax.Array, n_logical, m_logical, lam, tol, max_iter):
-    """The whole coordinate-descent fit — input prep AND epochs — as ONE
-    compiled program, so a fit is a single dispatch + a single host sync.
-    (The reference's Python epoch loop syncs per epoch, lasso.py:121-186;
-    per-op eager dispatch also pays a host↔device round trip per op, which
-    dominated wall-clock.) Returns (theta, n_iter).
-
-    ``xbuf``/``ybuf`` are the *physical* (tail-padded) buffers; rows at
-    global index ≥ ``n_logical`` and columns ≥ ``m_logical`` are pad and are
-    zeroed (a feature-split input pads columns)."""
+def _cd_sweep(
+    xbuf: jax.Array, ybuf: jax.Array, theta0: jax.Array,
+    n_logical, m_logical, lam, tol, max_iter,
+):
+    """The traceable coordinate-descent epochs with a WARM-START carry:
+    the same body as :func:`_cd_fit` but the initial coefficient vector
+    ``theta0`` (physical length ``xbuf.shape[1] + 1``, intercept first)
+    enters the program — the incremental ``Lasso.partial_fit`` (ISSUE
+    16) threads the previous chunk's coefficients through as the carry,
+    so each chunk runs warm-started coordinate steps instead of
+    refitting from zero. Pad coordinates (columns ≥ ``m_logical``) have
+    zero curvature and zero rho, so they stay at zero regardless of the
+    carry."""
     valid = jnp.arange(xbuf.shape[0]) < n_logical
     validc = jnp.arange(xbuf.shape[1]) < m_logical
     w = valid.astype(xbuf.dtype)
@@ -70,11 +72,30 @@ def _cd_fit(xbuf: jax.Array, ybuf: jax.Array, n_logical, m_logical, lam, tol, ma
         _, it, diff = carry
         return (it < max_iter) & (diff > tol)
 
-    theta0 = jnp.zeros((m,), dtype=xt.dtype)
     theta, n_iter, _ = jax.lax.while_loop(
-        cond, epoch, (theta0, jnp.int32(0), jnp.asarray(jnp.inf, dtype=xt.dtype))
+        cond, epoch,
+        (theta0.astype(xt.dtype), jnp.int32(0),
+         jnp.asarray(jnp.inf, dtype=xt.dtype)),
     )
     return theta, n_iter
+
+
+@jax.jit
+def _cd_fit(xbuf: jax.Array, ybuf: jax.Array, n_logical, m_logical, lam, tol, max_iter):
+    """The whole coordinate-descent fit — input prep AND epochs — as ONE
+    compiled program, so a fit is a single dispatch + a single host sync.
+    (The reference's Python epoch loop syncs per epoch, lasso.py:121-186;
+    per-op eager dispatch also pays a host↔device round trip per op, which
+    dominated wall-clock.) Returns (theta, n_iter).
+
+    ``xbuf``/``ybuf`` are the *physical* (tail-padded) buffers; rows at
+    global index ≥ ``n_logical`` and columns ≥ ``m_logical`` are pad and are
+    zeroed (a feature-split input pads columns). Cold start: the epochs
+    of :func:`_cd_sweep` from a zero coefficient vector."""
+    theta0 = jnp.zeros((xbuf.shape[1] + 1,), dtype=xbuf.dtype)
+    return _cd_sweep(
+        xbuf, ybuf, theta0, n_logical, m_logical, lam, tol, max_iter
+    )
 
 
 class Lasso(BaseEstimator, RegressionMixin):
@@ -150,6 +171,69 @@ class Lasso(BaseEstimator, RegressionMixin):
         self.n_iter = int(n_iter)
         # drop pad-column coordinates (feature-split inputs pad columns)
         theta = theta[: x.shape[1] + 1]
+        self.__theta = DNDarray.from_logical(theta, None, x.device, x.comm, dt)
+        return self
+
+    def partial_fit(self, x: DNDarray, y: DNDarray) -> "Lasso":
+        """Incremental fit on ONE chunk of a stream (ISSUE 16):
+        warm-started coordinate-descent epochs — the previous chunk's
+        coefficients enter :func:`_cd_sweep` as the carry, the chunk's
+        converged coefficients leave as the next carry. Each call is ONE
+        :func:`~heat_tpu.core.program_cache.cached_program` per (chunk
+        shape, split) at site ``streaming.lasso``, so a steady stream of
+        equal-shaped chunks runs zero-compile. Repeated passes over the
+        same data converge to the batch :meth:`fit` solution
+        (documented-tolerance equivalence — coordinate descent on
+        chunks is order-dependent, unlike the moments carry)."""
+        from ..core import program_cache
+
+        if not isinstance(x, DNDarray) or not isinstance(y, DNDarray):
+            raise TypeError("x and y need to be DNDarrays")
+        if x.ndim != 2:
+            raise ValueError("x needs to be 2D")
+        if y.ndim not in (1, 2):
+            raise ValueError("y needs to be 1D or 2D")
+
+        dt = types.promote_types(x.dtype, types.float32)
+        xbuf = x.larray.astype(dt.jnp_type())
+        ybuf = y.larray.astype(dt.jnp_type())
+        m_log = x.shape[1] + 1  # + intercept
+        prev = self.__theta
+        if prev is None:
+            theta0 = jnp.zeros((m_log,), dtype=xbuf.dtype)
+        else:
+            theta0 = prev.larray.astype(xbuf.dtype)
+            if theta0.shape[0] != m_log:
+                raise ValueError(
+                    f"partial_fit chunk has {x.shape[1]} features but the "
+                    f"carried coefficients expect {theta0.shape[0] - 1}"
+                )
+        comm = x.comm
+        key = (
+            "cd_sweep", tuple(xbuf.shape), str(xbuf.dtype),
+            tuple(ybuf.shape), x.split, y.split, m_log,
+        )
+
+        def build():
+            def prog(xb, yb, th0, n_logical, m_logical, lam, tol, max_iter):
+                # carry arrives at LOGICAL length; pad to the physical
+                # coordinate count (pad coords stay 0 — zero curvature)
+                th = jnp.pad(th0, (0, xb.shape[1] + 1 - th0.shape[0]))
+                return _cd_sweep(
+                    xb, yb, th, n_logical, m_logical, lam, tol, max_iter
+                )
+
+            return prog
+
+        fn = program_cache.cached_program(
+            "streaming.lasso", key, build, comm=comm,
+        )
+        theta, n_iter = fn(
+            xbuf, ybuf, theta0, x.shape[0], x.shape[1], float(self.lam),
+            float(self.tol), int(self.max_iter),
+        )
+        self.n_iter = int(n_iter)
+        theta = theta[: m_log]
         self.__theta = DNDarray.from_logical(theta, None, x.device, x.comm, dt)
         return self
 

@@ -221,7 +221,7 @@ class Endpoint:
     key). ``features`` / ``dtype`` define the request contract the server
     validates against."""
 
-    __slots__ = ("kind", "params", "config", "features", "dtype")
+    __slots__ = ("kind", "params", "config", "features", "dtype", "version")
 
     def __init__(
         self,
@@ -231,6 +231,7 @@ class Endpoint:
         *,
         features: int,
         dtype,
+        version: int = 1,
     ):
         if kind not in _KIND_FNS:
             raise ValueError(
@@ -249,6 +250,13 @@ class Endpoint:
         self.config.setdefault("exact", exact_mode())
         self.features = int(features)
         self.dtype = np.dtype(dtype)
+        # monotone publish counter (ISSUE 16): params are program
+        # *arguments*, so a republish with identical avals re-enters the
+        # warm executable — the version therefore deliberately does NOT
+        # ride in program_key (that would fork one compile per publish).
+        if int(version) < 1:
+            raise ValueError(f"endpoint version must be >= 1, got {version}")
+        self.version = int(version)
 
     # -- program plumbing ----------------------------------------------------
 
@@ -348,6 +356,31 @@ class Endpoint:
         out = bucket * max(n_ref, 1) * item
         return int(inp + mid + out)
 
+    def with_params(
+        self, params: Sequence, *, version: Optional[int] = None
+    ) -> "Endpoint":
+        """The versioned-publish constructor (ISSUE 16): the same program
+        family with freshly fitted parameters and a bumped version
+        (default ``self.version + 1``). Parameter avals must match the
+        current ones exactly — that is the zero-compile swap contract
+        (same ``program_key`` → the swap re-enters the warm executable);
+        a shape/dtype change is a *new* endpoint family and must go
+        through a fresh constructor + warmup instead."""
+        new = tuple(jnp.asarray(np.asarray(p)) for p in params)
+        old_sig = tuple((tuple(p.shape), str(p.dtype)) for p in self.params)
+        new_sig = tuple((tuple(p.shape), str(p.dtype)) for p in new)
+        if old_sig != new_sig:
+            raise ValueError(
+                f"with_params aval mismatch (zero-compile swaps need "
+                f"identical parameter shapes/dtypes): {old_sig} -> {new_sig}"
+            )
+        ep = Endpoint(
+            self.kind, new, config=dict(self.config),
+            features=self.features, dtype=self.dtype,
+            version=self.version + 1 if version is None else int(version),
+        )
+        return ep
+
     def describe(self) -> dict:
         """JSON-serializable manifest record (checkpoint/restore)."""
         return {
@@ -356,18 +389,21 @@ class Endpoint:
             "features": self.features,
             "dtype": str(self.dtype),
             "n_params": len(self.params),
+            "version": self.version,
         }
 
 
 def rebuild(record: dict, params: Sequence) -> Endpoint:
     """Inverse of :meth:`Endpoint.describe` + saved params — the
-    checkpoint-restore constructor (``Server.restore``)."""
+    checkpoint-restore constructor (``Server.restore``). Pre-16
+    checkpoints carry no version field and restore at version 1."""
     return Endpoint(
         record["kind"],
         [jnp.asarray(p) for p in params],
         config=record.get("config"),
         features=record["features"],
         dtype=np.dtype(record["dtype"]),
+        version=int(record.get("version", 1)),
     )
 
 

@@ -184,19 +184,29 @@ class ReplicaPool:
             h.wait_ready(self.ready_timeout)
         return self
 
-    def spawn(self) -> ReplicaHandle:
+    def spawn(self, checkpoint: Optional[str] = None) -> ReplicaHandle:
         """Add ONE replica (scale-up / re-add after a kill); blocks
-        until its ready line."""
-        h = self._spawn_one()
+        until its ready line. ``checkpoint`` (ISSUE 16) births the
+        replica from a *different* checkpoint than the pool default —
+        the rolling-update primitive: a replica process serves exactly
+        one checkpoint version for its whole life, so replacing
+        replicas one by one rolls a new version through the pool with
+        no process ever serving a half-updated endpoint set."""
+        h = self._spawn_one(checkpoint=checkpoint)
         h.wait_ready(self.ready_timeout)
         return h
 
-    def _spawn_one(self) -> ReplicaHandle:
+    def set_checkpoint(self, checkpoint: str) -> None:
+        """Re-point the pool default checkpoint (future spawns,
+        including crash-recovery respawns, pick up the new version)."""
+        self.checkpoint = str(checkpoint)
+
+    def _spawn_one(self, checkpoint: Optional[str] = None) -> ReplicaHandle:
         index = self._next_index
         self._next_index += 1
         cmd = [
             self.python, "-m", "heat_tpu.serve.net.replica",
-            "--checkpoint", self.checkpoint,
+            "--checkpoint", str(checkpoint or self.checkpoint),
             "--host", self.host, "--port", "0",
         ]
         if self.mesh:

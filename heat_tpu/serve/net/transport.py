@@ -122,6 +122,12 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_error(400, str(e), "bad_request")
             return
         try:
+            # capture the version at submit: the server swaps endpoints
+            # only between micro-batches, and a replica process mounts
+            # exactly one checkpoint version for its whole life, so this
+            # is the version that serves the request in a rolling deploy
+            getv = getattr(front.server, "endpoint_version", None)
+            version = getv(name) if getv is not None else None
             fut = front.server.submit(name, payload)
             result = fut.result(front.request_timeout)
         except ServerOverloadedError as e:
@@ -145,7 +151,7 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_error(500, repr(e), "internal")
             return
         front._count(200)
-        self._send(200, wire.encode_response(np.asarray(result)))
+        self._send(200, wire.encode_response(np.asarray(result), version=version))
 
 
 class HttpFront:

@@ -58,6 +58,7 @@ __all__ = [
     "encode_response",
     "encode_error",
     "decode_response",
+    "decode_response_version",
 ]
 
 
@@ -145,11 +146,15 @@ def decode_request(body: bytes):
     return decode_array(obj["payload"])
 
 
-def encode_response(result: np.ndarray) -> bytes:
-    """The JSON body of a 200 response."""
-    return json.dumps(
-        {"ok": True, "result": encode_array(result)}
-    ).encode("utf-8")
+def encode_response(result: np.ndarray, version=None) -> bytes:
+    """The JSON body of a 200 response. ``version`` (ISSUE 16) stamps
+    the endpoint version that served the request into the envelope, so
+    a client driving a rolling update can observe which replicas have
+    cut over; absent for pre-16 peers (decoders default it to None)."""
+    obj = {"ok": True, "result": encode_array(result)}
+    if version is not None:
+        obj["version"] = int(version)
+    return json.dumps(obj).encode("utf-8")
 
 
 def encode_error(message: str, reason: str) -> bytes:
@@ -175,3 +180,15 @@ def decode_response(body: bytes) -> Tuple[bool, object, str]:
             raise WireError('ok response is missing "result"')
         return True, decode_array(obj["result"]), ""
     return False, str(obj.get("error", "")), str(obj.get("reason", ""))
+
+
+def decode_response_version(body: bytes):
+    """The endpoint version stamped into a 200 envelope, or ``None``
+    (error responses, pre-16 peers). Used by the rolling-update driver
+    to verify every in-rotation replica answers from one version."""
+    try:
+        obj = json.loads(body.decode("utf-8"))
+    except Exception as e:
+        raise WireError(f"response body is not JSON: {e}") from None
+    v = obj.get("version") if isinstance(obj, dict) else None
+    return int(v) if v is not None else None

@@ -204,11 +204,22 @@ class Server:
 
     # -- registration --------------------------------------------------------
 
-    def register(self, name: str, endpoint: Endpoint) -> "Server":
+    def register(
+        self, name: str, endpoint: Endpoint, *, replace: bool = False
+    ) -> "Server":
         """Mount ``endpoint`` under ``name`` (the dispatch site becomes
-        ``serve.<name>``). Re-registering a name replaces the endpoint
-        (and drops its warmed-cost memo — the programs themselves stay
-        in the registry for the next endpoint with identical shapes)."""
+        ``serve.<name>``). Re-registering a live name is an explicit
+        *versioned publish* (ISSUE 16): it requires ``replace=True``,
+        assigns the newcomer ``max(old, new) + 1`` when its version does
+        not already supersede the old one, and swaps the endpoint in
+        with one atomic dict assignment — the dispatch loop reads the
+        endpoint exactly once per micro-batch, so a batch is served
+        entirely by one version (bit-exact cutover between batches).
+        Without ``replace=True`` a duplicate name raises instead of
+        silently shadowing the fitted estimator. A same-aval publish
+        keeps the warmed-cost memo and re-enters the warm programs; an
+        aval change drops the memo (the old programs stay in the
+        registry for any future endpoint with identical shapes)."""
         if not isinstance(endpoint, Endpoint):
             raise TypeError(
                 f"endpoint must be a serve.Endpoint, got {type(endpoint)}"
@@ -218,6 +229,24 @@ class Server:
         with self._lock:
             if self._closed:
                 raise ServerClosedError("server is closed")
+            old = self._endpoints.get(name)
+            if old is not None:
+                if not replace:
+                    raise ValueError(
+                        f"endpoint {name!r} is already registered "
+                        f"(version {old.version}); re-registering a live "
+                        f"name is a versioned publish — pass replace=True"
+                    )
+                if endpoint.version <= old.version:
+                    endpoint.version = old.version + 1
+                same_sig = (
+                    old.program_key(0) == endpoint.program_key(0)
+                )
+                self._endpoints[name] = endpoint
+                if not same_sig:
+                    for key in [k for k in self._measured if k[0] == name]:
+                        del self._measured[key]
+                return self
             self._endpoints[name] = endpoint
             self._stats[name] = EndpointStats(name)
             for key in [k for k in self._measured if k[0] == name]:
@@ -226,6 +255,42 @@ class Server:
 
     def endpoints(self) -> Dict[str, Endpoint]:
         return dict(self._endpoints)
+
+    def endpoint_version(self, name: str) -> int:
+        """The currently-mounted version of ``name`` (KeyError when the
+        endpoint is unknown) — the transport stamps this into every
+        response envelope so clients can observe rolling updates."""
+        return self._endpoints[name].version
+
+    def publish(self, name: str, endpoint: Endpoint, *, warm: bool = True) -> dict:
+        """Versioned publish + compile accounting (ISSUE 16): swap
+        ``endpoint`` in under ``name`` (``register(replace=True)``),
+        re-warm it under a :class:`telemetry.CompileWatcher`, and emit a
+        ``version_swap`` streaming event carrying the swap latency and
+        the backend-compile count — the ``compiles_per_swap == 0``
+        oracle for same-aval publishes. Returns ``{"name", "version",
+        "seconds", "backend_compiles"}``."""
+        t0 = time.perf_counter()
+        self.register(name, endpoint, replace=True)
+        compiles = 0
+        if warm:
+            report = self.warmup([name])
+            compiles = int(report.get("backend_compiles", 0))
+        version = self._endpoints[name].version
+        out = {
+            "name": name,
+            "version": version,
+            "seconds": round(time.perf_counter() - t0, 6),
+            "backend_compiles": compiles,
+        }
+        from ..streaming import events as _stream_events
+
+        _stream_events.emit(
+            name, "version_swap",
+            version=version, seconds=out["seconds"],
+            backend_compiles=compiles,
+        )
+        return out
 
     # -- warm-up -------------------------------------------------------------
 
@@ -516,6 +581,9 @@ class Server:
         return {
             "endpoints": {
                 name: s.snapshot() for name, s in self._stats.items()
+            },
+            "versions": {
+                name: ep.version for name, ep in self._endpoints.items()
             },
             "queue_depth": self._queue.qsize(),
             "ladder": list(self.ladder),

@@ -1,0 +1,41 @@
+"""heat_tpu.streaming — online estimators, out-of-core ingestion, and
+versioned fit-while-serve (ISSUE 16; docs/STREAMING.md).
+
+Three composing pieces:
+
+* **online estimators** — :class:`StreamingMoments` (the single-pass
+  pallas Welford kernel behind a Chan-mergeable host carry),
+  :class:`MiniBatchKMeans` (the Lloyd shift-carry window with
+  decayed-count blending), and the incremental
+  :meth:`heat_tpu.regression.Lasso.partial_fit` (warm-started
+  coordinate steps). Every ``partial_fit`` is ONE cached program per
+  (chunk shape, split) — a steady stream runs zero-compile
+  (``program_cache.site_stats("streaming.")`` is the oracle) — and the
+  carries checkpoint/resume bit-exactly via
+  :mod:`heat_tpu.resilience.checkpoint`;
+* **out-of-core ingestion** — :class:`ChunkStream` walks HDF5/npy files
+  in row blocks sized by ``memory_guard.temp_budget()``, never
+  materializing a file (the reference's ``PartialH5Dataset`` pattern);
+* **fit-while-serve** — ``Server.publish`` swaps a freshly fitted
+  version in as a zero-compile program-argument update, and
+  :func:`rolling_update` rolls a new checkpoint through a
+  :class:`~heat_tpu.serve.net.ReplicaPool` replica-by-replica with the
+  router draining each one.
+"""
+
+from __future__ import annotations
+
+from .chunks import ChunkStream
+from .events import EVENT_COUNTER, emit
+from .minibatch import MiniBatchKMeans
+from .moments import StreamingMoments
+from .publish import rolling_update
+
+__all__ = [
+    "ChunkStream",
+    "EVENT_COUNTER",
+    "MiniBatchKMeans",
+    "StreamingMoments",
+    "emit",
+    "rolling_update",
+]

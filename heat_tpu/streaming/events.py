@@ -1,0 +1,46 @@
+"""Telemetry naming contract of the streaming subsystem (ISSUE 16).
+
+Every ``streaming`` instant event increments exactly one aggregate
+counter (``streaming.<name>``) alongside its emission, so a **live**
+``report.summarize()`` (reading counters) and an **offline** one
+(replaying a JSONL sink) reconstruct the *same* ``streaming`` block —
+the reconciliation contract PR 5 established for resilience, PR 11 for
+autotune, PR 12 for the router/pool tier, and PR 13 for sparse,
+extended to the streaming tier. ``EVENT_COUNTER`` is that event-name →
+counter-name map; :mod:`heat_tpu.telemetry.report` imports it for the
+offline rename.
+
+One deliberate extension: a ``stream_chunk`` event additionally folds
+its ``rows`` field into the ``streaming.rows`` counter (the rows/s
+numerator), and the offline reconstruction sums the same field — the
+pair stays reconciled because both sides read the one ``rows`` value.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from .. import telemetry
+
+__all__ = ["EVENT_COUNTER", "emit"]
+
+# event (on the wire / in the sink)  ->  counter suffix (live registry)
+EVENT_COUNTER = {
+    "stream_chunk": "chunks",        # one out-of-core chunk ingested
+    "version_swap": "version_swaps",  # in-process versioned publish
+    "roll_step": "roll_steps",       # one replica replaced in a rolling update
+    "checkpoint": "checkpoints",     # estimator carry checkpointed
+    "resume": "resumes",             # estimator carry restored mid-stream
+}
+
+
+def emit(name: str, event: str, **fields: Any) -> None:
+    """Emit one ``streaming`` instant event + its paired counter (no-op
+    while telemetry is disabled — one flag check)."""
+    if not telemetry.enabled():
+        return
+    reg = telemetry.get_registry()
+    reg.add(f"streaming.{EVENT_COUNTER[event]}", 1)
+    if event == "stream_chunk" and fields.get("rows"):
+        reg.add("streaming.rows", int(fields["rows"]))
+    reg.emit("streaming", name, event=event, **fields)

@@ -1,0 +1,221 @@
+"""Mini-batch K-Means: the Lloyd shift-carry window, applied per chunk
+with decayed-count center blending (ISSUE 16).
+
+Each ``partial_fit(chunk)`` is ONE cached program (site
+``streaming.minibatch_kmeans``, one compile per chunk shape) that
+
+1. runs a window of at most ``inner_iter`` Lloyd iterations on the
+   chunk starting from the carried centers — the SAME
+   :func:`~heat_tpu.cluster.kmeans._lloyd_window` body the checkpointed
+   batch fit drives, with the SAME convergence carry (``shift``)
+   threading across chunks;
+2. hard-assigns the chunk against the window-refined centers (one more
+   ``_lloyd_step`` distance pass) to get per-center batch counts and
+   sums;
+3. blends: ``counts' = decay·counts + counts_b`` and ``centers' =
+   (decay·counts·centers + sums_b) / counts'`` for centers the batch
+   touched — the decayed running mean of everything each center has
+   absorbed (``decay=1`` is the pure running mean; ``decay<1`` forgets
+   old data geometrically, the non-stationary-stream mode).
+
+Mini-batch K-Means is order-dependent, so the K-chunk result matches a
+one-shot :class:`~heat_tpu.cluster.KMeans` fit only to a documented
+tolerance (well-separated data converges to the same centers; the
+equivalence battery pins it). Checkpoint/resume of the carry
+(centers, counts, shift) IS bit-exact on the same chunk sequence.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..cluster._kcluster import _KCluster, _d2, _pad_weights
+from ..cluster.kmeans import _lloyd_window
+from ..core import program_cache, types
+from ..core.dndarray import DNDarray
+from . import events
+
+__all__ = ["MiniBatchKMeans"]
+
+
+class MiniBatchKMeans(_KCluster):
+    """Online K-Means over a chunked stream.
+
+    Parameters
+    ----------
+    n_clusters : int
+    init : 'random' | 'probability_based' | DNDarray
+        Initial centers, drawn from the FIRST chunk (reference init
+        semantics applied to the head of the stream).
+    inner_iter : int
+        Lloyd window length per chunk (the ``max_iter`` of the carried
+        :func:`_lloyd_window`); the window still exits early when the
+        carried center shift drops below ``tol``.
+    tol : float
+        Convergence threshold on the squared center shift carry.
+    decay : float
+        Count decay per chunk in (0, 1]: 1.0 accumulates the exact
+        running mean; smaller values geometrically forget old chunks.
+    random_state : int, optional
+    """
+
+    def __init__(
+        self,
+        n_clusters: int = 8,
+        init: Union[str, DNDarray] = "random",
+        inner_iter: int = 3,
+        tol: float = 0.0,
+        decay: float = 1.0,
+        random_state: Optional[int] = None,
+    ):
+        super().__init__(
+            "euclidean", n_clusters, init, inner_iter, tol, random_state
+        )
+        if not 0.0 < decay <= 1.0:
+            raise ValueError(f"decay must be in (0, 1], got {decay}")
+        self.inner_iter = int(inner_iter)
+        self.decay = float(decay)
+        self._centers_np: Optional[np.ndarray] = None
+        self._counts_np: Optional[np.ndarray] = None
+        self._shift = float("inf")
+        self.chunks_seen = 0
+        self.rows_seen = 0
+
+    # -- streaming -----------------------------------------------------------
+
+    def partial_fit(self, x: DNDarray) -> "MiniBatchKMeans":
+        """Fold one chunk into (centers, counts, shift): one
+        cached-program dispatch per chunk shape (zero-compile steady
+        stream), carry state on the host."""
+        if not isinstance(x, DNDarray):
+            raise TypeError(f"input needs to be a DNDarray, but was {type(x)}")
+        if x.ndim != 2:
+            raise ValueError("input needs to be 2D")
+        dt = types.promote_types(x.dtype, types.float32)
+        xb = x._masked(0).astype(dt.jnp_type())
+        w = _pad_weights(xb, x.shape[0])
+        k = self.n_clusters
+        if self._centers_np is None:
+            # draw init centers from the head of the stream, then route
+            # them through the host carry like every later chunk — the
+            # program's carry inputs always enter with the same (host)
+            # placement, so call 2+ re-enters call 1's executable
+            init = self._initialize_cluster_centers(x).astype(xb.dtype)
+            self._centers_np = np.asarray(init)
+            self._counts_np = np.zeros((k,), dtype=self._centers_np.dtype)
+            self._shift = float("inf")
+        elif self._centers_np.shape[1] != xb.shape[1]:
+            raise ValueError(
+                f"partial_fit chunk has {xb.shape[1]} feature columns "
+                f"but the carried centers hold {self._centers_np.shape[1]}"
+            )
+        centers = jnp.asarray(self._centers_np, dtype=xb.dtype)
+        counts = jnp.asarray(self._counts_np, dtype=xb.dtype)
+        shift = jnp.asarray(self._shift, xb.dtype)
+        comm = x.comm
+        inner = self.inner_iter
+        # NOTE: the logical row count is NOT in the key — validity
+        # weights are a program *argument*, so a short final chunk that
+        # pads to the steady physical shape re-enters the warm program
+        key = (
+            "minibatch", tuple(xb.shape), str(xb.dtype), x.split, k, inner,
+        )
+
+        def build():
+            def prog(xv, wv, c0, cnt0, shift0, tol, decay):
+                # (1) the carried Lloyd window on this chunk
+                c_ref, _, shift_out = _lloyd_window(
+                    xv, wv, c0, shift0, inner, tol
+                )
+                # (2) hard assignment against the refined centers
+                d2 = _d2(xv, c_ref)
+                labels = jnp.argmin(d2, axis=1)
+                onehot = (
+                    labels[:, None] == jnp.arange(k)[None, :]
+                ).astype(xv.dtype) * wv[:, None]
+                c_b = jnp.sum(onehot, axis=0)        # (k,)
+                s_b = onehot.T @ xv                   # (k, d)
+                # (3) decayed-count blend into the running centers
+                cnt = decay * cnt0 + c_b
+                blended = (
+                    (decay * cnt0)[:, None] * c0 + s_b
+                ) / jnp.maximum(cnt, 1e-12)[:, None]
+                c_new = jnp.where(c_b[:, None] > 0, blended, c0)
+                inertia = jnp.sum(jnp.min(d2, axis=1) * wv)
+                return c_new, cnt, shift_out, inertia
+
+            return prog
+
+        fn = program_cache.cached_program(
+            "streaming.minibatch_kmeans", key, build, comm=comm,
+        )
+        centers, counts, shift, inertia = fn(
+            xb, w, centers, counts, shift,
+            jnp.asarray(self.tol, xb.dtype),
+            jnp.asarray(self.decay, xb.dtype),
+        )
+        self._centers_np = np.asarray(centers)
+        self._counts_np = np.asarray(counts)
+        self._shift = float(shift)
+        self._inertia = float(inertia)
+        self.chunks_seen += 1
+        self.rows_seen += int(x.shape[0])
+        self._cluster_centers = DNDarray.from_logical(
+            centers, None, x.device, x.comm, dt
+        )
+        return self
+
+    # -- checkpoint/resume ---------------------------------------------------
+
+    def save(self, path: str) -> str:
+        """Checkpoint the carry (centers, counts, shift) — same
+        substrate and resume-equivalence contract as the batch
+        ``KMeans`` checkpointed fit."""
+        from .. import resilience
+
+        if self._centers_np is None:
+            raise RuntimeError("nothing to checkpoint: no chunk seen yet")
+        out = resilience.save_checkpoint(
+            [self._centers_np, self._counts_np], path,
+            extra={
+                "algo": "minibatch_kmeans",
+                "shift": float(self._shift),
+                "chunks_seen": int(self.chunks_seen),
+                "rows_seen": int(self.rows_seen),
+                "decay": float(self.decay),
+                "inner_iter": int(self.inner_iter),
+                "tol": float(self.tol),
+            },
+        )
+        events.emit("minibatch_kmeans", "checkpoint", path=path,
+                    rows_seen=self.rows_seen, chunks=self.chunks_seen)
+        return out
+
+    @classmethod
+    def restore(cls, path: str) -> "MiniBatchKMeans":
+        from .. import resilience
+
+        leaves, extra = resilience.load_checkpoint(path, with_extra=True)
+        if (extra or {}).get("algo") != "minibatch_kmeans" or len(leaves) != 2:
+            raise resilience.CheckpointError(
+                f"{path!r} is a {(extra or {}).get('algo')!r} checkpoint, "
+                f"not minibatch_kmeans"
+            )
+        centers = np.asarray(leaves[0])
+        est = cls(
+            n_clusters=centers.shape[0],
+            inner_iter=int(extra.get("inner_iter", 3)),
+            tol=float(extra.get("tol", 0.0)),
+            decay=float(extra.get("decay", 1.0)),
+        )
+        est._centers_np = centers
+        est._counts_np = np.asarray(leaves[1])
+        est._shift = float(extra["shift"])
+        est.chunks_seen = int(extra.get("chunks_seen", 0))
+        est.rows_seen = int(extra.get("rows_seen", 0))
+        events.emit("minibatch_kmeans", "resume", path=path,
+                    rows_seen=est.rows_seen, chunks=est.chunks_seen)
+        return est

@@ -1,0 +1,165 @@
+"""Online column moments: the single-pass pallas Welford kernel behind a
+Chan-style mergeable carry (ISSUE 16).
+
+Each ``partial_fit(chunk)`` runs ONE cached program
+(:func:`heat_tpu.core.statistics.chunk_moments`, site
+``streaming.moments`` — the pallas single-HBM-read kernel on TPU, a
+masked one-pass XLA form elsewhere) producing the chunk's
+``(n, mean, M2)``, then folds it into the running carry with the exact
+:func:`~heat_tpu.core.pallas_moments.chan_merge` formula the kernel
+itself applies across row blocks. The carry lives on the HOST in
+float64: the merge sequence is deterministic python arithmetic, so a
+checkpointed stream resumes **bit-exactly** — and the carry is
+mesh-independent (only the per-chunk device reduction sees the mesh).
+
+Equivalence contract (pinned by tests/test_streaming.py):
+
+* one-chunk ``partial_fit`` ≡ the direct kernel call — same program;
+* K-chunk ``partial_fit`` vs one-shot moments over the concatenation —
+  equal to documented float tolerance (the merge tree associates
+  differently than the one-shot block sequence; Chan's formula keeps
+  the error at the f32-rounding level, and the f64 host carry adds no
+  error of its own);
+* checkpoint → restore → continue ≡ uninterrupted stream, bit-exact
+  (the carry round-trips through float64 blobs unchanged).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..core.dndarray import DNDarray
+from ..core.pallas_moments import chan_merge
+from ..core.statistics import chunk_moments
+from . import events
+
+__all__ = ["StreamingMoments"]
+
+
+class StreamingMoments:
+    """Single-pass streaming mean/var/std over the rows of a chunked
+    2-D stream.
+
+    Parameters
+    ----------
+    ddof : int
+        Delta degrees of freedom of :meth:`var`/:meth:`std` (0 =
+        population, 1 = sample).
+    """
+
+    def __init__(self, ddof: int = 0):
+        self.ddof = int(ddof)
+        self.n_seen = 0.0  # float64 exact for any realistic row count
+        self._mean: Optional[np.ndarray] = None  # (d,) float64
+        self._m2: Optional[np.ndarray] = None    # (d,) float64
+        self.chunks_seen = 0
+
+    # -- streaming -----------------------------------------------------------
+
+    def partial_fit(self, x: DNDarray) -> "StreamingMoments":
+        """Fold one chunk into the carry: one cached-program dispatch
+        (zero-compile on a steady stream of equal-shaped chunks) + one
+        host-side Chan merge."""
+        n, mu, m2 = chunk_moments(x)
+        mu = np.asarray(mu, dtype=np.float64)
+        m2 = np.asarray(m2, dtype=np.float64)
+        if self._mean is None:
+            self._mean = np.zeros_like(mu)
+            self._m2 = np.zeros_like(m2)
+        elif self._mean.shape != mu.shape:
+            raise ValueError(
+                f"partial_fit chunk has {mu.shape[0]} features but the "
+                f"carry holds {self._mean.shape[0]}"
+            )
+        self.n_seen, self._mean, self._m2 = chan_merge(
+            self.n_seen, self._mean, self._m2, float(n), mu, m2
+        )
+        self.chunks_seen += 1
+        return self
+
+    def merge(self, other: "StreamingMoments") -> "StreamingMoments":
+        """Combine another stream's carry into this one (exact — the
+        carry algebra is associative up to float rounding, so shards of
+        a stream processed independently merge into one estimate)."""
+        if other._mean is None:
+            return self
+        if self._mean is None:
+            self.n_seen = other.n_seen
+            self._mean = other._mean.copy()
+            self._m2 = other._m2.copy()
+            self.chunks_seen += other.chunks_seen
+            return self
+        self.n_seen, self._mean, self._m2 = chan_merge(
+            self.n_seen, self._mean, self._m2,
+            other.n_seen, other._mean, other._m2,
+        )
+        self.chunks_seen += other.chunks_seen
+        return self
+
+    # -- results -------------------------------------------------------------
+
+    @property
+    def mean(self) -> np.ndarray:
+        if self._mean is None:
+            raise RuntimeError("partial_fit needs at least one chunk")
+        return self._mean.copy()
+
+    def var(self, ddof: Optional[int] = None) -> np.ndarray:
+        if self._m2 is None:
+            raise RuntimeError("partial_fit needs at least one chunk")
+        k = self.ddof if ddof is None else int(ddof)
+        denom = self.n_seen - k
+        if denom <= 0:
+            raise ValueError(
+                f"var(ddof={k}) needs more than {k} rows, saw {self.n_seen}"
+            )
+        return self._m2 / denom
+
+    def std(self, ddof: Optional[int] = None) -> np.ndarray:
+        return np.sqrt(self.var(ddof))
+
+    # -- checkpoint/resume ---------------------------------------------------
+
+    def save(self, path: str) -> str:
+        """Checkpoint the carry (CRC-verified blobs, atomic directory
+        swap — :mod:`heat_tpu.resilience.checkpoint`). The float64 host
+        carry round-trips bit-exactly, so resume-then-continue equals
+        the uninterrupted stream on the same chunk sequence."""
+        from .. import resilience
+
+        if self._mean is None:
+            raise RuntimeError("nothing to checkpoint: no chunk seen yet")
+        out = resilience.save_checkpoint(
+            [self._mean, self._m2], path,
+            extra={
+                "algo": "streaming_moments",
+                "n_seen": float(self.n_seen),
+                "chunks_seen": int(self.chunks_seen),
+                "ddof": int(self.ddof),
+            },
+        )
+        events.emit("moments", "checkpoint", path=path,
+                    rows_seen=float(self.n_seen),
+                    chunks=int(self.chunks_seen))
+        return out
+
+    @classmethod
+    def restore(cls, path: str) -> "StreamingMoments":
+        from .. import resilience
+
+        leaves, extra = resilience.load_checkpoint(path, with_extra=True)
+        if (extra or {}).get("algo") != "streaming_moments" or len(leaves) != 2:
+            raise resilience.CheckpointError(
+                f"{path!r} is a {(extra or {}).get('algo')!r} checkpoint, "
+                f"not streaming_moments"
+            )
+        est = cls(ddof=int(extra.get("ddof", 0)))
+        est._mean = np.asarray(leaves[0], dtype=np.float64)
+        est._m2 = np.asarray(leaves[1], dtype=np.float64)
+        est.n_seen = float(extra["n_seen"])
+        est.chunks_seen = int(extra.get("chunks_seen", 0))
+        events.emit("moments", "resume", path=path,
+                    rows_seen=est.n_seen, chunks=est.chunks_seen)
+        return est

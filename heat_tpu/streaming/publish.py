@@ -1,0 +1,107 @@
+"""Versioned fit-while-serve: publish a freshly fitted endpoint version
+and roll it through a replica pool without a compile or a dropped
+request (ISSUE 16).
+
+Two publication planes:
+
+* **in-process** — ``Server.publish(name, endpoint.with_params(...))``:
+  endpoint parameters are program *arguments*, so a same-aval publish
+  re-enters the warm executable (zero compiles, the ``version_swap``
+  event records the CompileWatcher count) and the dispatch loop's
+  single endpoint read per micro-batch makes the cutover bit-exact
+  between batches;
+* **cross-process** — :func:`rolling_update` here: a replica process is
+  *born* from one checkpoint and serves exactly that version for its
+  whole life, so rolling a pool is replace-one-at-a-time: spawn a
+  replacement from the NEW checkpoint (it warms from the shared compile
+  cache — zero steady compiles), hand it to the router, then
+  drain-and-remove one old replica (the router retries its shedding
+  503s to siblings — zero failed requests, provided the router opted
+  into ``retry_in_flight=True``: serving queries are idempotent, and a
+  draining replica may reset connections it had already accepted). No
+  process ever serves a half-updated endpoint set, chaos included: SIGKILL mid-roll loses
+  only the victim's in-flight work, and the roll resumes by spawning
+  another replacement (every spawn after :meth:`ReplicaPool.
+  set_checkpoint` is already the new version).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from .. import _knobs as knobs
+from . import events
+
+__all__ = ["rolling_update"]
+
+
+def rolling_update(
+    pool,
+    router,
+    checkpoint: str,
+    *,
+    drain_timeout: Optional[float] = None,
+    ready_probe: bool = True,
+) -> dict:
+    """Roll every replica of ``pool`` onto ``checkpoint``,
+    replica-by-replica, with the router draining each one.
+
+    Per old replica: ``spawn(new) → router.add_target(new) →
+    remove(old)`` (drain-then-kill; the pool asserts exit code 0).
+    Capacity never drops below the starting replica count during the
+    roll — the new replica is in rotation before its predecessor starts
+    draining.
+
+    Returns ``{"steps": [...], "replicas", "seconds", "versions"}``
+    where ``versions`` maps replica index → the endpoint-version dict
+    its ``/stats`` reports after the roll (the all-on-new-version
+    oracle). ``drain_timeout`` defaults to the
+    ``HEAT_TPU_STREAM_DRAIN_TIMEOUT`` knob — the version-swap drain
+    policy: how long an old replica may take to finish its backlog
+    before the roll fails loudly."""
+    if drain_timeout is None:
+        drain_timeout = float(knobs.get("HEAT_TPU_STREAM_DRAIN_TIMEOUT"))
+    t_start = time.perf_counter()
+    pool.set_checkpoint(checkpoint)
+    old = [
+        h.index for h in list(pool.replicas)
+        if h.state == "up" and h.alive()
+    ]
+    if not old:
+        raise RuntimeError("rolling_update: pool has no live replicas")
+    steps = []
+    for idx in old:
+        t0 = time.perf_counter()
+        repl = pool.spawn()  # born from the NEW checkpoint
+        router.add_target(repl.url)
+        rc = pool.remove(idx, timeout=drain_timeout)
+        step = {
+            "replaced": idx,
+            "replacement": repl.index,
+            "drain_rc": rc,
+            "seconds": round(time.perf_counter() - t0, 3),
+        }
+        steps.append(step)
+        events.emit("pool", "roll_step", **step)
+        if rc != 0:
+            raise RuntimeError(
+                f"rolling_update: replica {idx} exited rc={rc} during "
+                f"drain (log: {pool.handle(idx).log_path})"
+            )
+    versions = {}
+    if ready_probe:
+        for h in list(pool.replicas):
+            if h.state == "up" and h.alive():
+                try:
+                    versions[h.index] = (
+                        pool.stats(h.index).get("versions") or {}
+                    )
+                except Exception as e:  # noqa: BLE001 — a dead replica is data
+                    versions[h.index] = {"error": repr(e)}
+    return {
+        "steps": steps,
+        "replicas": len(old),
+        "seconds": round(time.perf_counter() - t_start, 3),
+        "versions": versions,
+    }

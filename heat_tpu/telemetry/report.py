@@ -73,6 +73,13 @@ def summarize(
     at_events: dict = {}
     sn_events: dict = {}
     sp_events: dict = {}
+    st_events: dict = {}
+    st_rows = 0
+    st_read_seconds = 0.0
+    st_swap_seconds: list = []
+    st_roll_seconds: list = []
+    st_compiles = 0
+    st_max_version = 0
     plan_counts: dict = {}
     hier_rows: dict = {}
     plan_last: Optional[dict] = None
@@ -140,6 +147,20 @@ def summarize(
         elif kind == "sparse":
             what = ev.get("event") or "event"
             sp_events[what] = sp_events.get(what, 0) + 1
+        elif kind == "streaming":
+            what = ev.get("event") or "event"
+            st_events[what] = st_events.get(what, 0) + 1
+            if what == "stream_chunk":
+                st_rows += int(ev.get("rows", 0) or 0)
+                st_read_seconds += float(ev.get("seconds", 0.0) or 0.0)
+            elif what == "version_swap":
+                st_swap_seconds.append(float(ev.get("seconds", 0.0) or 0.0))
+                st_compiles += int(ev.get("backend_compiles", 0) or 0)
+                st_max_version = max(
+                    st_max_version, int(ev.get("version", 0) or 0)
+                )
+            elif what == "roll_step":
+                st_roll_seconds.append(float(ev.get("seconds", 0.0) or 0.0))
         elif kind == "relayout_plan":
             p = ev.get("plan") or ev.get("name")
             plan_counts[p] = plan_counts.get(p, 0) + 1
@@ -424,6 +445,50 @@ def summarize(
     if watermarks and "sparse.laplacian_live_bytes" in watermarks:
         out.setdefault("sparse", {})["laplacian_live_bytes"] = int(
             watermarks["sparse.laplacian_live_bytes"]
+        )
+    # streaming counters (heat_tpu/streaming, ISSUE 16): one
+    # `streaming.<counter>` per `streaming` instant event (plus the
+    # rows-field fold into `streaming.rows` — streaming/events.py), so
+    # live summaries (registry counters) and offline sink replays
+    # reconstruct the SAME `streaming` block — the PR 5/11/12/13
+    # reconciliation contract. Derived fields (rows/s ingested, publish
+    # latency, compiles-per-swap, max published version, version lag =
+    # the longest roll step, i.e. the widest mixed-version window) come
+    # from the events in BOTH modes. Absent entirely when no stream ran,
+    # so batch-only summary shapes are unchanged.
+    if live:
+        from . import get_registry as _get_registry
+
+        st = {
+            k[len("streaming."):]: (int(v) if float(v).is_integer() else v)
+            for k, v in _get_registry().counters.items()
+            if k.startswith("streaming.")
+        }
+        if st:
+            out["streaming"] = st
+    elif st_events:
+        from heat_tpu.streaming import EVENT_COUNTER as _st_names
+
+        st = {_st_names.get(k, k): v for k, v in st_events.items()}
+        if st_rows:
+            st["rows"] = st_rows
+        out["streaming"] = st
+    if st_events and "streaming" in out:
+        st = out["streaming"]
+        if st_read_seconds > 0:
+            st["rows_per_s"] = round(st_rows / st_read_seconds, 3)
+        if st_swap_seconds:
+            st["update_latency"] = {
+                "mean": round(sum(st_swap_seconds) / len(st_swap_seconds), 6),
+                "max": round(max(st_swap_seconds), 6),
+            }
+            st["compiles_per_swap"] = st_compiles
+            st["max_version"] = st_max_version
+        if st_roll_seconds:
+            st["version_lag"] = round(max(st_roll_seconds), 6)
+    if watermarks and "streaming.chunk_bytes" in watermarks:
+        out.setdefault("streaming", {})["chunk_bytes"] = int(
+            watermarks["streaming.chunk_bytes"]
         )
     if watermarks:
         peak = watermarks.get("live_bytes.total")

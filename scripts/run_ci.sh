@@ -1451,6 +1451,95 @@ EOF
     fi
 fi
 
+# Streaming gate (ISSUE 16, heat_tpu/streaming): a 2-file HDF5 stream
+# under a pinned HEAT_TPU_HBM_BUDGET that forbids materializing the file
+# set must show
+#   (a) the out-of-core chunk-bytes watermark strictly below the
+#       load-all bytes (the bounded-memory ingestion claim),
+#   (b) digest parity of the streamed moments carry against the
+#       in-memory full-pass reference,
+#   (c) a zero-compile steady stream (one cached-program miss for the
+#       steady chunk shape, hits for every later chunk), and
+#   (d) the rolling replica update: a 2-replica pool rolls v2 and v3
+#       through live open-loop traffic with ZERO failed requests, every
+#       survivor on the final version, and zero steady-state backend
+#       compiles on the replacements (shared-cache warm start).
+# HEAT_TPU_CI_SKIP_STREAMING=1 opts out.
+if [ -z "${HEAT_TPU_CI_SKIP_STREAMING:-}" ]; then
+    echo "=== streaming gate: out-of-core fit + rolling update (4-device mesh) ==="
+    stream_rc=0
+    stream_out=$(mktemp)
+    stream_fmt="--hdf5"
+    python -c "import h5py" 2>/dev/null || stream_fmt=""
+    if HEAT_TPU_TELEMETRY=1 python benchmarks/streaming/heat_tpu.py \
+            --n 40000 --features 16 --files 2 $stream_fmt \
+            --mesh 4 --replica-mesh 4 --replicas 2 --versions 3 \
+            --hbm-budget 2M --requests 120 --rate 100 > "$stream_out"; then
+        python - "$stream_out" <<'EOF' || stream_rc=$?
+import json, sys
+
+summary = None
+for line in open(sys.argv[1]):
+    line = line.strip()
+    if not line:
+        continue
+    try:
+        obj = json.loads(line)
+    except json.JSONDecodeError:
+        continue
+    if obj.get("bench") == "streaming":
+        summary = obj
+if summary is None:
+    raise SystemExit("streaming: no summary line")
+
+sf = summary["stream_fit"] or {}
+if not sf.get("watermark_below_load_all"):
+    raise SystemExit(
+        f"streaming: chunk watermark not below the load-all bytes: {sf}"
+    )
+if not sf.get("digest_match"):
+    raise SystemExit(
+        f"streaming: streamed moments diverged from the in-memory fit: {sf}"
+    )
+if not sf.get("steady_zero_compile"):
+    raise SystemExit(
+        f"streaming: the steady stream kept compiling: {sf}"
+    )
+
+roll = summary["rolling"] or {}
+if not roll.get("zero_failed_requests"):
+    raise SystemExit(
+        f"streaming: requests failed during the rolling update: {roll}"
+    )
+if not roll.get("all_on_final_version"):
+    raise SystemExit(
+        f"streaming: a replica is not on the final version: {roll}"
+    )
+if not roll.get("steady_backend_compiles_ok"):
+    raise SystemExit(
+        "streaming: a rolled replica backend-compiled in steady state "
+        f"(shared-cache warm start failed): {roll}"
+    )
+
+print(
+    f"streaming ok: watermark below load-all, digest parity, steady "
+    f"zero-compile, roll to v3 with 0 failed requests "
+    f"(p99 roll/steady = {roll.get('p99_roll_over_steady')})"
+)
+EOF
+    else
+        stream_rc=$?
+    fi
+    if [ -n "$REPORT" ]; then
+        cp "$stream_out" "${REPORT}/streaming.jsonl" || true
+    fi
+    rm -f "$stream_out"
+    if [ "$stream_rc" != 0 ]; then
+        echo "=== streaming gate FAILED (rc=$stream_rc) ==="
+        FAILED_SIZES="$FAILED_SIZES streaming"
+    fi
+fi
+
 if [ "$have_coverage" = 1 ]; then
     # merge the per-size coverage files, as the reference CI merges its
     # 8 mpirun passes (Jenkinsfile:33-44 / codecov)
