@@ -1,0 +1,219 @@
+#!/usr/bin/env python
+"""Tracing-overhead benchmark: the cost of the distributed request-trace
+plane on the in-process serving hot path (ISSUE 17).
+
+No reference analog (the reference framework has neither a serving tier
+nor request tracing). The runner mounts the PR 8 endpoint set, then
+drives the SAME seeded open-loop Poisson schedule through four tracing
+postures, one JSONL ``{"mode_row": ...}`` each:
+
+* ``telemetry_off`` — the true baseline: every tracing call site is the
+  usual single ``telemetry.enabled()`` flag check;
+* ``off`` — telemetry recording on, ``HEAT_TPU_TRACE_REQUESTS=0``: the
+  headline "tracing off" posture (one extra knob read at ingress, zero
+  per-hop work) — the row the overhead percentages are measured against;
+* ``sampled`` — ``HEAT_TPU_TRACE_SAMPLE=<--sample>`` (default 0.1): the
+  production posture, hop spans for ~10% of requests;
+* ``full`` — sample rate 1.0: every request decomposes into its
+  queue → coalesce → pad → execute → reply spans (worst case).
+
+Every row carries achieved QPS, p50/p99, the response **digest** — all
+four modes must match bit-for-bit (tracing never touches payloads; the
+summary's ``digest_match`` pins it) — and the mode's ``tracing.sampled``
+/ ``tracing.spans`` counters (off must be 0/0, full must sample every
+request). The final summary reports per-mode overhead as a fraction of
+the ``off`` row's QPS, plus the ``on_chip`` / ``cpu_fallback`` honesty
+fields (bench-honesty contract: a CPU-mesh number says so in-band).
+
+``--artifact PATH`` appends the emitted lines (the committed
+``artifacts/bench_tracing_r17.jsonl``).
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+import numpy as np
+
+from benchmarks._harness import base_parser, bootstrap
+
+
+def add_args(p):
+    p.add_argument("--requests", type=int, default=600,
+                   help="requests in the open-loop schedule (the same "
+                        "seeded schedule for every mode)")
+    p.add_argument("--rate", type=float, default=600.0,
+                   help="offered Poisson arrival rate, requests/second")
+    p.add_argument("--streams", type=int, default=2,
+                   help="concurrent submitter threads")
+    p.add_argument("--endpoints", default="dense,cdist",
+                   help="comma-separated endpoint subset "
+                        "(kmeans,lasso,gnb,dense,knn,rbf,cdist)")
+    p.add_argument("--max-batch", type=int, default=8,
+                   help="micro-batch ladder top")
+    p.add_argument("--sample", type=float, default=0.1,
+                   help="HEAT_TPU_TRACE_SAMPLE of the `sampled` mode")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--artifact", default=None,
+                   help="append the emitted JSONL lines to this file")
+
+
+def _emit(lines, obj):
+    print(json.dumps(obj), flush=True)
+    lines.append(obj)
+
+
+def _run_mode(ht, args, eps, reqs, mode, env):
+    """One posture: fresh Server (per-mode histograms and counters start
+    clean), warmup outside the timed window, one open-loop run."""
+    from benchmarks.serving import loadgen
+    from heat_tpu import telemetry
+
+    # benchmark-runner env staging for an in-process mode switch (the
+    # knobs are read per-request at ingress, so this is the same
+    # mechanism a deployment uses)
+    saved = {}
+    for k, v in env.items():
+        saved[k] = os.environ.get(k)
+        os.environ[k] = v
+    reg = None
+    sink = None
+    try:
+        if env.get("HEAT_TPU_TELEMETRY") == "1":
+            sink = tempfile.NamedTemporaryFile(
+                mode="w", suffix=".jsonl", delete=False
+            )
+            reg = telemetry.enable(sink.name)
+            reg.clear()
+        server = ht.serve.Server(max_batch=args.max_batch)
+        for name, ep in eps.items():
+            server.register(name, ep)
+        server.warmup()
+        report = loadgen.run_open_loop(
+            server, reqs, args.rate, seed=args.seed, streams=args.streams,
+        )
+        counters = dict(reg.counters) if reg is not None else {}
+        server.close()
+        return {
+            "mode": mode,
+            "achieved_qps": report["achieved_qps"],
+            "completed": report["completed"],
+            "failed": report["failed"],
+            "shed": report["shed"],
+            "p50_s": report["latency"].get("p50_s"),
+            "p99_s": report["latency"].get("p99_s"),
+            "digest": report["digest"],
+            "tracing": {
+                "sampled": int(counters.get("tracing.sampled", 0)),
+                "spans": int(counters.get("tracing.spans", 0)),
+            },
+        }
+    finally:
+        if reg is not None:
+            telemetry.disable()
+            reg.clear()
+        if sink is not None:
+            sink.close()
+            os.unlink(sink.name)
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def main():
+    p = base_parser("heat_tpu request-tracing overhead benchmark "
+                    "(off vs sampled vs 100%, bit-identity pinned)")
+    add_args(p)
+    args = p.parse_args()
+    ht = bootstrap(args)
+    import jax
+
+    from benchmarks.serving import loadgen
+    from benchmarks.serving.heat_tpu import build_endpoints
+
+    devs = jax.devices()
+    on_chip = devs[0].platform != "cpu"
+    cpu_fallback = (
+        None if on_chip else
+        ("forced virtual cpu mesh (--mesh)" if args.mesh
+         else "default backend is cpu (no accelerator attached)")
+    )
+    lines = []
+    names = [s.strip() for s in args.endpoints.split(",") if s.strip()]
+
+    eps = build_endpoints(ht, args, [n for n in names if n != "cdist"])
+    if "cdist" in names:
+        rng = np.random.default_rng(args.seed)
+        eps["cdist"] = ht.serve.cdist_query(
+            rng.standard_normal((128, args.features)).astype(np.float32)
+        )
+    reqs = loadgen.make_requests(
+        {n: eps[n].features for n in eps},
+        args.requests, args.seed,
+        dtypes={n: eps[n].dtype for n in eps},
+    )
+
+    modes = (
+        ("telemetry_off", {"HEAT_TPU_TELEMETRY": "0"}),
+        ("off", {"HEAT_TPU_TELEMETRY": "1",
+                 "HEAT_TPU_TRACE_REQUESTS": "0"}),
+        ("sampled", {"HEAT_TPU_TELEMETRY": "1",
+                     "HEAT_TPU_TRACE_REQUESTS": "1",
+                     "HEAT_TPU_TRACE_SAMPLE": str(args.sample)}),
+        ("full", {"HEAT_TPU_TELEMETRY": "1",
+                  "HEAT_TPU_TRACE_REQUESTS": "1",
+                  "HEAT_TPU_TRACE_SAMPLE": "1.0"}),
+    )
+    rows = []
+    for mode, env in modes:
+        row = _run_mode(ht, args, eps, reqs, mode, env)
+        rows.append(row)
+        _emit(lines, {"mode_row": row})
+
+    by_mode = {r["mode"]: r for r in rows}
+    base = by_mode["off"]
+    overhead = {
+        m: (round(1.0 - by_mode[m]["achieved_qps"] / base["achieved_qps"],
+                  4)
+            if base["achieved_qps"] else None)
+        for m in ("sampled", "full")
+    }
+    summary = {
+        "bench": "serving_tracing",
+        "requests": args.requests,
+        "offered_rate": args.rate,
+        "streams": args.streams,
+        "endpoints": sorted(eps),
+        "max_batch": args.max_batch,
+        "sample_rate": args.sample,
+        "qps_by_mode": {r["mode"]: r["achieved_qps"] for r in rows},
+        "p99_by_mode": {r["mode"]: r["p99_s"] for r in rows},
+        "overhead_vs_off": overhead,
+        # tracing must never touch answers: one digest across all modes
+        "digest_match": len({r["digest"] for r in rows}) == 1,
+        "off_counters_zero": by_mode["off"]["tracing"] == {
+            "sampled": 0, "spans": 0,
+        },
+        "full_sampled_all": (
+            by_mode["full"]["tracing"]["sampled"] >= args.requests
+        ),
+        "on_chip": on_chip,
+        "cpu_fallback": cpu_fallback,
+        "devices": {"count": len(devs), "kind": devs[0].device_kind},
+    }
+    _emit(lines, summary)
+
+    if args.artifact:
+        with open(args.artifact, "a") as f:
+            for obj in lines:
+                f.write(json.dumps(obj) + "\n")
+
+
+if __name__ == "__main__":
+    main()

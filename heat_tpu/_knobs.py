@@ -387,6 +387,38 @@ _register(
     "never blindly retried (the request may have executed).",
 )
 
+# -- cluster observability knobs (ISSUE 17; docs/OBSERVABILITY.md) ------------
+
+_register(
+    "HEAT_TPU_TRACE_REQUESTS", "bool", True,
+    "Record distributed request traces (serve/tracing.py): a trace id "
+    "minted at ingress rides the wire `trace` field and every hop — "
+    "router queue/post, replica queue/coalesce/pad/execute/reply — "
+    "lands as a `trace_span` telemetry event, mergeable into ONE "
+    "Perfetto timeline across processes. Off is a one-flag-check hot "
+    "path; answers are bit-identical either way.",
+)
+_register(
+    "HEAT_TPU_TRACE_SAMPLE", "float", 1.0,
+    "Ingress trace-sampling rate in [0, 1]. The keep/drop decision is "
+    "made ONCE where the id is minted (deterministic in the id, so "
+    "every process agrees) and propagated — downstream hops never "
+    "re-sample.",
+)
+_register(
+    "HEAT_TPU_SLO_WINDOW_S", "float", 60.0,
+    "Rolling window in seconds over which Router.cluster_summary() "
+    "computes SLO burn rates (windowed deltas of the cumulative "
+    "per-replica scrapes; the first evaluation falls back to the "
+    "lifetime window).",
+)
+_register(
+    "HEAT_TPU_SLO_BURN_THRESHOLD", "float", 1.0,
+    "Burn-rate level above which Router.check_slos() emits a "
+    "`slo_burn` event. 1.0 = consuming error budget exactly at the "
+    "rate that exhausts it over the objective period.",
+)
+
 # -- autotuner knobs (heat_tpu/autotune, ISSUE 11) ----------------------------
 
 _register(
@@ -508,6 +540,12 @@ for _name, _doc in (
      "flat-vs-tiered digest bit-identity on the emulated 2x2 mesh, "
      "audited cross-node byte reduction >= the local shard factor, "
      "DASO tiered-send equivalence, ZeRO watermark check)."),
+    ("HEAT_TPU_CI_SKIP_CLUSTER_OBS", "Skip the cluster-observability "
+     "gate (ISSUE 17: 2-replica pool under loadgen — merged-trace hop "
+     "completeness with a consistent trace id, /metrics merge equal to "
+     "the loadgen totals, tracing-off digest bit-identity with zero "
+     "tracing counters, and an induced-latency SLO burn emitting "
+     "slo_burn events)."),
 ):
     _register(_name, "str", None, _doc, scope="ci")
 del _name, _doc

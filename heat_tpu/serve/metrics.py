@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import math
 import threading
+import time
 from typing import List, Optional
 
 __all__ = ["LatencyHistogram", "EndpointStats"]
@@ -91,16 +92,88 @@ class LatencyHistogram:
             "p99_s": self.quantile(0.99),
         }
 
+    # -- cluster merge contract (ISSUE 17) -----------------------------------
+    # Two histograms with identical (base, growth, nbuckets) merge EXACTLY
+    # by bucket-wise addition: bucket membership depends only on the sample
+    # value, never on which process recorded it, so the merged counts (and
+    # hence every quantile estimate) equal those of a single histogram fed
+    # the concatenated samples. raw() / from_raw() are the wire form of
+    # that contract — GET /metrics ships raw bucket counts, the router
+    # merges them, and fleet-wide percentiles come out of the merged
+    # histogram at the same (one-bucket-width) resolution as local ones.
+
+    def raw(self) -> dict:
+        """Wire-form snapshot: the raw bucket counts plus the scalar
+        moments, tagged with the bucket geometry so a merger can refuse
+        a mismatched histogram instead of silently mis-binning."""
+        return {
+            "base": _BASE,
+            "growth": _GROWTH,
+            "counts": list(self.counts),
+            "count": self.count,
+            "total": self.total,
+            "min": self.min if self.count else None,
+            "max": self.max if self.count else None,
+        }
+
+    @classmethod
+    def from_raw(cls, raw: dict) -> "LatencyHistogram":
+        """Inverse of :meth:`raw` (ValueError on bucket-geometry drift)."""
+        if (
+            float(raw.get("base", _BASE)) != _BASE
+            or float(raw.get("growth", _GROWTH)) != _GROWTH
+            or len(raw.get("counts", ())) != _NBUCKETS
+        ):
+            raise ValueError(
+                "histogram bucket geometry mismatch: expected "
+                f"base={_BASE} growth={_GROWTH} nbuckets={_NBUCKETS}, got "
+                f"base={raw.get('base')} growth={raw.get('growth')} "
+                f"nbuckets={len(raw.get('counts', ()))}"
+            )
+        h = cls()
+        h.counts = [int(c) for c in raw["counts"]]
+        h.count = int(raw.get("count", sum(h.counts)))
+        h.total = float(raw.get("total", 0.0))
+        if raw.get("min") is not None:
+            h.min = float(raw["min"])
+        if raw.get("max") is not None:
+            h.max = float(raw["max"])
+        return h
+
+    def merge(self, other: "LatencyHistogram") -> "LatencyHistogram":
+        """Bucket-wise in-place merge (the exact aggregation contract);
+        returns ``self``."""
+        for i, c in enumerate(other.counts):
+            self.counts[i] += c
+        self.count += other.count
+        self.total += other.total
+        if other.count:
+            if other.min < self.min:
+                self.min = other.min
+            if other.max > self.max:
+                self.max = other.max
+        return self
+
 
 class EndpointStats:
     """Per-endpoint serving aggregates: request/row/batch tallies, shed
     and error counts, pad overhead, and the latency histogram. All
     mutation goes through the instance lock — the submit path and the
-    batcher thread both write here."""
+    batcher thread both write here.
+
+    Scrape contract (ISSUE 17): every tally is **cumulative since
+    ``window_start``** (a monotonic-clock stamp taken at construction)
+    and is never reset. A scraper derives windowed rates entirely on its
+    own side — ``(cur.requests - prev.requests) / (cur.mono -
+    prev.mono)`` — so two consecutive scrapes can never race a reset
+    (there is none), and K scrapers each keep their own window without
+    perturbing each other or the autoscaler.
+    """
 
     def __init__(self, name: str):
         self.name = name
         self._lock = threading.Lock()
+        self.window_start = time.monotonic()
         self.requests = 0
         self.rows = 0
         self.batches = 0
@@ -143,6 +216,8 @@ class EndpointStats:
                 "errors": self.errors,
                 "padded_rows": self.padded_rows,
                 "latency": self.latency.snapshot(),
+                "window_start": self.window_start,
+                "mono": time.monotonic(),
             }
             if self.batches:
                 out["mean_batch_rows"] = self.dispatched_rows / self.batches
@@ -151,3 +226,23 @@ class EndpointStats:
                     self.dispatched_rows / denom if denom else 1.0
                 )
             return out
+
+    def raw_snapshot(self) -> dict:
+        """The ``GET /metrics`` form: cumulative tallies plus the RAW
+        latency bucket counts (mergeable bucket-wise, unlike the
+        quantized quantiles in :meth:`snapshot`), stamped with
+        ``window_start``/``mono`` so scrapers derive windowed rates
+        without any server-side reset."""
+        with self._lock:
+            return {
+                "requests": self.requests,
+                "rows": self.rows,
+                "batches": self.batches,
+                "dispatched_rows": self.dispatched_rows,
+                "padded_rows": self.padded_rows,
+                "shed": self.shed,
+                "errors": self.errors,
+                "window_start": self.window_start,
+                "mono": time.monotonic(),
+                "latency_raw": self.latency.raw(),
+            }

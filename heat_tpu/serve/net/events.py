@@ -31,6 +31,7 @@ EVENT_COUNTER = {
     "kill": "replicas_killed",   # hard kill (chaos)
     "listen": "listens",         # HTTP front bound its port
     "drain": "drains",           # graceful drain began
+    "slo_burn": "slo_burns",     # SLO burn rate crossed threshold (ISSUE 17)
 }
 
 

@@ -245,12 +245,35 @@ class ReplicaPool:
 
     def stats(self, index: int, timeout: float = 5.0) -> dict:
         """``GET /stats`` from one replica."""
+        return self._get_json(index, "/stats", timeout)
+
+    def metrics(self, index: int, timeout: float = 5.0) -> dict:
+        """``GET /metrics`` from one replica (ISSUE 17): the cumulative
+        mergeable scrape — raw latency-histogram buckets, version map,
+        tracing counters — the same payload the router's fleet
+        aggregation consumes."""
+        return self._get_json(index, "/metrics", timeout)
+
+    def scrape_metrics(self, timeout: float = 5.0) -> Dict[str, dict]:
+        """``{url: metrics payload}`` across every live replica — a
+        routerless pool feeds this straight into
+        :func:`heat_tpu.telemetry.cluster.summarize_cluster`."""
+        out: Dict[str, dict] = {}
+        for h in self.replicas:
+            if h.state == "up" and h.url and h.alive():
+                try:
+                    out[h.url] = self.metrics(h.index, timeout)
+                except Exception:
+                    out[h.url] = None
+        return out
+
+    def _get_json(self, index: int, path: str, timeout: float) -> dict:
         import http.client
 
         h = self.handle(index)
         conn = http.client.HTTPConnection(self.host, h.port, timeout=timeout)
         try:
-            conn.request("GET", "/stats")
+            conn.request("GET", path)
             return json.loads(conn.getresponse().read().decode())
         finally:
             conn.close()

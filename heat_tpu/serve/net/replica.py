@@ -96,6 +96,8 @@ def main(argv=None) -> int:
 
     signal.signal(signal.SIGTERM, _on_signal)
     signal.signal(signal.SIGINT, _on_signal)
+    from heat_tpu.serve import tracing
+
     print(json.dumps({
         "ready": True,
         "url": front.url,
@@ -103,6 +105,10 @@ def main(argv=None) -> int:
         "pid": os.getpid(),
         "endpoints": sorted(server.endpoints()),
         "warmup": warm,
+        # observability posture (ISSUE 17): whether this replica records
+        # adopted trace contexts — the pool/CI can verify a fleet's
+        # tracing configuration from the ready lines alone
+        "tracing": tracing.active(),
     }), flush=True)
 
     stop.wait()

@@ -58,6 +58,7 @@ import numpy as np
 
 from heat_tpu import _knobs as knobs
 
+from .. import tracing
 from ..admission import ServeError, ServerClosedError, ServerOverloadedError
 from ..metrics import EndpointStats
 from . import wire
@@ -116,13 +117,16 @@ class _Target:
 
 
 class _Job:
-    __slots__ = ("endpoint", "body", "future", "t0")
+    __slots__ = ("endpoint", "body", "future", "t0", "t_wall", "ctx")
 
-    def __init__(self, endpoint: str, body: bytes):
+    def __init__(self, endpoint: str, body: bytes, ctx=None):
         self.endpoint = endpoint
         self.body = body
         self.future: Future = Future()
         self.t0 = time.perf_counter()
+        # wall twin of t0, trace-only (spans anchor on wall clock)
+        self.t_wall = time.time() if ctx is not None else 0.0
+        self.ctx = ctx  # Optional[tracing.TraceContext]
 
 
 class _InFlightDrop(Exception):
@@ -152,6 +156,7 @@ class Router:
         request_timeout: float = 30.0,
         retry_in_flight: bool = False,
         max_inflight: Optional[int] = None,
+        slos: Optional[Sequence] = None,
     ):
         if hasattr(targets, "urls"):
             targets = targets.urls()
@@ -185,6 +190,12 @@ class Router:
         self._stats_lock = threading.Lock()
         self._queue: "Queue" = Queue()
         self._closed = False
+        # ISSUE 17: declared SLOs (telemetry.cluster.SLO) + the rolling
+        # scrape-snapshot ring cluster_summary() windows burn rates over
+        self.slos = list(slos) if slos else []
+        self.window_start = time.monotonic()
+        self._slo_snaps: List[tuple] = []  # (mono, scrape state)
+        self._slo_lock = threading.Lock()
         self._counts = {"requests": 0, "retries": 0, "evictions": 0,
                         "readds": 0, "failed": 0, "shed": 0}
         self._counts_lock = threading.Lock()
@@ -214,7 +225,17 @@ class Router:
         or the upstream error."""
         if self._closed:
             raise ServerClosedError("router is closed")
-        job = _Job(name, wire.encode_request(np.asarray(payload)))
+        # trace ingress (ISSUE 17): the sampling verdict is made HERE,
+        # once, and rides the wire — replicas adopt, never re-mint
+        ctx = tracing.mint("router.submit")
+        job = _Job(
+            name,
+            wire.encode_request(
+                np.asarray(payload),
+                trace=ctx.to_wire() if ctx is not None else None,
+            ),
+            ctx,
+        )
         self._ep_stats(name).record_request(
             int(np.asarray(payload).shape[0])
             if np.asarray(payload).ndim else 1
@@ -247,6 +268,12 @@ class Router:
         return {
             "endpoints": {n: s.snapshot() for n, s in stats_items},
             "queue_depth": self._queue.qsize(),
+            # scrape contract (ISSUE 17): cumulative-since-window_start
+            # counters + a monotonic stamp, so two scrapes derive rates
+            # on their own side without racing any reset
+            "window_start": self.window_start,
+            "mono": time.monotonic(),
+            "slos": [s.describe() for s in self.slos],
             "replicas": {
                 t.url: {
                     "up": t.up,
@@ -260,6 +287,162 @@ class Router:
             "router": counts,
             "closed": self._closed,
         }
+
+    # -- fleet observability (ISSUE 17) --------------------------------------
+
+    def _ops_get(self, target: _Target, path: str):
+        """GET over a dedicated short-lived connection → ``(status,
+        body)``. The keep-alive poll connections are poll-thread-only;
+        observability scrapes run on caller threads and must not share
+        them."""
+        conn = _NoDelayConnection(
+            target.host, target.port, timeout=_POLL_TIMEOUT
+        )
+        try:
+            conn.request("GET", path)
+            resp = conn.getresponse()
+            return resp.status, resp.read()
+        finally:
+            conn.close()
+
+    def scrape_metrics(self) -> Dict[str, Optional[dict]]:
+        """Pull ``GET /metrics`` from every replica → ``{url: payload}``
+        (``None`` for replicas that failed to answer — merged summaries
+        report them as ``scrape_failures``, never silently drop them)."""
+        out: Dict[str, Optional[dict]] = {}
+        for t in list(self._targets):
+            try:
+                status, body = self._ops_get(t, "/metrics")
+                out[t.url] = (
+                    json.loads(body.decode()) if status == 200 else None
+                )
+            except Exception:
+                out[t.url] = None
+        return out
+
+    def scrape_traces(self) -> Dict[str, Optional[dict]]:
+        """Pull ``GET /trace`` (each replica's in-memory telemetry
+        events) → ``{url: {"pid", "wall", "events"} | None}``."""
+        out: Dict[str, Optional[dict]] = {}
+        for t in list(self._targets):
+            try:
+                status, body = self._ops_get(t, "/trace")
+                out[t.url] = (
+                    json.loads(body.decode()) if status == 200 else None
+                )
+            except Exception:
+                out[t.url] = None
+        return out
+
+    def clock_sync(self, probes: int = 3) -> Dict[str, dict]:
+        """Calibrate each replica's wall-clock offset against this process
+        via the ``/healthz`` round trip: of ``probes`` exchanges on one
+        keep-alive connection, take the minimum-RTT sample and estimate
+        ``offset = remote_wall - rtt_midpoint`` with ``uncertainty =
+        rtt / 2`` (the remote stamp happened somewhere inside the round
+        trip). Returns ``{url: {"offset", "uncertainty", "rtt", "pid"}}``
+        — pre-17 replicas (no ``wall`` in /healthz) are omitted."""
+        out: Dict[str, dict] = {}
+        for t in list(self._targets):
+            best = None
+            pid = None
+            try:
+                conn = _NoDelayConnection(
+                    t.host, t.port, timeout=_POLL_TIMEOUT
+                )
+                try:
+                    for _ in range(max(1, int(probes))):
+                        a = time.time()
+                        conn.request("GET", "/healthz")
+                        resp = conn.getresponse()
+                        body = resp.read()
+                        b = time.time()
+                        payload = json.loads(body.decode())
+                        wall = payload.get("wall")
+                        if wall is None:
+                            break
+                        pid = payload.get("pid")
+                        rtt = b - a
+                        if best is None or rtt < best[0]:
+                            best = (rtt, float(wall) - (a + b) / 2.0)
+                finally:
+                    conn.close()
+            except Exception:
+                continue
+            if best is not None:
+                out[t.url] = {
+                    "offset": best[1],
+                    "uncertainty": best[0] / 2.0,
+                    "rtt": best[0],
+                    "pid": pid,
+                }
+        return out
+
+    def cluster_summary(self) -> dict:
+        """Scrape every replica and return the fleet-merged report
+        (:func:`heat_tpu.telemetry.cluster.summarize_cluster`): fleet
+        QPS + exactly-merged p50/p95/p99 per endpoint, per-replica
+        occupancy/compile/version-lag rows, and — when this router
+        declares SLOs — the ``slo`` burn-rate block. Burn windows roll
+        over ``HEAT_TPU_SLO_WINDOW_S``: each call diffs against the
+        scrape snapshot taken about one window ago (the first call
+        covers each replica's lifetime)."""
+        from ...telemetry import cluster as _cluster
+
+        scrapes = self.scrape_metrics()
+        now = time.monotonic()
+        try:
+            window_s = float(knobs.get("HEAT_TPU_SLO_WINDOW_S"))
+        except (TypeError, ValueError):
+            window_s = 60.0
+        with self._slo_lock:
+            cutoff = now - max(0.001, window_s)
+            # keep the newest snapshot at/older than the cutoff as the
+            # window's far edge; everything older is garbage
+            while len(self._slo_snaps) >= 2 and self._slo_snaps[1][0] <= cutoff:
+                self._slo_snaps.pop(0)
+            prev = self._slo_snaps[0][1] if self._slo_snaps else None
+        summary = _cluster.summarize_cluster(
+            scrapes, slos=self.slos, prev_state=prev,
+            router_stats=self.stats(),
+        )
+        with self._slo_lock:
+            self._slo_snaps.append((now, summary["state"]))
+        return summary
+
+    def check_slos(self) -> List[dict]:
+        """One SLO accounting pass: :meth:`cluster_summary`'s ``slo``
+        block, with an ``slo_burn`` telemetry event emitted for every
+        breach (burn rate above ``HEAT_TPU_SLO_BURN_THRESHOLD``) — the
+        scale-up trigger signal ROADMAP item 4 consumes."""
+        rows = self.cluster_summary().get("slo", [])
+        for row in rows:
+            if row.get("breach"):
+                _emit(
+                    "slo", "slo_burn",
+                    endpoint=row["endpoint"],
+                    burn_rate=row["burn_rate"],
+                    threshold=row["threshold"],
+                    window_requests=row["window_requests"],
+                    window_seconds=row["window_seconds"],
+                )
+        return rows
+
+    def prometheus_text(self) -> str:
+        """The merged fleet view in Prometheus text exposition format
+        (scrape the router once instead of N replicas)."""
+        from ...telemetry import cluster as _cluster
+
+        return _cluster.prometheus_text(self.cluster_summary())
+
+    def export_cluster_trace(self, path: str) -> str:
+        """Export ONE merged Perfetto trace: this router's events plus
+        every replica's (``GET /trace``), clock-offset corrected via the
+        ``/healthz`` calibration, pid = replica, one fleet-wide t=0
+        (:func:`heat_tpu.telemetry.cluster.export_merged_trace`)."""
+        from ...telemetry import cluster as _cluster
+
+        return _cluster.export_merged_trace(self, path)
 
     def close(self) -> None:
         """Stop workers + poll thread; fail queued requests with
@@ -446,6 +629,16 @@ class Router:
 
     def _dispatch(self, job: _Job) -> None:
         st = self._ep_stats(job.endpoint)
+        if job.ctx is not None:
+            # router.queue: ingress -> a worker picked the job up. The
+            # ingress=True flag pairs this span 1:1 with the sampled
+            # mint, the live/offline reconciliation hook.
+            now_wall = time.time()
+            tracing.hop(
+                "router.queue", (job.ctx,), job.t_wall,
+                max(0.0, now_wall - job.t_wall), ingress=True,
+                endpoint=job.endpoint,
+            )
         path = f"/v1/{job.endpoint}"
         tried: set = set()
         attempts = 1 + max(0, self.retries)
@@ -462,6 +655,7 @@ class Router:
                     shed_reasons.append("router_timeout")
                 break
             tried.add(target.url)
+            t_post_wall = time.time() if job.ctx is not None else 0.0
             try:
                 status, data = self._post(target, path, job.body)
             except _ResponseTimeout as e:
@@ -524,6 +718,14 @@ class Router:
                 self._count("requests")
                 _emit("router", "route", replica=target.url,
                       endpoint=job.endpoint, seconds=dt)
+                if job.ctx is not None:
+                    # router.post: the winning HTTP round trip (retries
+                    # that shed/failed are visible as serve_net events)
+                    tracing.hop(
+                        "router.post", (job.ctx,), t_post_wall,
+                        max(0.0, time.time() - t_post_wall),
+                        endpoint=job.endpoint, replica=target.url,
+                    )
                 job.future.set_result(result)
                 return
             ok, message, reason = _safe_decode(data)

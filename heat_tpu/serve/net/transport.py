@@ -24,6 +24,18 @@ endpoints a router needs:
   armed when the front starts (i.e. *after* warm-up), so the router and
   the CI gate can pin the zero-compile steady state of a warm-started
   replica remotely.
+* ``GET /metrics`` — :meth:`Server.metrics` (ISSUE 17): cumulative
+  tallies + RAW latency-histogram buckets, the mergeable scrape form the
+  router's fleet aggregation consumes (docs/OBSERVABILITY.md schema).
+* ``GET /trace`` — this process's in-memory telemetry events plus pid
+  and a wall stamp, so a router can pull every replica's timeline
+  in-band and merge them into one Perfetto trace without sharing a sink
+  file across processes.
+
+``/healthz`` additionally reports ``wall``/``mono`` clock stamps — the
+round trip is the router's clock-sync probe (offset = remote wall − RTT
+midpoint, uncertainty = RTT/2) that aligns per-process timelines in the
+merged trace.
 
 Graceful shutdown: :meth:`HttpFront.drain` sheds new work 503-style
 (router retries siblings), lets queued + in-flight batches finish
@@ -36,6 +48,7 @@ from __future__ import annotations
 import json
 import os
 import threading
+import time
 from concurrent.futures import TimeoutError as FutureTimeoutError
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
@@ -45,6 +58,7 @@ import numpy as np
 from heat_tpu import _knobs as knobs
 
 from ... import telemetry
+from .. import tracing
 from ..admission import ServerClosedError, ServerOverloadedError
 from . import wire
 from .events import emit as _emit
@@ -91,13 +105,20 @@ class _Handler(BaseHTTPRequestHandler):
         front = self.server.front
         if self.path == "/healthz":
             accepting = front.accepting()
+            # wall/mono ride the health probe so the router's clock-sync
+            # round trip needs no extra route (pre-17 clients ignore them)
             body = json.dumps(
                 {"ok": accepting, "draining": front.draining,
-                 "pid": front.pid}
+                 "pid": front.pid, "wall": time.time(),
+                 "mono": time.monotonic()}
             ).encode()
             self._send(200 if accepting else 503, body)
         elif self.path == "/stats":
             self._send(200, json.dumps(front.stats_payload()).encode())
+        elif self.path == "/metrics":
+            self._send(200, json.dumps(front.metrics_payload()).encode())
+        elif self.path == "/trace":
+            self._send(200, json.dumps(front.trace_payload()).encode())
         else:
             self._send_error(404, f"unknown path {self.path!r}", "not_found")
 
@@ -117,10 +138,14 @@ class _Handler(BaseHTTPRequestHandler):
             return
         try:
             length = int(self.headers.get("Content-Length", 0))
-            payload = wire.decode_request(self.rfile.read(length))
+            payload, trace = wire.decode_request_ex(self.rfile.read(length))
         except wire.WireError as e:
             self._send_error(400, str(e), "bad_request")
             return
+        # adopt the ingress's trace verdict (None when the field is
+        # absent: a pre-17 router sent this, and the replica must not
+        # re-mint — sampling is decided once, at the ingress)
+        ctx = tracing.from_wire(trace) if trace is not None else None
         try:
             # capture the version at submit: the server swaps endpoints
             # only between micro-batches, and a replica process mounts
@@ -128,7 +153,7 @@ class _Handler(BaseHTTPRequestHandler):
             # is the version that serves the request in a rolling deploy
             getv = getattr(front.server, "endpoint_version", None)
             version = getv(name) if getv is not None else None
-            fut = front.server.submit(name, payload)
+            fut = front.server.submit(name, payload, trace=ctx)
             result = fut.result(front.request_timeout)
         except ServerOverloadedError as e:
             self._send_error(503, str(e), e.reason)
@@ -278,6 +303,30 @@ class HttpFront:
             "autotune_trials": _autotune_trials(),
         }
         return stats
+
+    def metrics_payload(self) -> dict:
+        """``GET /metrics`` body: :meth:`Server.metrics` (raw mergeable
+        tallies) + the replica identity/clock block scrapers key on."""
+        getm = getattr(self.server, "metrics", None)
+        out = getm() if getm is not None else {"endpoints": {}}
+        out["net"] = {
+            "pid": self.pid,
+            "port": self.port,
+            "draining": self.draining,
+            "steady_backend_compiles": self.steady_backend_compiles(),
+            "wall": time.time(),
+            "mono": time.monotonic(),
+        }
+        return out
+
+    def trace_payload(self) -> dict:
+        """``GET /trace`` body: this process's in-memory telemetry
+        events (empty when telemetry is off), stamped with pid + wall so
+        the merged-trace exporter can label and clock-align the track."""
+        reg = telemetry.get_registry()
+        with reg._lock:
+            events = [dict(ev) for ev in reg.events]
+        return {"pid": self.pid, "wall": time.time(), "events": events}
 
 
 def _autotune_trials() -> Optional[int]:

@@ -15,6 +15,19 @@ Request body (``POST /v1/<endpoint>``)::
 
     {"payload": "<base64(npy bytes)>"}
 
+With request tracing on (ISSUE 17), either envelope additionally carries
+a version-tolerant ``trace`` field minted at the router ingress::
+
+    {"payload": "...", "trace": {"id": "<hex>", "parent": "<span name>",
+                                 "sampled": true}}
+
+``trace`` follows the same compatibility discipline as the response
+``version`` field: :func:`decode_request` only requires the payload key
+and ignores everything else, so pre-17 replicas serve traced requests
+unchanged and pre-17 routers simply never send the field. The payload
+bytes are untouched either way — answers stay bit-identical with tracing
+on or off.
+
 Sparse request body (ISSUE 13 — ragged CSR rows for ``sparse_query``
 endpoints, :class:`heat_tpu.sparse.host.CsrRows`)::
 
@@ -55,6 +68,7 @@ __all__ = [
     "decode_array",
     "encode_request",
     "decode_request",
+    "decode_request_ex",
     "encode_response",
     "encode_error",
     "decode_response",
@@ -92,33 +106,52 @@ def decode_array(data: str) -> np.ndarray:
         raise WireError(f"payload is not a valid .npy blob: {e}") from None
 
 
-def encode_request(payload) -> bytes:
+def encode_request(payload, trace=None) -> bytes:
     """The JSON body of ``POST /v1/<endpoint>``. Dense payloads ride the
     ``payload`` envelope; :class:`~heat_tpu.sparse.host.CsrRows` batches
     ride ``payload_csr`` — three self-describing ``.npy`` blobs plus the
-    feature width, bitwise round-trip like the dense form."""
+    feature width, bitwise round-trip like the dense form. ``trace`` is
+    the optional ISSUE-17 trace-context dict (version-tolerant: absent
+    when tracing is off or the request is unsampled)."""
     from ...sparse.host import CsrRows
 
     if isinstance(payload, CsrRows):
-        return json.dumps({
+        obj = {
             "payload_csr": {
                 "indptr": encode_array(payload.indptr),
                 "indices": encode_array(payload.indices),
                 "values": encode_array(payload.values),
                 "cols": int(payload.cols),
             }
-        }).encode("utf-8")
-    return json.dumps({"payload": encode_array(payload)}).encode("utf-8")
+        }
+    else:
+        obj = {"payload": encode_array(payload)}
+    if trace is not None:
+        obj["trace"] = trace
+    return json.dumps(obj).encode("utf-8")
 
 
 def decode_request(body: bytes):
     """Parse a request body into the payload — a dense array, or a
     :class:`~heat_tpu.sparse.host.CsrRows` batch for the sparse
-    envelope (server side; ``Server.submit`` accepts both)."""
+    envelope (server side; ``Server.submit`` accepts both). Any
+    ``trace`` field is ignored here — transports that propagate tracing
+    use :func:`decode_request_ex`."""
+    return decode_request_ex(body)[0]
+
+
+def decode_request_ex(body: bytes):
+    """Parse a request body → ``(payload, trace_or_None)`` where
+    ``trace`` is the raw wire dict of the ISSUE-17 trace field (``None``
+    when absent or malformed — a bad trace field must never fail a
+    request, it only loses the trace)."""
     try:
         obj = json.loads(body.decode("utf-8"))
     except Exception as e:
         raise WireError(f"request body is not JSON: {e}") from None
+    trace = obj.get("trace") if isinstance(obj, dict) else None
+    if not isinstance(trace, dict):
+        trace = None
     if isinstance(obj, dict) and "payload_csr" in obj:
         csr = obj["payload_csr"]
         if not isinstance(csr, dict) or not all(
@@ -136,14 +169,14 @@ def decode_request(body: bytes):
                 decode_array(csr["indices"]),
                 decode_array(csr["values"]),
                 int(csr["cols"]),
-            )
+            ), trace
         except WireError:
             raise
         except Exception as e:
             raise WireError(f"malformed CSR payload: {e}") from None
     if not isinstance(obj, dict) or "payload" not in obj:
         raise WireError('request JSON must be {"payload": "<base64 npy>"}')
-    return decode_array(obj["payload"])
+    return decode_array(obj["payload"]), trace
 
 
 def encode_response(result: np.ndarray, version=None) -> bytes:

@@ -66,6 +66,7 @@ from .admission import (
     ServerClosedError,
     ServerOverloadedError,
 )
+from . import tracing
 from .endpoints import Endpoint, rebuild
 from .metrics import EndpointStats
 
@@ -75,6 +76,11 @@ DEFAULT_MAX_BATCH = 64
 DEFAULT_WAIT_MS = 2.0
 
 _SHUTDOWN = object()
+# submit()'s trace default: mint locally at this ingress. Distinct from
+# None, which transports pass to say "the remote ingress decides" — a
+# pre-17 router that sent no trace field must not re-mint replica-local
+# contexts (that would double-count against the ingress sampling rate).
+_MINT = object()
 
 
 def _resolve(fut: Future, value=None, exc=None) -> None:
@@ -126,9 +132,12 @@ def _env_ladder(max_batch: int) -> List[int]:
 
 
 class _Request:
-    __slots__ = ("endpoint", "array", "rows", "squeeze", "future", "t_submit")
+    __slots__ = (
+        "endpoint", "array", "rows", "squeeze", "future", "t_submit",
+        "t_wall", "ctx",
+    )
 
-    def __init__(self, endpoint: str, array, squeeze: bool):
+    def __init__(self, endpoint: str, array, squeeze: bool, ctx=None):
         # `array` is a dense (rows, features) ndarray, or a CsrRows
         # batch for sparse endpoints (both expose .shape[0])
         self.endpoint = endpoint
@@ -137,6 +146,10 @@ class _Request:
         self.squeeze = squeeze
         self.future: Future = Future()
         self.t_submit = time.perf_counter()
+        # wall-clock twin of t_submit: trace spans anchor on wall time
+        # so cross-process merges have one clock domain to reconcile
+        self.t_wall = time.time() if ctx is not None else 0.0
+        self.ctx = ctx  # Optional[tracing.TraceContext]
 
 
 class Server:
@@ -356,13 +369,21 @@ class Server:
 
     # -- request path --------------------------------------------------------
 
-    def submit(self, name: str, payload) -> Future:
+    def submit(self, name: str, payload, trace=_MINT) -> Future:
         """Admit + enqueue one request; returns a
         :class:`concurrent.futures.Future` resolving to the result rows
         (1-D payloads resolve to a single row). Sheds with
         :class:`ServerOverloadedError` (status 503) at the admission
         gate; a failed dispatch (after per-batch retries) resolves the
-        future with the error."""
+        future with the error.
+
+        ``trace`` (ISSUE 17) selects the request's trace context: the
+        default mints one here (in-process serving makes ``submit`` the
+        ingress), an adopted :class:`~heat_tpu.serve.tracing.TraceContext`
+        or wire dict continues an upstream router's trace, and ``None``
+        means untraced (the transport's verdict for requests whose
+        ingress sent no trace field). Tracing never changes the result —
+        answers are bit-identical on and off."""
         with self._lock:
             if self._closed:
                 raise ServerClosedError("server is closed")
@@ -418,7 +439,15 @@ class Server:
         except ServerOverloadedError:
             st.record_shed()
             raise
-        req = _Request(name, arr, squeeze)
+        if trace is _MINT:
+            ctx = tracing.mint("serve.submit")
+        elif isinstance(trace, tracing.TraceContext):
+            ctx = trace
+        elif trace is not None:
+            ctx = tracing.from_wire(trace)
+        else:
+            ctx = None
+        req = _Request(name, arr, squeeze, ctx)
         with self._pending_lock:
             self._pending += 1
         st.record_request(req.rows)
@@ -596,6 +625,28 @@ class Server:
             "closed": self._closed,
         }
 
+    def metrics(self) -> dict:
+        """The mergeable form of :meth:`stats` (ISSUE 17, served on
+        ``GET /metrics``): per-endpoint cumulative tallies with RAW
+        latency-histogram bucket counts (bucket-wise addition across
+        replicas is exact — :meth:`LatencyHistogram.merge`), endpoint
+        versions (fleet version-lag detection), the ``serve.*``
+        program-registry counters, and the process's telemetry counters
+        (includes the ``tracing.*`` pair the CI off-run asserts zero)."""
+        snap = telemetry.get_registry().snapshot()
+        return {
+            "endpoints": {
+                name: s.raw_snapshot() for name, s in self._stats.items()
+            },
+            "versions": {
+                name: ep.version for name, ep in self._endpoints.items()
+            },
+            "queue_depth": self._queue.qsize(),
+            "shed": self.admission.sheds,
+            "programs": program_cache.site_stats("serve."),
+            "counters": snap["counters"],
+        }
+
     # -- internals -----------------------------------------------------------
 
     def _ensure_thread(self) -> None:
@@ -676,6 +727,29 @@ class Server:
         ep = self._endpoints[name]
         st = self._stats[name]
         rows = sum(r.rows for r in reqs)
+        # request-trace hop decomposition (ISSUE 17): ctxs is empty for
+        # every untraced batch (tracing off, telemetry off, or nothing
+        # sampled), and all per-hop clock reads stay behind that check —
+        # the untraced dispatch path is timing-identical to pre-17.
+        ctxs = [r.ctx for r in reqs if r.ctx is not None]
+        t_start = time.perf_counter()
+        wall0 = time.time() if ctxs else 0.0
+        if ctxs:
+            # serve.queue: replica ingress -> the batcher picked this
+            # request up (one span per traced request; the coalesce
+            # window is accounted to the batch, not the stragglers)
+            for r in reqs:
+                if r.ctx is not None:
+                    # ingress marks the hop whose process MINTED the
+                    # context (counter-pairing: one ingress span per
+                    # tracing.sampled increment, so an offline sink
+                    # replay reconstructs the sampled tally). Contexts
+                    # adopted off the wire were counted at the router.
+                    tracing.hop(
+                        "serve.queue", (r.ctx,), r.t_wall,
+                        max(0.0, wall0 - r.t_wall), endpoint=name,
+                        ingress=r.ctx.parent_span == "serve.submit",
+                    )
         if ep.is_sparse:
             from ..sparse.host import CsrRows
 
@@ -688,8 +762,16 @@ class Server:
                 reqs[0].array if len(reqs) == 1
                 else np.concatenate([r.array for r in reqs], axis=0)
             )
+        if ctxs:
+            tracing.hop(
+                "serve.coalesce", ctxs, wall0,
+                time.perf_counter() - t_start, endpoint=name,
+                requests=len(reqs), rows=rows,
+            )
         cap = self.admission.bucket_cap(self.ladder)
         t0 = time.perf_counter()
+        pad_s = 0.0
+        exec_s = 0.0
         try:
             pieces = []
             padded_total = 0
@@ -704,9 +786,13 @@ class Server:
                 pad = bucket - crows
                 padded_total += pad
                 if ep.is_sparse:
+                    tp = time.perf_counter() if ctxs else 0.0
                     nnz_cap = ep.nnz_cap_for(bucket, chunk.nnz)
                     padded = chunk.padded(bucket, nnz_cap)
                     prog = self._program(name, ep, bucket, nnz_cap)
+                    if ctxs:
+                        te = time.perf_counter()
+                        pad_s += te - tp
                     out = prog(
                         jnp.asarray(padded.indptr.astype(np.int32)),
                         jnp.asarray(padded.indices),
@@ -714,15 +800,23 @@ class Server:
                         *ep.params,
                     )
                     pieces.append(np.asarray(out)[:crows])
+                    if ctxs:
+                        exec_s += time.perf_counter() - te
                     continue
+                tp = time.perf_counter() if ctxs else 0.0
                 if pad:
                     chunk = np.concatenate(
                         [chunk, np.zeros((pad, ep.features), dtype=ep.dtype)],
                         axis=0,
                     )
                 prog = self._program(name, ep, bucket)
+                if ctxs:
+                    te = time.perf_counter()
+                    pad_s += te - tp
                 out = prog(jnp.asarray(chunk), *ep.params)
                 pieces.append(np.asarray(out)[:crows])
+                if ctxs:
+                    exec_s += time.perf_counter() - te
             result = pieces[0] if len(pieces) == 1 else np.concatenate(
                 pieces, axis=0
             )
@@ -744,6 +838,20 @@ class Server:
         dt = time.perf_counter() - t0
         st.record_batch(rows, padded_total)
         now = time.perf_counter()
+        if ctxs:
+            # pad/execute interleave per chunk, so each gets ONE span
+            # with its accumulated seconds, anchored where the dispatch
+            # loop began (wall = wall0 + perf-clock delta: both stamps
+            # were taken at the same instant, so the offset is exact)
+            wall_t0 = wall0 + (t0 - t_start)
+            tracing.hop(
+                "serve.pad", ctxs, wall_t0, pad_s,
+                endpoint=name, padded_rows=padded_total,
+            )
+            tracing.hop(
+                "serve.execute", ctxs, wall_t0 + pad_s, exec_s,
+                endpoint=name, rows=rows,
+            )
         tel = telemetry.enabled()
         reg = telemetry.get_registry() if tel else None
         if tel:
@@ -768,3 +876,9 @@ class Server:
                     ok=True,
                 )
             _resolve(r.future, piece[0] if r.squeeze else piece)
+        if ctxs:
+            tracing.hop(
+                "serve.reply", ctxs, wall0 + (now - t_start),
+                time.perf_counter() - now, endpoint=name,
+                requests=len(reqs),
+            )

@@ -1,0 +1,187 @@
+"""Distributed request tracing (ISSUE 17 tentpole).
+
+One request through the fleet is many timelines: it queues in the
+router, rides the JSON wire, queues again in a replica, coalesces into a
+micro-batch, gets padded, executes as one ``cached_program`` dispatch,
+and is sliced back out. This module mints the **trace context** that
+stitches those hops into one story:
+
+* :func:`mint` creates a ``TraceContext`` at the ingress (``Router.submit``
+  for networked serving, ``Server.submit`` for in-process serving) — a
+  process-unique ``trace_id`` plus the name of the minting hop as
+  ``parent_span``. The sampling decision (``HEAT_TPU_TRACE_SAMPLE``) is
+  made **once, at ingress**, deterministically from the trace id, and
+  travels with the context — a request is traced at every hop or at
+  none, never half.
+* The context rides the request envelope as a version-tolerant ``trace``
+  field (:func:`heat_tpu.serve.net.wire.encode_request`): old replicas
+  ignore the unknown key, old routers simply never send it, and either
+  way the payload bytes — and therefore the answers — are bit-identical.
+* :func:`hop` stamps each hop as a ``trace_span`` telemetry event
+  (wall-clock ``start_ts`` + ``seconds``, ``trace_id``/``parent``
+  fields) that :mod:`heat_tpu.telemetry.trace` renders on a dedicated
+  *requests* track and :func:`heat_tpu.telemetry.cluster.export_merged_trace`
+  joins across processes into ONE Perfetto timeline.
+
+Cost contract: tracing only records while telemetry records, so with
+telemetry off every call site is the usual single flag check; with
+telemetry on but ``HEAT_TPU_TRACE_REQUESTS=0`` the ingress check is one
+knob read and no per-hop work happens. Tracing never touches payloads —
+answers are bit-identical on and off (pinned by the CI cluster gate).
+
+Counter pairing (the PR 5/11/12 reconciliation discipline): every
+``trace_span`` event increments ``tracing.spans``, and every sampled
+ingress mint increments ``tracing.sampled`` alongside a span carrying
+``ingress=True`` — a live ``report.summarize()`` (counters) and an
+offline sink replay reconstruct the same tallies.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import zlib
+from typing import Any, List, Optional, Sequence
+
+from heat_tpu import _knobs as knobs
+
+from .. import telemetry
+
+__all__ = ["TraceContext", "active", "sample_rate", "mint", "from_wire",
+           "hop", "HOPS"]
+
+# the canonical hop-span names, in request order (docs/OBSERVABILITY.md;
+# the CI gate asserts a sampled routed request produced every one)
+HOPS = (
+    "router.queue",    # router ingress -> worker picked the job up
+    "router.post",     # HTTP round trip to the chosen replica
+    "serve.queue",     # replica ingress -> batcher started its batch
+    "serve.coalesce",  # micro-batch assembly (concat across requests)
+    "serve.pad",       # pad-to-ladder-bucket host work
+    "serve.execute",   # cached_program dispatch + result materialization
+    "serve.reply",     # slicing results back + resolving futures
+)
+
+_COUNTER = itertools.count()
+
+
+class TraceContext:
+    """One request's trace identity: the fleet-unique ``trace_id``, the
+    minting hop's name as ``parent_span``, and the ingress sampling
+    verdict (an unsampled request never constructs one)."""
+
+    __slots__ = ("trace_id", "parent_span")
+
+    def __init__(self, trace_id: str, parent_span: str):
+        self.trace_id = trace_id
+        self.parent_span = parent_span
+
+    def to_wire(self) -> dict:
+        """The version-tolerant ``trace`` field of the request JSON."""
+        return {"id": self.trace_id, "parent": self.parent_span,
+                "sampled": True}
+
+    def __repr__(self) -> str:  # pragma: no cover — debugging aid
+        return f"TraceContext({self.trace_id!r}, parent={self.parent_span!r})"
+
+
+def active() -> bool:
+    """Whether request tracing records: telemetry must be on (the single
+    hot-path flag) AND ``HEAT_TPU_TRACE_REQUESTS`` not opted out."""
+    return telemetry.enabled() and bool(knobs.get("HEAT_TPU_TRACE_REQUESTS"))
+
+
+def sample_rate() -> float:
+    """``HEAT_TPU_TRACE_SAMPLE`` clamped to [0, 1]."""
+    try:
+        rate = float(knobs.get("HEAT_TPU_TRACE_SAMPLE"))
+    except (TypeError, ValueError):
+        return 1.0
+    return min(1.0, max(0.0, rate))
+
+
+def _sampled(trace_id: str, rate: float) -> bool:
+    # deterministic per trace id (the faults-style stable draw): the
+    # verdict is reproducible and independent of which thread minted it
+    if rate >= 1.0:
+        return True
+    if rate <= 0.0:
+        return False
+    return (zlib.crc32(trace_id.encode("ascii")) % 1_000_000) < rate * 1e6
+
+
+def mint(origin: str) -> Optional[TraceContext]:
+    """Mint a context at an ingress hop, or ``None`` when tracing is off
+    or the ingress sampling draw said no. Increments ``tracing.sampled``
+    for every minted (= sampled) context."""
+    if not active():
+        return None
+    trace_id = f"{os.getpid():08x}{next(_COUNTER) & 0xFFFFFFFF:08x}"
+    if not _sampled(trace_id, sample_rate()):
+        return None
+    telemetry.get_registry().add("tracing.sampled", 1)
+    return TraceContext(trace_id, origin)
+
+
+def from_wire(obj: Any) -> Optional[TraceContext]:
+    """Adopt a wire ``trace`` field minted by an upstream ingress, or
+    ``None`` (absent field / malformed / local tracing opted out — the
+    local ``HEAT_TPU_TRACE_REQUESTS=0`` flag wins even when the router
+    sampled the request)."""
+    if not isinstance(obj, dict) or not obj.get("sampled"):
+        return None
+    if not active():
+        return None
+    trace_id = obj.get("id")
+    if not isinstance(trace_id, str) or not trace_id:
+        return None
+    parent = obj.get("parent")
+    return TraceContext(
+        trace_id, parent if isinstance(parent, str) else "remote",
+    )
+
+
+def hop(
+    name: str,
+    ctxs: Sequence[TraceContext],
+    start_ts: float,
+    seconds: float,
+    *,
+    ingress: bool = False,
+    **fields: Any,
+) -> None:
+    """Stamp one hop span onto the telemetry stream. ``ctxs`` is every
+    sampled context the hop served — per-request hops pass one, batch
+    hops pass all of the batch's sampled contexts (the span then carries
+    ``trace_id`` of the first plus the full ``trace_ids`` list, so a
+    per-trace reader finds its batch hops by membership)."""
+    ctxs = [c for c in ctxs if c is not None]
+    if not ctxs:
+        return
+    reg = telemetry.get_registry()
+    reg.add("tracing.spans", 1)
+    primary = ctxs[0]
+    if len(ctxs) > 1:
+        fields["trace_ids"] = [c.trace_id for c in ctxs]
+    if ingress:
+        fields["ingress"] = True
+    reg.emit(
+        "trace_span", name,
+        seconds=float(seconds), start_ts=float(start_ts),
+        trace_id=primary.trace_id, parent=primary.parent_span,
+        **fields,
+    )
+
+
+def span_trace_ids(ev: dict) -> List[str]:
+    """Every trace id a ``trace_span`` event carries (the single
+    ``trace_id`` plus the batch ``trace_ids`` list) — the membership
+    helper trace checkers use."""
+    out = []
+    tid = ev.get("trace_id")
+    if tid:
+        out.append(tid)
+    for t in ev.get("trace_ids") or ():
+        if t not in out:
+            out.append(t)
+    return out

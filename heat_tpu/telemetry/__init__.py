@@ -546,14 +546,17 @@ def measure_compile(fn, *args, **kwargs):
     return dt, compiled
 
 
-# memory/report/hlo/trace import the registry machinery above, so they
-# load last.
+# memory/report/hlo/trace/cluster import the registry machinery above,
+# so they load last.
 from . import memory  # noqa: E402,F401
 from . import report  # noqa: E402,F401
 from . import hlo  # noqa: E402,F401
 from . import trace  # noqa: E402,F401
+from . import cluster  # noqa: E402,F401
 
 export_trace = trace.export_trace
+SLO = cluster.SLO
+summarize_cluster = cluster.summarize_cluster
 
 # Environment activation: HEAT_TPU_TELEMETRY=1 turns recording on at import
 # (heat_tpu/__init__ imports this package, so `import heat_tpu` suffices).

@@ -25,7 +25,7 @@ from __future__ import annotations
 import json
 from typing import Iterable, List, Optional
 
-__all__ = ["load_events", "summarize", "bench_fields"]
+__all__ = ["load_events", "summarize", "summarize_cluster", "bench_fields"]
 
 
 def load_events(path: str) -> List[dict]:
@@ -74,6 +74,8 @@ def summarize(
     sn_events: dict = {}
     sp_events: dict = {}
     st_events: dict = {}
+    tr_spans = 0
+    tr_ingress = 0
     st_rows = 0
     st_read_seconds = 0.0
     st_swap_seconds: list = []
@@ -144,6 +146,13 @@ def summarize(
         elif kind == "serve_net":
             what = ev.get("event") or "event"
             sn_events[what] = sn_events.get(what, 0) + 1
+        elif kind == "trace_span":
+            # request-trace hops (ISSUE 17): every hop pairs with the
+            # `tracing.spans` counter, every ingress hop with
+            # `tracing.sampled` — the live/offline reconciliation pair
+            tr_spans += 1
+            if ev.get("ingress"):
+                tr_ingress += 1
         elif kind == "sparse":
             what = ev.get("event") or "event"
             sp_events[what] = sp_events.get(what, 0) + 1
@@ -423,6 +432,24 @@ def summarize(
         out["serving_net"] = {
             _sn_names.get(k, k): v for k, v in sn_events.items()
         }
+    # request-tracing counters (ISSUE 17): one `trace_span` event per
+    # `tracing.spans` increment, one ingress span per `tracing.sampled`,
+    # so live summaries and offline sink replays reconstruct the SAME
+    # `tracing` block. Absent when no request was traced, so untraced
+    # summary shapes are unchanged — and the CI off-run pins exactly
+    # this absence.
+    if live:
+        from . import get_registry as _get_registry
+
+        _c = _get_registry().counters
+        tr = {
+            "sampled": int(_c.get("tracing.sampled", 0)),
+            "spans": int(_c.get("tracing.spans", 0)),
+        }
+        if tr["sampled"] or tr["spans"]:
+            out["tracing"] = tr
+    elif tr_spans:
+        out["tracing"] = {"sampled": tr_ingress, "spans": tr_spans}
     # sparse-container counters (heat_tpu/sparse, ISSUE 13): every op
     # pairs one `sparse.<op>` counter with one `sparse` instant event
     # (sparse.EVENT_COUNTER), so live summaries (registry counters) and
@@ -495,6 +522,16 @@ def summarize(
         if peak is not None:
             out["peak_live_bytes"] = int(peak)
     return out
+
+
+def summarize_cluster(scrapes, **kwargs) -> dict:
+    """Fleet-merged summary over per-replica ``GET /metrics`` scrapes —
+    thin alias for :func:`heat_tpu.telemetry.cluster.summarize_cluster`
+    (ISSUE 17), living here so the per-process and fleet reports share
+    one import surface."""
+    from . import cluster
+
+    return cluster.summarize_cluster(scrapes, **kwargs)
 
 
 def bench_fields() -> dict:

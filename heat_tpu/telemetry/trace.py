@@ -11,6 +11,10 @@ JSON file loadable in ``chrome://tracing`` or https://ui.perfetto.dev:
 * ``compile`` events → ``"X"`` slices on the *compile* track (the
   AOT/backend-compile durations, visually separated from execution);
 * ``memory`` events → a ``live_bytes`` counter (``"C"``) track;
+* ``trace_span`` events (ISSUE 17 request-trace hops) → ``"X"`` slices
+  on the *requests* track, carrying their ``trace_id`` in ``args`` so
+  Perfetto's query/filter UI groups one request's hops across tracks —
+  and, in a merged export, across processes;
 * everything else (``collective_trace``, ``hlo_audit``, …) → instant
   (``"i"``) markers on the *events* track.
 
@@ -18,6 +22,16 @@ Timestamps: the registry records wall-clock *end* times plus durations;
 slices are re-anchored to their start (``ts - seconds``), shifted so the
 earliest event is t=0, and emitted in microseconds, sorted — the
 monotonic, pid/tid-complete stream the format requires.
+
+Cross-process merging (ISSUE 17): each process records wall clock on its
+own clock domain. A merged export passes per-process ``clock_offset``
+(this process's wall minus the reference process's wall, measured by the
+``/healthz`` round trip), ``clock_uncertainty`` (± RTT/2 of that probe),
+and one fleet-wide ``anchor_ts`` so every track shares t=0. The offset
+correction is explicit, never silent: a merged track carries a
+``clock_sync`` instant record stating the applied offset and its
+uncertainty. The single-process default (no offset, no anchor, no
+uncertainty) is byte-identical to the pre-17 export.
 """
 
 from __future__ import annotations
@@ -26,13 +40,14 @@ import json
 import os
 from typing import Iterable, List, Optional
 
-__all__ = ["to_trace_events", "export_trace"]
+__all__ = ["to_trace_events", "export_trace", "earliest_start"]
 
 _TID_SPANS = 1
 _TID_COMPILE = 2
 _TID_EVENTS = 3
 _TID_MEMORY = 4
 _TID_AUTOTUNE = 5
+_TID_REQUESTS = 6
 
 _THREAD_NAMES = {
     _TID_SPANS: "spans",
@@ -40,6 +55,7 @@ _THREAD_NAMES = {
     _TID_EVENTS: "events",
     _TID_MEMORY: "memory",
     _TID_AUTOTUNE: "autotune",
+    _TID_REQUESTS: "requests",
 }
 
 _META_KEYS = ("ts", "kind", "name", "seconds", "depth", "parent", "start_ts")
@@ -53,12 +69,51 @@ def _args(ev: dict) -> dict:
     return out
 
 
+def _event_start(ev: dict) -> float:
+    kind = ev.get("kind")
+    ts_end = float(ev.get("ts", 0.0))
+    dur = float(ev.get("seconds", 0.0) or 0.0)
+    if kind in ("span", "span_error", "compile", "trace_span"):
+        # spans carry their wall-clock start explicitly (deriving it as
+        # `ts - seconds` mixes the wall and perf_counter clocks and
+        # breaks slice containment at µs scale); compile events do not,
+        # so they fall back to the derived start
+        return float(ev.get("start_ts") or (ts_end - dur))
+    return ts_end
+
+
+def earliest_start(events: Iterable[dict]) -> Optional[float]:
+    """Earliest wall-clock slice start in ``events`` (this process's
+    clock domain) — the per-process input to a merged export's global
+    ``anchor_ts``. ``None`` for an empty stream."""
+    t0 = None
+    for ev in events:
+        start = _event_start(ev)
+        if t0 is None or start < t0:
+            t0 = start
+    return t0
+
+
 def to_trace_events(
-    events: Optional[Iterable[dict]] = None, pid: Optional[int] = None
+    events: Optional[Iterable[dict]] = None, pid: Optional[int] = None,
+    *,
+    clock_offset: float = 0.0,
+    clock_uncertainty: Optional[float] = None,
+    anchor_ts: Optional[float] = None,
+    process_name: Optional[str] = None,
 ) -> List[dict]:
     """Convert telemetry events (default: the live registry's) into a
     sorted Trace Event Format list (``ts``/``dur`` in microseconds,
-    earliest event at t=0, ``pid``/``tid`` on every record)."""
+    earliest event at t=0, ``pid``/``tid`` on every record).
+
+    The keyword-only parameters serve cross-process merges (module
+    docstring): ``clock_offset`` (seconds this process's wall clock runs
+    ahead of the reference — subtracted from every timestamp) with its
+    ``clock_uncertainty`` (emitted as an explicit ``clock_sync`` record
+    whenever it is not ``None``), ``anchor_ts`` (the fleet-wide t=0 in
+    reference wall seconds, replacing the local earliest-event anchor),
+    and ``process_name`` (the track label — e.g. the replica URL). The
+    defaults reproduce the single-process export byte-for-byte."""
     if events is None:
         from . import get_registry
 
@@ -70,7 +125,7 @@ def to_trace_events(
 
     out: List[dict] = [
         {"name": "process_name", "ph": "M", "ts": 0, "pid": pid, "tid": 0,
-         "args": {"name": "heat_tpu.telemetry"}},
+         "args": {"name": process_name or "heat_tpu.telemetry"}},
     ]
     for tid, tname in _THREAD_NAMES.items():
         out.append({"name": "thread_name", "ph": "M", "ts": 0, "pid": pid,
@@ -79,21 +134,24 @@ def to_trace_events(
     rows: List[dict] = []
     t0 = None
     for ev in events:
-        kind = ev.get("kind")
-        ts_end = float(ev.get("ts", 0.0))
+        start = _event_start(ev) - clock_offset
         dur = float(ev.get("seconds", 0.0) or 0.0)
-        if kind in ("span", "span_error", "compile"):
-            # spans carry their wall-clock start explicitly (deriving it as
-            # `ts - seconds` mixes the wall and perf_counter clocks and
-            # breaks slice containment at µs scale); compile events do not,
-            # so they fall back to the derived start
-            start = float(ev.get("start_ts") or (ts_end - dur))
-        else:
-            start = ts_end
         if t0 is None or start < t0:
             t0 = start
         rows.append({"_start": start, "_dur": dur, **ev})
+    if anchor_ts is not None:
+        t0 = anchor_ts
     t0 = t0 or 0.0
+
+    if clock_uncertainty is not None:
+        # merged-export honesty: state the applied correction instead of
+        # silently mixing clock domains (satellite of ISSUE 17)
+        out.append({
+            "name": "clock_sync", "cat": "clock_sync", "ph": "i", "ts": 0.0,
+            "s": "p", "pid": pid, "tid": _TID_EVENTS,
+            "args": {"offset_s": clock_offset,
+                     "uncertainty_s": clock_uncertainty},
+        })
 
     for ev in rows:
         kind = ev.get("kind")
@@ -105,6 +163,14 @@ def to_trace_events(
             out.append({
                 "name": name, "cat": kind, "ph": "X", "ts": ts_us,
                 "dur": dur_us, "pid": pid, "tid": _TID_SPANS,
+                "args": _args(clean),
+            })
+        elif kind == "trace_span":
+            # request-trace hops (ISSUE 17): trace_id stays in args so
+            # Perfetto's filter box collects one request across tracks
+            out.append({
+                "name": name, "cat": "trace_span", "ph": "X", "ts": ts_us,
+                "dur": dur_us, "pid": pid, "tid": _TID_REQUESTS,
                 "args": _args(clean),
             })
         elif kind == "compile":

@@ -1540,6 +1540,99 @@ EOF
     fi
 fi
 
+if [ -z "${HEAT_TPU_CI_SKIP_CLUSTER_OBS:-}" ]; then
+    echo "=== cluster-observability gate: merged tracing + fleet metrics + SLO burn (2-replica pool) ==="
+    clobs_rc=0
+    clobs_out=$(mktemp)
+    if python benchmarks/serving/cluster_obs.py \
+            --n 256 --features 16 --requests 40 --rate 80 \
+            --slo-requests 12 --slo-rate 20 > "$clobs_out"; then
+        python - "$clobs_out" <<'EOF' || clobs_rc=$?
+import json, sys
+
+summary = None
+for line in open(sys.argv[1]):
+    line = line.strip()
+    if not line:
+        continue
+    try:
+        obj = json.loads(line)
+    except json.JSONDecodeError:
+        continue
+    if obj.get("bench") == "cluster_obs":
+        summary = obj
+if summary is None:
+    raise SystemExit("cluster-obs: no summary line")
+
+if not (summary.get("off_clean") and summary.get("on_clean")):
+    raise SystemExit(f"cluster-obs: load phases not clean: {summary}")
+if not summary.get("off_tracing_zero"):
+    raise SystemExit(
+        "cluster-obs: tracing-off run recorded tracing counters "
+        f"(the off posture must do zero per-hop work): {summary}"
+    )
+if not summary.get("digest_match"):
+    raise SystemExit(
+        "cluster-obs: tracing changed the answers (digest mismatch "
+        f"between off and sampled-1.0 runs): {summary}"
+    )
+if not summary.get("metrics_merge_match"):
+    raise SystemExit(
+        "cluster-obs: merged /metrics request totals diverged from "
+        f"the loadgen completions: {summary}"
+    )
+if not summary.get("hops_complete"):
+    raise SystemExit(
+        "cluster-obs: a sampled trace id is missing hop spans "
+        f"({summary.get('complete_ids')}/{summary.get('sampled_ids')} "
+        f"complete): {summary}"
+    )
+if not summary.get("p99_exact_match_inproc"):
+    raise SystemExit(
+        "cluster-obs: summarize_cluster p99 diverged from the "
+        f"server's own histogram quantile: {summary}"
+    )
+if not summary.get("p99_within_bucket"):
+    raise SystemExit(
+        "cluster-obs: merged server-side p99 not within one bucket "
+        f"width of the client-observed p99: {summary}"
+    )
+if not summary.get("merged_trace_ok"):
+    raise SystemExit(
+        "cluster-obs: merged Perfetto export missing pid tracks or "
+        f"clock_sync records: {summary}"
+    )
+if not summary.get("slo_breach"):
+    raise SystemExit(
+        "cluster-obs: injected latency did not drive the SLO burn "
+        f"rate above threshold: {summary}"
+    )
+if not summary.get("slo_burn_emitted"):
+    raise SystemExit(
+        "cluster-obs: breach detected but no slo_burn event/counter "
+        f"emitted: {summary}"
+    )
+
+print(
+    f"cluster-obs ok: digest bit-identity off/on, zero off-counters, "
+    f"{summary.get('complete_ids')}/{summary.get('sampled_ids')} trace "
+    f"ids complete across all hops, exact merged p99, SLO burn "
+    f"breach + slo_burn emitted"
+)
+EOF
+    else
+        clobs_rc=$?
+    fi
+    if [ -n "$REPORT" ]; then
+        cp "$clobs_out" "${REPORT}/cluster_obs.jsonl" || true
+    fi
+    rm -f "$clobs_out"
+    if [ "$clobs_rc" != 0 ]; then
+        echo "=== cluster-observability gate FAILED (rc=$clobs_rc) ==="
+        FAILED_SIZES="$FAILED_SIZES cluster-obs"
+    fi
+fi
+
 if [ "$have_coverage" = 1 ]; then
     # merge the per-size coverage files, as the reference CI merges its
     # 8 mpirun passes (Jenkinsfile:33-44 / codecov)

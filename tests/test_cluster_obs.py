@@ -1,0 +1,698 @@
+"""Cluster observability plane (ISSUE 17): distributed request tracing,
+fleet metrics aggregation, and SLO error-budget accounting.
+
+Covers: the exact bucket-wise histogram-merge contract (K-replica merge
+== the concatenated samples, associativity, empty/single-sample edges),
+the version-tolerant ``trace`` wire field, trace-context mint/adopt and
+deterministic ingress sampling, the in-process Server's hop spans (one
+request decomposes into queue → coalesce → pad → execute → reply sharing
+one trace id, answers bit-identical with tracing off), the scrape
+contract (cumulative tallies + monotonic ``window_start``, scraper-side
+windowed rates), :func:`summarize_cluster` / SLO burn math / Prometheus
+exposition, the HTTP front's ``/metrics`` / ``/trace`` / calibrated
+``/healthz`` endpoints, and the merged cross-process Perfetto export
+with explicit per-track ``clock_sync`` records.
+"""
+
+import http.client
+import json
+import os
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from heat_tpu import telemetry as tm
+from heat_tpu.serve import Server, tracing
+from heat_tpu.serve.metrics import (
+    _BASE,
+    _GROWTH,
+    _NBUCKETS,
+    EndpointStats,
+    LatencyHistogram,
+)
+from heat_tpu.serve.net import HttpFront, wire
+from heat_tpu.telemetry import cluster as tcluster
+from heat_tpu.telemetry import trace as ttrace
+from heat_tpu.telemetry.cluster import (
+    SLO,
+    evaluate_slos,
+    merge_metrics,
+    prometheus_text,
+    summarize_cluster,
+)
+
+
+@pytest.fixture
+def telem(tmp_path):
+    sink = tmp_path / "events.jsonl"
+    reg = tm.enable(str(sink))
+    reg.clear()
+    yield reg, sink
+    tm.disable()
+    reg.clear()
+
+
+@pytest.fixture()
+def rng():
+    return np.random.default_rng(17)
+
+
+def _cdist_server(**kw):
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("max_wait_ms", 1.0)
+    srv = Server(**kw)
+    y = np.random.default_rng(7).standard_normal((16, 8)).astype(np.float32)
+    srv.register("cdist", ht.serve.cdist_query(y))
+    return srv
+
+
+def _hist(samples):
+    h = LatencyHistogram()
+    for s in samples:
+        h.record(s)
+    return h
+
+
+def _copy(h):
+    return LatencyHistogram.from_raw(h.raw())
+
+
+# -- histogram merge contract (satellite c) -----------------------------------
+
+
+class TestHistogramMerge:
+    def test_k_replica_merge_equals_concatenated_samples(self, rng):
+        """The aggregation contract: bucket-wise addition of K replica
+        histograms is byte-for-byte the histogram of the concatenated
+        samples — fleet quantiles lose nothing to merging."""
+        shards = [
+            list(np.abs(rng.standard_normal(n)) * 0.01 + 1e-4)
+            for n in (37, 11, 53, 1)
+        ]
+        merged = LatencyHistogram()
+        for s in shards:
+            merged.merge(_hist(s))
+        concat = _hist([x for s in shards for x in s])
+        assert merged.counts == concat.counts
+        assert merged.count == concat.count
+        assert merged.min == concat.min
+        assert merged.max == concat.max
+        assert merged.total == pytest.approx(concat.total)
+        for q in (0.5, 0.95, 0.99):
+            assert merged.quantile(q) == concat.quantile(q)
+
+    def test_merge_is_associative_and_commutative(self, rng):
+        a = _hist(np.abs(rng.standard_normal(20)) * 0.005)
+        b = _hist(np.abs(rng.standard_normal(30)) * 0.05)
+        c = _hist(np.abs(rng.standard_normal(10)) * 0.5)
+        left = _copy(a).merge(b).merge(c)           # (a + b) + c
+        right = _copy(a).merge(_copy(b).merge(c))   # a + (b + c)
+        swapped = _copy(c).merge(b).merge(a)        # c + b + a
+        assert left.counts == right.counts == swapped.counts
+        assert left.count == right.count == swapped.count
+        assert left.min == right.min == swapped.min
+        assert left.max == right.max == swapped.max
+
+    def test_empty_and_single_sample_edges(self):
+        # empty is the merge identity
+        e = LatencyHistogram().merge(LatencyHistogram())
+        assert e.count == 0 and e.snapshot() == {"count": 0}
+        one = _hist([0.003])
+        merged = _copy(one).merge(LatencyHistogram())
+        assert merged.counts == one.counts and merged.count == 1
+        assert LatencyHistogram().merge(one).counts == one.counts
+        # single sample: every quantile collapses to the observed value
+        assert merged.quantile(0.5) == pytest.approx(0.003)
+        assert merged.quantile(0.99) == pytest.approx(0.003)
+
+    def test_raw_round_trip_and_geometry_check(self, rng):
+        h = _hist(np.abs(rng.standard_normal(25)) * 0.01)
+        back = LatencyHistogram.from_raw(
+            json.loads(json.dumps(h.raw()))  # survives the JSON wire
+        )
+        assert back.counts == h.counts and back.count == h.count
+        assert back.min == h.min and back.max == h.max
+        bad = h.raw()
+        bad["growth"] = 2.0
+        with pytest.raises(ValueError, match="geometry"):
+            LatencyHistogram.from_raw(bad)
+        bad2 = h.raw()
+        bad2["counts"] = bad2["counts"][:10]
+        with pytest.raises(ValueError, match="geometry"):
+            LatencyHistogram.from_raw(bad2)
+
+
+# -- scrape contract (satellite b) --------------------------------------------
+
+
+class TestScrapeContract:
+    def test_window_start_monotonic_and_no_reset(self):
+        st = EndpointStats("ep")
+        st.record_request(3)
+        s1 = st.snapshot()
+        st.record_request(2)
+        s2 = st.snapshot()
+        # window_start is fixed at construction; mono advances; tallies
+        # are cumulative — a scraper can never race a reset
+        assert s1["window_start"] == s2["window_start"]
+        assert s2["mono"] >= s1["mono"] >= s1["window_start"]
+        assert (s1["requests"], s2["requests"]) == (1, 2)
+        r = st.raw_snapshot()
+        assert r["window_start"] == s1["window_start"]
+        assert r["requests"] == 2 and r["rows"] == 5
+        assert r["latency_raw"]["counts"] == [0] * _NBUCKETS
+
+    def test_server_metrics_payload_shape(self, rng):
+        with _cdist_server() as srv:
+            q = rng.standard_normal((2, 8)).astype(np.float32)
+            srv.predict("cdist", q)
+            m = srv.metrics()
+        ep = m["endpoints"]["cdist"]
+        assert ep["requests"] == 1
+        assert ep["latency_raw"]["count"] == 1
+        assert len(ep["latency_raw"]["counts"]) == _NBUCKETS
+        assert m["versions"]["cdist"] >= 1
+        assert "queue_depth" in m and "shed" in m and "counters" in m
+
+
+# -- wire trace field ---------------------------------------------------------
+
+
+class TestWireTrace:
+    def test_trace_field_round_trips(self, rng):
+        payload = rng.standard_normal((2, 6)).astype(np.float32)
+        t = {"id": "deadbeef00000001", "parent": "router.submit",
+             "sampled": True}
+        body = wire.encode_request(payload, trace=t)
+        back, trace = wire.decode_request_ex(body)
+        assert back.tobytes() == payload.tobytes()
+        assert trace == t
+        # plain decode_request ignores the field (old-replica tolerance)
+        assert wire.decode_request(body).tobytes() == payload.tobytes()
+
+    def test_absent_trace_decodes_none_and_payload_unchanged(self, rng):
+        payload = rng.standard_normal((3, 4)).astype(np.float32)
+        body = wire.encode_request(payload)
+        back, trace = wire.decode_request_ex(body)
+        assert trace is None
+        assert back.tobytes() == payload.tobytes()
+        # trace=None must not perturb the encoded bytes (bit-identity of
+        # the off path on the wire)
+        assert wire.encode_request(payload, trace=None) == body
+
+
+# -- trace context: mint / adopt / sample -------------------------------------
+
+
+class TestTraceContext:
+    def test_inactive_without_telemetry(self):
+        assert not tm.enabled()
+        assert tracing.active() is False
+        assert tracing.mint("serve.submit") is None
+
+    def test_mint_and_counter(self, telem):
+        reg, _ = telem
+        ctx = tracing.mint("router.submit")
+        assert ctx is not None
+        assert ctx.parent_span == "router.submit"
+        assert len(ctx.trace_id) == 16
+        assert reg.counters["tracing.sampled"] == 1
+        w = ctx.to_wire()
+        assert w == {"id": ctx.trace_id, "parent": "router.submit",
+                     "sampled": True}
+
+    def test_opt_out_knob(self, telem, monkeypatch):
+        monkeypatch.setenv("HEAT_TPU_TRACE_REQUESTS", "0")
+        assert tracing.active() is False
+        assert tracing.mint("serve.submit") is None
+        # the local opt-out wins even over an upstream-sampled wire field
+        assert tracing.from_wire({"id": "x", "sampled": True}) is None
+
+    def test_sampling_deterministic_and_clamped(self, telem, monkeypatch):
+        assert tracing._sampled("anything", 1.0) is True
+        assert tracing._sampled("anything", 0.0) is False
+        # verdict is a pure function of the id — every process agrees
+        for tid in ("aaaa", "bbbb", "cccc"):
+            assert tracing._sampled(tid, 0.3) == tracing._sampled(tid, 0.3)
+        monkeypatch.setenv("HEAT_TPU_TRACE_SAMPLE", "7.5")
+        assert tracing.sample_rate() == 1.0
+        monkeypatch.setenv("HEAT_TPU_TRACE_SAMPLE", "-1")
+        assert tracing.sample_rate() == 0.0
+        monkeypatch.setenv("HEAT_TPU_TRACE_SAMPLE", "bogus")
+        assert tracing.sample_rate() == 1.0
+
+    def test_sample_zero_mints_nothing(self, telem, monkeypatch):
+        reg, _ = telem
+        monkeypatch.setenv("HEAT_TPU_TRACE_SAMPLE", "0")
+        assert tracing.mint("serve.submit") is None
+        assert reg.counters.get("tracing.sampled", 0) == 0
+
+    def test_from_wire_adoption_and_rejection(self, telem):
+        ctx = tracing.from_wire({"id": "abc123", "sampled": True})
+        assert ctx.trace_id == "abc123" and ctx.parent_span == "remote"
+        ctx = tracing.from_wire(
+            {"id": "abc123", "parent": "router.submit", "sampled": True}
+        )
+        assert ctx.parent_span == "router.submit"
+        for bad in (None, "str", 42, {}, {"id": "x"},
+                    {"id": "x", "sampled": False},
+                    {"id": "", "sampled": True},
+                    {"id": 9, "sampled": True}):
+            assert tracing.from_wire(bad) is None
+
+    def test_hop_emits_span_and_counter(self, telem):
+        reg, _ = telem
+        tracing.hop("router.queue", [None, None], 1.0, 0.5)  # all unsampled
+        assert not reg.events
+        a = tracing.TraceContext("aaaa", "router.submit")
+        b = tracing.TraceContext("bbbb", "router.submit")
+        tracing.hop("router.queue", [a], 100.0, 0.25, ingress=True)
+        tracing.hop("serve.coalesce", [a, b], 101.0, 0.5, rows=8)
+        assert reg.counters["tracing.spans"] == 2
+        ev1, ev2 = reg.events
+        assert ev1["kind"] == "trace_span" and ev1["name"] == "router.queue"
+        assert ev1["trace_id"] == "aaaa" and ev1["parent"] == "router.submit"
+        assert ev1["start_ts"] == 100.0 and ev1["seconds"] == 0.25
+        assert ev1["ingress"] is True and "trace_ids" not in ev1
+        # batch hops carry the full membership list
+        assert ev2["trace_ids"] == ["aaaa", "bbbb"] and ev2["rows"] == 8
+        assert tracing.span_trace_ids(ev2) == ["aaaa", "bbbb"]
+        assert tracing.span_trace_ids(ev1) == ["aaaa"]
+
+
+# -- in-process server hop spans ----------------------------------------------
+
+
+class TestServerTracing:
+    def test_one_request_decomposes_into_all_serve_hops(self, telem, rng):
+        reg, _ = telem
+        q = rng.standard_normal((2, 8)).astype(np.float32)
+        with _cdist_server() as srv:
+            srv.warmup()
+            reg.clear()
+            srv.predict("cdist", q)
+        spans = [e for e in reg.events if e["kind"] == "trace_span"]
+        names = {e["name"] for e in spans}
+        assert names == {"serve.queue", "serve.coalesce", "serve.pad",
+                         "serve.execute", "serve.reply"}
+        # every hop carries the ONE minted trace id
+        (tid,) = {e["trace_id"] for e in spans
+                  if e["name"] == "serve.queue"}
+        for e in spans:
+            assert tid in tracing.span_trace_ids(e), e["name"]
+        # ingress mint increments sampled; each hop incremented spans
+        assert reg.counters["tracing.sampled"] >= 1
+        assert reg.counters["tracing.spans"] == len(spans)
+        # the ingress span names its minting hop as parent
+        q_span = next(e for e in spans if e["name"] == "serve.queue")
+        assert q_span["parent"] == "serve.submit"
+
+    def test_explicit_none_trace_is_untraced(self, telem, rng):
+        reg, _ = telem
+        q = rng.standard_normal((1, 8)).astype(np.float32)
+        with _cdist_server() as srv:
+            srv.warmup()
+            reg.clear()
+            # the transport's contract: an absent wire field must NOT
+            # trigger replica-local re-minting
+            srv.submit("cdist", q, trace=None).result(30.0)
+        assert not [e for e in reg.events if e["kind"] == "trace_span"]
+        assert reg.counters.get("tracing.sampled", 0) == 0
+
+    def test_answers_bit_identical_tracing_on_vs_off(
+        self, telem, rng, monkeypatch
+    ):
+        q = rng.standard_normal((3, 8)).astype(np.float32)
+        with _cdist_server() as srv:
+            srv.warmup()
+            on = np.asarray(srv.predict("cdist", q))
+            monkeypatch.setenv("HEAT_TPU_TRACE_REQUESTS", "0")
+            off = np.asarray(srv.predict("cdist", q))
+        assert on.tobytes() == off.tobytes()
+
+    def test_report_reconciles_live_and_offline(self, telem, rng):
+        reg, sink = telem
+        q = rng.standard_normal((2, 8)).astype(np.float32)
+        with _cdist_server() as srv:
+            srv.warmup()
+            reg.clear()
+            srv.predict("cdist", q)
+            tm.flush("test")
+        live = tm.report.summarize()["tracing"]
+        offline = tm.report.summarize(
+            events=tm.report.load_events(str(sink))
+        )["tracing"]
+        assert live["spans"] == offline["spans"] > 0
+        assert live["sampled"] == offline["sampled"] >= 1
+
+    def test_untraced_summary_has_no_tracing_block(self, telem):
+        assert "tracing" not in tm.report.summarize(events=[])
+
+
+# -- fleet merge + summary ----------------------------------------------------
+
+
+def _payload(requests, mono, *, hist=None, errors=0, shed=0, version=1,
+             pid=100, window_start=0.0, sampled=0, spans=0):
+    h = hist if hist is not None else LatencyHistogram()
+    return {
+        "endpoints": {"ep": {
+            "requests": requests, "rows": requests, "batches": requests,
+            "dispatched_rows": requests, "padded_rows": 0,
+            "shed": shed, "errors": errors,
+            "window_start": window_start, "mono": mono,
+            "latency_raw": h.raw(),
+        }},
+        "versions": {"ep": version},
+        "queue_depth": 0,
+        "shed": shed,
+        "counters": {"tracing.sampled": sampled, "tracing.spans": spans},
+        "net": {"pid": pid, "steady_backend_compiles": 0},
+    }
+
+
+class TestClusterSummary:
+    def test_merge_metrics_sums_and_merges(self, rng):
+        h1 = _hist(np.abs(rng.standard_normal(40)) * 0.01)
+        h2 = _hist(np.abs(rng.standard_normal(60)) * 0.01)
+        scrapes = {
+            "http://r1": _payload(40, 10.0, hist=h1, pid=1, sampled=4),
+            "http://r2": _payload(60, 10.0, hist=h2, pid=2, errors=2),
+            "http://r3": None,  # failed scrape is reported, never dropped
+        }
+        merged = merge_metrics(scrapes)
+        ep = merged["endpoints"]["ep"]
+        assert ep["requests"] == 100 and ep["errors"] == 2
+        assert ep["replicas"] == 2
+        want = _copy(h1).merge(h2)
+        assert ep["hist"].counts == want.counts
+        assert merged["scrape_failures"] == ["http://r3"]
+        assert merged["replicas"]["http://r1"]["tracing"]["sampled"] == 4
+
+    def test_summary_windowed_qps_and_p99(self, rng):
+        samples = np.abs(rng.standard_normal(200)) * 0.01 + 1e-4
+        h1, h2 = _hist(samples[:80]), _hist(samples[80:])
+        s1 = summarize_cluster({
+            "http://r1": _payload(80, 10.0, hist=h1, pid=1),
+            "http://r2": _payload(120, 10.0, hist=h2, pid=2),
+        })
+        ep = s1["endpoints"]["ep"]
+        # lifetime window on the first scrape: 200 requests over 10 s
+        assert ep["qps"] == pytest.approx(20.0, abs=0.01)
+        assert ep["window_requests"] == 200
+        # fleet p99 == the concatenated-sample p99 (merge exactness)
+        assert ep["latency"]["p99_s"] == _hist(samples).quantile(0.99)
+        assert ep["occupancy"] == 1.0
+        # windowed second scrape: +50 requests per replica over +5 s
+        s2 = summarize_cluster({
+            "http://r1": _payload(130, 15.0, hist=h1, pid=1),
+            "http://r2": _payload(170, 15.0, hist=h2, pid=2),
+        }, prev_state=s1["state"])
+        ep2 = s2["endpoints"]["ep"]
+        assert ep2["window_requests"] == 100
+        assert ep2["qps"] == pytest.approx(20.0, abs=0.01)
+
+    def test_version_lag_counts_stale_replicas(self):
+        s = summarize_cluster({
+            "http://r1": _payload(1, 1.0, version=3, pid=1),
+            "http://r2": _payload(1, 1.0, version=2, pid=2),
+        })
+        ep = s["endpoints"]["ep"]
+        assert ep["version"] == 3 and ep["version_lag"] == 1
+
+    def test_prometheus_text_exposition(self, rng):
+        h = _hist(np.abs(rng.standard_normal(50)) * 0.01 + 1e-4)
+        s = summarize_cluster(
+            {"http://r1": _payload(50, 10.0, hist=h, pid=1)},
+            slos=[SLO("ep", p99_s=10.0)],
+        )
+        text = prometheus_text(s)
+        assert 'heat_tpu_requests_total{endpoint="ep"} 50' in text
+        assert 'heat_tpu_qps{endpoint="ep"}' in text
+        assert 'quantile="0.99"' in text
+        assert 'heat_tpu_replica_queue_depth{replica="http://r1"} 0' in text
+        assert 'heat_tpu_slo_burn_rate{endpoint="ep"}' in text
+        # every non-comment line is "name{labels} value"
+        for line in text.strip().splitlines():
+            if not line.startswith("#"):
+                name, _, value = line.rpartition(" ")
+                float(value)
+                assert name.startswith("heat_tpu_")
+
+
+# -- SLO burn math ------------------------------------------------------------
+
+
+class TestSLOBurn:
+    def test_validation(self):
+        with pytest.raises(ValueError, match="no objective"):
+            SLO("ep")
+        with pytest.raises(ValueError, match="positive"):
+            SLO("ep", p99_s=0.0)
+        with pytest.raises(ValueError, match="availability"):
+            SLO("ep", availability=1.0)
+        assert SLO("ep", p99_s=0.5).describe() == {
+            "endpoint": "ep", "p99_s": 0.5, "availability": None,
+        }
+
+    def test_latency_burn_from_tail_fraction(self):
+        # 90 fast requests + 10 at 0.5 s against a 0.1 s p99 target:
+        # slow fraction 0.1 over a 1% budget → burn 10
+        h = _hist([0.001] * 90 + [0.5] * 10)
+        window = {"ep": {
+            "requests": 100, "errors": 0, "shed": 0, "seconds": 10.0,
+            "qps": 10.0, "counts": list(h.counts), "count": h.count,
+        }}
+        (row,) = evaluate_slos([SLO("ep", p99_s=0.1)], window)
+        assert row["slow_fraction"] == pytest.approx(0.1)
+        assert row["latency_burn"] == pytest.approx(10.0)
+        assert row["burn_rate"] == pytest.approx(10.0)
+        assert row["breach"] is True
+        # the same traffic against a generous target burns nothing
+        (ok,) = evaluate_slos([SLO("ep", p99_s=10.0)], window)
+        assert ok["latency_burn"] == 0.0 and ok["breach"] is False
+
+    def test_availability_burn_counts_errors_and_shed(self):
+        window = {"ep": {
+            "requests": 95, "errors": 3, "shed": 5, "seconds": 10.0,
+            "qps": 9.5, "counts": None, "count": 0,
+        }}
+        (row,) = evaluate_slos([SLO("ep", availability=0.99)], window)
+        # bad = 3 errors + 5 shed over 95 + 5 attempts = 8%; budget 1%
+        assert row["bad_fraction"] == pytest.approx(0.08)
+        assert row["availability_burn"] == pytest.approx(8.0)
+        assert row["breach"] is True
+
+    def test_combined_burn_is_max_of_objectives(self):
+        h = _hist([0.001] * 100)
+        window = {"ep": {
+            "requests": 99, "errors": 1, "shed": 0, "seconds": 10.0,
+            "qps": 9.9, "counts": list(h.counts), "count": h.count,
+        }}
+        (row,) = evaluate_slos(
+            [SLO("ep", p99_s=0.1, availability=0.99)], window
+        )
+        assert row["latency_burn"] == 0.0
+        assert row["availability_burn"] == pytest.approx(1.0101, abs=1e-3)
+        assert row["burn_rate"] == row["availability_burn"]
+
+    def test_threshold_knob_gates_breach(self, monkeypatch):
+        window = {"ep": {
+            "requests": 90, "errors": 10, "shed": 0, "seconds": 1.0,
+            "qps": 90.0, "counts": None, "count": 0,
+        }}
+        slo = SLO("ep", availability=0.99)
+        (row,) = evaluate_slos([slo], window)
+        assert row["breach"] is True
+        monkeypatch.setenv("HEAT_TPU_SLO_BURN_THRESHOLD", "1000")
+        (row,) = evaluate_slos([slo], window)
+        assert row["breach"] is False and row["threshold"] == 1000.0
+
+    def test_no_traffic_no_burn(self):
+        (row,) = evaluate_slos([SLO("ep", p99_s=0.1, availability=0.99)], {})
+        assert row["burn_rate"] == 0.0 and row["breach"] is False
+
+    def test_tail_count_interpolation(self):
+        counts = [0] * _NBUCKETS
+        counts[20] = 10  # one bucket of 10 samples
+        lo = _BASE * _GROWTH ** 19
+        hi = _BASE * _GROWTH ** 20
+        # threshold below the bucket → all 10; above → none; midpoint →
+        # the straddling fraction
+        assert tcluster._tail_count(counts, lo / 2) == pytest.approx(10.0)
+        assert tcluster._tail_count(counts, hi * 2) == 0.0
+        mid = tcluster._tail_count(counts, (lo + hi) / 2)
+        assert 0.0 < mid < 10.0
+
+
+# -- HTTP front: /metrics, /trace, calibrated /healthz ------------------------
+
+
+def _http(host, port, method, path, body=None, timeout=10.0):
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        conn.request(method, path, body=body)
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    finally:
+        conn.close()
+
+
+class TestHttpObservability:
+    def test_metrics_trace_and_healthz_endpoints(self, telem, rng):
+        reg, _ = telem
+        q = rng.standard_normal((2, 8)).astype(np.float32)
+        t = {"id": "feedface00000001", "parent": "router.submit",
+             "sampled": True}
+        with _cdist_server() as srv:
+            srv.warmup()
+            reg.clear()
+            with HttpFront(srv, port=0) as front:
+                status, body = _http(
+                    front.host, front.port, "POST", "/v1/cdist",
+                    wire.encode_request(q, trace=t),
+                )
+                assert status == 200
+                ok, got, _ = wire.decode_response(body)
+                assert ok
+                # the wire-adopted context stamped every replica hop
+                spans = [e for e in reg.events
+                         if e["kind"] == "trace_span"]
+                assert {e["name"] for e in spans} >= {
+                    "serve.queue", "serve.execute", "serve.reply",
+                }
+                for e in spans:
+                    assert "feedface00000001" in tracing.span_trace_ids(e)
+                # no replica-side re-mint for a routed request
+                assert reg.counters.get("tracing.sampled", 0) == 0
+
+                status, body = _http(
+                    front.host, front.port, "GET", "/metrics"
+                )
+                m = json.loads(body)
+                assert status == 200
+                assert m["endpoints"]["cdist"]["requests"] == 1
+                assert m["endpoints"]["cdist"]["latency_raw"]["count"] == 1
+                assert m["net"]["pid"] == os.getpid()
+                assert m["counters"]["tracing.spans"] == len(spans)
+
+                status, body = _http(front.host, front.port, "GET", "/trace")
+                tr = json.loads(body)
+                assert status == 200 and tr["pid"] == os.getpid()
+                assert any(e.get("kind") == "trace_span"
+                           for e in tr["events"])
+
+                status, body = _http(
+                    front.host, front.port, "GET", "/healthz"
+                )
+                hz = json.loads(body)
+                # the clock-calibration fields (offset = wall − RTT mid)
+                assert hz["ok"] and "wall" in hz and "mono" in hz
+
+    def test_metrics_works_without_telemetry(self, rng):
+        assert not tm.enabled()
+        with _cdist_server() as srv:
+            srv.warmup()
+            with HttpFront(srv, port=0) as front:
+                status, body = _http(
+                    front.host, front.port, "GET", "/metrics"
+                )
+                m = json.loads(body)
+                assert status == 200 and "cdist" in m["endpoints"]
+
+
+# -- merged trace export + clock sync (satellite a) ---------------------------
+
+
+class TestMergedTraceExport:
+    def _events(self):
+        with tm.span("op", bytes=32):
+            pass
+        tracing.hop(
+            "router.queue",
+            [tracing.TraceContext("aaaa0000bbbb1111", "router.submit")],
+            1000.0, 0.25, ingress=True,
+        )
+        return list(tm.get_registry().events)
+
+    def test_default_export_unchanged_by_zero_offset(self, telem):
+        """Satellite a: single-process export stays byte-identical —
+        the clock-sync machinery is additive."""
+        events = self._events()
+        base = ttrace.to_trace_events(events, pid=7)
+        zero = ttrace.to_trace_events(events, pid=7, clock_offset=0.0)
+        assert json.dumps(base) == json.dumps(zero)
+        assert not any(e.get("cat") == "clock_sync" for e in base)
+
+    def test_offset_shifts_and_uncertainty_records(self, telem):
+        events = self._events()
+        t0 = ttrace.earliest_start(events)
+        assert t0 is not None
+        base = ttrace.to_trace_events(events, pid=7, anchor_ts=t0 - 1.0)
+        shifted = ttrace.to_trace_events(
+            events, pid=7, clock_offset=0.5, clock_uncertainty=0.002,
+            anchor_ts=t0 - 1.0,
+        )
+        b = [e for e in base if e["ph"] == "X"]
+        s = [e for e in shifted if e["ph"] == "X"]
+        for eb, es in zip(b, s):
+            assert es["ts"] == pytest.approx(eb["ts"] - 0.5e6, abs=1.0)
+        (sync,) = [e for e in shifted if e.get("cat") == "clock_sync"]
+        assert sync["args"]["offset_s"] == 0.5
+        assert sync["args"]["uncertainty_s"] == 0.002
+
+    def test_trace_span_renders_on_requests_track(self, telem):
+        events = self._events()
+        evs = ttrace.to_trace_events(events, pid=7)
+        req = [e for e in evs if e.get("cat") == "trace_span"]
+        assert req and all(e["ph"] == "X" for e in req)
+        assert req[0]["args"]["trace_id"] == "aaaa0000bbbb1111"
+        names = {e["args"]["name"] for e in evs if e["ph"] == "M"
+                 and e["name"] == "thread_name"}
+        assert "requests" in names
+
+    def test_export_merged_trace_joins_processes(self, telem, tmp_path):
+        self._events()
+
+        class _FakeRouter:
+            def clock_sync(self):
+                return {"http://r1": {
+                    "offset": 0.25, "uncertainty": 0.001,
+                    "rtt": 0.002, "pid": 4242,
+                }}
+
+            def scrape_traces(self):
+                return {"http://r1": {
+                    "pid": 4242, "wall": 2000.0,
+                    "events": [{
+                        "ts": 2000.0, "kind": "trace_span",
+                        "name": "serve.execute", "seconds": 0.1,
+                        "start_ts": 2000.0,
+                        "trace_id": "aaaa0000bbbb1111",
+                        "parent": "router.post",
+                    }],
+                }}
+
+        out = tmp_path / "merged.json"
+        tcluster.export_merged_trace(_FakeRouter(), str(out))
+        doc = json.loads(out.read_text())
+        evs = doc["traceEvents"]
+        pids = {e["pid"] for e in evs}
+        assert os.getpid() in pids and 4242 in pids
+        # each pid track is labelled with its process identity
+        labels = {e["args"]["name"] for e in evs
+                  if e["ph"] == "M" and e["name"] == "process_name"}
+        assert {"router", "http://r1"} <= labels
+        # EVERY track carries its explicit clock_sync record (the
+        # router's is the zero-offset reference domain)
+        syncs = {e["pid"]: e["args"]
+                 for e in evs if e.get("cat") == "clock_sync"}
+        assert set(syncs) == pids
+        assert syncs[4242]["offset_s"] == 0.25
+        assert syncs[os.getpid()]["offset_s"] == 0.0
+        # the same trace id appears on both process tracks
+        joined = {e["pid"] for e in evs
+                  if e.get("args", {}).get("trace_id")
+                  == "aaaa0000bbbb1111"}
+        assert joined == pids

@@ -188,7 +188,7 @@ class TestHttpFront:
             _closed = False
             behavior = "ok"
 
-            def submit(self, name, payload):
+            def submit(self, name, payload, trace=None):
                 if self.behavior == "queue_full":
                     raise ServerOverloadedError(
                         "full", reason="queue_full", endpoint=name
