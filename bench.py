@@ -1053,6 +1053,19 @@ def main():
             # carries its own on_chip=false + cpu_fallback reason even
             # when the parent bench is on-chip (an accelerator cannot be
             # shared across replica processes).
+            # full-FSDP probe (ISSUE 18, schema in docs/BENCHMARKS.md):
+            # replicated vs fsdp vs fsdp+prefetch training step — step
+            # wall, per-device parameter + optimizer-state watermark,
+            # and audited-vs-predicted weight-gather wire bytes. The
+            # memory and byte figures transfer to real hardware; on a
+            # CPU host the walls are structural (the honest on_chip bit
+            # above governs this field too).
+            try:
+                from benchmarks.fsdp import heat_tpu as _fsdp_bench
+
+                detail["fsdp"] = _fsdp_bench.bench_field()
+            except Exception as e:  # noqa: BLE001
+                detail["fsdp"] = {"error": repr(e)}
             try:
                 from benchmarks.serving import net as _snet
 

@@ -20,7 +20,7 @@ from __future__ import annotations
 import math
 from typing import Callable, Dict, List, Optional, Sequence
 
-__all__ = ["prune", "rank", "relayout_cost_fn"]
+__all__ = ["prune", "rank", "relayout_cost_fn", "fsdp_cost_fn"]
 
 ConfigCost = Callable[[Dict[str, str]], float]
 
@@ -66,6 +66,106 @@ def prune(
             continue
         kept.append(cfg)
     return kept
+
+
+def fsdp_cost_fn(
+    leaf_numels: Sequence[int],
+    itemsize: int,
+    nproc: int,
+    *,
+    dtype: str = "float32",
+) -> ConfigCost:
+    """Analytic cost of one FSDP training step (ISSUE 18) under a
+    candidate config: per sharded leaf, one just-in-time weight gather
+    in the forward, one re-gather in the rematerialized backward, and
+    one gradient reduce-scatter — priced by
+    :func:`heat_tpu.telemetry.collectives.fsdp_gather_cost` /
+    ``fsdp_scatter_cost`` at the candidate's wire precision
+    (``HEAT_TPU_FSDP_PREC``, falling back through the tiered cross-node
+    chain exactly like :func:`heat_tpu.core.topology.fsdp_wire`).
+
+    Prefetch depth (``HEAT_TPU_FSDP_PREFETCH``) moves no bytes — it is
+    pure scheduling — so it is modelled as *exposure*: depth ``d``
+    overlaps gathers with compute, leaving roughly ``1/(d+1)`` of the
+    gather volume on the critical path, while the backward's scatter
+    stream stays exposed. That is enough for the analytic stage to rank
+    prefetch>0 above serial without pretending to know the GEMM wall;
+    measured trials settle the rest. Topology-aware DCN pricing arms
+    only when the lattice searches ``HEAT_TPU_HIERARCHICAL``, mirroring
+    :func:`relayout_cost_fn`."""
+    from ..telemetry import collectives as model
+
+    numels = [int(n) for n in leaf_numels]
+
+    def fn(config: Dict[str, str]) -> float:
+        from ..core import collective_prec, topology
+
+        prec = (config.get("HEAT_TPU_FSDP_PREC") or "").strip() or None
+        if prec is None:
+            prec = (
+                config.get("HEAT_TPU_HIERARCHICAL_PREC") or ""
+            ).strip() or None
+        if prec is None:
+            prec = (config.get("HEAT_TPU_COLLECTIVE_PREC") or "off").strip()
+        prec = collective_prec.effective(dtype, prec)
+        try:
+            block = int(config.get("HEAT_TPU_COLLECTIVE_PREC_BLOCK") or 0)
+        except ValueError:
+            block = 0
+        block = block if block > 0 else model.DEFAULT_WIRE_BLOCK
+        try:
+            depth = int(config.get("HEAT_TPU_FSDP_PREFETCH") or 0)
+        except ValueError:
+            return math.inf
+        if depth < 0:
+            return math.inf
+        searching_hier = "HEAT_TPU_HIERARCHICAL" in config
+        hier_on = (config.get("HEAT_TPU_HIERARCHICAL") or "0").strip() in (
+            "1", "true", "yes", "on",
+        )
+        topo = topology.resolve(nproc)
+        tiered = hier_on and topo.nontrivial
+        node, local = (topo.node, topo.local) if tiered else (1, nproc)
+        gathers: List = []
+        scatters: List = []
+        for numel in numels:
+            chunk = -(-numel // nproc)
+            if prec == "blockwise":
+                chunk = -(-chunk // block) * block
+            gathers.append(
+                model.fsdp_gather_cost(
+                    chunk, itemsize, node, local, prec, block=block
+                )
+            )
+            scatters.append(
+                model.fsdp_scatter_cost(
+                    chunk * nproc, itemsize, node, local, prec, block=block
+                )
+            )
+        premium = None
+        if searching_hier:
+            try:
+                premium = float(config.get("HEAT_TPU_DCN_PREMIUM") or 0)
+            except ValueError:
+                premium = 0.0
+            if premium <= 0:
+                premium = None  # weighted_wire falls back to the live knob
+
+        def price(c) -> float:
+            if not searching_hier:
+                return float(c.bytes)
+            if topo.nontrivial and not c.dcn_bytes and c.bytes:
+                # flat lowering on a 2-level topology: all bytes ride DCN
+                c = model.CollectiveCost(
+                    c.kind, c.bytes, steps=c.steps, dcn_bytes=c.bytes
+                )
+            return float(model.weighted_wire(c, premium))
+
+        gather_wall = 2.0 * sum(price(c) for c in gathers)
+        scatter_wall = sum(price(c) for c in scatters)
+        return scatter_wall + gather_wall / float(depth + 1)
+
+    return fn
 
 
 def relayout_cost_fn(

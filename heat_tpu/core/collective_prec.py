@@ -90,6 +90,8 @@ __all__ = [
     "all_to_all",
     "gspmd_reshard",
     "local_roundtrip",
+    "quant_error_bound",
+    "allreduce_wire_dtype",
     "bench_field",
 ]
 
@@ -248,6 +250,57 @@ def local_roundtrip(x, mode_: str, block: Optional[int] = None):
     block = block or block_size()
     q, s = _quant_flat_blocks(x, block)
     return _dequant_flat_blocks(q, s, x.size, x.shape, x.dtype)
+
+
+def quant_error_bound(x, mode_: str, hops: int = 1) -> float:
+    """Documented per-element absolute error bound of ``hops``
+    quantization steps of ``x`` under wire mode ``mode_`` — the
+    tolerance the parity gates use when a lossy wire is opted in (the
+    module-docstring accuracy contract as a number):
+
+    * ``off`` (or a non-compressible dtype) — ``0.0``, bit-exact;
+    * ``bf16`` — ``2^-8`` relative to the max-abs per hop (bf16 has 8
+      significand bits);
+    * ``int8``/``blockwise`` — one step is at most ``amax/254``
+      (symmetric round-to-nearest onto ±127) per hop; blockwise bounds
+      by the per-block max-abs, which this conservative form upper-
+      bounds with the global max-abs.
+
+    ``x`` may be an array or a known max-abs float. Non-finite payloads
+    are outside the contract (returns ``inf``)."""
+    import numpy as np
+
+    if hasattr(x, "dtype") and not compressible(x.dtype):
+        return 0.0
+    amax = float(np.max(np.abs(np.asarray(x)))) if hasattr(x, "ndim") \
+        else float(x)
+    if not np.isfinite(amax):
+        return float("inf")
+    if mode_ == "off":
+        return 0.0
+    if mode_ == "bf16":
+        return amax * (2.0 ** -8) * max(1, int(hops))
+    return amax / 254.0 * max(1, int(hops))
+
+
+def allreduce_wire_dtype(dtype, platform: Optional[str] = None) -> str:
+    """The element type a SUMMING all-reduce of this payload actually
+    moves on ``platform`` (default: the attached backend) — the
+    carried-debt PR 9 caveat as a queryable table. XLA's CPU backend
+    legalizes a bf16 (and f16) summing all-reduce to f32 — the wire
+    moves 2x the payload bytes and the audit sees ``f32`` — while TPU
+    keeps the native narrow type. Every other float payload reduces in
+    its own dtype on both backends. The bench harness and the FSDP gate
+    consult this so cross-tier compression claims on the emulated CPU
+    mesh name the legalization instead of reporting a bare drift."""
+    if platform is None:
+        platform = jax.devices()[0].platform
+    name = jnp.dtype(dtype).name
+    wire = {"bfloat16": "bf16", "float16": "f16", "float32": "f32",
+            "float64": "f64"}.get(name, name)
+    if platform == "cpu" and wire in ("bf16", "f16"):
+        return "f32"
+    return wire
 
 
 # -- shard_map-level compressed collectives -----------------------------------

@@ -67,6 +67,7 @@ __all__ = [
     "active",
     "hierarchical_requested",
     "cross_mode",
+    "fsdp_wire",
     "cache_token",
     "hier_psum",
     "hier_reduce_scatter",
@@ -204,6 +205,31 @@ def cross_mode(dtype, precision: Optional[str] = None) -> str:
         raw = (knobs.raw(_ENV_PREC, "") or "").strip().lower()
         if raw in collective_prec.MODES:
             precision = raw
+    return collective_prec.effective(dtype, precision)
+
+
+def fsdp_wire(dtype, p: int, precision: Optional[str] = None) -> str:
+    """The wire mode of one FSDP weight gather (and its transpose
+    reduce-scatter) for one leaf (ISSUE 18, parallel/fsdp.py): an
+    explicit per-rule ``precision`` wins; else ``HEAT_TPU_FSDP_PREC``
+    when set; else — under an ACTIVE 2-level topology — the cross-node
+    chain (:func:`cross_mode`: ``HEAT_TPU_HIERARCHICAL_PREC``, then
+    ``HEAT_TPU_COLLECTIVE_PREC``), because there the in-node tier moves
+    exact regardless and only the DCN hop compresses; else ``off``. The
+    flat-mesh default is deliberately exact, NOT the global collective
+    knob: a compressed weight gather changes the model every step, so
+    lossy weight wires require the FSDP-specific opt-in. Demoted to
+    ``off`` for non-float payloads like every ISSUE 9 surface."""
+    from . import collective_prec
+
+    if precision is None:
+        raw = (knobs.raw("HEAT_TPU_FSDP_PREC", "") or "").strip().lower()
+        if raw in collective_prec.MODES:
+            precision = raw
+    if precision is None:
+        if active(p) is not None:
+            return cross_mode(dtype, None)
+        return "off"
     return collective_prec.effective(dtype, precision)
 
 

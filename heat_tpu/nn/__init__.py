@@ -9,6 +9,7 @@ module system of the JAX stack — with the distributed wrappers defined here.
 
 from . import functional
 from .data_parallel import DataParallel, DataParallelMultiGPU
+from .fsdp import FSDP
 from .transformer import MultiHeadAttention, TransformerBlock, TransformerLM
 from .moe import MoEMLP
 from .quant_dense import QuantDense
@@ -16,6 +17,7 @@ from .quant_dense import QuantDense
 __all__ = [
     "DataParallel",
     "DataParallelMultiGPU",
+    "FSDP",
     "functional",
     "MoEMLP",
     "MultiHeadAttention",

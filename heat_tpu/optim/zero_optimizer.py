@@ -102,8 +102,19 @@ class ZeroOptimizer(DataParallelOptimizer):
         """Sharded optimizer state: ``optimizer.init`` on the flat
         ``(p, chunk)`` leaves, every following-shape state leaf pinned
         sharded along axis 0 (scalars — step counts — replicate)."""
+        flat = fsdp.flat_shard_pytree(
+            params, self.comm, self._wire, self._block
+        )
+        return self.init_from_shards(flat)
+
+    def init_from_shards(self, flat_params):
+        """:meth:`init` for parameters ALREADY in the flat ``(p, chunk)``
+        layout — the composition point full FSDP (ISSUE 18) builds on:
+        sharded optimizer state over parameters that are themselves
+        persistent shards, without a round-trip through the logical
+        form."""
         comm = self.comm
-        flat = fsdp.flat_shard_pytree(params, comm, self._wire, self._block)
+        flat = flat_params
         opt = self.optimizer
         p = comm.size
 
@@ -152,6 +163,11 @@ class ZeroOptimizer(DataParallelOptimizer):
             lambda s: s[None] if getattr(s, "ndim", 0) == 1 else s, s_new
         )
         return p_new, s_new
+
+    # public alias: the per-chunk update IS the ZeRO/FSDP composition
+    # surface (heat_tpu.nn.FSDP reuses the same chunk arithmetic), so it
+    # is part of the supported API, not an implementation detail
+    shard_update = _shard_update
 
     def _gather_params(self, local_new, params_template):
         """all-gather each updated chunk back to the replicated logical

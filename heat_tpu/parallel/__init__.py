@@ -22,6 +22,11 @@ builds long-context attention on top of them:
 * :func:`shard_pytree` / :func:`constrain_pytree` — FSDP/ZeRO-style
   parameter and optimizer-state sharding (largest divisible axis per
   leaf; XLA inserts the use-site all-gathers).
+* :class:`PartitionRules` / :func:`plan_partition` / :func:`fsdp_gather`
+  — full FSDP (ISSUE 18): regex rule tables resolve arbitrary pytrees to
+  flat 1/p layouts, and the just-in-time weight gather (tiered,
+  wire-compressible, custom-vjp reduce-scatter backward) that
+  :class:`heat_tpu.nn.FSDP` schedules with prefetch overlap.
 """
 
 from .ring import ring_pipeline
@@ -29,7 +34,19 @@ from .attention import local_attention, ring_attention, ulysses_attention
 from .halo import halo_exchange
 from .pallas_attention import flash_attention
 from .pipeline import pipeline_apply, stack_stage_params
-from .fsdp import constrain_pytree, replicate_pytree, shard_pytree
+from .fsdp import (
+    FsdpLeaf,
+    FsdpPlan,
+    PartitionRules,
+    constrain_pytree,
+    fsdp_gather,
+    fsdp_shard,
+    fsdp_unshard,
+    leaf_paths,
+    plan_partition,
+    replicate_pytree,
+    shard_pytree,
+)
 
 __all__ = [
     "ring_pipeline",
@@ -43,4 +60,12 @@ __all__ = [
     "shard_pytree",
     "constrain_pytree",
     "replicate_pytree",
+    "PartitionRules",
+    "FsdpLeaf",
+    "FsdpPlan",
+    "leaf_paths",
+    "plan_partition",
+    "fsdp_shard",
+    "fsdp_unshard",
+    "fsdp_gather",
 ]

@@ -19,7 +19,10 @@ leaf, and XLA does the rest.
 
 from __future__ import annotations
 
-from typing import Any
+import ast
+import dataclasses
+import re
+from typing import Any, Iterable, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -31,6 +34,15 @@ __all__ = [
     "flat_chunk",
     "flat_shard_pytree",
     "flat_unshard_leaf",
+    "PartitionRules",
+    "FsdpLeaf",
+    "FsdpPlan",
+    "leaf_paths",
+    "plan_partition",
+    "fsdp_shard",
+    "fsdp_unshard",
+    "fsdp_gather",
+    "bytes_per_device",
 ]
 
 
@@ -117,6 +129,364 @@ def flat_shard_pytree(tree: Any, comm, wire: str = "off",
         return jax.device_put(flat.reshape(p, c), comm.sharding(0, 2))
 
     return jax.tree_util.tree_map(shard, tree)
+
+
+# -- partition rules (ISSUE 18) ------------------------------------------------
+# Full FSDP needs a *declarative* layout map so arbitrary model pytrees —
+# not just the nn/ demos — get shardings without hand-placed device_puts.
+# The idiom is the regex rule table of the big JAX training codebases
+# (match_partition_rules, SNIPPETS.md [3]): leaf key paths are joined
+# with "/" and matched against an ORDERED rule list; the first match
+# wins. Two deliberate divergences from the exemplar: an unmatched leaf
+# REPLICATES (it does not raise — partial rule tables must be safe on
+# models they were not written for), and a rule may pin a per-rule wire
+# precision for its gather/scatter stream (ISSUE 9 vocabulary).
+
+_PLACEMENTS = ("fsdp", "replicate")
+
+
+def _key_str(k) -> str:
+    """One path component of `jax.tree_util.tree_flatten_with_path` as
+    text: dict keys and attr names verbatim, sequence indices as digits."""
+    for attr in ("key", "name", "idx"):
+        if hasattr(k, attr):
+            return str(getattr(k, attr))
+    return str(k)
+
+
+def leaf_paths(tree: Any) -> List[Tuple[str, Any]]:
+    """``(path, leaf)`` per leaf, paths "/"-joined in flatten order —
+    the strings :class:`PartitionRules` patterns match against. Nested
+    dicts, lists/tuples, and registered custom nodes (flax FrozenDict,
+    optax states) all spell naturally: ``"block0/attn/query/kernel"``,
+    ``"0/bias"``."""
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    return [
+        ("/".join(_key_str(k) for k in path), leaf) for path, leaf in flat
+    ]
+
+
+@dataclasses.dataclass(frozen=True)
+class FsdpLeaf:
+    """One leaf's resolved layout: ``sharded`` leaves live as flat
+    ``(p, chunk)`` rows (axis 0 over the mesh) and are gathered
+    just-in-time at wire mode ``wire``; replicated leaves keep their
+    logical shape on every position. ``rule`` is the index of the
+    matched rule (−1: the replicated default)."""
+
+    path: str
+    shape: Tuple[int, ...]
+    dtype: str
+    sharded: bool
+    wire: str
+    chunk: int
+    rule: int
+
+    @property
+    def numel(self) -> int:
+        n = 1
+        for s in self.shape:
+            n *= int(s)
+        return n
+
+
+class PartitionRules:
+    """Ordered ``(pattern, placement[, wire])`` rules mapping leaf key
+    paths to FSDP layouts.
+
+    ``pattern`` is an uncompiled regex matched with ``re.search`` against
+    the "/"-joined leaf path; the FIRST matching rule wins. ``placement``
+    is ``"fsdp"`` (flat 1/p shard) or ``"replicate"``. The optional
+    ``wire`` pins that rule's gather/scatter wire precision
+    (``off | bf16 | int8 | blockwise``); omitted, the leaf inherits
+    :func:`heat_tpu.core.topology.fsdp_wire`'s chain. Unmatched leaves
+    and scalars replicate. ``repr`` round-trips through :meth:`parse`."""
+
+    def __init__(self, rules: Iterable[Sequence]):
+        norm = []
+        for r in rules:
+            r = tuple(r)
+            if len(r) == 2:
+                pattern, placement, wire = r[0], r[1], None
+            elif len(r) == 3:
+                pattern, placement, wire = r
+            else:
+                raise ValueError(
+                    f"rule must be (pattern, placement[, wire]), got {r!r}"
+                )
+            re.compile(pattern)  # fail fast on a bad regex
+            if placement not in _PLACEMENTS:
+                raise ValueError(
+                    f"placement must be one of {_PLACEMENTS}, got "
+                    f"{placement!r} (rule {pattern!r})"
+                )
+            if wire is not None:
+                from ..core import collective_prec
+
+                if wire not in collective_prec.MODES:
+                    raise ValueError(
+                        f"wire must be one of {sorted(collective_prec.MODES)},"
+                        f" got {wire!r} (rule {pattern!r})"
+                    )
+            norm.append((str(pattern), str(placement), wire))
+        self.rules: Tuple[Tuple[str, str, Optional[str]], ...] = tuple(norm)
+
+    @classmethod
+    def fsdp_default(cls) -> "PartitionRules":
+        """Shard every non-scalar leaf (scalars always replicate)."""
+        return cls(((".*", "fsdp"),))
+
+    def match(self, path: str) -> Tuple[str, Optional[str], int]:
+        """``(placement, wire, rule_index)`` of the first rule whose
+        pattern ``re.search``-matches ``path``; the replicated default
+        (``rule_index == -1``) when none does."""
+        for i, (pattern, placement, wire) in enumerate(self.rules):
+            if re.search(pattern, path):
+                return placement, wire, i
+        return "replicate", None, -1
+
+    def __repr__(self) -> str:
+        return f"PartitionRules({self.rules!r})"
+
+    @classmethod
+    def parse(cls, text: str) -> "PartitionRules":
+        """Invert :meth:`__repr__` (also accepts the bare tuple literal)
+        — the rule table is plain data, so tuned layouts can live in
+        configs and survive a round-trip textually."""
+        s = text.strip()
+        if s.startswith("PartitionRules(") and s.endswith(")"):
+            s = s[len("PartitionRules("):-1]
+        return cls(ast.literal_eval(s))
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, PartitionRules) and self.rules == other.rules
+
+    def __hash__(self) -> int:
+        return hash(self.rules)
+
+
+class FsdpPlan:
+    """The resolved layout of one parameter pytree: a :class:`FsdpLeaf`
+    per leaf (flatten order) plus the treedef. Built once per
+    (template, rules, mesh) by :func:`plan_partition`; its
+    :meth:`signature` is the program-cache key component every compiled
+    FSDP step is memoized on."""
+
+    def __init__(self, leaves: Sequence[FsdpLeaf], treedef, p: int):
+        self.leaves: Tuple[FsdpLeaf, ...] = tuple(leaves)
+        self.treedef = treedef
+        self.p = int(p)
+        self.by_path = {l.path: l for l in self.leaves}
+
+    def signature(self) -> Tuple:
+        """Hashable identity of the layout (program-cache key part)."""
+        return tuple(
+            (l.path, l.shape, l.dtype, l.sharded, l.wire, l.chunk)
+            for l in self.leaves
+        ) + (self.p,)
+
+    def unflatten(self, values: Sequence[Any]) -> Any:
+        return jax.tree_util.tree_unflatten(self.treedef, list(values))
+
+    def sharded_numels(self) -> List[int]:
+        return [l.numel for l in self.leaves if l.sharded]
+
+    def __repr__(self) -> str:
+        n_sh = sum(1 for l in self.leaves if l.sharded)
+        return (
+            f"FsdpPlan(p={self.p}, leaves={len(self.leaves)}, "
+            f"sharded={n_sh})"
+        )
+
+
+def plan_partition(
+    tree: Any,
+    rules: Optional[PartitionRules],
+    comm,
+    *,
+    precision: Optional[str] = None,
+    block: Optional[int] = None,
+) -> FsdpPlan:
+    """Resolve ``rules`` over a parameter pytree (arrays or
+    ``ShapeDtypeStruct`` templates) into an :class:`FsdpPlan`.
+
+    Scalars always replicate — a 1/p shard of a scalar is meaningless.
+    Each sharded leaf's wire mode runs the
+    :func:`heat_tpu.core.topology.fsdp_wire` chain (rule wire →
+    ``HEAT_TPU_FSDP_PREC`` → tiered cross-node chain → exact) and its
+    chunk is :func:`flat_chunk` under that wire, so blockwise chunk
+    boundaries land on the shard boundaries. Refuses layouts where a
+    REPLICATED leaf's logical shape collides with a sharded leaf's
+    ``(p, chunk)`` row shape — downstream state-sharding inference tells
+    the two apart by shape, and an ambiguous table is a rules bug better
+    caught here than as a silently misplaced optimizer state."""
+    from ..core import collective_prec, topology
+
+    if rules is None:
+        rules = PartitionRules.fsdp_default()
+    p = comm.size
+    if block is None:
+        block = collective_prec.block_size()
+    paths = leaf_paths(tree)
+    treedef = jax.tree_util.tree_structure(tree)
+
+    leaves = []
+    for path, leaf in paths:
+        shape = tuple(int(s) for s in getattr(leaf, "shape", ()))
+        dtype = jnp.asarray(leaf).dtype if not hasattr(leaf, "dtype") else leaf.dtype
+        dtype = jnp.dtype(dtype)
+        placement, rule_wire, idx = rules.match(path)
+        sharded = placement == "fsdp" and len(shape) > 0
+        if sharded:
+            wire = topology.fsdp_wire(
+                dtype, p, rule_wire if rule_wire is not None else precision
+            )
+            numel = 1
+            for s in shape:
+                numel *= s
+            chunk = flat_chunk(numel, p, wire, block)
+        else:
+            wire, chunk = "off", 0
+        leaves.append(
+            FsdpLeaf(path, shape, str(dtype), sharded, wire, chunk, idx)
+        )
+
+    row_shapes = {(p, l.chunk) for l in leaves if l.sharded}
+    for l in leaves:
+        if not l.sharded and l.shape in row_shapes:
+            raise ValueError(
+                f"ambiguous partition plan: replicated leaf {l.path!r} has "
+                f"logical shape {l.shape}, identical to a sharded leaf's "
+                f"(p, chunk) row shape — state-sharding inference pairs "
+                "state to parameters by shape, so this table cannot be "
+                "placed safely. Shard that leaf too, or adjust the rules."
+            )
+    return FsdpPlan(leaves, treedef, p)
+
+
+def fsdp_shard(tree: Any, plan: FsdpPlan, comm) -> Any:
+    """Place a logical parameter pytree into ``plan``'s layout: sharded
+    leaves as ``(p, chunk)`` rows (axis 0 over the mesh, zero-padded
+    tail), replicated leaves replicated. The persistent-state half of
+    FSDP — parameters STAY in this layout across steps."""
+    p = comm.size
+    flat = jax.tree_util.tree_flatten(tree)[0]
+    out = []
+    for leaf, lp in zip(flat, plan.leaves):
+        l = jnp.asarray(leaf)
+        if tuple(l.shape) != lp.shape:
+            raise ValueError(
+                f"leaf {lp.path!r} has shape {tuple(l.shape)}, plan says "
+                f"{lp.shape} — re-plan before sharding"
+            )
+        if not lp.sharded:
+            out.append(jax.device_put(l, comm.replicated()))
+            continue
+        flat_l = l.reshape(-1)
+        if p * lp.chunk != l.size:
+            flat_l = jnp.pad(flat_l, (0, p * lp.chunk - l.size))
+        out.append(
+            jax.device_put(flat_l.reshape(p, lp.chunk), comm.sharding(0, 2))
+        )
+    return plan.unflatten(out)
+
+
+def fsdp_unshard(tree: Any, plan: FsdpPlan) -> Any:
+    """Invert :func:`fsdp_shard` to the topology-independent logical
+    form (numpy leaves) — the checkpoint interchange layout. A tree
+    sharded over 4 positions unshards to the same logical bytes as one
+    sharded over 8 (same property the ZeRO restore relies on)."""
+    import numpy as np
+
+    flat = jax.tree_util.tree_flatten(tree)[0]
+    out = []
+    for leaf, lp in zip(flat, plan.leaves):
+        if lp.sharded:
+            out.append(flat_unshard_leaf(leaf, lp.shape, lp.dtype))
+        else:
+            out.append(np.asarray(leaf))
+    return plan.unflatten(out)
+
+
+def fsdp_gather(local_chunk, leaf: FsdpLeaf, comm, *, block: Optional[int] = None):
+    """Just-in-time weight gather of one flat-sharded leaf inside a
+    ``shard_map`` kernel: the per-position ``(1, chunk)`` row all-gathers
+    (tiered under ``HEAT_TPU_HIERARCHICAL=1``; wire-compressed at
+    ``leaf.wire``) back to the logical parameter the layer consumes.
+
+    Differentiable by construction (``jax.custom_vjp``): the backward of
+    an all-gather is exactly the reduce-scatter of the cotangent — each
+    position gets the global SUM over its own chunk, the canonical FSDP
+    gradient path — at the SAME wire mode, so forward and backward move
+    symmetric volumes. The custom rule also sidesteps differentiating
+    through the quantized collectives, which have no meaningful gradient
+    of their own. No residuals are saved: callers wrap the *consuming*
+    layer in ``jax.checkpoint`` so the backward re-gathers instead of
+    keeping every layer's full weights live.
+
+    Emits trace-time ``fsdp_gather``/``fsdp_scatter`` events priced by
+    :func:`heat_tpu.telemetry.collectives.fsdp_gather_cost` /
+    ``fsdp_scatter_cost`` — per-leaf attribution on top of the wrappers'
+    own ``all_gather``/``reduce_scatter`` events, and the figures the CI
+    gate audits against the HLO."""
+    from .. import telemetry
+    from ..core import collective_prec, topology
+
+    if not leaf.sharded:
+        return local_chunk
+    if block is None:
+        block = collective_prec.block_size()
+    p = comm.size
+    topo = topology.active(p)
+    node, local = (topo.node, topo.local) if topo is not None else (1, p)
+    dtype = jnp.dtype(leaf.dtype)
+    shape, numel, chunk, wire = leaf.shape, leaf.numel, leaf.chunk, leaf.wire
+    in_shape, in_dtype = local_chunk.shape, local_chunk.dtype
+
+    @jax.custom_vjp
+    def gather(c):
+        return _fwd(c)[0]
+
+    def _fwd(c):
+        telemetry.trace_event(
+            "fsdp_gather", path=leaf.path, wire=wire,
+            **telemetry.collectives.fsdp_gather_cost(
+                chunk, dtype.itemsize, node, local, wire, block
+            ).as_fields(),
+        )
+        flat = comm.all_gather(c.reshape(-1), tiled=True, precision=wire)
+        return flat[:numel].reshape(shape).astype(dtype), None
+
+    def _bwd(_, ct):
+        telemetry.trace_event(
+            "fsdp_scatter", path=leaf.path, wire=wire,
+            **telemetry.collectives.fsdp_scatter_cost(
+                p * chunk, dtype.itemsize, node, local, wire, block
+            ).as_fields(),
+        )
+        flat = ct.reshape(-1)
+        if p * chunk != numel:
+            flat = jnp.pad(flat, (0, p * chunk - numel))
+        g = comm.reduce_scatter(flat, precision=wire)
+        return (g.reshape(in_shape).astype(in_dtype),)
+
+    gather.defvjp(_fwd, _bwd)
+    return gather(local_chunk)
+
+
+def bytes_per_device(tree: Any) -> int:
+    """Worst-case per-device live bytes of a pytree of placed jax
+    arrays (``addressable_shards`` accounting — the same figure
+    ``ZeroOptimizer.state_bytes_per_device`` reports for state, here
+    usable for params + state together: the FSDP watermark oracle)."""
+    per_dev: dict = {}
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if not hasattr(leaf, "addressable_shards"):
+            continue
+        for sh in leaf.addressable_shards:
+            d = str(sh.device)
+            per_dev[d] = per_dev.get(d, 0) + sh.data.nbytes
+    return max(per_dev.values()) if per_dev else 0
 
 
 def flat_unshard_leaf(padded, shape, dtype=None):

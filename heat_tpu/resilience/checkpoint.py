@@ -21,6 +21,8 @@ Manifest schema (``format: "heat_tpu.checkpoint", version: 1``)::
        {"kind": "dndarray", "gshape": [...], "split": 0, "dtype": "float32",
         "shards": [{"file": ..., "crc32": ..., "shape": [...]}, ...]},
        {"kind": "array", "file": ..., "crc32": ..., "dtype": ..., "shape": [...]},
+       {"kind": "jax_sharded", "shape": [...], "dtype": ...,
+        "shards": [{"file": ..., "crc32": ..., "index": [[lo, hi], ...]}, ...]},
        {"kind": "scalar", "value": 3.5, "type": "float"},
        {"kind": "none"}],
      "extra": {...}}           # caller state (iteration counters, schedules)
@@ -41,6 +43,14 @@ DNDarray leaves are stored as their **per-mesh-position logical chunks**
 touch disk) and restored via ``factories.array(split=...)``, so a
 checkpoint written on one mesh restores on another mesh size: the manifest
 records the logical layout, not the physical one.
+
+Sharded **jax** arrays (FSDP/ZeRO parameter and state shards, ISSUE 18)
+are written as one blob *per addressable shard*, streamed straight from
+each device buffer — the full value is never gathered host-side, which
+matters exactly when a leaf was sharded because it does not fit one
+device. The manifest records each shard's index into the logical shape;
+restore reassembles the logical array, so the next mesh (any
+factorization) re-places it freely.
 """
 
 from __future__ import annotations
@@ -146,6 +156,30 @@ def _pack_leaf(x, dirpath: str, idx: int) -> dict:
             "dtype": x.dtype.__name__,
             "shards": shards,
         }
+    if _is_sharded_jax_array(x):
+        # sharded-param save (ISSUE 18): one blob PER ADDRESSABLE SHARD,
+        # written straight from each device buffer — the full logical
+        # array is never materialized host-side, which matters exactly
+        # when FSDP sharded the leaf because it does not fit one device.
+        # The manifest records each shard's index into the logical
+        # shape, so restore reassembles (and the next mesh re-shards)
+        # independent of this mesh's factorization.
+        shards = []
+        for s, sh in enumerate(x.addressable_shards):
+            rec = _write_blob(
+                dirpath, f"leaf{idx:05d}_shard{s:03d}.npy",
+                np.ascontiguousarray(sh.data),
+            )
+            rec["index"] = [
+                [sl.start, sl.stop] for sl in _norm_index(sh.index, x.shape)
+            ]
+            shards.append(rec)
+        return {
+            "kind": "jax_sharded",
+            "shape": list(x.shape),
+            "dtype": str(x.dtype),
+            "shards": shards,
+        }
     if isinstance(x, (np.ndarray, np.generic)) or hasattr(x, "__array__"):
         rec = _write_blob(dirpath, f"leaf{idx:05d}.npy", np.asarray(x))
         rec["kind"] = "array"
@@ -162,8 +196,51 @@ def _pack_leaf(x, dirpath: str, idx: int) -> dict:
     )
 
 
+def _is_sharded_jax_array(x) -> bool:
+    """A placed jax array whose shards do NOT all hold the full value —
+    the leaves :func:`_pack_leaf` streams per-shard instead of gathering."""
+    if not (hasattr(x, "addressable_shards") and hasattr(x, "sharding")):
+        return False
+    try:
+        return not x.sharding.is_fully_replicated
+    except Exception:
+        return False
+
+
+def _norm_index(index, shape) -> Tuple:
+    """Normalize a shard's index (tuple of slices, possibly open-ended)
+    to concrete ``slice(start, stop)`` per dimension."""
+    out = []
+    for sl, dim in zip(index, shape):
+        start, stop, step = sl.indices(int(dim))
+        if step != 1:
+            raise CheckpointError(
+                "cannot checkpoint a shard with a strided index"
+            )
+        out.append(slice(start, stop))
+    return tuple(out)
+
+
 def _unpack_leaf(rec: dict, dirpath: str, comm, device):
     kind = rec.get("kind")
+    if kind == "jax_sharded":
+        import jax.numpy as jnp
+
+        shape = tuple(int(s) for s in rec.get("shape", []))
+        host = np.empty(shape, dtype=np.dtype(rec.get("dtype", "float64")))
+        seen = np.zeros(shape, dtype=bool) if shape else None
+        for s in rec.get("shards", []):
+            blob = _read_blob(dirpath, s)
+            idx = tuple(slice(int(a), int(b)) for a, b in s.get("index", []))
+            host[idx] = blob
+            if seen is not None:
+                seen[idx] = True
+        if seen is not None and not seen.all():
+            raise CheckpointError(
+                "jax_sharded record does not cover the full logical shape "
+                f"{shape} — shard set is incomplete"
+            )
+        return jnp.asarray(host)
     if kind == "dndarray":
         from ..core import types
         from ..core.factories import array as _array

@@ -50,6 +50,8 @@ __all__ = [
     "hierarchical_reduce_scatter_cost",
     "hierarchical_allgather_cost",
     "hierarchical_a2a_cost",
+    "fsdp_gather_cost",
+    "fsdp_scatter_cost",
     "ring_attention_cost",
     "ulysses_attention_cost",
     "pipeline_cost",
@@ -672,6 +674,60 @@ def hierarchical_a2a_cost(
     ici = total_payload * (local - 1) // local
     cross = total_payload * (node - 1) // node
     return CollectiveCost("all-to-all", ici + cross, dcn_bytes=cross)
+
+
+# -- FSDP weight-stream collectives (ISSUE 18, parallel/fsdp.py) --------------
+# The FSDP forward all-gathers each leaf's flat 1/p chunk just-in-time and
+# the backward re-scatters the weight cotangent through the gather's
+# transpose. Both ride the MeshCommunication wrappers, so the tiered
+# lowering (and its DCN split) and the ISSUE 9 compressed wire apply
+# unchanged — these entries just price the FSDP payload convention (the
+# pre-padded ``p x chunk`` flat layout of ``fsdp.flat_chunk``) so the
+# per-layer HLO audit diffs against exactly the program dispatched.
+
+
+def fsdp_gather_cost(
+    chunk_numel: int,
+    itemsize: int,
+    node: int,
+    local: int,
+    precision: str = "off",
+    block: int = DEFAULT_WIRE_BLOCK,
+) -> CollectiveCost:
+    """Cost of one just-in-time FSDP weight gather: every device
+    contributes its ``chunk_numel``-element flat shard and receives the
+    full ``p x chunk`` leaf. Flat meshes (``node == 1`` or ``local ==
+    1``) emit one all-gather of ``p·s·(p-1)`` wire bytes (compressed
+    modes move payload + scales, the ``collective_prec.all_gather``
+    convention); 2-level topologies split the identical total across the
+    DCN/ICI tiers (:func:`hierarchical_allgather_cost`), with
+    ``precision`` compressing the wire payload quantized once at the
+    source. ``dcn_bytes`` carries the cross-node stage for
+    :func:`weighted_wire` premium pricing."""
+    return hierarchical_allgather_cost(
+        chunk_numel, itemsize, node, local, precision, block
+    )
+
+
+def fsdp_scatter_cost(
+    padded_numel: int,
+    itemsize: int,
+    node: int,
+    local: int,
+    precision: str = "off",
+    block: int = DEFAULT_WIRE_BLOCK,
+) -> CollectiveCost:
+    """Cost of the FSDP gather's transpose — the backward reduce-scatter
+    of one leaf's weight cotangent: each device holds the full
+    ``padded_numel``-element cotangent (the pre-padded ``p·chunk`` flat
+    layout) and keeps the summed 1/p chunk it owns. Flat meshes price
+    the ring reduce-scatter (quantized modes: the EQuARX first phase as
+    an all-to-all, :func:`reduce_scatter_cost`); 2-level topologies the
+    tiered in-node-exact / cross-node-``precision`` split
+    (:func:`hierarchical_reduce_scatter_cost`)."""
+    return hierarchical_reduce_scatter_cost(
+        padded_numel, itemsize, node, local, precision, block
+    )
 
 
 # -- attention / pipeline kernels (the last unpriced collectives) -------------

@@ -1451,6 +1451,204 @@ EOF
     fi
 fi
 
+# FSDP gate (ISSUE 18): on the emulated 2x2 mesh — the big-model
+# scenario end to end: a model whose REPLICATED parameters+state exceed
+# a pinned HEAT_TPU_HBM_BUDGET trains under FSDP with the per-device
+# watermark strictly below both the budget and the replicated base;
+# knob-off dispatch bit-identical to the DataParallel program; enabled
+# trajectory within documented-ulp (1e-6) of the replicated baseline
+# (exact wire — the reduction ORDER differs, bits may not); prefetch
+# depths bit-identical to each other (pure scheduling); per-layer
+# audited gather wire bytes == fsdp_gather_cost with ZERO drift; and
+# zero steady-state compiles at the fsdp_train_step site.
+# HEAT_TPU_CI_SKIP_FSDP=1 opts out.
+if [ -z "${HEAT_TPU_CI_SKIP_FSDP:-}" ]; then
+    echo "=== fsdp gate: sharded-parameter training (emulated 2x2 mesh) ==="
+    fsdp_rc=0
+    fsdp_out=$(mktemp)
+    XLA_FLAGS="--xla_force_host_platform_device_count=4" JAX_PLATFORMS=cpu \
+        HEAT_TPU_TOPOLOGY=2x2 \
+        python - <<'EOF' > "$fsdp_out" 2>&1 || fsdp_rc=$?
+import json
+import os
+
+import flax.linen as fnn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import PartitionSpec as P
+
+import heat_tpu as ht
+from heat_tpu.core import program_cache
+from heat_tpu.nn.fsdp import FSDP
+from heat_tpu.parallel import fsdp as F
+from heat_tpu.telemetry import collectives as model, hlo
+
+comm = ht.get_comm()
+p = comm.size
+assert p == 4, f"expected a 4-device mesh, got {p}"
+topo = comm.topology()
+assert (topo.node, topo.local) == (2, 2), topo
+report = {"mesh": p, "topology": topo.describe()}
+
+STAGES = [fnn.Dense(96), fnn.Dense(96), fnn.Dense(32)]
+OPT = optax.adam(1e-3)
+rng = np.random.default_rng(0)
+x = rng.standard_normal((8, 32)).astype(np.float32)
+y = rng.standard_normal((8, 32)).astype(np.float32)
+
+
+def loss_fn(out, yy):
+    return jnp.mean((out - yy) ** 2)
+
+
+def build(enabled, prefetch=1):
+    os.environ["HEAT_TPU_FSDP"] = "1" if enabled else "0"
+    return FSDP(list(STAGES), optimizer=OPT, prefetch=prefetch)
+
+
+def run(net, steps=4):
+    params = net.shard_params(net.init(jax.random.PRNGKey(0), x))
+    state = net.init_opt_state(params)
+    step = net.make_train_step(loss_fn)
+    xb, yb = net.shard_batch(x, y)
+    for _ in range(steps):
+        params, state, loss = step(params, state, xb, yb)
+    return net, params, state, step, (xb, yb)
+
+
+def digest(net, params):
+    return b"".join(
+        np.asarray(l).tobytes()
+        for l in jax.tree_util.tree_leaves(net.unshard_params(params))
+    )
+
+
+# -- knob-off dispatch is the DataParallel program, bit for bit ---------------
+off_net, off_p, _, _, _ = run(build(enabled=False))
+
+
+def full_forward(params, xx):
+    for m, sp in zip(STAGES, params):
+        xx = m.apply(sp, xx)
+    return xx
+
+
+dp = ht.nn.DataParallel(
+    full_forward, comm, OPT, blocking_parameter_updates=True
+)
+dpp = jax.device_put(
+    off_net.init(jax.random.PRNGKey(0), x), comm.replicated()
+)
+dps = jax.device_put(OPT.init(dpp), comm.replicated())
+dstep = dp.make_train_step(
+    lambda params, xx, yy: loss_fn(full_forward(params, xx), yy)
+)
+xb, yb = dp.shard_batch(x, y)
+for _ in range(4):
+    dpp, dps, _ = dstep(dpp, dps, xb, yb)
+if digest(off_net, off_p) != b"".join(
+    np.asarray(l).tobytes() for l in jax.tree_util.tree_leaves(dpp)
+):
+    raise SystemExit("fsdp: knob-off dispatch != DataParallel bits")
+
+# -- big-model scenario: replicated exceeds the budget, FSDP fits -------------
+on_net, on_p, on_s, on_step, on_batch = run(build(enabled=True))
+rep_params = jax.device_put(
+    on_net.init(jax.random.PRNGKey(0), x), comm.replicated()
+)
+rb = F.bytes_per_device(rep_params) + F.bytes_per_device(
+    jax.device_put(OPT.init(rep_params), comm.replicated())
+)
+fb = F.bytes_per_device(on_p) + F.bytes_per_device(on_s)
+budget = (fb + rb) // 2
+os.environ["HEAT_TPU_HBM_BUDGET"] = str(budget)
+# train MORE steps with the guard budget pinned: the sharded layout must
+# keep fitting where the replicated layout could not
+pp, ss = on_p, on_s
+for _ in range(2):
+    pp, ss, _ = on_step(pp, ss, *on_batch)
+if not (0 < fb < budget < rb):
+    raise SystemExit(
+        f"fsdp: watermark {fb} not strictly below budget {budget} "
+        f"below replicated {rb}"
+    )
+report["bytes_per_device"] = {
+    "fsdp": fb, "replicated": rb, "hbm_budget": budget,
+}
+
+# -- enabled trajectory within documented ulp of the replicated base ----------
+drift = max(
+    float(np.abs(np.asarray(a) - np.asarray(b)).max())
+    for a, b in zip(
+        jax.tree_util.tree_leaves(on_net.unshard_params(on_p)),
+        jax.tree_util.tree_leaves(off_net.unshard_params(off_p)),
+    )
+)
+if drift > 1e-6:
+    raise SystemExit(f"fsdp: trajectory drifted {drift} > 1e-6")
+report["trajectory_drift"] = drift
+
+# -- prefetch depths are pure scheduling: bit-identical -----------------------
+d0 = digest(*run(build(enabled=True, prefetch=0))[:2])
+d2 = digest(*run(build(enabled=True, prefetch=2))[:2])
+if d0 != d2:
+    raise SystemExit("fsdp: prefetch depth changed the bits")
+
+# -- per-layer audited gather bytes == cost model, zero drift -----------------
+plan = on_net._plan
+axis = comm.axis_name
+p_specs = plan.unflatten(
+    [P(axis) if l.sharded else P() for l in plan.leaves]
+)
+fwd = jax.jit(jax.shard_map(
+    lambda ps, xx: on_net._forward_local(
+        ps, xx, plan, on_net.prefetch, remat=False
+    ),
+    mesh=comm.mesh, in_specs=(p_specs, P(axis)), out_specs=P(axis),
+))
+aud = hlo.audit_computation(fwd, on_p, on_batch[0])
+predicted = sum(
+    model.fsdp_gather_cost(
+        l.chunk, 4, topo.node, topo.local, l.wire
+    ).bytes
+    for l in plan.leaves if l.sharded
+)
+audited = sum(
+    c.wire_bytes for c in aud.collectives if c.op == "all-gather"
+)
+if audited != predicted:
+    raise SystemExit(
+        f"fsdp: audited gather bytes {audited} != predicted {predicted}"
+    )
+report["gather_wire_bytes"] = {"audited": audited, "predicted": predicted}
+
+# -- zero steady-state compiles ----------------------------------------------
+before = program_cache.site_stats("fsdp_train_step")
+pp, ss = on_p, on_s
+for _ in range(3):
+    pp, ss, _ = on_step(pp, ss, *on_batch)
+again = on_net.make_train_step(loss_fn)
+after = program_cache.site_stats("fsdp_train_step")
+if after["misses"] != before["misses"] or again is not on_step:
+    raise SystemExit(
+        f"fsdp: steady state recompiled ({before} -> {after})"
+    )
+report["train_step_site"] = after
+print(json.dumps({"fsdp": "ok", **report}))
+EOF
+    cat "$fsdp_out"
+    if [ -n "$REPORT" ]; then
+        cp "$fsdp_out" "${REPORT}/fsdp_gate.log" || true
+    fi
+    rm -f "$fsdp_out"
+    if [ "$fsdp_rc" != 0 ]; then
+        echo "=== fsdp gate FAILED (rc=$fsdp_rc) ==="
+        FAILED_SIZES="$FAILED_SIZES fsdp"
+    fi
+fi
+
 # Streaming gate (ISSUE 16, heat_tpu/streaming): a 2-file HDF5 stream
 # under a pinned HEAT_TPU_HBM_BUDGET that forbids materializing the file
 # set must show

@@ -667,3 +667,80 @@ class TestBenchField:
             assert off["audited_wire_bytes"] / i8["audited_wire_bytes"] >= 3.5
             assert field["modes"]["off"]["max_rel_err"] == 0.0
             assert 0 < field["modes"]["int8"]["max_rel_err"] <= 1.05 / 127
+
+
+# -- backend wire-dtype quirks (ISSUE 18 satellite) ---------------------------
+
+
+class TestAllreduceWireDtype:
+    """XLA's CPU backend legalizes a SUMMING bf16/f16 all-reduce to f32
+    (2x the payload bytes on the wire); TPU keeps the native narrow
+    type. ``allreduce_wire_dtype`` is that quirk as a queryable table,
+    and the audit below pins the legalization on the backend we run."""
+
+    def test_table_per_backend(self):
+        assert cp.allreduce_wire_dtype(jnp.bfloat16, "cpu") == "f32"
+        assert cp.allreduce_wire_dtype(jnp.float16, "cpu") == "f32"
+        assert cp.allreduce_wire_dtype(jnp.bfloat16, "tpu") == "bf16"
+        assert cp.allreduce_wire_dtype(jnp.float16, "tpu") == "f16"
+        # f32/f64 reduce natively everywhere
+        for plat in ("cpu", "tpu"):
+            assert cp.allreduce_wire_dtype(jnp.float32, plat) == "f32"
+            assert cp.allreduce_wire_dtype(jnp.float64, plat) == "f64"
+        # default platform = the attached backend
+        here = jax.devices()[0].platform
+        assert cp.allreduce_wire_dtype(jnp.bfloat16) == \
+            cp.allreduce_wire_dtype(jnp.bfloat16, here)
+
+    @pytest.mark.skipif(
+        ht.get_comm().size < 2, reason="needs a >=2-device mesh"
+    )
+    def test_audited_wire_dtype_matches_table(self, comm):
+        """Compile a summing bf16 psum and read the all-reduce's element
+        type out of the HLO: it must be what the table predicts for this
+        backend — on this CPU mesh, the f32 legalization."""
+        from jax.sharding import PartitionSpec as P
+
+        axis = comm.axis_name
+
+        def kernel(x):
+            return jax.lax.psum(x, axis)
+
+        fn = jax.jit(
+            jax.shard_map(
+                kernel, mesh=comm.mesh,
+                in_specs=P(axis), out_specs=P(axis),
+            )
+        )
+        x = jnp.ones((comm.size, 8), jnp.bfloat16)
+        aud = hlo.audit_computation(fn, x)
+        ars = [c for c in aud.collectives if c.op == "all-reduce"]
+        assert ars, "no all-reduce in the compiled psum"
+        want = cp.allreduce_wire_dtype(jnp.bfloat16)
+        assert all(c.dtype == want for c in ars), (want, ars)
+        if jax.devices()[0].platform == "cpu":
+            assert want == "f32"  # the documented CPU legalization
+
+
+class TestQuantErrorBound:
+    def test_off_and_nonfloat_are_exact(self):
+        assert cp.quant_error_bound(3.5, "off") == 0.0
+        assert cp.quant_error_bound(
+            np.arange(8, dtype=np.int32), "int8"
+        ) == 0.0
+
+    def test_bound_holds_empirically(self, comm):
+        """One quantization hop's measured error stays under the
+        documented bound for every lossy mode."""
+        rng = np.random.default_rng(18)
+        x = rng.standard_normal(512).astype(np.float32) * 3.0
+        for mode in ("bf16", "int8", "blockwise"):
+            q = np.asarray(cp.local_roundtrip(jnp.asarray(x), mode))
+            err = float(np.abs(q - x).max())
+            assert err <= cp.quant_error_bound(x, mode, hops=1), mode
+
+    def test_hops_scale_linearly_and_nonfinite_is_inf(self):
+        x = np.linspace(-2, 2, 64, dtype=np.float32)
+        b1 = cp.quant_error_bound(x, "int8", hops=1)
+        assert cp.quant_error_bound(x, "int8", hops=3) == 3 * b1
+        assert cp.quant_error_bound(float("nan"), "int8") == float("inf")

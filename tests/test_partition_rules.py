@@ -1,0 +1,195 @@
+"""Partition-rule engine tests (ISSUE 18 satellite): regex precedence,
+the replicated default for unmatched leaves, repr round-trip, and rule
+resolution over nested dict/list/custom-node pytrees."""
+
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from heat_tpu.parallel import fsdp as F
+from heat_tpu.parallel import (
+    FsdpPlan,
+    PartitionRules,
+    fsdp_shard,
+    fsdp_unshard,
+    leaf_paths,
+    plan_partition,
+)
+
+
+@pytest.fixture(scope="module")
+def comm():
+    return ht.get_comm()
+
+
+class TestRuleMatching:
+    def test_first_match_wins(self):
+        rules = PartitionRules((
+            ("attn/(query|key|value)", "fsdp", "bf16"),
+            (r"attn/.*", "replicate"),
+            (".*", "fsdp"),
+        ))
+        # rule 0 and rule 1 both match; precedence is ORDER, not specificity
+        assert rules.match("block0/attn/query/kernel") == ("fsdp", "bf16", 0)
+        assert rules.match("block0/attn/out/kernel") == ("replicate", None, 1)
+        assert rules.match("lm_head/kernel") == ("fsdp", None, 2)
+
+    def test_search_semantics_not_fullmatch(self):
+        # re.search: the pattern may hit anywhere in the path
+        rules = PartitionRules((("bias", "replicate"), (".*", "fsdp")))
+        assert rules.match("deep/nested/bias")[0] == "replicate"
+        assert rules.match("bias_correction")[0] == "replicate"
+        assert rules.match(r"kernel")[0] == "fsdp"
+
+    def test_unmatched_leaf_replicates(self):
+        # deliberate divergence from the exemplar (which raises): a partial
+        # rule table must be safe on models it was not written for
+        rules = PartitionRules((("attn/", "fsdp"),))
+        assert rules.match("mlp/kernel") == ("replicate", None, -1)
+        assert PartitionRules(()).match("anything") == ("replicate", None, -1)
+
+    def test_anchored_patterns(self):
+        rules = PartitionRules(((r"^embed/", "replicate"), (".*", "fsdp")))
+        assert rules.match("embed/table")[0] == "replicate"
+        assert rules.match("block0/embed/kernel")[0] == "fsdp"
+
+    def test_bad_rules_rejected(self):
+        with pytest.raises(re.error):
+            PartitionRules((("([unclosed", "fsdp"),))
+        with pytest.raises(ValueError):
+            PartitionRules(((".*", "sharded"),))  # not a placement
+        with pytest.raises(ValueError):
+            PartitionRules(((".*", "fsdp", "fp8"),))  # not a wire mode
+        with pytest.raises(ValueError):
+            PartitionRules(((".*",),))  # arity
+
+
+class TestReprRoundTrip:
+    def test_repr_parses_back(self):
+        rules = PartitionRules((
+            ("attn/(query|key|value)", "fsdp", "bf16"),
+            (r"bias$", "replicate"),
+            (".*", "fsdp", "off"),
+        ))
+        again = PartitionRules.parse(repr(rules))
+        assert again == rules
+        assert hash(again) == hash(rules)
+
+    def test_parse_bare_tuple_literal(self):
+        rules = PartitionRules.parse("(('kernel', 'fsdp'),)")
+        assert rules.match("a/kernel")[0] == "fsdp"
+
+    def test_eq_is_structural(self):
+        a = PartitionRules(((".*", "fsdp"),))
+        b = PartitionRules([[".*", "fsdp"]])
+        assert a == b
+        assert a != PartitionRules(((".*", "replicate"),))
+        assert a != "PartitionRules"
+
+
+class TestLeafPaths:
+    def test_nested_dict_list_paths(self):
+        tree = {
+            "block": {"attn": {"q": jnp.zeros((2, 2))}},
+            "head": [jnp.zeros((3,)), jnp.zeros(())],
+        }
+        paths = [p for p, _ in leaf_paths(tree)]
+        assert paths == ["block/attn/q", "head/0", "head/1"]
+
+    def test_custom_node_paths(self):
+        # flax FrozenDict is a registered custom pytree node
+        from flax.core import freeze
+
+        tree = freeze({"layer": {"kernel": jnp.zeros((4, 4))}})
+        paths = [p for p, _ in leaf_paths(tree)]
+        assert paths == ["layer/kernel"]
+
+    def test_tuple_of_stage_trees(self):
+        tree = ({"w": jnp.zeros((2,))}, {"w": jnp.zeros((2,))})
+        paths = [p for p, _ in leaf_paths(tree)]
+        assert paths == ["0/w", "1/w"]
+
+
+class TestPlanPartition:
+    def test_scalars_always_replicate(self, comm):
+        plan = plan_partition(
+            {"w": jnp.ones((comm.size * 2,)), "step": jnp.float32(0.0)},
+            PartitionRules.fsdp_default(), comm,
+        )
+        by = plan.by_path
+        assert by["w"].sharded and by["w"].chunk == 2
+        assert not by["step"].sharded and by["step"].chunk == 0
+
+    def test_plan_signature_hashable_and_layout_sensitive(self, comm):
+        t1 = {"w": jnp.ones((8, 8))}
+        plan_a = plan_partition(t1, PartitionRules.fsdp_default(), comm)
+        plan_b = plan_partition(
+            t1, PartitionRules(((".*", "replicate"),)), comm
+        )
+        assert hash(plan_a.signature()) != hash(plan_b.signature())
+        assert isinstance(plan_a, FsdpPlan)
+
+    def test_rule_wire_and_env_fallback(self, comm, monkeypatch):
+        monkeypatch.delenv("HEAT_TPU_FSDP_PREC", raising=False)
+        tree = {"a": jnp.ones((16,)), "b": jnp.ones((16,))}
+        rules = PartitionRules((("a", "fsdp", "int8"), (".*", "fsdp")))
+        plan = plan_partition(tree, rules, comm)
+        assert plan.by_path["a"].wire == "int8"   # per-rule pin wins
+        assert plan.by_path["b"].wire == "off"    # flat default stays exact
+        monkeypatch.setenv("HEAT_TPU_FSDP_PREC", "bf16")
+        plan2 = plan_partition(tree, rules, comm)
+        assert plan2.by_path["a"].wire == "int8"
+        assert plan2.by_path["b"].wire == "bf16"
+
+    def test_nonfloat_leaf_wire_demotes_to_off(self, comm):
+        rules = PartitionRules(((".*", "fsdp", "int8"),))
+        plan = plan_partition({"idx": jnp.zeros((16,), jnp.int32)}, rules, comm)
+        assert plan.by_path["idx"].wire == "off"
+
+    def test_blockwise_chunk_rounds_to_blocks(self, comm):
+        rules = PartitionRules(((".*", "fsdp", "blockwise"),))
+        plan = plan_partition({"w": jnp.ones((1000,))}, rules, comm)
+        lp = plan.by_path["w"]
+        assert lp.chunk == F.flat_chunk(1000, comm.size, "blockwise")
+
+    def test_ambiguous_replicated_row_shape_rejected(self, comm):
+        p = comm.size
+        # sharded leaf of 4p elements -> (p, 4) rows; a REPLICATED leaf of
+        # logical shape (p, 4) is indistinguishable by shape
+        tree = {"w": jnp.ones((4 * p,)), "trap": jnp.ones((p, 4))}
+        rules = PartitionRules((("w", "fsdp"), ("trap", "replicate")))
+        with pytest.raises(ValueError, match="ambiguous partition plan"):
+            plan_partition(tree, rules, comm)
+
+    def test_unmatched_default_replicates_in_plan(self, comm):
+        plan = plan_partition(
+            {"w": jnp.ones((16,))}, PartitionRules((("zzz", "fsdp"),)), comm
+        )
+        assert not plan.by_path["w"].sharded
+
+
+class TestShardUnshard:
+    def test_roundtrip_mixed_tree(self, comm):
+        p = comm.size
+        tree = {
+            "big": jnp.arange(p * 3 + 1, dtype=jnp.float32),  # uneven: pads
+            "rep": jnp.ones((3, 5)),
+            "s": jnp.float32(7.0),
+        }
+        rules = PartitionRules((("big", "fsdp"),))
+        plan = plan_partition(tree, rules, comm)
+        sharded = fsdp_shard(tree, plan, comm)
+        assert sharded["big"].shape == (p, plan.by_path["big"].chunk)
+        logical = fsdp_unshard(sharded, plan)
+        for (path, a), (_, b) in zip(leaf_paths(tree), leaf_paths(logical)):
+            assert np.array_equal(np.asarray(a), np.asarray(b)), path
+
+    def test_shape_mismatch_rejected(self, comm):
+        tree = {"w": jnp.ones((16,))}
+        plan = plan_partition(tree, PartitionRules.fsdp_default(), comm)
+        with pytest.raises(ValueError, match="re-plan"):
+            fsdp_shard({"w": jnp.ones((8,))}, plan, comm)
