@@ -1072,6 +1072,18 @@ def main():
                 detail["serving_net"] = _snet.bench_field()
             except Exception as e:  # noqa: BLE001
                 detail["serving_net"] = {"error": repr(e)}
+            # MPMD pipeline probe (ISSUE 19, schema in
+            # docs/BENCHMARKS.md): gpipe vs 1f1b training step — step
+            # wall, measured-vs-analytic bubble accounting, activation
+            # watermark, audited inter-stage hop bytes, cross-schedule
+            # digest. Same honesty rule: walls on a CPU host are
+            # structural; bubbles/watermarks/bytes transfer.
+            try:
+                from benchmarks.pipeline import heat_tpu as _pl_bench
+
+                detail["pipeline"] = _pl_bench.bench_field()
+            except Exception as e:  # noqa: BLE001
+                detail["pipeline"] = {"error": repr(e)}
         print(json.dumps(detail), file=sys.stderr, flush=True)
 
         # honesty bit (VERDICT r5 #9, schema in docs/BENCHMARKS.md): the

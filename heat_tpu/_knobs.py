@@ -374,6 +374,41 @@ _register(
     ),
 )
 
+# -- pipeline parallelism knobs (heat_tpu/parallel, ISSUE 19) -----------------
+
+_register(
+    "HEAT_TPU_PIPELINE_SCHEDULE", "enum", "gpipe",
+    "Pipeline-training schedule of ht.nn.Pipeline / parallel/pipeline.py "
+    "site pipeline.step (parallel/schedule.py tables): `gpipe` (default "
+    "— all-forward wave, flush, all-backward wave, bit-compat with the "
+    "historical kernel lineage) or `1f1b` (PipeDream-flush one-forward-"
+    "one-backward: same results bit-for-bit — every stage still runs "
+    "its backwards in increasing microbatch order — with the activation "
+    "stash cut from M to min(S, M) in-flight microbatches and strictly "
+    "fewer steady-window bubble ticks whenever M > 1 and S > 2).",
+    choices=("gpipe", "1f1b"),
+    tunable=Tunable(("gpipe", "1f1b"), "exact"),
+)
+_register(
+    "HEAT_TPU_PIPELINE_STAGES", "int", 0,
+    "Stage count of the pipeline mapping (parallel/schedule.plan_stages). "
+    "0 (default) = auto: the node count of an ACTIVE 2-level topology "
+    "(stages ARE the HEAT_TPU_TOPOLOGY node groups — every inter-stage "
+    "hop crosses the DCN tier, and the `local` positions inside a stage "
+    "keep the FSDP weight tier), else one stage per mesh position. Must "
+    "divide the mesh size.",
+)
+_register(
+    "HEAT_TPU_PIPELINE_MICROBATCHES", "int", 0,
+    "Microbatch count M of ht.nn.Pipeline steps. 0 (default) = auto "
+    "(the stage count S, the classic balanced point: bubble fraction "
+    "(S-1)/(S+M-1) at M=S). Must divide the batch. Pure scheduling at "
+    "fixed M; CHANGING M regroups the per-microbatch loss mean and "
+    "gradient accumulation, so M itself tunes as a neutral axis only "
+    "through the autotuner's guarded measured trials.",
+    tunable=Tunable(("0", "2", "4", "8"), "neutral"),
+)
+
 # -- sparse container knobs (heat_tpu/sparse, ISSUE 13) -----------------------
 
 _register(
@@ -590,6 +625,12 @@ for _name, _doc in (
      "parity vs the replicated baseline, per-layer audited gather "
      "bytes equal to the cost model with zero drift, knob-off "
      "bit-identical dispatch, zero steady-state compiles)."),
+    ("HEAT_TPU_CI_SKIP_PIPELINE", "Skip the pipeline gate (ISSUE 19: "
+     "1f1b digest bit-identical to gpipe, measured bubble ticks equal "
+     "to the analytic schedule table, audited inter-stage hop bytes "
+     "equal to pipeline_hop_cost with zero drift, elastic kill/restore "
+     "onto a different node-by-local factorization matching the "
+     "uninterrupted trajectory, zero steady-state compiles)."),
 ):
     _register(_name, "str", None, _doc, scope="ci")
 del _name, _doc

@@ -20,7 +20,9 @@ from __future__ import annotations
 import math
 from typing import Callable, Dict, List, Optional, Sequence
 
-__all__ = ["prune", "rank", "relayout_cost_fn", "fsdp_cost_fn"]
+__all__ = [
+    "prune", "rank", "relayout_cost_fn", "fsdp_cost_fn", "pipeline_cost_fn",
+]
 
 ConfigCost = Callable[[Dict[str, str]], float]
 
@@ -164,6 +166,150 @@ def fsdp_cost_fn(
         gather_wall = 2.0 * sum(price(c) for c in gathers)
         scatter_wall = sum(price(c) for c in scatters)
         return scatter_wall + gather_wall / float(depth + 1)
+
+    return fn
+
+
+def pipeline_cost_fn(
+    layer_numels: Sequence[int],
+    n_layers: int,
+    batch: int,
+    feat_numel: int,
+    itemsize: int,
+    nproc: int,
+    *,
+    n_stages: Optional[int] = None,
+    budget: Optional[int] = None,
+    dtype: str = "float32",
+) -> ConfigCost:
+    """Analytic cost of one pipeline training step (ISSUE 19) under a
+    candidate config over the ``schedule × microbatch-count × prefetch ×
+    wire`` lattice (``HEAT_TPU_PIPELINE_SCHEDULE``,
+    ``HEAT_TPU_PIPELINE_MICROBATCHES``, ``HEAT_TPU_FSDP_PREFETCH``,
+    ``HEAT_TPU_FSDP_PREC``). Three terms, all in (weighted) wire-byte
+    units, straight from the schedule table the candidate would compile:
+
+    * **hops** — every tick moves one collective-permute per direction,
+      priced by :func:`heat_tpu.telemetry.collectives.pipeline_hop_cost`
+      (DCN-weighted under a searched ``HEAT_TPU_HIERARCHICAL``, mirroring
+      :func:`relayout_cost_fn`'s premium arming rule).
+    * **gathers** — each (layer, microbatch, direction) is one in-stage
+      grouped all-gather (ICI tier, never DCN); the forward share rides
+      the prefetch window like :func:`fsdp_cost_fn` (``1/(d+1)``
+      exposure), the backward re-gather stays exposed.
+    * **bubble exposure** — ``steady_bubble_ticks`` (the schedule-shaped
+      figure; total bubble cells are IDENTICAL across gpipe/1f1b at one
+      ``(S, M)``) times the mean busy-cell compute proxy, which is what
+      ranks 1f1b above gpipe and larger ``M`` above smaller before
+      anything is measured.
+
+    Feasibility: the candidate's activation stash
+    (``stash_depth × microbatch bytes``, per stage) must fit ``budget``
+    when one is given — gpipe at large ``M`` prunes to ``inf`` exactly
+    where 1f1b's ``min(S, M)`` stash survives. Microbatch counts that do
+    not divide the batch (or stage counts that do not divide the mesh or
+    the layer count) are ``inf``. M changes the accumulation grouping, so
+    its axis is neutral-kind in the knob registry: the tuner only adopts
+    a different M through guarded measured trials; this model just ranks
+    the candidates it measures first."""
+    from ..telemetry import collectives as model
+
+    numels = [int(n) for n in layer_numels]
+    n_layers = int(n_layers)
+    batch = int(batch)
+
+    def fn(config: Dict[str, str]) -> float:
+        from ..core import collective_prec, topology
+        from ..parallel import schedule as sched_mod
+
+        sched = (
+            config.get("HEAT_TPU_PIPELINE_SCHEDULE") or "gpipe"
+        ).strip().lower()
+        if sched not in sched_mod.SCHEDULES:
+            return math.inf
+        searching_hier = "HEAT_TPU_HIERARCHICAL" in config
+        hier_on = (config.get("HEAT_TPU_HIERARCHICAL") or "0").strip() in (
+            "1", "true", "yes", "on",
+        )
+        topo = topology.resolve(nproc)
+        tiered = hier_on and topo.nontrivial
+        S = n_stages
+        if S is None:
+            try:
+                S = int(config.get("HEAT_TPU_PIPELINE_STAGES") or 0)
+            except ValueError:
+                return math.inf
+        if S == 0:
+            S = topo.node if tiered else nproc
+        if S < 1 or nproc % S or n_layers % S:
+            return math.inf
+        local = nproc // S
+        try:
+            M = int(config.get("HEAT_TPU_PIPELINE_MICROBATCHES") or 0)
+        except ValueError:
+            return math.inf
+        M = M if M > 0 else S
+        if batch % M:
+            return math.inf
+        try:
+            depth = int(config.get("HEAT_TPU_FSDP_PREFETCH") or 0)
+        except ValueError:
+            return math.inf
+        if depth < 0:
+            return math.inf
+        prec = (config.get("HEAT_TPU_FSDP_PREC") or "").strip() or None
+        if prec is None:
+            prec = (
+                config.get("HEAT_TPU_HIERARCHICAL_PREC") or ""
+            ).strip() or None
+        if prec is None:
+            prec = (config.get("HEAT_TPU_COLLECTIVE_PREC") or "off").strip()
+        prec = collective_prec.effective(dtype, prec)
+        if prec in ("int8", "blockwise"):
+            prec = "bf16"  # the pipeline gather coercion (plan_pipeline)
+        wire_item = 2 if prec == "bf16" else itemsize
+
+        table = sched_mod.build_schedule(S, M, sched, train=True)
+        mb = batch // M
+        if budget is not None:
+            stash_bytes = (
+                table.stash_depth() * mb * int(feat_numel) * itemsize
+            )
+            if stash_bytes > budget:
+                return math.inf
+
+        hop = model.pipeline_hop_cost(
+            mb, int(feat_numel), itemsize, nproc,
+            stride=local, local=topo.local if tiered else None,
+        )
+        premium = None
+        if searching_hier:
+            try:
+                premium = float(config.get("HEAT_TPU_DCN_PREMIUM") or 0)
+            except ValueError:
+                premium = 0.0
+            if premium <= 0:
+                premium = None  # weighted_wire falls back to the live knob
+        hop_price = (
+            model.weighted_wire(hop, premium)
+            if searching_hier
+            else float(hop.bytes)
+        )
+        # the kernel skips the final tick's hops (no consumer), so a
+        # compiled step carries 2 x (n_ticks - 1) permutes
+        hop_wall = (table.n_ticks - 1) * 2.0 * hop_price
+
+        per_layer = sum(
+            local * (local - 1) * -(-numel // local) for numel in numels
+        ) * wire_item
+        fwd_gathers = M * n_layers * per_layer
+        bwd_gathers = M * n_layers * per_layer
+        gather_wall = bwd_gathers + fwd_gathers / float(depth + 1)
+
+        compute_proxy = 2.0 * M * n_layers * sum(numels) * itemsize
+        per_cell = compute_proxy / float(max(1, table.busy_cells()))
+        bubble_wall = table.steady_bubble_ticks() * per_cell
+        return hop_wall + gather_wall + bubble_wall
 
     return fn
 

@@ -10,6 +10,7 @@ module system of the JAX stack — with the distributed wrappers defined here.
 from . import functional
 from .data_parallel import DataParallel, DataParallelMultiGPU
 from .fsdp import FSDP
+from .pipeline import Pipeline
 from .transformer import MultiHeadAttention, TransformerBlock, TransformerLM
 from .moe import MoEMLP
 from .quant_dense import QuantDense
@@ -21,6 +22,7 @@ __all__ = [
     "functional",
     "MoEMLP",
     "MultiHeadAttention",
+    "Pipeline",
     "QuantDense",
     "TransformerBlock",
     "TransformerLM",

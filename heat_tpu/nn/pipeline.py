@@ -1,0 +1,445 @@
+"""ht.nn.Pipeline — the MPMD pipeline-training front end (ISSUE 19).
+
+Wraps `heat_tpu/parallel/pipeline.py`'s schedule-table kernel into the
+module-level workflow the other ``nn`` wrappers follow: plan → shard →
+train-step → checkpoint. A :class:`Pipeline` holds one homogeneous layer
+function applied ``n_layers`` times; the layers split into ``S`` stages
+mapped per node group (:func:`heat_tpu.parallel.plan_stages`), each
+stage's weights live flat-sharded ``1/local`` across its group (the PR 18
+FSDP tier), microbatch activations hop stage→stage over the DCN tier,
+and the whole step — warmup/steady/cooldown, forwards, hand-rolled
+backwards, optimizer update — is ONE cached program at site
+``pipeline.step``.
+
+Elastic contract: checkpoints store the LOGICAL form — per-layer
+unpadded params, per-layer optimizer-state rows matched to their param
+leaf by tree-path correspondence, and the step cursor — so a run killed
+on one ``node × local`` factorization resumes bit-exactly on another
+(any stage count dividing the layer count), because within-stage compute
+is replicated (the ``1/local`` sharding changes WHERE chunks live, never
+what any microbatch computes) and the schedule replays from the step
+boundary.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from .. import _knobs as knobs
+from ..core import topology as _topo
+from ..core.communication import MeshCommunication, get_comm
+from ..parallel import pipeline as _pl
+from ..parallel import schedule as _sched
+
+__all__ = ["Pipeline"]
+
+
+def _layer_apply(layer) -> Callable:
+    if hasattr(layer, "apply"):
+        return lambda p, x: layer.apply(p, x)
+    if callable(layer):
+        return layer
+    raise TypeError(f"layer must be a flax module or callable, got {layer!r}")
+
+
+class Pipeline:
+    """Pipeline-parallel training of ``n_layers`` homogeneous layers.
+
+    Parameters
+    ----------
+    layer : flax.linen.Module or callable
+        One layer, ``h = layer(params, h)`` (shape-preserving — the
+        homogeneous-pipeline contract). Every layer shares this function
+        and the parameter *signature*; each has its own parameter values.
+    n_layers : int
+        Total layer count; must divide by the stage count.
+    comm, optimizer, loss_fn
+        Mesh, bound optax optimizer, and ``loss_fn(out, y) -> scalar``
+        (both required for :meth:`make_train_step`).
+    n_stages : int, optional
+        Default ``HEAT_TPU_PIPELINE_STAGES`` (0 = auto: node groups of an
+        active 2-level topology, else one stage per position).
+    n_microbatches : int, optional
+        Default ``HEAT_TPU_PIPELINE_MICROBATCHES`` (0 = auto: the stage
+        count — the classic balanced point).
+    schedule : str, optional
+        ``gpipe`` or ``1f1b``; default ``HEAT_TPU_PIPELINE_SCHEDULE``.
+        Results are bit-identical either way; 1f1b cuts the activation
+        stash to ``min(S, M)`` and the steady-window bubble.
+    prefetch : int, optional
+        In-stage weight-gather prefetch depth (default
+        ``HEAT_TPU_FSDP_PREFETCH`` — the same window contract).
+    precision : str, optional
+        In-stage gather wire (default the ``fsdp_wire`` chain; lossy
+        modes beyond bf16 coerce to bf16 — see ``plan_pipeline``).
+    remat : bool
+        Rematerialize layer forwards inside backward ticks
+        (`jax.checkpoint`), bounding the stash to INPUT activations of
+        in-flight microbatches. Default True.
+
+    Knobs resolve at construction, like every other nn wrapper: the
+    schedule is part of the training state, not something to flip
+    mid-run (resume re-resolves on the new instance).
+    """
+
+    def __init__(
+        self,
+        layer,
+        n_layers: int,
+        comm: Optional[MeshCommunication] = None,
+        optimizer=None,
+        loss_fn: Optional[Callable] = None,
+        *,
+        n_stages: Optional[int] = None,
+        n_microbatches: Optional[int] = None,
+        schedule: Optional[str] = None,
+        prefetch: Optional[int] = None,
+        precision: Optional[str] = None,
+        remat: bool = True,
+    ):
+        self.layer = layer
+        self.layer_apply = _layer_apply(layer)
+        self.n_layers = int(n_layers)
+        self.comm = comm if comm is not None else get_comm()
+        self.optimizer = optimizer
+        self.loss_fn = loss_fn
+        self.mapping = _sched.plan_stages(self.comm.size, n_stages)
+        if self.n_layers % self.mapping.n_stages:
+            raise ValueError(
+                f"{self.n_layers} layers do not divide into "
+                f"{self.mapping.n_stages} stages"
+            )
+        m = (
+            n_microbatches
+            if n_microbatches is not None
+            else int(knobs.get("HEAT_TPU_PIPELINE_MICROBATCHES"))
+        )
+        self.n_microbatches = int(m) if int(m) > 0 else self.mapping.n_stages
+        self.schedule = _sched.resolve_schedule_name(schedule)
+        self.prefetch = int(
+            prefetch
+            if prefetch is not None
+            else knobs.get("HEAT_TPU_FSDP_PREFETCH")
+        )
+        if self.prefetch < 0:
+            raise ValueError(
+                f"prefetch depth must be >= 0, got {self.prefetch}"
+            )
+        self.precision = _topo.fsdp_wire(
+            jnp.float32, self.comm.size, precision
+        )
+        self.remat = bool(remat)
+        self._layout: Optional[_pl.PipelineLayout] = None
+
+    # -- initialization / layout ----------------------------------------------
+
+    def init(self, rng, sample_x) -> List[Any]:
+        """Per-layer logical params (a list of ``n_layers`` pytrees) —
+        flax layers initialize with split keys, the sample activation
+        flowing forward; bare callables cannot self-initialize."""
+        if not hasattr(self.layer, "init"):
+            raise TypeError(
+                "layer is a bare callable — build the per-layer params "
+                "list yourself and call shard_params"
+            )
+        params = []
+        x = sample_x
+        for j in range(self.n_layers):
+            key = jax.random.fold_in(rng, j)
+            p_j = self.layer.init(key, x)
+            x = self.layer.apply(p_j, x)
+            params.append(p_j)
+        return params
+
+    def plan(self, layer_params: Sequence[Any]) -> _pl.PipelineLayout:
+        """Resolve (and pin) the chunked stage-layer layout."""
+        self._layout = _pl.plan_pipeline(
+            layer_params, self.mapping, wire=self.precision
+        )
+        return self._layout
+
+    def _ensure_layout(self, layer_params) -> _pl.PipelineLayout:
+        if self._layout is None:
+            return self.plan(layer_params)
+        return self._layout
+
+    @property
+    def layout(self) -> _pl.PipelineLayout:
+        if self._layout is None:
+            raise ValueError("no layout pinned — call plan/shard_params first")
+        return self._layout
+
+    def shard_params(self, layer_params: Sequence[Any]):
+        """Logical per-layer list → persistent ``(p, lps, chunk)`` rows."""
+        return _pl.shard_pipeline_params(
+            layer_params, self._ensure_layout(layer_params), self.comm
+        )
+
+    def unshard_params(self, params) -> List[Any]:
+        """Persistent rows → logical per-layer numpy list."""
+        return _pl.unshard_pipeline_params(params, self.layout)
+
+    def param_bytes_per_device(self) -> int:
+        """Per-device persistent parameter bytes of the pinned layout —
+        ``1/p`` of the model (each position holds its stage's ``1/local``
+        chunks of ``n_layers/S`` layers)."""
+        return self.layout.bytes_per_device()
+
+    def init_opt_state(self, params):
+        """Optimizer state OVER the persistent layout (ZeRO-composed):
+        state leaves shaped like a param row are pinned to the same
+        sharding; scalars stay replicated."""
+        opt = self.optimizer
+        if opt is None:
+            raise ValueError("no optimizer bound; pass one at construction")
+        state = opt.init(params)
+        rows = self.layout.row_shapes()
+        comm = self.comm
+        return jax.tree_util.tree_map(
+            lambda l: jax.device_put(l, comm.sharding(0, 3))
+            if tuple(getattr(l, "shape", ())) in rows
+            else jax.device_put(l, comm.replicated()),
+            state,
+        )
+
+    # -- the step programs -----------------------------------------------------
+
+    def _table(self, train: bool) -> _sched.ScheduleTable:
+        return _sched.build_schedule(
+            self.mapping.n_stages,
+            self.n_microbatches,
+            self.schedule,
+            train=train,
+        )
+
+    def _micro(self, arr):
+        m = self.n_microbatches
+        b = arr.shape[0]
+        if b % m:
+            raise ValueError(
+                f"batch {b} not divisible into {m} microbatches"
+            )
+        return arr.reshape(m, b // m, *arr.shape[1:])
+
+    def make_train_step(self) -> Callable:
+        """``step(params, opt_state, x, y) -> (params, opt_state, loss)``
+        — one cached schedule-table program (site ``pipeline.step``);
+        repeat steps at fixed shapes are pure cache hits."""
+        if self.optimizer is None or self.loss_fn is None:
+            raise ValueError(
+                "make_train_step needs optimizer and loss_fn bound at "
+                "construction"
+            )
+        prog = _pl.pipeline_step_program(
+            self.layer_apply,
+            self.layout,
+            self.mapping,
+            self._table(train=True),
+            comm=self.comm,
+            loss_fn=self.loss_fn,
+            optimizer=self.optimizer,
+            prefetch=self.prefetch,
+            remat=self.remat,
+        )
+
+        def step(params, opt_state, x, y):
+            return prog(params, opt_state, self._micro(x), self._micro(y))
+
+        return step
+
+    def __call__(self, params, x):
+        """Forward-only pipelined apply of all ``n_layers`` layers."""
+        prog = _pl.pipeline_step_program(
+            self.layer_apply,
+            self.layout,
+            self.mapping,
+            self._table(train=False),
+            comm=self.comm,
+            prefetch=self.prefetch,
+            remat=self.remat,
+        )
+        out = prog(params, self._micro(x))
+        return out.reshape(x.shape[0], *out.shape[2:])
+
+    # -- optimizer-state correspondence (the elastic machinery) ----------------
+
+    def _state_correspondence(self, layout: _pl.PipelineLayout):
+        """Map each optimizer-state leaf to its param leaf (or None for
+        replicated scalars): a state leaf corresponds to param leaf ``k``
+        iff it has the ``(p, lps, chunk_k)`` row shape AND the param
+        leaf's tree path is a suffix of the state leaf's path — the
+        structure optax transforms produce (``mu``/``nu`` mirror the
+        params tree). Row-shaped leaves with no unique correspondence are
+        rejected loudly: without a param identity their padding cannot be
+        unpadded topology-independently."""
+        opt = self.optimizer
+        if opt is None:
+            raise ValueError("no optimizer bound; pass one at construction")
+        stacked_t = jax.tree_util.tree_unflatten(
+            layout.treedef,
+            [
+                jax.ShapeDtypeStruct(
+                    (layout.p, layout.layers_per_stage, layout.chunk(k)),
+                    layout.dtypes[k],
+                )
+                for k in range(len(layout.shapes))
+            ],
+        )
+        state_t = jax.eval_shape(opt.init, stacked_t)
+        p_paths = [
+            tuple(path)
+            for path, _ in jax.tree_util.tree_flatten_with_path(stacked_t)[0]
+        ]
+        rows = layout.row_shapes()
+        corr: List[Optional[int]] = []
+        for path, leaf in jax.tree_util.tree_flatten_with_path(state_t)[0]:
+            shape = tuple(leaf.shape)
+            if shape not in rows:
+                corr.append(None)
+                continue
+            pt = tuple(path)
+            hits = [
+                k
+                for k, pp in enumerate(p_paths)
+                if len(pt) >= len(pp)
+                and pt[len(pt) - len(pp):] == pp
+                and (layout.p, layout.layers_per_stage, layout.chunk(k))
+                == shape
+            ]
+            if len(hits) != 1:
+                raise ValueError(
+                    f"optimizer-state leaf at {jax.tree_util.keystr(path)} "
+                    "has a sharded row shape but no unique param-leaf "
+                    "correspondence; Pipeline checkpoints support optax-"
+                    "style states whose sharded leaves mirror the params "
+                    "tree"
+                )
+            corr.append(hits[0])
+        return state_t, corr
+
+    def _logical_state(self, opt_state):
+        """Persistent state → topology-independent logical form: matched
+        leaves become stacked ``(n_layers, *shape)`` numpy, scalars pass
+        through."""
+        import numpy as np
+
+        layout = self.layout
+        _, corr = self._state_correspondence(layout)
+        leaves, treedef = jax.tree_util.tree_flatten(opt_state)
+        out = []
+        for leaf, k in zip(leaves, corr):
+            if k is None:
+                out.append(np.asarray(leaf))
+            else:
+                out.append(
+                    _pl.unshard_state_rows(
+                        leaf, layout, layout.numel(k), layout.shapes[k]
+                    )
+                )
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    def _reshard_state(self, logical_state):
+        layout = self.layout
+        _, corr = self._state_correspondence(layout)
+        leaves, treedef = jax.tree_util.tree_flatten(logical_state)
+        comm = self.comm
+        out = []
+        for leaf, k in zip(leaves, corr):
+            if k is None:
+                out.append(
+                    jax.device_put(jnp.asarray(leaf), comm.replicated())
+                )
+            else:
+                out.append(_pl.shard_state_rows(leaf, layout, comm))
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    def _logical_state_template(self, layout: _pl.PipelineLayout):
+        state_t, corr = self._state_correspondence(layout)
+        leaves, treedef = jax.tree_util.tree_flatten(state_t)
+        out = []
+        for leaf, k in zip(leaves, corr):
+            if k is None:
+                out.append(leaf)
+            else:
+                out.append(
+                    jax.ShapeDtypeStruct(
+                        (layout.n_layers,) + layout.shapes[k], leaf.dtype
+                    )
+                )
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    # -- elastic checkpoint / resume -------------------------------------------
+
+    def save_checkpoint(self, path: str, params, opt_state, step: int = 0):
+        """Checkpoint the LOGICAL form + the schedule cursor ``step``
+        (per-leaf blobs, CRC-checked, atomic swap — no trace of this
+        mesh's factorization, stage count, or schedule)."""
+        from .. import resilience
+
+        return resilience.save_checkpoint(
+            {
+                "params": self.unshard_params(params),
+                "opt_state": self._logical_state(opt_state),
+            },
+            path,
+            extra={
+                "algo": "pipeline",
+                "step": int(step),
+                "schedule": self.schedule,
+                "n_microbatches": int(self.n_microbatches),
+                "n_layers": int(self.n_layers),
+            },
+        )
+
+    def resume(self, path: str, params_template: Sequence[Any]):
+        """Restore onto THIS instance's mesh/mapping (possibly a
+        different ``node × local`` factorization or stage count than the
+        writer's): logical blobs re-pad and re-shard for the current
+        layout, bit-exactly. ``params_template`` supplies structure and
+        logical shapes (e.g. a fresh :meth:`init`). Returns
+        ``(params, opt_state, step)``."""
+        from .. import resilience
+
+        # validate provenance BEFORE the structural load so a wrong-model
+        # checkpoint fails with the informative error, not a leaf-count one
+        extra = resilience.checkpoint.load_manifest(path).get("extra", {})
+        if extra.get("algo") != "pipeline":
+            raise resilience.CheckpointError(
+                f"{path!r} is a {extra.get('algo')!r} checkpoint, "
+                "not pipeline"
+            )
+        if int(extra.get("n_layers", self.n_layers)) != self.n_layers:
+            raise resilience.CheckpointError(
+                f"checkpoint has {extra.get('n_layers')} layers, this "
+                f"Pipeline has {self.n_layers}"
+            )
+
+        layout = self._ensure_layout(params_template)
+        like = {
+            "params": [
+                jax.tree_util.tree_map(
+                    lambda l: jax.ShapeDtypeStruct(
+                        jnp.shape(l), jnp.asarray(l).dtype
+                    ),
+                    layer,
+                )
+                for layer in params_template
+            ],
+            "opt_state": self._logical_state_template(layout),
+        }
+        tree, extra = resilience.load_checkpoint(
+            path, like=like, with_extra=True
+        )
+        params = _pl.shard_pipeline_params(
+            [
+                jax.tree_util.tree_map(jnp.asarray, layer)
+                for layer in tree["params"]
+            ],
+            layout,
+            self.comm,
+        )
+        opt_state = self._reshard_state(tree["opt_state"])
+        return params, opt_state, int(extra.get("step", 0))

@@ -19,6 +19,11 @@ builds long-context attention on top of them:
   TPU kernel (VMEM-resident online softmax, MXU-blocked QKᵀ/PV).
 * :func:`pipeline_apply` — GPipe pipeline parallelism: one stage per mesh
   position, microbatch activations hopping the ring via `ppermute`.
+* :class:`ScheduleTable` / :func:`build_schedule` / :class:`StageMapping`
+  / :func:`pipeline_step_program` — MPMD pipeline training (ISSUE 19):
+  static gpipe/1f1b action tables driving one cached `shard_map` train
+  program, stages mapped per node group with the in-stage FSDP weight
+  tier (see :class:`heat_tpu.nn.Pipeline`).
 * :func:`shard_pytree` / :func:`constrain_pytree` — FSDP/ZeRO-style
   parameter and optimizer-state sharding (largest divisible axis per
   leaf; XLA inserts the use-site all-gathers).
@@ -33,7 +38,24 @@ from .ring import ring_pipeline
 from .attention import local_attention, ring_attention, ulysses_attention
 from .halo import halo_exchange
 from .pallas_attention import flash_attention
-from .pipeline import pipeline_apply, stack_stage_params
+from .pipeline import (
+    PipelineLayout,
+    pipeline_apply,
+    pipeline_step_program,
+    plan_pipeline,
+    shard_pipeline_params,
+    stack_stage_params,
+    unshard_pipeline_params,
+)
+from .schedule import (
+    ScheduleTable,
+    StageMapping,
+    build_schedule,
+    gpipe_schedule,
+    one_f1b_schedule,
+    plan_stages,
+    resolve_schedule_name,
+)
 from .fsdp import (
     FsdpLeaf,
     FsdpPlan,
@@ -57,6 +79,18 @@ __all__ = [
     "flash_attention",
     "pipeline_apply",
     "stack_stage_params",
+    "PipelineLayout",
+    "pipeline_step_program",
+    "plan_pipeline",
+    "shard_pipeline_params",
+    "unshard_pipeline_params",
+    "ScheduleTable",
+    "StageMapping",
+    "build_schedule",
+    "gpipe_schedule",
+    "one_f1b_schedule",
+    "plan_stages",
+    "resolve_schedule_name",
     "shard_pytree",
     "constrain_pytree",
     "replicate_pytree",

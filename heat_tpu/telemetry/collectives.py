@@ -55,6 +55,7 @@ __all__ = [
     "ring_attention_cost",
     "ulysses_attention_cost",
     "pipeline_cost",
+    "pipeline_hop_cost",
     "spmv_cost",
     "spmm_cost",
     "sparse_transpose_cost",
@@ -786,6 +787,54 @@ def pipeline_cost(
     allreduce = 2 * out_bytes * (nproc - 1)
     return CollectiveCost(
         "ppermute-ring+all-reduce", ring + allreduce, steps=ticks
+    )
+
+
+def pipeline_hop_cost(
+    mb_batch: int,
+    feat_numel: int,
+    itemsize: int,
+    nproc: int,
+    stride: int = 1,
+    local: Optional[int] = None,
+) -> CollectiveCost:
+    """Cost of ONE inter-stage pipeline hop (ISSUE 19,
+    ``heat_tpu/parallel/pipeline.py`` site ``pipeline.step``): every mesh
+    position ships its ``(mb_batch, feat)`` microbatch activation along
+    one ``collective-permute`` pair ``i -> (i + stride) % p`` — ``p``
+    pairs total, wraparound included, mirroring the emitted
+    ``source_target_pairs`` byte-for-byte (the HLO auditor's
+    collective-permute model is ``in_bytes x |pairs|``).
+
+    ``stride`` is the stage-mapping hop (the in-stage group size —
+    ``p/S``; the backward cotangent hop is the same permutation
+    reversed, so one figure prices both directions). ``local`` is the
+    MESH topology's in-node group size: pairs whose endpoints lie in
+    different node groups ride the DCN tier and land in ``dcn_bytes``,
+    priced at ``HEAT_TPU_DCN_PREMIUM`` by :func:`weighted_wire`. With
+    the auto stage placement (stages == node groups, ``stride ==
+    local``) every pair crosses — the full hop is DCN; ``local=None``
+    (1-level mesh) prices zero DCN bytes. A schedule's total is
+    ``n_hops x`` this figure (one fwd + one bwd permute per tick on a
+    training table), which the zero-drift audit re-derives from the
+    compiled program's pair lists."""
+    if nproc <= 1:
+        return CollectiveCost("none", 0)
+    mb_bytes = int(mb_batch) * int(feat_numel) * int(itemsize)
+    stride = int(stride) % int(nproc)
+    cross = 0
+    if local is not None and 0 < int(local) < int(nproc):
+        local = int(local)
+        cross = sum(
+            1
+            for i in range(int(nproc))
+            if (i // local) != (((i + stride) % int(nproc)) // local)
+        )
+    return CollectiveCost(
+        "ppermute-ring",
+        int(nproc) * mb_bytes,
+        steps=1,
+        dcn_bytes=cross * mb_bytes,
     )
 
 

@@ -84,6 +84,9 @@ def summarize(
     st_max_version = 0
     plan_counts: dict = {}
     hier_rows: dict = {}
+    pipe_rows: dict = {}
+    pipe_gather_bytes = 0
+    pipe_gather_events = 0
     plan_last: Optional[dict] = None
     plan_wire = 0
     pc_evictions = 0
@@ -131,6 +134,36 @@ def summarize(
                 hrow["dcn_bytes"] += int(ev.get("dcn_bytes", 0) or 0)
                 w = ev.get("wire") or "off"
                 hrow["wire"][w] = hrow["wire"].get(w, 0) + 1
+            if name == "pipeline_tick":
+                # per-tick schedule spans (ISSUE 19): one event per tick
+                # per traced pipeline.step program — the measured bubble
+                # accounting the CI gate reconciles against the analytic
+                # ScheduleTable, plus the hop wire/DCN volume per tick
+                prow = pipe_rows.setdefault(
+                    ev.get("schedule") or "?",
+                    {"ticks": 0, "fwd": 0, "bwd": 0, "bubble_cells": 0,
+                     "steady_bubble_cells": 0, "stages": 0,
+                     "phases": {}, "hop_bytes": 0, "hop_dcn_bytes": 0},
+                )
+                prow["ticks"] += 1
+                prow["stages"] = int(ev.get("stages", 0) or 0)
+                prow["fwd"] += int(ev.get("n_fwd", 0) or 0)
+                prow["bwd"] += int(ev.get("n_bwd", 0) or 0)
+                bub = int(ev.get("bubble", 0) or 0)
+                prow["bubble_cells"] += bub
+                ph = ev.get("phase") or "?"
+                prow["phases"][ph] = prow["phases"].get(ph, 0) + 1
+                if ph == "steady":
+                    prow["steady_bubble_cells"] += bub
+                hops = ev.get("hops")
+                hops = 1 if hops is None else int(hops)
+                prow["hop_bytes"] += hops * int(ev.get("hop_bytes", 0) or 0)
+                prow["hop_dcn_bytes"] += hops * int(
+                    ev.get("hop_dcn_bytes", 0) or 0
+                )
+            elif name == "pipeline_gather":
+                pipe_gather_bytes += int(ev.get("bytes", 0) or 0)
+                pipe_gather_events += 1
         elif kind == "program_cache":
             if ev.get("event") == "retrace":
                 name = ev.get("name")
@@ -251,6 +284,17 @@ def summarize(
             "collectives": hier_rows,
             "dcn_bytes": sum(r["dcn_bytes"] for r in hier_rows.values()),
             "bytes": sum(r["bytes"] for r in hier_rows.values()),
+        }
+    if pipe_rows or pipe_gather_events:
+        # pipeline view (parallel/pipeline.py, ISSUE 19): per traced
+        # schedule, tick/action/bubble tallies (steady_bubble_cells is
+        # the schedule-shaped figure 1f1b cuts), per-tick hop wire and
+        # DCN bytes, and the in-stage weight-gather stream. Absent when
+        # no pipeline program was traced, so other summaries keep shape.
+        out["pipeline"] = {
+            "schedules": pipe_rows,
+            "gather_bytes": pipe_gather_bytes,
+            "gather_events": pipe_gather_events,
         }
     if plan_counts:
         # relayout-planner decisions (core/relayout_planner.py): how many

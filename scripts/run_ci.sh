@@ -1649,6 +1649,357 @@ EOF
     fi
 fi
 
+# Pipeline gate (ISSUE 19): on an emulated 4x2 mesh (stages == node
+# groups) —
+#   (a) the 1f1b training digest is BIT-identical to gpipe (same loss,
+#       params, and optimizer state bytes: pure scheduling),
+#   (b) measured per-tick telemetry bubbles reconcile EXACTLY with the
+#       analytic ScheduleTable for both schedules, and 1f1b's
+#       steady-window bubble ticks are strictly fewer (12 -> 10 at
+#       S=4, M=8),
+#   (c) the 1f1b activation watermark (memory_analysis temp bytes) is
+#       strictly below gpipe's,
+#   (d) the audited inter-stage hop is zero-drift: emitted
+#       collective-permute count == 2*(n_ticks-1), per-instruction wire
+#       == pipeline_hop_cost, and the DCN split re-derived from the
+#       emitted source-target pairs == the model's dcn_bytes exactly,
+#   (e) a run SIGKILLed after checkpointing resumes onto a DIFFERENT
+#       node x local factorization AND schedule with a bit-identical
+#       continued trajectory, and
+#   (f) zero steady-state compiles at the pipeline.step site.
+# HEAT_TPU_CI_SKIP_PIPELINE=1 opts out.
+if [ -z "${HEAT_TPU_CI_SKIP_PIPELINE:-}" ]; then
+    echo "=== pipeline gate: 1F1B over node groups (emulated 4x2 mesh) ==="
+    pipe_rc=0
+    pipe_out=$(mktemp)
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" JAX_PLATFORMS=cpu \
+        HEAT_TPU_TOPOLOGY=4x2 \
+        python - <<'EOF' > "$pipe_out" 2>&1 || pipe_rc=$?
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import heat_tpu as ht
+from heat_tpu import telemetry as tm
+from heat_tpu.core import program_cache
+from heat_tpu.nn import Pipeline
+from heat_tpu.parallel import pipeline as pl
+from heat_tpu.parallel import schedule as sch
+from heat_tpu.telemetry import collectives as model, hlo
+
+comm = ht.get_comm()
+p = comm.size
+assert p == 8, f"expected an 8-device mesh, got {p}"
+report = {"mesh": p, "topology": comm.topology().describe()}
+
+S, M, L, DIN = 4, 8, 4, 8
+OPT = optax.adam(1e-2)
+
+
+def layer_fn(w, h):
+    return jnp.tanh(h @ w["w"] + w["b"])
+
+
+def loss_fn(out, yy):
+    return jnp.mean((out - yy) ** 2)
+
+
+def make_layers():
+    rng = np.random.default_rng(0)
+    return [
+        {"w": jnp.asarray(rng.standard_normal((DIN, DIN)) * 0.3,
+                          jnp.float32),
+         "b": jnp.asarray(rng.standard_normal((DIN,)) * 0.1, jnp.float32)}
+        for _ in range(L)
+    ]
+
+
+def make_data():
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.standard_normal((16, DIN)), jnp.float32)
+    y = jnp.asarray(rng.standard_normal((16, DIN)), jnp.float32)
+    return x, y
+
+
+def run(schedule, n_stages=S, steps=4):
+    pipe = Pipeline(layer_fn, L, comm, OPT, loss_fn, n_stages=n_stages,
+                    n_microbatches=M, schedule=schedule)
+    params = pipe.shard_params(make_layers())
+    state = pipe.init_opt_state(params)
+    step = pipe.make_train_step()
+    x, y = make_data()
+    loss = None
+    for _ in range(steps):
+        params, state, loss = step(params, state, x, y)
+    return pipe, params, state, step, (x, y), loss
+
+
+def digest(pipe, params, state, loss):
+    blobs = [
+        np.asarray(l).tobytes()
+        for layer in pipe.unshard_params(params)
+        for l in jax.tree_util.tree_leaves(layer)
+    ]
+    blobs.append(np.asarray(loss).tobytes())
+    return b"".join(blobs)
+
+
+# -- (a) 1f1b digest bit-identical to gpipe -----------------------------------
+g_pipe, g_p, g_s, g_step, g_batch, g_loss = run("gpipe")
+f_pipe, f_p, f_s, f_step, f_batch, f_loss = run("1f1b")
+if digest(g_pipe, g_p, g_s, g_loss) != digest(f_pipe, f_p, f_s, f_loss):
+    raise SystemExit("pipeline: 1f1b digest differs from gpipe")
+if np.asarray(g_loss).tobytes() != np.asarray(f_loss).tobytes():
+    raise SystemExit("pipeline: schedule changed the loss bytes")
+report["digest_bit_identical"] = True
+report["loss"] = float(g_loss)
+
+# -- (b) measured per-tick bubbles == analytic table, 1f1b strictly wins ------
+measured = {}
+for name in ("gpipe", "1f1b"):
+    table = sch.build_schedule(S, M, name)
+    mapping = sch.StageMapping(p, S)
+    layers = make_layers()
+    layout = pl.plan_pipeline(layers, mapping)
+    rows = pl.shard_pipeline_params(layers, layout, comm)
+    st = OPT.init(rows)
+    x, y = make_data()
+    mx, my = x.reshape(M, 2, DIN), y.reshape(M, 2, DIN)
+
+    def fresh_layer(w, h):  # new callable => fresh trace under telemetry
+        return jnp.tanh(h @ w["w"] + w["b"])
+
+    sink = tempfile.mktemp(suffix=".jsonl")
+    reg = tm.enable(sink)
+    n0 = len(reg.events)
+    try:
+        step = pl.pipeline_step_program(
+            fresh_layer, layout, mapping, table, comm=comm,
+            loss_fn=loss_fn, optimizer=OPT)
+        step(rows, st, mx, my)
+        events = list(reg.events)[n0:]
+    finally:
+        tm.disable()
+        os.path.exists(sink) and os.unlink(sink)
+    ticks = [e for e in events if e.get("name") == "pipeline_tick"]
+    if len(ticks) != table.n_ticks:
+        raise SystemExit(
+            f"pipeline: {name} traced {len(ticks)} tick spans, "
+            f"table has {table.n_ticks}"
+        )
+    steady = sum(e["bubble"] for e in ticks if e["phase"] == "steady")
+    total = sum(e["bubble"] for e in ticks)
+    if steady != table.steady_bubble_ticks():
+        raise SystemExit(
+            f"pipeline: {name} measured {steady} steady bubbles, "
+            f"table says {table.steady_bubble_ticks()}"
+        )
+    if total != table.bubble_cells():
+        raise SystemExit(
+            f"pipeline: {name} measured {total} bubble cells, "
+            f"table says {table.bubble_cells()}"
+        )
+    measured[name] = {"steady_bubble_ticks": steady,
+                      "bubble_cells": total,
+                      "bubble_fraction": table.bubble_fraction()}
+if not (measured["1f1b"]["steady_bubble_ticks"]
+        < measured["gpipe"]["steady_bubble_ticks"]):
+    raise SystemExit(f"pipeline: 1f1b did not win steady bubbles {measured}")
+report["schedules"] = measured
+
+# -- (c) 1f1b activation watermark strictly below gpipe -----------------------
+def temp_bytes(name):
+    table = sch.build_schedule(S, M, name)
+    mapping = sch.StageMapping(p, S)
+    layers = make_layers()
+    layout = pl.plan_pipeline(layers, mapping)
+    rows = pl.shard_pipeline_params(layers, layout, comm)
+    st = OPT.init(rows)
+    x, y = make_data()
+    mx, my = x.reshape(M, 2, DIN), y.reshape(M, 2, DIN)
+    step = pl.pipeline_step_program(
+        layer_fn, layout, mapping, table, comm=comm,
+        loss_fn=loss_fn, optimizer=OPT)
+    ma = jax.jit(step).lower(rows, st, mx, my).compile().memory_analysis()
+    return int(getattr(ma, "temp_size_in_bytes", 0) or 0)
+
+
+g_temp, f_temp = temp_bytes("gpipe"), temp_bytes("1f1b")
+if g_temp and f_temp:
+    if not f_temp < g_temp:
+        raise SystemExit(
+            f"pipeline: 1f1b watermark {f_temp} not below gpipe {g_temp}"
+        )
+    report["activation_watermark"] = {"gpipe": g_temp, "1f1b": f_temp}
+else:
+    report["activation_watermark"] = "unavailable"
+
+# -- (d) audited inter-stage hop: zero drift incl. the DCN split --------------
+mapping = sch.StageMapping(p, S)
+table = sch.build_schedule(S, M, "gpipe")
+layers = make_layers()
+layout = pl.plan_pipeline(layers, mapping)
+rows = pl.shard_pipeline_params(layers, layout, comm)
+st = OPT.init(rows)
+x, y = make_data()
+mx, my = x.reshape(M, 2, DIN), y.reshape(M, 2, DIN)
+step = pl.pipeline_step_program(
+    layer_fn, layout, mapping, table, comm=comm,
+    loss_fn=loss_fn, optimizer=OPT)
+audit = hlo.audit_computation(step, rows, st, mx, my)
+perms = [c for c in audit.collectives if c.op == "collective-permute"]
+hop = model.pipeline_hop_cost(
+    2, DIN, 4, p, stride=mapping.local, local=comm.topology().local)
+if hop.dcn_bytes != hop.bytes:
+    raise SystemExit(
+        "pipeline: stages==node groups must make the whole hop DCN"
+    )
+if len(perms) != 2 * (table.n_ticks - 1):
+    raise SystemExit(
+        f"pipeline: {len(perms)} permutes, expected {2 * (table.n_ticks - 1)}"
+    )
+emitted = emitted_dcn = 0
+for c in perms:
+    if c.wire_bytes != hop.bytes:
+        raise SystemExit(
+            f"pipeline: hop drift {c.wire_bytes} != {hop.bytes}"
+        )
+    pairs = [tuple(pr) for pr in c.groups]
+    per_pair = c.wire_bytes // len(pairs)
+    nl = comm.topology().local
+    cross = [pr for pr in pairs if pr[0] // nl != pr[1] // nl]
+    emitted += c.wire_bytes
+    emitted_dcn += per_pair * len(cross)
+if emitted != 2 * (table.n_ticks - 1) * hop.bytes:
+    raise SystemExit("pipeline: total hop bytes drift")
+if emitted_dcn != 2 * (table.n_ticks - 1) * hop.dcn_bytes:
+    raise SystemExit(
+        f"pipeline: DCN split drift {emitted_dcn} != "
+        f"{2 * (table.n_ticks - 1) * hop.dcn_bytes}"
+    )
+report["hop_audit"] = {
+    "permutes": len(perms), "wire_bytes": emitted,
+    "dcn_bytes": emitted_dcn, "drift": 0,
+}
+
+# -- (e) SIGKILLed run resumes on a different factorization, bit-exact --------
+ckpt_dir = tempfile.mkdtemp(prefix="pipe_gate_") + "/ckpt"
+child = r"""
+import os, signal
+import jax.numpy as jnp
+import numpy as np
+import optax
+import heat_tpu as ht
+from heat_tpu.nn import Pipeline
+
+comm = ht.get_comm()
+S, M, L, DIN = 4, 8, 4, 8
+
+def layer_fn(w, h):
+    return jnp.tanh(h @ w["w"] + w["b"])
+
+def loss_fn(out, yy):
+    return jnp.mean((out - yy) ** 2)
+
+rng = np.random.default_rng(0)
+layers = [
+    {"w": jnp.asarray(rng.standard_normal((DIN, DIN)) * 0.3, jnp.float32),
+     "b": jnp.asarray(rng.standard_normal((DIN,)) * 0.1, jnp.float32)}
+    for _ in range(L)
+]
+rng = np.random.default_rng(1)
+x = jnp.asarray(rng.standard_normal((16, DIN)), jnp.float32)
+y = jnp.asarray(rng.standard_normal((16, DIN)), jnp.float32)
+
+pipe = Pipeline(layer_fn, L, comm, optax.adam(1e-2), loss_fn,
+                n_stages=S, n_microbatches=M, schedule="1f1b")
+params = pipe.shard_params(layers)
+state = pipe.init_opt_state(params)
+step = pipe.make_train_step()
+for _ in range(2):
+    params, state, loss = step(params, state, x, y)
+pipe.save_checkpoint(os.environ["PIPE_GATE_CKPT"], params, state, step=2)
+print("checkpointed at step 2", flush=True)
+params, state, loss = step(params, state, x, y)  # dies mid-run
+os.kill(os.getpid(), signal.SIGKILL)
+"""
+env = dict(os.environ, PIPE_GATE_CKPT=ckpt_dir)
+proc = subprocess.run([sys.executable, "-c", child], env=env,
+                      capture_output=True, text=True, timeout=600)
+if proc.returncode != -signal.SIGKILL:
+    raise SystemExit(
+        f"pipeline: chaos child rc={proc.returncode}\n{proc.stdout}"
+        f"\n{proc.stderr}"
+    )
+if "checkpointed at step 2" not in proc.stdout:
+    raise SystemExit(f"pipeline: child never checkpointed\n{proc.stderr}")
+
+# the uninterrupted reference (same seeds/schedule as the killed run)
+ref_pipe, ref_p, ref_s, _, _, ref_loss = run("1f1b")
+# restore onto 2 stages x 4 local AND the other schedule
+res_pipe = Pipeline(layer_fn, L, comm, OPT, loss_fn, n_stages=2,
+                    n_microbatches=M, schedule="gpipe")
+res_params, res_state, cursor = res_pipe.resume(ckpt_dir, make_layers())
+if cursor != 2:
+    raise SystemExit(f"pipeline: resumed cursor {cursor} != 2")
+res_step = res_pipe.make_train_step()
+x, y = make_data()
+res_loss = None
+for _ in range(2):
+    res_params, res_state, res_loss = res_step(res_params, res_state, x, y)
+if np.asarray(ref_loss).tobytes() != np.asarray(res_loss).tobytes():
+    raise SystemExit("pipeline: restored loss trajectory diverged")
+ref_final = ref_pipe.unshard_params(ref_p)
+res_final = res_pipe.unshard_params(res_params)
+for ja, jb in zip(ref_final, res_final):
+    for la, lb in zip(jax.tree_util.tree_leaves(ja),
+                      jax.tree_util.tree_leaves(jb)):
+        if np.asarray(la).tobytes() != np.asarray(lb).tobytes():
+            raise SystemExit(
+                "pipeline: restored params diverged from uninterrupted run"
+            )
+report["elastic"] = {
+    "killed_at": "step 3 (SIGKILL)", "resumed_onto": "2x4 gpipe",
+    "trajectory": "bit-identical",
+}
+
+# -- (f) zero steady-state compiles at the pipeline.step site -----------------
+before = program_cache.site_stats("pipeline.step")
+with tm.CompileWatcher() as watch:
+    for _ in range(3):
+        g_p, g_s, _ = g_step(g_p, g_s, *g_batch)
+after = program_cache.site_stats("pipeline.step")
+if after["misses"] != before["misses"]:
+    raise SystemExit(
+        f"pipeline: steady state recompiled ({before} -> {after})"
+    )
+if watch.backend_seconds != 0.0:
+    raise SystemExit(
+        f"pipeline: steady state hit the backend "
+        f"({watch.backend_seconds}s)"
+    )
+report["step_site"] = after
+print(json.dumps({"pipeline": "ok", **report}))
+EOF
+    cat "$pipe_out"
+    if [ -n "$REPORT" ]; then
+        cp "$pipe_out" "${REPORT}/pipeline_gate.log" || true
+    fi
+    rm -f "$pipe_out"
+    if [ "$pipe_rc" != 0 ]; then
+        echo "=== pipeline gate FAILED (rc=$pipe_rc) ==="
+        FAILED_SIZES="$FAILED_SIZES pipeline"
+    fi
+fi
+
 # Streaming gate (ISSUE 16, heat_tpu/streaming): a 2-file HDF5 stream
 # under a pinned HEAT_TPU_HBM_BUDGET that forbids materializing the file
 # set must show
