@@ -1084,6 +1084,18 @@ def main():
                 detail["pipeline"] = _pl_bench.bench_field()
             except Exception as e:  # noqa: BLE001
                 detail["pipeline"] = {"error": repr(e)}
+            # autoscale probe (ISSUE 20, schema in docs/BENCHMARKS.md):
+            # a quick step-profile run under the AutoscaleController —
+            # scale-up/drain trail, failed count, p99, and the
+            # replica-seconds ratio vs static max provisioning. Replica
+            # processes always run virtual CPU meshes, so the row
+            # carries its own on_chip/cpu_fallback verdict.
+            try:
+                from benchmarks.autoscale import run as _as_bench
+
+                detail["autoscale"] = _as_bench.bench_field()
+            except Exception as e:  # noqa: BLE001
+                detail["autoscale"] = {"error": repr(e)}
         print(json.dumps(detail), file=sys.stderr, flush=True)
 
         # honesty bit (VERDICT r5 #9, schema in docs/BENCHMARKS.md): the

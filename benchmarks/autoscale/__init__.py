@@ -1,0 +1,11 @@
+"""Autoscaling control-plane benchmark package (ISSUE 20).
+
+* :mod:`.profiles` — deterministic offered-load shapes (step / spike /
+  diurnal) and the seeded inhomogeneous-Poisson arrival schedules built
+  from them (thinning — unit-testable without running any server);
+* :mod:`.run` — the loadgen runner behind the committed
+  ``artifacts/bench_autoscale_r20.jsonl``: controller-vs-static
+  replica-seconds pricing, the two-tenant weighted-fair overload phase,
+  hedged-retry tail trimming under injected stragglers, and the chaos
+  SIGKILL-replacement phase the CI autoscale gate asserts.
+"""

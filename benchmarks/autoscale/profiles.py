@@ -1,0 +1,87 @@
+"""Offered-load profiles for the autoscale benchmark (ISSUE 20).
+
+A profile is a *shape*: a function of normalized time ``u in [0, 1)``
+returning the rate multiplier in ``(0, 1]`` applied to the peak offered
+rate. :func:`schedule` turns a shape into concrete arrival offsets of an
+inhomogeneous Poisson process via thinning (candidates at the peak rate,
+each kept with probability ``shape(u)``), from a seeded RNG — the same
+determinism contract as ``benchmarks.serving.loadgen.poisson_schedule``:
+same seed → same schedule, no server required to test the generator.
+
+The three shipped shapes exercise the three controller behaviors the
+artifact prices:
+
+* ``step``   — low / 3× sustained high / low thirds: sustained-backlog
+  scale-up, then the drain-idle scale-down;
+* ``spike``  — a short 10%-of-duration burst: cooldown hysteresis (one
+  decisive scale-up, no flapping on the edges);
+* ``diurnal`` — a raised-cosine day: gradual ramp both ways, capacity
+  tracking demand instead of the static-max worst case.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Union
+
+import numpy as np
+
+__all__ = ["PROFILES", "rate_at", "schedule"]
+
+
+def _step(u: float) -> float:
+    return 1.0 if 1.0 / 3.0 <= u < 2.0 / 3.0 else 0.15
+
+
+def _spike(u: float) -> float:
+    return 1.0 if 0.45 <= u < 0.55 else 0.12
+
+
+def _diurnal(u: float) -> float:
+    # squared raised cosine: a quiet "night" at the edges (8% of peak),
+    # peak mid-"day", never zero — the squared term keeps the trough
+    # wide the way real diurnal traffic is, instead of spending most of
+    # the day near peak
+    return 0.08 + 0.92 * float(np.sin(np.pi * u)) ** 4
+
+
+PROFILES = {"step": _step, "spike": _spike, "diurnal": _diurnal}
+
+
+def rate_at(
+    profile: Union[str, Callable[[float], float]],
+    t: float,
+    duration_s: float,
+    peak_rate: float,
+) -> float:
+    """Instantaneous offered rate (requests/second) at time ``t``."""
+    shape = PROFILES[profile] if isinstance(profile, str) else profile
+    u = min(max(t / float(duration_s), 0.0), 1.0 - 1e-12)
+    return float(peak_rate) * float(shape(u))
+
+
+def schedule(
+    profile: Union[str, Callable[[float], float]],
+    duration_s: float,
+    peak_rate: float,
+    seed: int = 0,
+) -> np.ndarray:
+    """Arrival offsets (seconds from start, strictly increasing) of an
+    inhomogeneous Poisson process whose rate is
+    ``peak_rate * shape(t / duration_s)``, via thinning."""
+    shape = PROFILES[profile] if isinstance(profile, str) else profile
+    if duration_s <= 0 or peak_rate <= 0:
+        raise ValueError("need positive duration and peak rate")
+    rng = np.random.default_rng(seed)
+    out = []
+    t = 0.0
+    while True:
+        t += rng.exponential(1.0 / peak_rate)
+        if t >= duration_s:
+            break
+        keep = shape(t / duration_s)
+        if not 0.0 <= keep <= 1.0:
+            raise ValueError(f"shape({t / duration_s:.3f}) = {keep} "
+                             "outside [0, 1]")
+        if rng.random() < keep:
+            out.append(t)
+    return np.asarray(out, dtype=np.float64)

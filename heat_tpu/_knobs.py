@@ -461,6 +461,113 @@ _register(
     "never blindly retried (the request may have executed).",
 )
 
+# -- autoscaling / priority / hedging knobs (serve/net, ISSUE 20) -------------
+
+_register(
+    "HEAT_TPU_AUTOSCALE_MIN", "int", 1,
+    "Lower replica bound of serve.net.AutoscaleController: scale-down "
+    "decisions clamp here (the pool never drains below it), so a "
+    "diurnal trough cannot leave the endpoint cold.",
+)
+_register(
+    "HEAT_TPU_AUTOSCALE_MAX", "int", 4,
+    "Upper replica bound of the autoscale controller: scale-up clamps "
+    "here (capacity/cost ceiling). A clamped-at-max tick is counted "
+    "(`clamped_max`) so saturation is visible in stats().",
+)
+_register(
+    "HEAT_TPU_AUTOSCALE_TICK_S", "float", 1.0,
+    "Control-loop period of AutoscaleController.start() in seconds. "
+    "Ticks observe, then maybe act; all cooldowns/streaks below are "
+    "expressed in ticks or seconds of this clock.",
+)
+_register(
+    "HEAT_TPU_AUTOSCALE_UP_COOLDOWN_S", "float", 5.0,
+    "Minimum seconds between successive scale-UPS: lets the previous "
+    "replica finish warm-up and absorb load before the controller "
+    "decides more capacity is still needed (anti-flap, up side).",
+)
+_register(
+    "HEAT_TPU_AUTOSCALE_DOWN_COOLDOWN_S", "float", 30.0,
+    "Minimum seconds after ANY scaling action before a scale-DOWN: "
+    "asymmetric hysteresis (down much slower than up) so a load dip "
+    "right after a spike does not bounce replicas.",
+)
+_register(
+    "HEAT_TPU_AUTOSCALE_BACKLOG_HIGH", "float", 4.0,
+    "Per-replica backlog (queued + in-flight per live replica) above "
+    "which a tick counts toward the sustained-pressure streak that "
+    "triggers scale-up (see HEAT_TPU_AUTOSCALE_BACKLOG_TICKS). An "
+    "`slo_burn` breach scales up immediately, bypassing the streak.",
+)
+_register(
+    "HEAT_TPU_AUTOSCALE_BACKLOG_TICKS", "int", 2,
+    "Consecutive over-backlog ticks required before a backlog-driven "
+    "scale-up (debounce: one bursty tick is not a trend).",
+)
+_register(
+    "HEAT_TPU_AUTOSCALE_IDLE_LOW", "float", 0.5,
+    "Per-replica backlog below which a tick counts toward the "
+    "drain-idle streak that triggers scale-down; any shed activity in "
+    "the window resets the streak.",
+)
+_register(
+    "HEAT_TPU_AUTOSCALE_IDLE_TICKS", "int", 5,
+    "Consecutive idle ticks required before a scale-down (the "
+    "drain-idle window; long relative to BACKLOG_TICKS — giving back "
+    "capacity is cheap to delay, missing the SLO is not).",
+)
+_register(
+    "HEAT_TPU_AUTOSCALE_SPAWN_RETRIES", "int", 2,
+    "Extra spawn attempts ReplicaPool.spawn() makes after a replica "
+    "dies during warmup (each failure is reaped — killed, logged, "
+    "evented `spawn_fail`, never left a zombie target) with "
+    "exponential backoff between attempts.",
+)
+_register(
+    "HEAT_TPU_SERVE_PRIORITY_WEIGHTS", "str", "",
+    "Priority-class weight table of the router's weighted-fair "
+    "admission queue, e.g. 'latency=8,bulk=1'. Empty = every class "
+    "weighs 1.0 (plain FIFO). Classes are attached per endpoint "
+    "(Router.set_priority) or per request (submit(priority=...)); "
+    "dispatch order follows smooth weighted round-robin over nonempty "
+    "classes, and sheds take the newest job of the lowest-weight class "
+    "first.",
+)
+_register(
+    "HEAT_TPU_SERVE_PRIORITY_QUEUE_MAX", "int", 0,
+    "Bound on the router's admission queue (0 = unbounded). When full, "
+    "an arriving job sheds the newest queued job of the lowest-weight "
+    "class strictly below its own weight — or is itself shed if no "
+    "such victim exists — so a bulk tenant cannot starve a "
+    "latency-sensitive one under overload.",
+)
+_register(
+    "HEAT_TPU_HEDGE_ENABLE", "bool", False,
+    "Hedged retries (router): after the hedge delay, duplicate a "
+    "straggling in-flight request to an idle sibling replica, take the "
+    "first answer, cancel the loser. Requires idempotent endpoints "
+    "(both arms may execute). Off by default.",
+)
+_register(
+    "HEAT_TPU_HEDGE_DELAY_MS", "float", 0.0,
+    "Fixed hedge delay in milliseconds; 0 (default) derives the delay "
+    "from the endpoint's observed p95 latency (no hedging until "
+    "HEAT_TPU_HEDGE_MIN_SAMPLES completions exist).",
+)
+_register(
+    "HEAT_TPU_HEDGE_MAX_FRACTION", "float", 0.05,
+    "Hard cap on hedged requests as a fraction of all requests "
+    "(budget earned by completions): hedging trims the tail, it must "
+    "never become a load doubler during overload.",
+)
+_register(
+    "HEAT_TPU_HEDGE_MIN_SAMPLES", "int", 32,
+    "Completed-request count an endpoint needs before a p95-derived "
+    "hedge delay is trusted (too few samples make p95 noise, and "
+    "hedging on noise wastes the budget).",
+)
+
 # -- cluster observability knobs (ISSUE 17; docs/OBSERVABILITY.md) ------------
 
 _register(
@@ -631,6 +738,10 @@ for _name, _doc in (
      "equal to pipeline_hop_cost with zero drift, elastic kill/restore "
      "onto a different node-by-local factorization matching the "
      "uninterrupted trajectory, zero steady-state compiles)."),
+    ("HEAT_TPU_CI_SKIP_AUTOSCALE", "Skip the autoscale gate (ISSUE 20: "
+     "step-load scale-up then drain-down with zero failed requests, "
+     "chaos SIGKILL under load replaced within bounded ticks with zero "
+     "steady-state compiles on the respawned replica)."),
 ):
     _register(_name, "str", None, _doc, scope="ci")
 del _name, _doc

@@ -22,7 +22,15 @@ puts a network in front of it and scales it out, using only the stdlib:
   ``/stats``, sticky degradation (a 503 shed retries siblings before
   the client sees it), health-check eviction + re-add, and the same
   ``submit``/``predict``/``stats`` client surface as the in-process
-  server (so one load generator drives both).
+  server (so one load generator drives both). ISSUE 20 adds
+  priority-class weighted-fair admission and hedged tail-latency
+  retries;
+* :mod:`.controller` — :class:`AutoscaleController` (ISSUE 20), the
+  SLO-driven control loop closing the sensors (PR 17) → actuators
+  (PR 12 spawn/remove) gap: scale-up on ``slo_burn``/sustained
+  backlog, drain-idle scale-down, chaos replacement of dead replicas,
+  all bounded by min/max + cooldown hysteresis and deterministically
+  testable via an injectable clock + scripted metrics.
 
 docs/SERVING.md §"Network serving" has the architecture, wire schema,
 routing policy, degradation ladder, and failure semantics;
@@ -32,14 +40,16 @@ behind the committed replica-scaling artifact.
 
 from __future__ import annotations
 
+from .controller import AutoscaleController
 from .events import EVENT_COUNTER
 from .pool import ReplicaHandle, ReplicaPool
 from .router import ReplicaDownError, Router
 from .transport import HttpFront
 from .wire import WireError
-from . import events, pool, replica, router, transport, wire  # noqa: F401
+from . import controller, events, pool, replica, router, transport, wire  # noqa: F401
 
 __all__ = [
+    "AutoscaleController",
     "HttpFront",
     "ReplicaPool",
     "ReplicaHandle",

@@ -32,6 +32,18 @@ EVENT_COUNTER = {
     "listen": "listens",         # HTTP front bound its port
     "drain": "drains",           # graceful drain began
     "slo_burn": "slo_burns",     # SLO burn rate crossed threshold (ISSUE 17)
+    # -- ISSUE 20: autoscaling control plane / priority / hedging ------------
+    "spawn_fail": "spawn_fails",  # replica died during warmup, reaped
+    "suspect": "suspects",       # ops scrape failed after retry: target
+    #                              flagged suspect (never a silent None)
+    "detach": "detaches",        # target administratively removed from
+    #                              rotation (scale-down / replacement)
+    "priority_shed": "priority_sheds",  # weighted-fair admission shed the
+    #                              lowest-priority queued (or incoming) job
+    "hedge": "hedges",           # duplicate dispatch launched for a
+    #                              straggling in-flight request
+    "hedge_win": "hedge_wins",   # the hedge arm answered first (loser
+    #                              canceled by closing its connection)
 }
 
 

@@ -171,30 +171,95 @@ class ReplicaPool:
         os.makedirs(self.log_dir, exist_ok=True)
         self.replica_args = list(replica_args or [])
         self.replicas: List[ReplicaHandle] = []
+        self.failed: List[ReplicaHandle] = []   # warmup-dead, reaped (ISSUE 20)
         self._next_index = 0
+        self._sleep = time.sleep                # injectable (spawn-retry backoff)
 
     # -- lifecycle -----------------------------------------------------------
 
     def start(self) -> "ReplicaPool":
         """Spawn all replicas CONCURRENTLY, then wait for every ready
         line (imports + warm-up overlap across processes; the shared
-        compile cache is multi-process safe)."""
+        compile cache is multi-process safe). A replica that dies
+        before ready is reaped (never left a zombie target) before the
+        error propagates."""
         handles = [self._spawn_one() for _ in range(self.n)]
+        first_error = None
         for h in handles:
-            h.wait_ready(self.ready_timeout)
+            try:
+                h.wait_ready(self.ready_timeout)
+            except Exception as e:  # noqa: BLE001 — reap, then re-raise
+                self._reap(h, why=repr(e))
+                if first_error is None:
+                    first_error = e
+        if first_error is not None:
+            raise first_error
         return self
 
-    def spawn(self, checkpoint: Optional[str] = None) -> ReplicaHandle:
+    def spawn(
+        self,
+        checkpoint: Optional[str] = None,
+        *,
+        retries: Optional[int] = None,
+        backoff_s: float = 0.5,
+    ) -> ReplicaHandle:
         """Add ONE replica (scale-up / re-add after a kill); blocks
         until its ready line. ``checkpoint`` (ISSUE 16) births the
         replica from a *different* checkpoint than the pool default —
         the rolling-update primitive: a replica process serves exactly
         one checkpoint version for its whole life, so replacing
         replicas one by one rolls a new version through the pool with
-        no process ever serving a half-updated endpoint set."""
-        h = self._spawn_one(checkpoint=checkpoint)
-        h.wait_ready(self.ready_timeout)
-        return h
+        no process ever serving a half-updated endpoint set.
+
+        Failure path (ISSUE 20): a replica that dies (or hangs) during
+        warmup is **reaped** — killed, marked dead, dropped from the
+        live set, ``spawn_fail`` evented — and the spawn retried with
+        exponential backoff up to ``retries`` extra attempts (default
+        ``HEAT_TPU_AUTOSCALE_SPAWN_RETRIES``). It is never left as a
+        zombie target a router keeps scoring."""
+        attempts = 1 + int(
+            retries if retries is not None
+            else knobs.get("HEAT_TPU_AUTOSCALE_SPAWN_RETRIES")
+        )
+        delay = float(backoff_s)
+        last: Optional[Exception] = None
+        for i in range(max(1, attempts)):
+            h = self._spawn_one(checkpoint=checkpoint)
+            try:
+                h.wait_ready(self.ready_timeout)
+                return h
+            except Exception as e:  # noqa: BLE001 — reap + retry
+                last = e
+                self._reap(h, why=repr(e))
+                if i + 1 < attempts:
+                    self._sleep(delay)
+                    delay *= 2
+        raise RuntimeError(
+            f"replica spawn failed {attempts} time(s) "
+            f"(reaped each attempt; last log at "
+            f"{self.failed[-1].log_path if self.failed else '<none>'})"
+        ) from last
+
+    def _reap(self, h: ReplicaHandle, why: str = "") -> None:
+        """Remove a warmup-dead replica from the live set: kill the
+        process if anything is left of it, mark the handle dead, move
+        it to ``self.failed`` (log kept for post-mortems), and emit
+        ``spawn_fail``. After this the handle can never appear in
+        :meth:`urls` — no zombie targets."""
+        try:
+            if h.alive():
+                h.proc.kill()
+                h.proc.wait(10.0)
+        except Exception:
+            pass
+        h.state = "dead"
+        try:
+            self.replicas.remove(h)
+        except ValueError:
+            pass
+        self.failed.append(h)
+        _emit("pool", "spawn_fail", replica=h.index,
+              rc=h.proc.returncode, why=why[:200])
 
     def set_checkpoint(self, checkpoint: str) -> None:
         """Re-point the pool default checkpoint (future spawns,

@@ -72,6 +72,7 @@ def summarize(
     res_events: dict = {}
     at_events: dict = {}
     sn_events: dict = {}
+    as_events: dict = {}
     sp_events: dict = {}
     st_events: dict = {}
     tr_spans = 0
@@ -179,6 +180,9 @@ def summarize(
         elif kind == "serve_net":
             what = ev.get("event") or "event"
             sn_events[what] = sn_events.get(what, 0) + 1
+        elif kind == "autoscale":
+            what = ev.get("event") or "event"
+            as_events[what] = as_events.get(what, 0) + 1
         elif kind == "trace_span":
             # request-trace hops (ISSUE 17): every hop pairs with the
             # `tracing.spans` counter, every ingress hop with
@@ -475,6 +479,26 @@ def summarize(
 
         out["serving_net"] = {
             _sn_names.get(k, k): v for k, v in sn_events.items()
+        }
+    # autoscaling-control-plane counters (serve/net/controller, ISSUE 20):
+    # one `autoscale` event per `autoscale.<name>` counter increment, same
+    # live/offline reconciliation contract as serving_net above. Absent
+    # when no controller ran.
+    if live:
+        from . import get_registry as _get_registry
+
+        asc = {
+            k[len("autoscale."):]: int(v)
+            for k, v in _get_registry().counters.items()
+            if k.startswith("autoscale.")
+        }
+        if asc:
+            out["autoscale"] = asc
+    elif as_events:
+        from heat_tpu.serve.net.controller import EVENT_COUNTER as _as_names
+
+        out["autoscale"] = {
+            _as_names.get(k, k): v for k, v in as_events.items()
         }
     # request-tracing counters (ISSUE 17): one `trace_span` event per
     # `tracing.spans` increment, one ingress span per `tracing.sampled`,

@@ -2182,6 +2182,86 @@ EOF
     fi
 fi
 
+if [ -z "${HEAT_TPU_CI_SKIP_AUTOSCALE:-}" ]; then
+    echo "=== autoscale gate: SLO-driven scale-up/drain-down + chaos SIGKILL replacement (ISSUE 20) ==="
+    autoscale_rc=0
+    autoscale_out=$(mktemp)
+    if python benchmarks/autoscale/run.py \
+            --n 500 --features 16 --replica-mesh 1 \
+            --profiles step --duration 15 --peak-rate 150 \
+            --max-replicas 3 --drain-wait 25 \
+            --chaos --chaos-duration 10 --chaos-rate 20 > "$autoscale_out"; then
+        python - "$autoscale_out" <<'EOF' || autoscale_rc=$?
+import json, sys
+
+summary = None
+for line in open(sys.argv[1]):
+    line = line.strip()
+    if not line:
+        continue
+    try:
+        obj = json.loads(line)
+    except json.JSONDecodeError:
+        continue
+    if obj.get("bench") == "autoscale":
+        summary = obj
+if summary is None:
+    raise SystemExit("autoscale: no summary line")
+
+step = (summary.get("profiles") or {}).get("step") or {}
+if step.get("failed") != 0:
+    raise SystemExit(
+        f"autoscale: step-load phase had failed requests: {step}"
+    )
+if not step.get("drained_to_min"):
+    raise SystemExit(
+        "autoscale: controller did not drain back down to the minimum "
+        f"footprint after the load step ended: {step}"
+    )
+if not summary.get("steady_backend_compiles_ok"):
+    raise SystemExit(
+        "autoscale: a scaled-up replica compiled in steady state (the "
+        f"shared-cache warm start is broken): {summary}"
+    )
+chaos = summary.get("chaos") or {}
+if not chaos.get("replaced_within_bound"):
+    raise SystemExit(
+        "autoscale: SIGKILLed replica not replaced within "
+        f"{chaos.get('replace_tick_bound')} controller ticks: {chaos}"
+    )
+if not chaos.get("zero_failed"):
+    raise SystemExit(
+        "autoscale: chaos kill surfaced failed requests despite "
+        f"retry_in_flight: {chaos}"
+    )
+if chaos.get("replacement_steady_compiles") != 0:
+    raise SystemExit(
+        "autoscale: the chaos-respawned replica compiled in steady "
+        f"state: {chaos}"
+    )
+if not (step.get("scale_ups") or 0) >= 1:
+    raise SystemExit(
+        f"autoscale: controller never scaled up under the step load: {step}"
+    )
+print(
+    "autoscale ok: step load scaled up then drained to min with "
+    f"0 failed, chaos replacement in {chaos.get('ticks_to_replace')} "
+    "tick(s) with 0 failed and 0 steady compiles"
+)
+EOF
+    else
+        autoscale_rc=$?
+    fi
+    if [ -n "$REPORT" ]; then
+        cp "$autoscale_out" "${REPORT}/autoscale.jsonl" || true
+    fi
+    rm -f "$autoscale_out"
+    if [ "$autoscale_rc" != 0 ]; then
+        echo "=== autoscale gate FAILED (rc=$autoscale_rc) ==="
+        FAILED_SIZES="$FAILED_SIZES autoscale"
+    fi
+fi
+
 if [ "$have_coverage" = 1 ]; then
     # merge the per-size coverage files, as the reference CI merges its
     # 8 mpirun passes (Jenkinsfile:33-44 / codecov)

@@ -1,0 +1,660 @@
+"""Self-healing autoscaling control plane (ISSUE 20).
+
+Covers: the AutoscaleController decision table against scripted metrics
+and a counter clock (scale-up on SLO burn / sustained backlog, cooldown
+hysteresis in both directions, min/max clamps, chaos replacement outside
+the cooldown discipline, the replica-seconds integral), the weighted-fair
+admission queue (SWRR proportions, priority-aware shed order, the
+single-class FIFO degeneration), router-level two-tenant isolation
+against scripted fake replicas (a bulk flood never sheds the latency
+class), hedged retries (first-wins with loser cancel, the hedge-budget
+hard cap, p95-derived delay gating), the hardened ops plane (retry-once
+then suspect, recovery clears), the ReplicaPool spawn failure path
+(reap + backoff retry, never a zombie target), and the live==offline
+``autoscale`` telemetry reconciliation.
+"""
+
+import itertools
+import threading
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from heat_tpu import _knobs as knobs
+from heat_tpu import telemetry
+from heat_tpu.serve import ServerOverloadedError
+from heat_tpu.serve.net import AutoscaleController, Router, wire
+from heat_tpu.serve.net.router import _FairQueue, _parse_weights
+
+from tests.test_serve_net import _FakeReplica, _ok_body, _wait_until
+
+
+# -- scripted controller harness ----------------------------------------------
+
+
+def _obs(replicas=1, backlog=0.0, burn=False, shed=0, dead=()):
+    return {"replicas": replicas, "backlog": backlog, "slo_burn": burn,
+            "shed": shed, "dead": list(dead)}
+
+
+class _Scripted:
+    """AutoscaleController over a scripted observation trace, a counter
+    clock (1 "second" per tick), and recording stub actuators — tick()
+    becomes a pure decision-table step."""
+
+    def __init__(self, script, **over):
+        self.script = iter(script)
+        self.ups = 0
+        self.downs = 0
+        self.replaced = []
+        counter = itertools.count()
+        kw = dict(
+            min_replicas=1, max_replicas=4,
+            backlog_high=4.0, backlog_ticks=2,
+            idle_low=0.5, idle_ticks=2,
+            up_cooldown_s=0.0, down_cooldown_s=0.0,
+            tick_interval_s=0.01,
+            clock=lambda: float(next(counter)),
+            metrics_fn=lambda: next(self.script),
+            scale_up_fn=self._up,
+            scale_down_fn=self._down,
+            replace_fn=self._replace,
+        )
+        kw.update(over)
+        self.ctrl = AutoscaleController(**kw)
+
+    def _up(self):
+        self.ups += 1
+        return 100 + self.ups
+
+    def _down(self):
+        self.downs += 1
+        return 200 + self.downs
+
+    def _replace(self, index):
+        self.replaced.append(index)
+        return 300 + len(self.replaced)
+
+    def actions(self):
+        return [r["action"] for r in self.ctrl.history]
+
+    def run(self, n):
+        for _ in range(n):
+            self.ctrl.tick()
+        return self
+
+
+class TestControllerDecisionTable:
+    def test_slo_burn_scales_up_immediately(self):
+        s = _Scripted([_obs(replicas=1, burn=True)]).run(1)
+        assert s.actions() == ["scale_up"]
+        assert s.ups == 1
+        assert s.ctrl.counts["scale_ups"] == 1
+        assert s.ctrl.history[0]["replica"] == 101
+
+    def test_backlog_needs_a_sustained_streak(self):
+        # one hot tick is not a signal; backlog_ticks consecutive are
+        s = _Scripted([
+            _obs(replicas=1, backlog=10.0),
+            _obs(replicas=1, backlog=10.0),
+        ]).run(2)
+        assert s.actions() == ["hold", "scale_up"]
+
+    def test_backlog_streak_resets_on_a_calm_tick(self):
+        s = _Scripted([
+            _obs(replicas=1, backlog=10.0),
+            _obs(replicas=1, backlog=1.0),   # not hot, not idle
+            _obs(replicas=1, backlog=10.0),
+            _obs(replicas=1, backlog=10.0),
+        ]).run(4)
+        assert s.actions() == ["hold", "hold", "hold", "scale_up"]
+
+    def test_shed_delta_is_pressure(self):
+        # cumulative shed counter moving = fresh sheds this tick
+        s = _Scripted([
+            _obs(replicas=1, shed=0),
+            _obs(replicas=1, shed=3),
+            _obs(replicas=1, shed=6),
+        ]).run(3)
+        # first tick seeds the diff; two moving ticks complete the streak
+        assert s.actions() == ["hold", "hold", "scale_up"]
+
+    def test_up_cooldown_blocks_flapping(self):
+        s = _Scripted(
+            [_obs(replicas=1 + min(i, 1), burn=True) for i in range(4)],
+            up_cooldown_s=3.0,
+        ).run(4)
+        # scale-up at t=0; t=1,2 inside the 3s cooldown; t=3 allowed
+        assert s.actions() == \
+            ["scale_up", "cooldown_up", "cooldown_up", "scale_up"]
+
+    def test_drain_idle_scales_down_after_streak(self):
+        s = _Scripted([
+            _obs(replicas=2, backlog=0.0),
+            _obs(replicas=2, backlog=0.0),
+        ]).run(2)
+        assert s.actions() == ["hold", "scale_down"]
+        assert s.downs == 1
+
+    def test_scale_up_is_not_undone_by_a_stale_idle_streak(self):
+        # the down cooldown is measured from the LAST action in either
+        # direction — the hysteresis claim
+        s = _Scripted([
+            _obs(replicas=1, backlog=10.0),
+            _obs(replicas=1, backlog=10.0),   # scale_up at t=1
+            _obs(replicas=2, backlog=0.0),
+            _obs(replicas=2, backlog=0.0),
+            _obs(replicas=2, backlog=0.0),    # t=4: 4-1=3, not < 3
+            _obs(replicas=2, backlog=0.0),
+        ], down_cooldown_s=3.0, idle_ticks=1).run(6)
+        assert s.actions() == [
+            "hold", "scale_up", "cooldown_down", "cooldown_down",
+            "scale_down", "cooldown_down",
+        ]
+
+    def test_clamp_max(self):
+        s = _Scripted([_obs(replicas=2, burn=True)] * 2,
+                      max_replicas=2).run(2)
+        assert s.actions() == ["clamp_max", "clamp_max"]
+        assert s.ups == 0
+        assert s.ctrl.counts["clamped_max"] == 2
+
+    def test_clamp_min(self):
+        s = _Scripted([_obs(replicas=1, backlog=0.0)] * 4,
+                      idle_ticks=2).run(4)
+        assert s.downs == 0
+        assert "scale_down" not in s.actions()
+        assert s.ctrl.counts["clamped_min"] >= 1
+
+    def test_dead_replica_replaced_outside_cooldown(self):
+        # a replacement is repair, not scaling: it happens even though
+        # the up cooldown would still block a scale-up, and resets both
+        # streaks
+        s = _Scripted([
+            _obs(replicas=1, burn=True),              # scale_up at t=0
+            _obs(replicas=2, backlog=2.0, dead=[0]),  # dead inside cooldown
+        ], up_cooldown_s=100.0).run(2)
+        assert s.actions() == ["scale_up", "replace"]
+        assert s.replaced == [0]
+        assert s.ctrl.counts["replacements"] == 1
+        assert s.ctrl.history[1]["hot_ticks"] == 0
+        assert s.ctrl.history[1]["idle_ticks"] == 0
+
+    def test_actuator_error_is_recorded_not_raised(self):
+        def boom():
+            raise RuntimeError("no capacity")
+
+        s = _Scripted([_obs(replicas=1, burn=True)], scale_up_fn=boom)
+        s.ctrl._scale_up_fn = boom
+        s.run(1)
+        assert s.actions() == ["scale_up_error"]
+        assert "no capacity" in s.ctrl.history[0]["error"]
+        assert s.ctrl.counts["scale_ups"] == 0
+
+    def test_replica_seconds_integral(self):
+        # counter clock: 1s per tick; the first tick only anchors t
+        s = _Scripted([_obs(replicas=2, backlog=1.0)] * 3).run(3)
+        assert s.ctrl.replica_seconds == pytest.approx(4.0)
+        assert s.ctrl.stats()["replica_seconds"] == pytest.approx(4.0)
+
+    def test_bounds_validation(self):
+        with pytest.raises(ValueError):
+            AutoscaleController(min_replicas=0, max_replicas=2,
+                                metrics_fn=lambda: _obs())
+        with pytest.raises(ValueError):
+            AutoscaleController(min_replicas=3, max_replicas=2,
+                                metrics_fn=lambda: _obs())
+
+    def test_knob_defaults_registered(self):
+        assert knobs.get("HEAT_TPU_AUTOSCALE_MIN") == 1
+        assert knobs.get("HEAT_TPU_AUTOSCALE_MAX") >= 1
+        assert knobs.get("HEAT_TPU_AUTOSCALE_SPAWN_RETRIES") >= 0
+        assert knobs.get("HEAT_TPU_HEDGE_MAX_FRACTION") > 0
+
+
+# -- weighted-fair admission queue --------------------------------------------
+
+
+def _jobs(cls, n):
+    return [SimpleNamespace(cls=cls, tag=f"{cls}{i}") for i in range(n)]
+
+
+class TestFairQueue:
+    def test_swrr_serves_in_weight_proportion(self):
+        q = _FairQueue({"a": 3.0, "b": 1.0})
+        for ja, jb in zip(_jobs("a", 40), _jobs("b", 40)):
+            q.put(ja)
+            q.put(jb)
+        first = [q.get_nowait().cls for _ in range(40)]
+        # over any backlogged window the split tracks the 3:1 weights
+        assert 28 <= first.count("a") <= 32
+        assert 8 <= first.count("b") <= 12
+
+    def test_single_class_is_fifo(self):
+        q = _FairQueue({})
+        jobs = _jobs("default", 10)
+        for j in jobs:
+            q.put(j)
+        assert [q.get_nowait().tag for _ in range(10)] == \
+            [j.tag for j in jobs]
+
+    def test_low_weight_class_is_never_starved(self):
+        q = _FairQueue({"big": 100.0, "small": 1.0})
+        for j in _jobs("big", 200) + _jobs("small", 2):
+            q.put(j)
+        served = [q.get_nowait().cls for _ in range(150)]
+        assert "small" in served
+
+    def test_shed_lowest_pops_newest_of_lowest_class(self):
+        q = _FairQueue({"latency": 8.0, "bulk": 1.0})
+        for j in _jobs("latency", 2) + _jobs("bulk", 3):
+            q.put(j)
+        victim = q.shed_lowest(8.0)
+        assert victim.tag == "bulk2"  # newest arrival of the lowest class
+        assert q.qsize() == 4
+
+    def test_shed_lowest_never_sheds_at_or_above_priority(self):
+        q = _FairQueue({"latency": 8.0, "bulk": 1.0})
+        for j in _jobs("latency", 3):
+            q.put(j)
+        # an incoming bulk job (weight 1) finds nothing strictly below it
+        assert q.shed_lowest(1.0) is None
+        assert q.qsize() == 3
+
+    def test_max_queued_weight(self):
+        q = _FairQueue({"latency": 8.0, "bulk": 1.0})
+        assert q.max_queued_weight() is None
+        q.put(_jobs("bulk", 1)[0])
+        assert q.max_queued_weight() == 1.0
+        q.put(_jobs("latency", 1)[0])
+        assert q.max_queued_weight() == 8.0
+
+    def test_control_lane_beats_jobs(self):
+        q = _FairQueue({})
+        q.put(_jobs("default", 1)[0])
+        q.put(None)
+        assert q.get_nowait() is None
+
+
+class TestParseWeights:
+    def test_parse(self):
+        assert _parse_weights("latency=8,bulk=1") == \
+            {"latency": 8.0, "bulk": 1.0}
+        assert _parse_weights(" latency = 8 ; bulk = 1 ") == \
+            {"latency": 8.0, "bulk": 1.0}
+        assert _parse_weights("") == {}
+        assert _parse_weights(None) == {}
+
+    def test_rejects_malformed(self):
+        with pytest.raises(ValueError):
+            _parse_weights("latency")
+        with pytest.raises(ValueError):
+            _parse_weights("latency=0")
+        with pytest.raises(ValueError):
+            _parse_weights("bulk=-1")
+
+
+class TestRouterPriorityIsolation:
+    def test_bulk_flood_never_sheds_the_latency_class(self):
+        """Property: with weighted-fair admission and a bounded queue,
+        a bulk flood past the queue bound sheds ONLY bulk work — every
+        latency submit completes (isolation), and bulk still completes
+        some share (no starvation)."""
+        fake = _FakeReplica(lambda: (time.sleep(0.02), _ok_body())[1])
+        router = Router(
+            [fake.url], workers=1, poll_ms=1000.0,
+            priorities={"latency": 8.0, "bulk": 1.0},
+            endpoint_priorities={"kmeans": "latency", "cdist": "bulk"},
+            priority_queue_max=6,
+        )
+        try:
+            x = np.zeros((1, 2), np.float32)
+            bulk = [router.submit("cdist", x) for _ in range(30)]
+            # let the worker route at least one bulk job before the
+            # latency burst sheds the queued remainder (two posts on the
+            # one-request-per-connection fake = one fully completed)
+            _wait_until(lambda: fake.posts >= 2, what="bulk dispatch")
+            lat = [router.submit("kmeans", x) for _ in range(6)]
+            for f in lat:
+                f.result(30.0)  # raises if a latency job was shed
+            shed = ok = 0
+            for f in bulk:
+                try:
+                    f.result(30.0)
+                    ok += 1
+                except ServerOverloadedError as e:
+                    assert e.reason == "priority_shed"
+                    shed += 1
+            st = router.stats()
+            classes = st["priority"]["classes"]
+            assert classes["latency"].get("shed", 0) == 0
+            assert shed >= 1                      # the flood WAS shed
+            assert ok >= 1                        # but not starved
+            assert st["router"]["priority_sheds"] == shed
+            assert st["priority"]["weights"]["latency"] == 8.0
+        finally:
+            router.close()
+            fake.stop()
+
+    def test_submit_priority_overrides_endpoint_class(self):
+        fake = _FakeReplica(_ok_body)
+        router = Router(
+            [fake.url], workers=1, poll_ms=1000.0,
+            priorities={"latency": 8.0, "bulk": 1.0},
+            endpoint_priorities={"e": "bulk"},
+        )
+        try:
+            router.submit(
+                "e", np.zeros((1, 2), np.float32), priority="latency",
+            ).result(10.0)
+            assert router.stats()["priority"]["classes"]["latency"][
+                "submitted"] == 1
+        finally:
+            router.close()
+            fake.stop()
+
+
+# -- hedged retries ------------------------------------------------------------
+
+
+class TestHedging:
+    def test_first_wins_and_loser_is_cancelled(self):
+        slow = _FakeReplica(lambda: (time.sleep(0.6), _ok_body())[1])
+        fast = _FakeReplica(_ok_body)
+        router = Router(
+            [slow.url, fast.url], workers=1, poll_ms=1000.0,
+            hedge=True, hedge_delay_ms=50.0, hedge_max_fraction=1.0,
+        )
+        try:
+            t0 = time.perf_counter()
+            got = router.predict("e", np.zeros((1, 2), np.float32))
+            elapsed = time.perf_counter() - t0
+            assert np.asarray(got).tobytes() == \
+                np.arange(6, dtype=np.float32).tobytes()
+            # the fast sibling's answer won well before the straggler
+            assert elapsed < 0.55
+            counts = router.stats()["router"]
+            assert counts["hedges"] == 1
+            assert counts["hedge_wins"] == 1
+            assert slow.posts == 1 and fast.posts == 1
+        finally:
+            router.close()
+            slow.stop()
+            fast.stop()
+
+    def test_budget_cap_blocks_a_cold_router(self):
+        # hedges + 1 <= fraction * max(1, requests): at fraction 0.01 a
+        # cold router must serve ~100 requests before its first hedge
+        slow = _FakeReplica(lambda: (time.sleep(0.25), _ok_body())[1])
+        fast = _FakeReplica(_ok_body)
+        router = Router(
+            [slow.url, fast.url], workers=1, poll_ms=1000.0,
+            hedge=True, hedge_delay_ms=30.0, hedge_max_fraction=0.01,
+        )
+        try:
+            router.predict("e", np.zeros((1, 2), np.float32))
+            assert router.stats()["router"]["hedges"] == 0
+        finally:
+            router.close()
+            slow.stop()
+            fast.stop()
+
+    def test_hedge_delay_fixed_vs_p95_derived(self):
+        fake = _FakeReplica(_ok_body)
+        router = Router([fake.url], workers=1, poll_ms=1000.0,
+                        hedge=True, hedge_delay_ms=75.0)
+        try:
+            assert router._hedge_delay_s("e") == pytest.approx(0.075)
+            # p95 mode: no explicit delay, gated on min samples
+            router.hedge_delay_ms = 0.0
+            router.hedge_min_samples = 5
+            assert router._hedge_delay_s("e") is None
+            for _ in range(5):
+                router.predict("e", np.zeros((1, 2), np.float32))
+            d = router._hedge_delay_s("e")
+            assert d is not None and d > 0.0
+        finally:
+            router.close()
+            fake.stop()
+
+
+# -- hardened ops plane --------------------------------------------------------
+
+
+class _OpsFake(_FakeReplica):
+    """Fake replica whose /metrics can be scripted to drop the
+    connection (a mid-scrape restart — the transient the ops plane
+    retries once before marking the target suspect)."""
+
+    def __init__(self):
+        self.drop_metrics = False
+        self.metrics_gets = 0
+        fake = self
+        super().__init__(_ok_body)
+        parent_do_get = self._cls.do_GET
+
+        def do_GET(handler):
+            if handler.path == "/metrics":
+                fake.metrics_gets += 1
+                if fake.drop_metrics:
+                    import socket
+
+                    handler.connection.shutdown(socket.SHUT_RDWR)
+                    handler.connection.close()
+                    return
+                handler._reply(200, b'{"counters": {}}')
+                return
+            parent_do_get(handler)
+
+        self._cls.do_GET = do_GET
+
+
+class TestOpsPlaneHardening:
+    def test_scrape_retries_once_then_marks_suspect(self):
+        fake = _OpsFake()
+        router = Router([fake.url], workers=1, poll_ms=1000.0)
+        try:
+            fake.drop_metrics = True
+            out = router.scrape_metrics()
+            # failed after the one retry: None entry, never silent
+            assert out[fake.url] is None
+            assert fake.metrics_gets == 2
+            assert router.stats()["replicas"][fake.url]["suspect"]
+            # recovery clears the flag
+            fake.drop_metrics = False
+            out = router.scrape_metrics()
+            assert out[fake.url] == {"counters": {}}
+            assert not router.stats()["replicas"][fake.url]["suspect"]
+        finally:
+            router.close()
+            fake.stop()
+
+    def test_transient_drop_recovers_on_the_retry(self):
+        fake = _OpsFake()
+        router = Router([fake.url], workers=1, poll_ms=1000.0)
+        try:
+            drops = {"left": 1}
+
+            orig = router._ops_get_once
+
+            def flaky(target, path):
+                if drops["left"] > 0:
+                    drops["left"] -= 1
+                    raise ConnectionResetError("mid-scrape restart")
+                return orig(target, path)
+
+            router._ops_get_once = flaky
+            out = router.scrape_metrics()
+            assert out[fake.url] == {"counters": {}}
+            assert not router.stats()["replicas"][fake.url]["suspect"]
+        finally:
+            router.close()
+            fake.stop()
+
+
+# -- pool spawn failure path ---------------------------------------------------
+
+
+class _FakeProc:
+    def __init__(self):
+        self.returncode = None
+        self.kills = 0
+
+    def poll(self):
+        return self.returncode
+
+    def kill(self):
+        self.kills += 1
+        self.returncode = -9
+
+    def wait(self, timeout=None):
+        return self.returncode
+
+
+class _FakeHandle:
+    def __init__(self, index, ok):
+        self.index = index
+        self.proc = _FakeProc()
+        self.log_path = f"<fake-{index}>"
+        self.url = None  # published only by a successful ready line
+        self.state = "spawning"
+        self._ok = ok
+
+    def alive(self):
+        return self.proc.poll() is None
+
+    def wait_ready(self, timeout):
+        if not self._ok:
+            self.proc.returncode = 1
+            raise RuntimeError(f"replica {self.index} exited rc=1")
+        self.state = "up"
+        self.url = f"http://127.0.0.1:{40000 + self.index}"
+        return {"ready": True}
+
+
+class TestSpawnFailurePath:
+    def _pool(self, tmp_path, outcomes):
+        from heat_tpu.serve.net.pool import ReplicaPool
+
+        pool = ReplicaPool(str(tmp_path / "ckpt"), 1,
+                           log_dir=str(tmp_path / "logs"))
+        seq = iter(outcomes)
+
+        def fake_spawn_one(checkpoint=None):
+            h = _FakeHandle(pool._next_index, next(seq))
+            pool._next_index += 1
+            pool.replicas.append(h)
+            return h
+
+        pool._spawn_one = fake_spawn_one
+        pool._sleep = lambda s: pool.sleeps.append(s)
+        pool.sleeps = []
+        return pool
+
+    def test_warmup_death_is_reaped_and_retried(self, tmp_path):
+        pool = self._pool(tmp_path, [False, True])
+        h = pool.spawn()
+        assert h.state == "up"
+        # the dead attempt was reaped: never a zombie in the live set
+        assert pool.replicas == [h]
+        assert len(pool.failed) == 1
+        assert pool.failed[0].state == "dead"
+        assert pool.failed[0].proc.kills == 0  # already exited, not killed
+        assert pool.sleeps == [0.5]            # one backoff before retry
+        assert pool.urls() == [h.url]          # the zombie is not a target
+        assert h not in pool.failed
+
+    def test_backoff_doubles_and_exhaustion_raises(self, tmp_path):
+        pool = self._pool(tmp_path, [False, False, False])
+        with pytest.raises(RuntimeError, match="spawn failed 3 time"):
+            pool.spawn(retries=2)
+        assert pool.replicas == []
+        assert len(pool.failed) == 3
+        assert pool.sleeps == [0.5, 1.0]
+
+    def test_zero_retries_fails_fast(self, tmp_path):
+        pool = self._pool(tmp_path, [False])
+        with pytest.raises(RuntimeError):
+            pool.spawn(retries=0)
+        assert pool.sleeps == []
+
+
+# -- telemetry: autoscale live == offline reconciliation -----------------------
+
+
+class TestAutoscaleTelemetry:
+    def test_summarize_autoscale_block_live_equals_offline(self):
+        was_enabled = telemetry.enabled()
+        reg = telemetry.get_registry()
+        saved_counters = dict(reg.counters)
+        saved_events = list(reg.events)
+        saved_marks = dict(reg.watermarks)
+        reg.clear()
+        telemetry.enable()
+        try:
+            s = _Scripted([
+                _obs(replicas=1, burn=True),               # scale_up
+                _obs(replicas=2, backlog=10.0, dead=[0]),  # replace
+                _obs(replicas=2, backlog=0.0),
+                _obs(replicas=2, backlog=0.0),             # scale_down
+            ]).run(4)
+            assert s.actions() == \
+                ["scale_up", "replace", "hold", "scale_down"]
+            live = telemetry.report.summarize()
+            assert live["autoscale"] == {
+                "scale_ups": 1, "replacements": 1, "scale_downs": 1,
+            }
+            offline = telemetry.report.summarize(
+                list(reg.events), dict(reg.watermarks)
+            )
+            assert offline["autoscale"] == live["autoscale"]
+            # every autoscale event moved exactly one paired counter
+            assert reg.counters["autoscale.scale_ups"] == 1
+            assert reg.counters["autoscale.replacements"] == 1
+            assert reg.counters["autoscale.scale_downs"] == 1
+        finally:
+            if not was_enabled:
+                telemetry.disable()
+            reg.clear()
+            reg.counters.update(saved_counters)
+            reg.events.extend(saved_events)
+            reg.watermarks.update(saved_marks)
+
+    def test_no_autoscale_block_without_actions(self):
+        assert "autoscale" not in telemetry.report.summarize(events=[])
+
+
+# -- loadgen profiles ----------------------------------------------------------
+
+
+class TestProfiles:
+    def test_schedule_is_deterministic(self):
+        from benchmarks.autoscale import profiles
+
+        a = profiles.schedule("step", 10.0, 50.0, seed=7)
+        b = profiles.schedule("step", 10.0, 50.0, seed=7)
+        assert np.array_equal(a, b)
+        assert len(a) > 0
+        assert np.all(np.diff(a) > 0)
+        assert float(a[-1]) < 10.0
+
+    def test_step_shape_concentrates_in_the_middle_third(self):
+        from benchmarks.autoscale import profiles
+
+        offs = profiles.schedule("step", 30.0, 100.0, seed=0)
+        mid = np.sum((offs >= 10.0) & (offs < 20.0))
+        assert mid / len(offs) > 0.5
+        assert profiles.rate_at("step", 15.0, 30.0, 100.0) == 100.0
+        assert profiles.rate_at("step", 1.0, 30.0, 100.0) == 15.0
+
+    def test_bad_params_raise(self):
+        from benchmarks.autoscale import profiles
+
+        with pytest.raises(ValueError):
+            profiles.schedule("step", 0.0, 50.0)
+        with pytest.raises(ValueError):
+            profiles.schedule(lambda u: 2.0, 10.0, 50.0, seed=1)
+        with pytest.raises(KeyError):
+            profiles.schedule("nope", 10.0, 50.0)
